@@ -1,0 +1,24 @@
+"""scaling_tpu — a TPU-native distributed training framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of Aleph Alpha's
+``scaling`` library (reference: marcobellagente93/scaling): 3D parallelism
+(data x tensor x pipeline) over a ``jax.sharding.Mesh``, Megatron-style
+sequence parallelism, ZeRO-1 optimizer-state sharding, mixed precision with
+dynamic loss scaling, activation rematerialisation, layout-independent
+checkpoints, and a transformer suite (GQA, RoPE, SwiGLU, sequence packing,
+local attention, LoRA/adapter/bitfit/softprompt fine-tuning, KV-cached
+inference).
+
+Layout:
+  scaling_tpu.config     pydantic config base (yaml/json, templates)
+  scaling_tpu.topology   3D device layout -> jax.sharding.Mesh
+  scaling_tpu.data       memory-mapped datasets, deterministic loaders
+  scaling_tpu.nn         functional layers + parameter metadata
+  scaling_tpu.parallel   collectives, sharding rules, pipeline engine
+  scaling_tpu.ops        Pallas TPU kernels (flash attention, fused norms)
+  scaling_tpu.optimizer  AdamW w/ fp32 master, ZeRO-1, loss scaler, LR
+  scaling_tpu.trainer    generic train loop + checkpoint orchestration
+  scaling_tpu.models     model suites (transformer)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from .base import BaseConfig, overwrite_recursive
+
+__all__ = ["BaseConfig", "overwrite_recursive"]

@@ -1,0 +1,147 @@
+"""Config base class.
+
+Pydantic v2 models that are frozen, forbid unknown keys, load/save yaml+json,
+support recursive dict overwrites and emit self-documenting commented config
+templates from the field descriptions.
+
+Capability parity with the reference ``scaling.core.config.base``
+(reference: src/scaling/core/config/base.py:26-153); implementation is new.
+"""
+
+from __future__ import annotations
+
+import json
+from enum import Enum
+from pathlib import Path
+from typing import Any, Type, TypeVar
+
+import yaml
+from pydantic import BaseModel, ConfigDict
+
+T = TypeVar("T", bound="BaseConfig")
+
+
+def overwrite_recursive(base: dict, overwrite: dict) -> dict:
+    """Merge ``overwrite`` into ``base`` in place, recursing into nested dicts.
+
+    Non-dict values (including lists) replace wholesale.
+    """
+    for key, value in overwrite.items():
+        if isinstance(value, dict) and isinstance(base.get(key), dict):
+            overwrite_recursive(base[key], value)
+        else:
+            base[key] = value
+    return base
+
+
+def _to_jsonable(value: Any) -> Any:
+    if isinstance(value, Enum):
+        return value.value
+    if isinstance(value, Path):
+        return str(value)
+    if isinstance(value, dict):
+        return {k: _to_jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_to_jsonable(v) for v in value]
+    return value
+
+
+class BaseConfig(BaseModel):
+    """Immutable config node; composes into trees (e.g. TransformerConfig)."""
+
+    model_config = ConfigDict(
+        frozen=True,
+        extra="forbid",
+        use_enum_values=False,
+        populate_by_name=True,
+    )
+
+    # ------------------------------------------------------------- loading
+    @classmethod
+    def from_dict(cls: Type[T], d: dict, overwrite_values: dict | None = None) -> T:
+        data = json.loads(json.dumps(_to_jsonable(dict(d))))
+        if overwrite_values:
+            overwrite_recursive(data, _to_jsonable(overwrite_values))
+        return cls(**data)
+
+    @classmethod
+    def from_yaml(cls: Type[T], path: str | Path, overwrite_values: dict | None = None) -> T:
+        with open(path) as f:
+            data = yaml.safe_load(f)
+        return cls.from_dict(data or {}, overwrite_values=overwrite_values)
+
+    @classmethod
+    def from_json(cls: Type[T], path: str | Path, overwrite_values: dict | None = None) -> T:
+        with open(path) as f:
+            data = json.load(f)
+        return cls.from_dict(data or {}, overwrite_values=overwrite_values)
+
+    # -------------------------------------------------------------- saving
+    def as_dict(self) -> dict:
+        return _to_jsonable(self.model_dump(mode="json"))
+
+    def as_str(self) -> str:
+        return json.dumps(self.as_dict(), indent=2)
+
+    def save(self, path: str | Path, indent: int = 2) -> None:
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        data = self.as_dict()
+        if path.suffix in (".yml", ".yaml"):
+            with open(path, "w") as f:
+                yaml.safe_dump(data, f, sort_keys=False)
+        else:
+            with open(path, "w") as f:
+                json.dump(data, f, indent=indent)
+
+    # ------------------------------------------------------------ template
+    @classmethod
+    def get_template_str(cls, indent: int = 0) -> str:
+        """Commented json-ish template built from field descriptions."""
+        pad = " " * indent
+        lines = [f"{pad}{{", f"{pad}    # {cls.__name__}"]
+        doc = (cls.__doc__ or "").strip().splitlines()
+        for d in doc[:1]:
+            lines.append(f"{pad}    # {d.strip()}")
+        lines.append("")
+        items = list(cls.model_fields.items())
+        for i, (name, field) in enumerate(items):
+            desc = field.description
+            if desc:
+                for dline in str(desc).splitlines():
+                    lines.append(f"{pad}    # {dline.strip()}")
+            annotation = field.annotation
+            nested = _unwrap_config_type(annotation)
+            if nested is not None:
+                lines.append(f'{pad}    "{name}":')
+                lines.append(nested.get_template_str(indent=indent + 4))
+            else:
+                default = field.default
+                if isinstance(default, Enum):
+                    default = default.value
+                try:
+                    rendered = json.dumps(_to_jsonable(default))
+                except (TypeError, ValueError):
+                    rendered = "null"
+                lines.append(f'{pad}    "{name}": {rendered}')
+            if i != len(items) - 1:
+                lines[-1] += ","
+            lines.append("")
+        lines.append(f"{pad}}}")
+        return "\n".join(lines)
+
+    @classmethod
+    def save_template(cls, path: str | Path) -> None:
+        Path(path).write_text(cls.get_template_str() + "\n")
+
+
+def _unwrap_config_type(annotation: Any) -> type | None:
+    """Return the BaseConfig subclass inside an annotation, if any."""
+    import typing
+
+    if isinstance(annotation, type) and issubclass(annotation, BaseConfig):
+        return annotation
+    for arg in typing.get_args(annotation):
+        if isinstance(arg, type) and issubclass(arg, BaseConfig):
+            return arg
+    return None

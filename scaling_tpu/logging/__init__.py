@@ -1,0 +1,3 @@
+from .logger import LoggerConfig, logger
+
+__all__ = ["LoggerConfig", "logger"]

@@ -1,0 +1,169 @@
+"""Singleton logger with rank-scoped sinks.
+
+Parity with the reference's logging stack (reference:
+src/scaling/core/logging/logging.py:46-209): colored console, per-rank file
+logs, rank-gated TensorBoard/wandb metric sinks. TensorBoard and wandb are
+optional imports — absent packages degrade to no-ops.
+"""
+
+from __future__ import annotations
+
+import logging as _pylogging
+import sys
+from pathlib import Path
+from typing import Any, List, Optional
+
+from pydantic import Field
+
+from ..config import BaseConfig
+
+_LEVELS = {
+    "debug": _pylogging.DEBUG,
+    "info": _pylogging.INFO,
+    "warning": _pylogging.WARNING,
+    "error": _pylogging.ERROR,
+    "critical": _pylogging.CRITICAL,
+}
+
+
+class LoggerConfig(BaseConfig):
+    log_level: str = Field("info", description="")
+    log_dir: Optional[str] = Field(None, description="directory for per-rank log files")
+    metrics_ranks: Optional[List[int]] = Field(
+        None, description="global ranks that record metrics; None -> rank 0 only"
+    )
+    use_wandb: bool = Field(False, description="")
+    use_tensorboard: bool = Field(False, description="")
+    tensorboard_ranks: Optional[List[int]] = Field(
+        None,
+        description="global ranks that write to tensorboard. None -> rank 0 only.",
+    )
+    wandb_ranks: Optional[List[int]] = Field(
+        None, description="global ranks that log to wandb. None -> rank 0 only."
+    )
+    wandb_host: Optional[str] = Field(None, description="")
+    wandb_team: Optional[str] = Field(None, description="")
+    wandb_project: str = Field("scaling_tpu", description="")
+    wandb_group: str = Field("default", description="")
+    wandb_api_key: Optional[str] = Field(None, description="")
+
+
+def _rank_enabled(ranks: Optional[List[int]], rank: int) -> bool:
+    if ranks is None:
+        return rank == 0
+    return rank in ranks
+
+
+class _Logger:
+    """Process-wide logger; ``configure`` wires sinks, default = console."""
+
+    def __init__(self) -> None:
+        self._log = _pylogging.getLogger("scaling_tpu")
+        self._log.propagate = False
+        self._configured = False
+        self._rank = 0
+        self._config: Optional[LoggerConfig] = None
+        self._tb_writer: Any = None
+        self._wandb: Any = None
+        self._ensure_console()
+
+    def _ensure_console(self) -> None:
+        if not self._log.handlers:
+            handler = _pylogging.StreamHandler(sys.stdout)
+            handler.setFormatter(
+                _pylogging.Formatter("[%(asctime)s] [%(levelname)s] %(message)s")
+            )
+            self._log.addHandler(handler)
+            self._log.setLevel(_pylogging.INFO)
+
+    def configure(
+        self,
+        config: Optional[LoggerConfig] = None,
+        name: str = "",
+        global_rank: int = 0,
+    ) -> None:
+        config = config or LoggerConfig()
+        self._config = config
+        self._rank = global_rank
+        self._log.setLevel(_LEVELS.get(config.log_level, _pylogging.INFO))
+        prefix = f"[rank {global_rank}]" + (f" [{name}]" if name else "")
+        for h in list(self._log.handlers):
+            self._log.removeHandler(h)
+        console = _pylogging.StreamHandler(sys.stdout)
+        console.setFormatter(
+            _pylogging.Formatter(f"[%(asctime)s] {prefix} [%(levelname)s] %(message)s")
+        )
+        self._log.addHandler(console)
+        if config.log_dir:
+            log_dir = Path(config.log_dir)
+            log_dir.mkdir(parents=True, exist_ok=True)
+            fh = _pylogging.FileHandler(log_dir / f"rank_{global_rank}.log")
+            fh.setFormatter(
+                _pylogging.Formatter(f"[%(asctime)s] {prefix} [%(levelname)s] %(message)s")
+            )
+            self._log.addHandler(fh)
+        if config.use_tensorboard and _rank_enabled(config.tensorboard_ranks, global_rank):
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                tb_dir = Path(config.log_dir or ".") / "tensorboard"
+                self._tb_writer = SummaryWriter(log_dir=str(tb_dir))
+            except Exception:  # pragma: no cover - optional dep
+                self.warning("tensorboard requested but unavailable; disabled")
+        if config.use_wandb and _rank_enabled(config.wandb_ranks, global_rank):
+            try:  # pragma: no cover - optional dep
+                import wandb
+
+                wandb.init(project=config.wandb_project, group=config.wandb_group)
+                self._wandb = wandb
+            except Exception:  # pragma: no cover
+                self.warning("wandb requested but unavailable; disabled")
+        self._configured = True
+
+    # ------------------------------------------------------------ passthru
+    def debug(self, msg: Any) -> None:
+        self._log.debug(msg)
+
+    def info(self, msg: Any) -> None:
+        self._log.info(msg)
+
+    def warning(self, msg: Any) -> None:
+        self._log.warning(msg)
+
+    def error(self, msg: Any) -> None:
+        self._log.error(msg)
+
+    def critical(self, msg: Any) -> None:
+        self._log.critical(msg)
+
+    # ------------------------------------------------------------- metrics
+    def log_metrics(self, metrics: dict, step: int) -> None:
+        if self._config is not None and not _rank_enabled(
+            self._config.metrics_ranks, self._rank
+        ):
+            return
+        rendered = " | ".join(
+            f"{k}: {float(v):.6g}" if _is_number(v) else f"{k}: {v}"
+            for k, v in metrics.items()
+        )
+        self.info(f"step {step} | {rendered}")
+        if self._tb_writer is not None:
+            for k, v in metrics.items():
+                if _is_number(v):
+                    self._tb_writer.add_scalar(k, float(v), step)
+        if self._wandb is not None:  # pragma: no cover
+            self._wandb.log(metrics, step=step)
+
+    def log_config(self, config: BaseConfig) -> None:
+        self.info(f"config:\n{config.as_str()}")
+
+
+def _is_number(v: Any) -> bool:
+    try:
+        float(v)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+logger = _Logger()

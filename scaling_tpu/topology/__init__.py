@@ -1,0 +1,15 @@
+from .config import ActivationCheckpointingType, PipePartitionMethod, TopologyConfig
+from .rng import RngTracker
+from .topology import DATA_AXIS, MESH_AXES, MODEL_AXIS, PIPE_AXIS, Topology
+
+__all__ = [
+    "ActivationCheckpointingType",
+    "PipePartitionMethod",
+    "TopologyConfig",
+    "RngTracker",
+    "Topology",
+    "DATA_AXIS",
+    "MESH_AXES",
+    "MODEL_AXIS",
+    "PIPE_AXIS",
+]

@@ -1,0 +1,143 @@
+"""Topology configuration.
+
+Validates and derives the 3D parallel layout (pipe x data x model) and the
+batch hierarchy (global = micro x grad_accum x dp). Field surface matches the
+reference so configs run unchanged
+(reference: src/scaling/core/topology/topology_config.py:20-207).
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Any, List, Optional
+
+from pydantic import Field, model_validator
+
+from ..config import BaseConfig
+
+
+class PipePartitionMethod(Enum):
+    UNIFORM = "uniform"
+    BALANCED = "balanced"
+
+
+class ActivationCheckpointingType(Enum):
+    EVERY_PIPE_STAGE = "every_pipe_stage"
+    EVERY_LAYER = "every_layer"
+    DISABLED = "disabled"
+
+
+class TopologyConfig(BaseConfig):
+    global_rank: Optional[int] = Field(None, description="", ge=0)
+
+    world_size: int = Field(description="", gt=0)
+
+    local_slot: Optional[int] = Field(None, description="", ge=0)
+
+    model_parallel_size: int = Field(description="", gt=0)
+
+    pipe_parallel_size: int = Field(description="", gt=0)
+
+    data_parallel_size: int = Field(description="", gt=0)
+
+    global_batch_size: int = Field(
+        description="global train batch size including all gradient accumulation steps",
+        gt=0,
+    )
+
+    micro_batch_size: int = Field(
+        description="Batch size for one training micro step. This is used when the "
+        "global_batch_size cannot fit in device memory to determine the number of "
+        "gradient accumulation steps.",
+        gt=0,
+    )
+
+    gradient_accumulation_steps: int = Field(
+        description="Number of gradient accumulation steps. This is used when the "
+        "global_batch_size cannot fit in device memory to determine the number of "
+        "gradient accumulation steps.",
+        gt=0,
+    )
+
+    pipe_partition_method: PipePartitionMethod = Field(
+        PipePartitionMethod.UNIFORM,
+        description="Method to assign layers to pipeline stages",
+    )
+
+    pipe_partition_overwrite: Optional[List[int]] = Field(
+        None, description="manually set pipe partitions"
+    )
+
+    activation_checkpointing_type: ActivationCheckpointingType = Field(
+        ActivationCheckpointingType.DISABLED,
+        description="",
+    )
+
+    sequence_parallel: bool = Field(
+        False,
+        description="shard activations along the sequence dimension over the model "
+        "axis between tensor-parallel regions (Megatron-style SP)",
+    )
+
+    @model_validator(mode="before")
+    @classmethod
+    def _derive(cls, values: dict[Any, Any]) -> dict[Any, Any]:
+        if not isinstance(values, dict):
+            return values
+
+        mp = values.get("model_parallel_size")
+        pp = values.get("pipe_parallel_size")
+        dp = values.get("data_parallel_size")
+        world = values.get("world_size")
+
+        sizes = [mp, pp, dp, world]
+        if sum(1 for s in sizes if s is not None) < 3:
+            raise AssertionError(
+                "At least 3 out of 4 parallelization parameters (model_parallel_size, "
+                "pipe_parallel_size, data_parallel_size and world_size) need to be set."
+            )
+        if world is None:
+            world = mp * pp * dp
+        if mp is None:
+            mp = world // (pp * dp)
+        if pp is None:
+            pp = world // (mp * dp)
+        if dp is None:
+            dp = world // (mp * pp)
+        if mp * pp * dp != world:
+            raise AssertionError(
+                f"world_size {world} does not equal model_parallel_size ({mp}) x "
+                f"pipe_parallel_size ({pp}) x data_parallel_size ({dp})."
+            )
+
+        gbs = values.get("global_batch_size")
+        mbs = values.get("micro_batch_size")
+        gas = values.get("gradient_accumulation_steps")
+        if sum(1 for s in (gbs, mbs, gas) if s is not None) < 2:
+            raise AssertionError(
+                "At least 2 out of 3 batch size parameters (global_batch_size, "
+                "micro_batch_size, and gradient_accumulation_steps) need to be set."
+            )
+        if gas is None:
+            gas = gbs // (mbs * dp)
+        if mbs is None:
+            mbs = gbs // (gas * dp)
+        if gbs is None:
+            gbs = mbs * gas * dp
+        if gbs != mbs * gas * dp:
+            raise AssertionError(
+                f"global_batch_size {gbs} does not equal the product of "
+                f"micro_batch_size ({mbs}) and gradient_accumulation_steps ({gas}) "
+                f"and data_parallel_size ({dp})."
+            )
+
+        values.update(
+            world_size=world,
+            model_parallel_size=mp,
+            pipe_parallel_size=pp,
+            data_parallel_size=dp,
+            global_batch_size=gbs,
+            micro_batch_size=mbs,
+            gradient_accumulation_steps=gas,
+        )
+        return values

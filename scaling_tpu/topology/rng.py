@@ -1,0 +1,43 @@
+"""Deterministic RNG key plumbing.
+
+The reference needs a CUDA RNG state tracker so all model-parallel ranks draw
+identical dropout masks (reference: src/scaling/core/topology/rng_tracker.py).
+With stateless ``jax.random`` the whole apparatus collapses to key
+derivation: one base key per training run, folded with (step, layer, name)
+tags. Under jit+sharding every device computes its slice of the same global
+mask, so model-parallel consistency is automatic.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+
+
+def _tag_to_int(tag: str) -> int:
+    return int.from_bytes(hashlib.md5(tag.encode()).digest()[:4], "little")
+
+
+class RngTracker:
+    """Derives per-(step, purpose) keys from a single seed."""
+
+    def __init__(self, seed: int):
+        self.seed = int(seed)
+        self._base = jax.random.PRNGKey(self.seed)
+
+    def base_key(self) -> jax.Array:
+        return self._base
+
+    def key(self, *tags: str | int) -> jax.Array:
+        k = self._base
+        for tag in tags:
+            data = _tag_to_int(tag) if isinstance(tag, str) else int(tag)
+            k = jax.random.fold_in(k, data)
+        return k
+
+    def step_key(self, step: jax.Array | int, *tags: str | int) -> jax.Array:
+        """Key usable inside jit: fold the (traced) step counter last."""
+        k = self.key(*tags)
+        return jax.random.fold_in(k, jnp.asarray(step, dtype=jnp.uint32))

@@ -1,0 +1,33 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The reference tests spawn N NCCL processes on one host (reference:
+tests/core/utils.py:244-307). Under JAX single-controller SPMD the same
+coverage comes from forcing 8 host-platform devices and building real meshes
+over them — every sharding/collective path is exercised without TPUs.
+
+jax may already be imported by the interpreter's sitecustomize (TPU tunnel),
+so platform selection must go through jax.config, not env vars.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs

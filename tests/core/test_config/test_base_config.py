@@ -1,0 +1,59 @@
+import json
+
+import pytest
+from pydantic import Field, ValidationError
+
+from scaling_tpu.config import BaseConfig, overwrite_recursive
+
+
+class Inner(BaseConfig):
+    value: int = Field(3, description="an inner value")
+
+
+class Outer(BaseConfig):
+    name: str = Field("x", description="a name")
+    inner: Inner = Field(Inner(), description="nested")
+
+
+def test_frozen():
+    c = Outer()
+    with pytest.raises(ValidationError):
+        c.name = "y"
+
+
+def test_extra_forbidden():
+    with pytest.raises(ValidationError):
+        Outer(name="a", bogus=1)
+
+
+def test_overwrite_recursive():
+    base = {"a": {"b": 1, "c": 2}, "d": 3}
+    overwrite_recursive(base, {"a": {"b": 10}, "e": 4})
+    assert base == {"a": {"b": 10, "c": 2}, "d": 3, "e": 4}
+
+
+def test_from_dict_overwrite():
+    c = Outer.from_dict({"name": "a"}, overwrite_values={"inner": {"value": 7}})
+    assert c.name == "a"
+    assert c.inner.value == 7
+
+
+def test_yaml_json_roundtrip(tmp_path):
+    c = Outer(name="hello", inner=Inner(value=42))
+    for fname in ("c.yml", "c.json"):
+        p = tmp_path / fname
+        c.save(p)
+        loaded = Outer.from_yaml(p) if fname.endswith("yml") else Outer.from_json(p)
+        assert loaded == c
+
+
+def test_template_contains_descriptions():
+    t = Outer.get_template_str()
+    assert "# a name" in t
+    assert '"name": "x"' in t
+    assert "# an inner value" in t
+    assert "Inner" in t
+
+
+def test_as_dict_json_serializable():
+    json.dumps(Outer().as_dict())

@@ -1,0 +1,116 @@
+import pytest
+
+from scaling_tpu.topology import Topology, TopologyConfig
+
+
+def make_config(**kwargs):
+    defaults = dict(
+        model_parallel_size=2,
+        pipe_parallel_size=2,
+        data_parallel_size=2,
+        micro_batch_size=2,
+        gradient_accumulation_steps=1,
+    )
+    defaults.update(kwargs)
+    return TopologyConfig(**defaults)
+
+
+def test_world_size_derived():
+    c = make_config()
+    assert c.world_size == 8
+    assert c.global_batch_size == 4
+
+
+def test_derive_each_missing_size():
+    c = TopologyConfig(
+        world_size=8,
+        pipe_parallel_size=2,
+        data_parallel_size=2,
+        micro_batch_size=1,
+        gradient_accumulation_steps=1,
+    )
+    assert c.model_parallel_size == 2
+    c = TopologyConfig(
+        world_size=8,
+        model_parallel_size=2,
+        data_parallel_size=2,
+        micro_batch_size=1,
+        gradient_accumulation_steps=1,
+    )
+    assert c.pipe_parallel_size == 2
+    c = TopologyConfig(
+        world_size=8,
+        model_parallel_size=2,
+        pipe_parallel_size=2,
+        micro_batch_size=1,
+        gradient_accumulation_steps=1,
+    )
+    assert c.data_parallel_size == 2
+
+
+def test_too_few_parallel_params():
+    with pytest.raises(Exception):
+        TopologyConfig(
+            model_parallel_size=2,
+            pipe_parallel_size=2,
+            micro_batch_size=1,
+            gradient_accumulation_steps=1,
+        )
+
+
+def test_batch_params_derived():
+    c = TopologyConfig(
+        model_parallel_size=1,
+        pipe_parallel_size=1,
+        data_parallel_size=4,
+        global_batch_size=16,
+        micro_batch_size=2,
+    )
+    assert c.gradient_accumulation_steps == 2
+
+
+def test_inconsistent_batch_params():
+    with pytest.raises(Exception):
+        TopologyConfig(
+            model_parallel_size=1,
+            pipe_parallel_size=1,
+            data_parallel_size=4,
+            global_batch_size=17,
+            micro_batch_size=2,
+            gradient_accumulation_steps=2,
+        )
+
+
+def test_rank_math(devices):
+    topo = Topology(make_config())
+    # rank = ((pp*dp + dp_rank) * mp + mp_rank)
+    seen = set()
+    for pp in range(2):
+        for dp in range(2):
+            for mp in range(2):
+                g = topo.get_global_rank(pp, dp, mp)
+                assert topo.pipe_parallel_rank_of(g) == pp
+                assert topo.data_parallel_rank_of(g) == dp
+                assert topo.model_parallel_rank_of(g) == mp
+                seen.add(g)
+    assert seen == set(range(8))
+
+
+def test_io_ranks(devices):
+    cfg = TopologyConfig(
+        model_parallel_size=2,
+        pipe_parallel_size=2,
+        data_parallel_size=2,
+        micro_batch_size=1,
+        gradient_accumulation_steps=1,
+    )
+    topo = Topology(cfg)
+    io = [g for g in range(8) if topo.is_io_rank(g)]
+    # mp rank 0 on first and last pipe stages
+    assert io == [0, 2, 4, 6]
+
+
+def test_mesh_axes(devices):
+    topo = Topology(make_config())
+    assert topo.mesh.axis_names == ("pipe", "data", "model")
+    assert topo.mesh.devices.shape == (2, 2, 2)
