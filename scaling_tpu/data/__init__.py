@@ -1,0 +1,27 @@
+from .base_dataset import BaseDataset, BaseDatasetBatch, BaseDatasetItem
+from .blended_dataset import (
+    BaseBlendedDataset,
+    BlendedDatasetConfig,
+    interleave_counts,
+    weights_by_num_docs,
+    weights_examples_proportional,
+)
+from .dataloader import DataLoader, RandomSampler
+from .file_dataset import FileDataset
+from .memory_map import MemoryMapDataset, MemoryMapDatasetBuilder
+
+__all__ = [
+    "BaseDataset",
+    "BaseDatasetBatch",
+    "BaseDatasetItem",
+    "BaseBlendedDataset",
+    "BlendedDatasetConfig",
+    "interleave_counts",
+    "weights_by_num_docs",
+    "weights_examples_proportional",
+    "DataLoader",
+    "RandomSampler",
+    "FileDataset",
+    "MemoryMapDataset",
+    "MemoryMapDatasetBuilder",
+]

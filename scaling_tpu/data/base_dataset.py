@@ -1,0 +1,60 @@
+"""Dataset contracts.
+
+Mirrors the reference's ``BaseDatasetItem/Batch``/``BaseDataset`` surface
+(reference: src/scaling/core/data/base_dataset.py:11-108), minus torch: a
+batch is a pytree of numpy/jax arrays; ``sync_batch_to_model_parallel``
+disappears under single-controller SPMD (the loader materialises the global
+batch and jax shards it), but the hook is kept for multi-host feeding.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+TBatch = TypeVar("TBatch")
+
+
+class BaseDatasetItem:
+    """Marker base class for single dataset items."""
+
+
+class BaseDatasetBatch(ABC):
+    """A batch pytree; subclasses register as jax pytrees where needed."""
+
+    def only_inputs(self):
+        """Strip target-only fields (first pipe stage feed)."""
+        return self
+
+    def only_targets(self):
+        """Strip input-only fields (last pipe stage feed)."""
+        return self
+
+
+class BaseDataset(ABC, Generic[T, TBatch]):
+    """Seeded, shuffleable dataset yielding items collatable into batches."""
+
+    def __init__(self, seed: int, shuffle: bool = True):
+        self.seed: Optional[int] = None
+        self.set_seed(seed=seed, shuffle=shuffle)
+
+    @abstractmethod
+    def ident(self) -> str:
+        """Stable identity string (used for blended-index cache keys)."""
+
+    @abstractmethod
+    def __len__(self) -> int: ...
+
+    @abstractmethod
+    def __getitem__(self, index: int) -> T: ...
+
+    @abstractmethod
+    def set_seed(self, seed: int, shuffle: bool = True) -> None:
+        """Reshuffle the dataset deterministically for a new epoch."""
+
+    @abstractmethod
+    def collate(self, batch: List[T]) -> TBatch: ...
+
+    def __repr__(self) -> str:
+        return self.__class__.__name__

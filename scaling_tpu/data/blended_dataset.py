@@ -1,0 +1,249 @@
+"""Blended (multi-source) datasets.
+
+Mixes N component datasets with weights from one of three schemes
+(reference: src/scaling/core/data/blended_dataset.py:24-120):
+
+- ``weight_by_num_documents``: p(L) proportional to |L|**alpha (XLM-R style);
+- ``weight_examples_proportional``: r_m = min(e_m, K)/sum(min(e_n, K)) with
+  temperature 1/T (T5 mixing);
+- explicit user ``weights``.
+
+The interleave index (which (dataset, sample) pair each global index maps to)
+spreads each dataset's samples as evenly as possible and is cached on disk
+keyed by (seed, dataset idents, weights). The reference computes this index
+in a native Rust extension (``blended_dataset_loop``); here it is a
+vectorised numpy argsort (O(N log N), no per-sample Python loop). Cache
+files are published with atomic renames (meta last), so concurrent builders
+on a shared filesystem either see complete files or rebuild identical ones.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+import numpy as np
+from pydantic import Field
+
+from ..config import BaseConfig
+from ..logging import logger
+from .base_dataset import BaseDataset
+
+
+class BlendedDatasetConfig(BaseConfig):
+    weight_by_num_documents: bool = Field(
+        True,
+        description="Build dataset weights from a multinomial distribution over "
+        "groups of data according to the number of documents in each group. "
+        "WARNING: setting this to True will override any user provided weights",
+    )
+    weighted_sampler_alpha: float = Field(
+        0.3,
+        description="Alpha value for weight_by_num_documents. alpha=1 keeps the "
+        "natural distribution, alpha->0 equalises groups.",
+    )
+    weights: Optional[List[float]] = Field(
+        None,
+        description="weights of singular datasets. The list needs to have the same "
+        "length and order as the datasets provided",
+    )
+    weight_examples_proportional: bool = Field(
+        False,
+        description="Examples-proportional mixing: r_m = min(e_m, K)/sum(min(e_n, K)) "
+        "with temperature scaling (see https://arxiv.org/pdf/1910.10683.pdf p31)",
+    )
+    ep_maximum: Optional[int] = Field(
+        None, description="rate limit K used in weight_examples_proportional"
+    )
+    ep_temperature: float = Field(
+        1.0, description="Temperature for weight_examples_proportional"
+    )
+    minimum_dataset_size: int = Field(0, description="Minimal size of the dataset.")
+    cache_directory: Optional[Path] = Field(
+        None, description="directory to cache the blended dataset index"
+    )
+    shuffle_dataset_indices: bool = Field(
+        True, description="shuffle the interleaved index so sources mix"
+    )
+
+
+def weights_by_num_docs(examples: list[int], alpha: float = 0.3) -> np.ndarray:
+    """p_i ∝ n_i; q_i ∝ p_i**alpha; weight_i ∝ q_i / p_i (normalised)."""
+    n = np.asarray(examples, dtype=np.float64)
+    p = n / n.sum()
+    q = p**alpha
+    q = q / q.sum()
+    w = q / p
+    return w / w.sum()
+
+
+def weights_examples_proportional(
+    examples: list[int], temperature: float = 1.0, maximum: Optional[float] = None
+) -> np.ndarray:
+    assert temperature, "temperature must be a non-zero float"
+    n = np.asarray(examples, dtype=np.float64)
+    p = n / n.sum()
+    capped = n.copy()
+    if maximum:
+        assert maximum > 0, f"examples-proportional sampling requires maximum > 0 (got {maximum})"
+        capped = np.minimum(capped, maximum)
+    r = capped / capped.sum()
+    if temperature != 1.0:
+        r = r ** (1.0 / temperature)
+        r = r / r.sum()
+    w = r / p
+    return w / w.sum()
+
+
+def interleave_counts(counts: np.ndarray) -> np.ndarray:
+    """Error-diffusion interleave of ``counts[d]`` samples per dataset.
+
+    Returns an int64 array of shape (sum(counts), 2): (dataset_index,
+    sample_index_within_dataset), ordered so each dataset's samples are spread
+    evenly over the whole range. Equivalent role to the reference's native
+    ``blended_dataset_loop.sample``; computed here by sorting each dataset's
+    evenly spaced target positions, which yields the same even spreading in
+    O(N log N) vectorised numpy.
+    """
+    counts = np.asarray(counts, dtype=np.int64)
+    total = int(counts.sum())
+    ds_col = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
+    within = np.concatenate([np.arange(c, dtype=np.int64) for c in counts]) if total else np.empty(0, np.int64)
+    # target position of sample j of dataset d: (j + 0.5) / counts[d]
+    pos = (within + 0.5) / np.repeat(counts, counts)
+    order = np.argsort(pos, kind="stable")
+    return np.stack([ds_col[order], within[order]], axis=1)
+
+
+class BaseBlendedDataset(BaseDataset):
+    """Blend of component datasets; global index -> (dataset, sample)."""
+
+    def __init__(
+        self,
+        seed: int,
+        config: BlendedDatasetConfig,
+        datasets: Sequence[BaseDataset],
+    ):
+        self.config = config
+        self.datasets = list(datasets)
+        self.num_datasets = len(self.datasets)
+        assert self.num_datasets > 0, "need at least one component dataset"
+        self.seed: Optional[int] = None
+        self.weights: Optional[np.ndarray] = None
+        self.set_seed(seed=seed, shuffle=True)
+
+    # ------------------------------------------------------------- identity
+    def ident(self) -> str:
+        prefix_hash = hashlib.md5("-".join(d.ident() for d in self.datasets).encode()).hexdigest()
+        weights = self.weights if self.weights is not None else np.ones(self.num_datasets)
+        weight_hash = hashlib.md5(
+            "-".join(str(round(float(w) * 100) / 100) for w in weights).encode()
+        ).hexdigest()
+        return f"{self.datasets[0].__class__.__name__}_prefix_{prefix_hash}_weights_{weight_hash}"
+
+    # ---------------------------------------------------------------- index
+    def _compute_weights(self, sizes: list[int]) -> np.ndarray:
+        if self.config.weight_by_num_documents:
+            if self.config.weight_examples_proportional:
+                return weights_examples_proportional(
+                    sizes, self.config.ep_temperature, self.config.ep_maximum
+                )
+            return weights_by_num_docs(sizes, self.config.weighted_sampler_alpha)
+        assert self.config.weights is not None, "weights required when weight_by_num_documents=False"
+        assert len(self.config.weights) == self.num_datasets
+        w = np.asarray(self.config.weights, dtype=np.float64)
+        assert w.sum() > 0.0
+        return w / w.sum()
+
+    def set_seed(self, seed: int, shuffle: bool = True) -> None:
+        if seed == self.seed:
+            return
+        self.seed = seed
+        assert shuffle, "Blended datasets should always be shuffled"
+
+        if self.num_datasets == 1:
+            self.datasets[0].set_seed(seed=seed, shuffle=shuffle)
+            self.size = len(self.datasets[0])
+            self.dataset_indices = None
+            return
+
+        sizes = []
+        for ds in self.datasets:
+            ds.set_seed(seed=seed, shuffle=shuffle)
+            sizes.append(len(ds))
+        self.weights = self._compute_weights(sizes)
+
+        # samples taken per dataset: the largest-weighted dataset is fully
+        # represented, the rest scaled down proportionally
+        rel = self.weights / self.weights.max()
+        if self.config.weight_examples_proportional:
+            counts = np.array(
+                [max(1, int(round(p * n))) for n, p in zip(sizes, rel)], dtype=np.int64
+            )
+        else:
+            counts = np.array(
+                [max(1, int(p * n)) for n, p in zip(sizes, rel)], dtype=np.int64
+            )
+
+        index = self._load_or_build_index(seed, counts)
+        if self.config.shuffle_dataset_indices:
+            rng = np.random.RandomState(seed=seed)
+            rng.shuffle(index)
+        self.dataset_indices = index
+        self.size = index.shape[0]
+
+    def _load_or_build_index(self, seed: int, counts: np.ndarray) -> np.ndarray:
+        if self.config.cache_directory is None:
+            return interleave_counts(counts)
+        cache_dir = Path(self.config.cache_directory)
+        cache_dir.mkdir(parents=True, exist_ok=True)
+        stem = cache_dir / f"index_cache_blended_dataset_seed_{seed}_{self.ident()}"
+        bin_path = Path(str(stem) + ".bin")
+        meta_path = Path(str(stem) + ".meta.json")
+        if meta_path.is_file() and bin_path.is_file():
+            meta = json.loads(meta_path.read_text())
+            data = np.fromfile(bin_path, dtype=np.dtype(meta["dtype"]))
+            if data.size == int(np.prod(meta["shape"])):
+                return data.reshape(tuple(meta["shape"]))
+            logger.warning(f"blended index cache at {bin_path} is truncated; rebuilding")
+        logger.info(f"{self.__class__.__name__}: computing blended index for seed {seed}")
+        index = interleave_counts(counts)
+        # atomic publish: bin first, meta last; readers only trust meta
+        def _atomic_write(path: Path, payload: bytes) -> None:
+            fd, tmp = tempfile.mkstemp(dir=str(path.parent), prefix=path.name + ".tmp")
+            with os.fdopen(fd, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+
+        _atomic_write(bin_path, index.tobytes())
+        _atomic_write(
+            Path(str(stem) + ".input.json"),
+            json.dumps({"counts": counts.tolist(), "seed": seed}).encode(),
+        )
+        _atomic_write(
+            meta_path,
+            json.dumps({"dtype": index.dtype.name, "shape": list(index.shape)}).encode(),
+        )
+        return index
+
+    # ---------------------------------------------------------------- access
+    def __len__(self) -> int:
+        return max(self.size, self.config.minimum_dataset_size)
+
+    def __getitem__(self, index: int):
+        if self.size < self.config.minimum_dataset_size:
+            index %= self.size
+        if self.num_datasets == 1:
+            return self.datasets[0][index]
+        ds_idx, sample_idx = self.dataset_indices[index]
+        return self.datasets[int(ds_idx)][int(sample_idx)]
+
+    def collate(self, batch: list):
+        return self.datasets[0].collate(batch=batch)
+
+    def __repr__(self) -> str:
+        return f"{self.__class__.__name__}_{self.datasets[0].__class__.__name__}"

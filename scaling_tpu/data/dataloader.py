@@ -1,0 +1,142 @@
+"""Deterministic, resumable data loading.
+
+Keeps the reference's sample-order contract
+(reference: src/scaling/core/data/dataloader.py:55-162):
+
+- each epoch reshuffles the dataset with ``seed + epoch``;
+- within an epoch, DP rank ``r`` sees indices ``i*dp + r + consumed_in_epoch``;
+- ``consumed_samples`` advances by ``micro_batch_size * dp`` per micro batch,
+  making mid-epoch checkpoint resume exact;
+- trailing samples that don't fill a full micro batch x dp grid are dropped.
+
+Single-controller difference: one loader feeds ALL data-parallel shards —
+each ``__next__`` returns the micro batch for every dp rank stacked along the
+batch axis (shard r occupying rows [r*mbs, (r+1)*mbs)), ready to be sharded
+over the mesh's data axis. Per-rank iteration (multi-host) is available via
+``dp_rank``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Generator, Optional
+
+import numpy as np
+
+from ..topology import Topology
+from .base_dataset import BaseDataset
+
+
+class RandomSampler:
+    """Yields per-micro-step index lists, DP-strided, resumable."""
+
+    def __init__(
+        self,
+        dataset: BaseDataset,
+        seed: int,
+        consumed_samples: int,
+        topology: Topology,
+        shuffle: bool = True,
+        dp_rank: Optional[int] = None,
+    ):
+        self.dataset = dataset
+        self.seed = seed
+        self.consumed_samples = consumed_samples
+        self.topology = topology
+        self.shuffle = shuffle
+        self.dp_rank = dp_rank  # None -> all ranks stacked
+
+        mbs = topology.config.micro_batch_size
+        dp = topology.config.data_parallel_size
+        self.total_samples = len(dataset)
+        self.total_micro_batches = self.total_samples // mbs
+        self.total_micro_batches_per_data_parallel = self.total_micro_batches // dp
+        self.usable_total_samples = self.total_micro_batches_per_data_parallel * mbs * dp
+        if self.usable_total_samples <= 0:
+            raise AssertionError(
+                "no usable samples; the dataset is too small for the provided "
+                "data parallel size and micro batch size"
+            )
+        if consumed_samples % (mbs * dp) != 0:
+            raise AssertionError(
+                f"consumed_samples ({consumed_samples}) must be a multiple of "
+                f"micro_batch_size * data_parallel_size ({mbs * dp}); a checkpoint "
+                "written by this framework always satisfies this"
+            )
+
+    def __len__(self) -> int:
+        return self.total_micro_batches
+
+    def _epoch_indices(self, dp_rank: int, start: int, count: int) -> np.ndarray:
+        return np.arange(count, dtype=np.int64) * self.topology.config.data_parallel_size + dp_rank + start
+
+    def __iter__(self) -> Generator[list[int], None, None]:
+        mbs = self.topology.config.micro_batch_size
+        dp = self.topology.config.data_parallel_size
+        while True:  # infinite: epochs chain with fresh shuffles
+            epoch = self.consumed_samples // self.usable_total_samples
+            in_epoch = self.consumed_samples % self.usable_total_samples
+            remaining = self.usable_total_samples - in_epoch
+            self.dataset.set_seed(seed=self.seed + epoch, shuffle=self.shuffle)
+
+            per_rank = remaining // dp
+            n_micro = per_rank // mbs
+            assert n_micro > 0, (
+                f"internal error: zero micro batches for epoch {epoch} "
+                f"(remaining={remaining}, dp={dp}, mbs={mbs})"
+            )
+            if self.dp_rank is not None:
+                rank_indices = self._epoch_indices(self.dp_rank, in_epoch, per_rank)
+                for m in range(n_micro):
+                    self.consumed_samples += mbs * dp
+                    yield rank_indices[m * mbs : (m + 1) * mbs].tolist()
+            else:
+                all_rank_indices = [self._epoch_indices(r, in_epoch, per_rank) for r in range(dp)]
+                for m in range(n_micro):
+                    batch: list[int] = []
+                    for r in range(dp):
+                        batch.extend(all_rank_indices[r][m * mbs : (m + 1) * mbs].tolist())
+                    self.consumed_samples += mbs * dp
+                    yield batch
+
+
+class DataLoader:
+    """Infinite iterator over micro batches; ``next(loader)`` -> batch pytree."""
+
+    def __init__(
+        self,
+        seed: int,
+        consumed_samples: int,
+        dataset: BaseDataset,
+        topology: Topology,
+        shuffle: bool = True,
+        dp_rank: Optional[int] = None,
+    ):
+        self.seed = seed
+        self.consumed_samples = consumed_samples
+        self.dataset = dataset
+        self.topology = topology
+        if len(dataset) < topology.config.micro_batch_size:
+            raise AssertionError(
+                f"cannot instantiate data loader with micro_batch_size "
+                f"{topology.config.micro_batch_size} because dataset has only "
+                f"length {len(dataset)}"
+            )
+        self._sampler = RandomSampler(
+            dataset=dataset,
+            seed=seed,
+            consumed_samples=consumed_samples,
+            topology=topology,
+            shuffle=shuffle,
+            dp_rank=dp_rank,
+        )
+        self._iter = iter(self._sampler)
+
+    def __next__(self) -> Any:
+        indices = next(self._iter)
+        items = [self.dataset[i] for i in indices]
+        batch = self.dataset.collate(items)
+        self.consumed_samples = self._sampler.consumed_samples
+        return batch
+
+    def __iter__(self):
+        return self

@@ -1,0 +1,45 @@
+"""Non-memory-mapped document dataset.
+
+Counterpart of the reference's ``FileDataset``
+(reference: src/scaling/core/data/file_dataset.py): same on-disk triple as
+``MemoryMapDataset`` but reads with a persistent file handle and seeks —
+useful on filesystems where mmap misbehaves (e.g. some network mounts).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from .memory_map import DocumentIndex
+
+
+class FileDataset:
+    def __init__(self, prefix_path: Path | str):
+        self.prefix_path = Path(prefix_path)
+        self._layout = DocumentIndex(self.prefix_path)
+        self._data_file = open(self._layout.file_path_data, "rb")
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self._layout.dtype
+
+    @property
+    def document_count(self) -> int:
+        return self._layout.document_count
+
+    def __len__(self) -> int:
+        return self._layout.document_count
+
+    def sizes(self, idx: int | None = None) -> np.ndarray:
+        return self._layout.sizes(idx)
+
+    def __getitem__(self, idx: int) -> np.ndarray:
+        start, size = self._layout.span(idx)
+        self._data_file.seek(start * self._layout.dtype.itemsize)
+        buf = self._data_file.read(size * self._layout.dtype.itemsize)
+        return np.frombuffer(buf, dtype=self._layout.dtype)
+
+    def close(self) -> None:
+        self._data_file.close()

@@ -1,0 +1,161 @@
+"""Memory-mapped token datasets.
+
+On-disk format is compatible with the reference
+(reference: src/scaling/core/data/memory_map.py:8-250):
+``<prefix>.bin`` raw item values, ``<prefix>.idx`` int pairs
+``(start_index, size)`` per document, ``<prefix>.meta.json`` with
+``{dtype, index_dtype, document_count}`` — so datasets tokenized for the
+reference load unchanged. Implementation here reads the whole index
+vectorised instead of per-document ``frombuffer`` calls.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Iterator
+
+import numpy as np
+
+
+class DocumentIndex:
+    """Parses the ``.bin/.idx/.meta.json`` triple's meta + document index.
+
+    Shared by MemoryMapDataset and FileDataset so the on-disk format is
+    defined in exactly one place.
+    """
+
+    def __init__(self, prefix_path: Path | str, load_index_to_memory: bool = True):
+        self.prefix_path = Path(prefix_path)
+        for p in (self.file_path_data, self.file_path_index, self.file_path_meta):
+            if not p.is_file():
+                raise FileNotFoundError(f"cannot initialize memory map, file not found: {p}")
+        meta = json.loads(self.file_path_meta.read_text())
+        self.dtype = np.dtype(meta["dtype"])
+        self.index_dtype = np.dtype(meta["index_dtype"])
+        self.document_count = int(meta["document_count"])
+        index_mmap = np.memmap(self.file_path_index, mode="r", dtype=self.index_dtype)
+        index = index_mmap.reshape(self.document_count, 2)
+        # the index is tiny relative to the data; keep it in RAM by default
+        self._index = np.array(index) if load_index_to_memory else index
+
+    @property
+    def file_path_data(self) -> Path:
+        return Path(str(self.prefix_path) + ".bin")
+
+    @property
+    def file_path_index(self) -> Path:
+        return Path(str(self.prefix_path) + ".idx")
+
+    @property
+    def file_path_meta(self) -> Path:
+        return Path(str(self.prefix_path) + ".meta.json")
+
+    def sizes(self, idx: int | None = None) -> np.ndarray:
+        if idx is None:
+            return self._index[:, 1]
+        return self._index[idx, 1]
+
+    def span(self, idx: int) -> tuple[int, int]:
+        if idx < 0 or idx >= self.document_count:
+            raise IndexError(
+                f"cannot retrieve document idx {idx} from {self.document_count} documents"
+            )
+        start, size = (int(v) for v in self._index[idx])
+        return start, size
+
+
+class MemoryMapDataset:
+    """Random access to variable-length documents in a flat binary file."""
+
+    def __init__(self, prefix_path: Path | str, load_index_to_memory: bool = True):
+        self._layout = DocumentIndex(prefix_path, load_index_to_memory=load_index_to_memory)
+        self.prefix_path = self._layout.prefix_path
+        self.dtype = self._layout.dtype
+        self.index_dtype = self._layout.index_dtype
+        self.document_count = self._layout.document_count
+        self._data = np.memmap(self.file_path_data, mode="r", dtype=self.dtype)
+
+    @property
+    def file_path_data(self) -> Path:
+        return self._layout.file_path_data
+
+    @property
+    def file_path_index(self) -> Path:
+        return self._layout.file_path_index
+
+    @property
+    def file_path_meta(self) -> Path:
+        return self._layout.file_path_meta
+
+    def sizes(self, idx: int | None = None) -> np.ndarray:
+        return self._layout.sizes(idx)
+
+    def __len__(self) -> int:
+        return self.document_count
+
+    def __getitem__(self, idx: int) -> np.ndarray:
+        start, size = self._layout.span(idx)
+        return np.asarray(self._data[start : start + size])
+
+    def read_span(self, start_token: int, num_tokens: int) -> np.ndarray:
+        """Read a flat token span irrespective of document boundaries."""
+        return np.asarray(self._data[start_token : start_token + num_tokens])
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        for i in range(len(self)):
+            yield self[i]
+
+
+class MemoryMapDatasetBuilder:
+    """Streaming writer producing the ``.bin``/``.idx``/``.meta.json`` triple."""
+
+    def __init__(
+        self,
+        prefix_path: Path | str,
+        dtype: np.dtype = np.dtype(np.int32),
+        index_dtype: np.dtype = np.dtype(np.int64),
+    ):
+        self.prefix_path = Path(prefix_path)
+        self.dtype = np.dtype(dtype)
+        self.index_dtype = np.dtype(index_dtype)
+        data_path = Path(str(self.prefix_path) + ".bin")
+        index_path = Path(str(self.prefix_path) + ".idx")
+        if data_path.is_file():
+            raise FileExistsError(f"data file already exists: {data_path}")
+        if index_path.is_file():
+            raise FileExistsError(f"index file already exists: {index_path}")
+        data_path.parent.mkdir(parents=True, exist_ok=True)
+        self._data_file = open(data_path, "wb")
+        self._index_file = open(index_path, "wb")
+        self._cursor = 0
+        self.document_count = 0
+
+    def add(self, array: np.ndarray) -> None:
+        array = np.asarray(array)
+        if array.ndim != 1:
+            raise ValueError("cannot add arrays of more than one dimension")
+        array = array.astype(self.dtype, copy=False)
+        self._data_file.write(array.tobytes())
+        self._index_file.write(
+            np.array([self._cursor, array.size], dtype=self.index_dtype).tobytes()
+        )
+        self._cursor += array.size
+        self.document_count += 1
+
+    def finalize(self) -> None:
+        self._data_file.close()
+        self._index_file.close()
+        meta = {
+            "dtype": self.dtype.name,
+            "index_dtype": self.index_dtype.name,
+            "document_count": self.document_count,
+        }
+        Path(str(self.prefix_path) + ".meta.json").write_text(json.dumps(meta))
+
+    def __enter__(self) -> "MemoryMapDatasetBuilder":
+        return self
+
+    def __exit__(self, *args) -> bool:
+        self.finalize()
+        return False

@@ -1,0 +1,109 @@
+import numpy as np
+import pytest
+
+from scaling_tpu.data import (
+    BaseBlendedDataset,
+    BlendedDatasetConfig,
+    interleave_counts,
+    weights_by_num_docs,
+    weights_examples_proportional,
+)
+from tests.core.test_data.test_dataloader import ToyDataset
+
+
+class TaggedDataset(ToyDataset):
+    def __init__(self, size, seed, tag):
+        self.tag = tag
+        super().__init__(size, seed)
+
+    def ident(self):
+        return f"tagged_{self.tag}_{self.size}"
+
+    def __getitem__(self, index):
+        return (self.tag, int(self._order[index]))
+
+
+def test_weights_by_num_docs_alpha_edges():
+    w1 = weights_by_num_docs([100, 300], alpha=1.0)
+    np.testing.assert_allclose(w1, [0.5, 0.5])  # alpha=1: natural distribution
+    w0 = weights_by_num_docs([100, 300], alpha=0.0)
+    # alpha=0: equal sampling probability -> small dataset upweighted
+    assert w0[0] > w0[1]
+    np.testing.assert_allclose(w0, [0.75, 0.25])
+
+
+def test_weights_examples_proportional_maximum():
+    w = weights_examples_proportional([100, 1000], maximum=500)
+    # large dataset capped at 500 -> rates 100/600, 500/600
+    np.testing.assert_allclose(w * np.array([100, 1000]) / (w @ np.array([100, 1000])),
+                               [1 / 6, 5 / 6], atol=1e-9)
+
+
+def test_interleave_counts_even_spread():
+    idx = interleave_counts(np.array([2, 6]))
+    assert idx.shape == (8, 2)
+    # dataset 0's two samples land near positions 2 and 6 (evenly spread)
+    pos0 = np.where(idx[:, 0] == 0)[0]
+    assert len(pos0) == 2
+    assert pos0[1] - pos0[0] >= 3
+    # within-dataset order preserved
+    for d in (0, 1):
+        w = idx[idx[:, 0] == d][:, 1]
+        np.testing.assert_array_equal(w, np.arange(len(w)))
+
+
+def test_single_dataset_passthrough():
+    ds = TaggedDataset(16, 0, tag=0)
+    blended = BaseBlendedDataset(seed=0, config=BlendedDatasetConfig(), datasets=[ds])
+    assert len(blended) == 16
+    assert blended[3] == ds[3]
+
+
+def test_blend_covers_both_sources():
+    a, b = TaggedDataset(40, 0, tag=0), TaggedDataset(40, 0, tag=1)
+    blended = BaseBlendedDataset(
+        seed=0,
+        config=BlendedDatasetConfig(weighted_sampler_alpha=1.0),
+        datasets=[a, b],
+    )
+    tags = [blended[i][0] for i in range(len(blended))]
+    assert set(tags) == {0, 1}
+    # alpha=1, equal sizes -> both fully represented
+    assert len(blended) == 80
+
+
+def test_explicit_weights():
+    a, b = TaggedDataset(100, 0, tag=0), TaggedDataset(100, 0, tag=1)
+    blended = BaseBlendedDataset(
+        seed=0,
+        config=BlendedDatasetConfig(weight_by_num_documents=False, weights=[3.0, 1.0]),
+        datasets=[a, b],
+    )
+    tags = np.array([blended[i][0] for i in range(len(blended))])
+    n0, n1 = (tags == 0).sum(), (tags == 1).sum()
+    assert n0 == 100  # max-weight dataset fully represented
+    assert abs(n1 - 33) <= 1
+
+
+def test_index_cache_reused(tmp_path):
+    a, b = TaggedDataset(50, 0, tag=0), TaggedDataset(30, 0, tag=1)
+    cfg = BlendedDatasetConfig(cache_directory=tmp_path)
+    b1 = BaseBlendedDataset(seed=0, config=cfg, datasets=[a, b])
+    cache_files = list(tmp_path.glob("*.bin"))
+    assert len(cache_files) == 1
+    mtime = cache_files[0].stat().st_mtime_ns
+    b2 = BaseBlendedDataset(
+        seed=0, config=cfg, datasets=[TaggedDataset(50, 0, tag=0), TaggedDataset(30, 0, tag=1)]
+    )
+    assert cache_files[0].stat().st_mtime_ns == mtime  # not rebuilt
+    for i in range(len(b1)):
+        assert b1[i] == b2[i]
+
+
+def test_minimum_dataset_size_wraps():
+    ds = TaggedDataset(8, 0, tag=0)
+    blended = BaseBlendedDataset(
+        seed=0, config=BlendedDatasetConfig(minimum_dataset_size=20), datasets=[ds]
+    )
+    assert len(blended) == 20
+    assert blended[10] == blended[10 % 8]
