@@ -204,12 +204,19 @@ class BaseBlendedDataset(BaseDataset):
         stem = cache_dir / f"index_cache_blended_dataset_seed_{seed}_{self.ident()}"
         bin_path = Path(str(stem) + ".bin")
         meta_path = Path(str(stem) + ".meta.json")
+        input_path = Path(str(stem) + ".input.json")
         if meta_path.is_file() and bin_path.is_file():
             meta = json.loads(meta_path.read_text())
             data = np.fromfile(bin_path, dtype=np.dtype(meta["dtype"]))
-            if data.size == int(np.prod(meta["shape"])):
+            # the cache stem hashes weights only to 2 decimals; validate the
+            # exact per-dataset counts so a changed mixture never reuses a
+            # stale index
+            cached_counts = None
+            if input_path.is_file():
+                cached_counts = json.loads(input_path.read_text()).get("counts")
+            if data.size == int(np.prod(meta["shape"])) and cached_counts == counts.tolist():
                 return data.reshape(tuple(meta["shape"]))
-            logger.warning(f"blended index cache at {bin_path} is truncated; rebuilding")
+            logger.warning(f"blended index cache at {bin_path} is stale or truncated; rebuilding")
         logger.info(f"{self.__class__.__name__}: computing blended index for seed {seed}")
         index = interleave_counts(counts)
         # atomic publish: bin first, meta last; readers only trust meta
@@ -221,7 +228,7 @@ class BaseBlendedDataset(BaseDataset):
 
         _atomic_write(bin_path, index.tobytes())
         _atomic_write(
-            Path(str(stem) + ".input.json"),
+            input_path,
             json.dumps({"counts": counts.tolist(), "seed": seed}).encode(),
         )
         _atomic_write(

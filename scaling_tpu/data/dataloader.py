@@ -64,7 +64,8 @@ class RandomSampler:
             )
 
     def __len__(self) -> int:
-        return self.total_micro_batches
+        """Micro batches yielded per epoch (each consumes mbs * dp samples)."""
+        return self.total_micro_batches_per_data_parallel
 
     def _epoch_indices(self, dp_rank: int, start: int, count: int) -> np.ndarray:
         return np.arange(count, dtype=np.int64) * self.topology.config.data_parallel_size + dp_rank + start

@@ -156,6 +156,12 @@ class MemoryMapDatasetBuilder:
     def __enter__(self) -> "MemoryMapDatasetBuilder":
         return self
 
-    def __exit__(self, *args) -> bool:
-        self.finalize()
+    def __exit__(self, exc_type, exc_val, exc_tb) -> bool:
+        if exc_type is None:
+            self.finalize()
+        else:
+            # don't publish meta for a torn dataset; leave .bin/.idx for debris
+            # inspection but a reader will refuse without .meta.json
+            self._data_file.close()
+            self._index_file.close()
         return False

@@ -112,12 +112,23 @@ class _Logger:
                 self.warning("tensorboard requested but unavailable; disabled")
         if config.use_wandb and _rank_enabled(config.wandb_ranks, global_rank):
             try:  # pragma: no cover - optional dep
+                import os as _os
+
+                if config.wandb_host:
+                    _os.environ["WANDB_BASE_URL"] = config.wandb_host
+                if config.wandb_api_key:
+                    _os.environ["WANDB_API_KEY"] = config.wandb_api_key
                 import wandb
 
-                wandb.init(project=config.wandb_project, group=config.wandb_group)
+                wandb.init(
+                    project=config.wandb_project,
+                    group=config.wandb_group,
+                    entity=config.wandb_team,
+                    name=name or None,
+                )
                 self._wandb = wandb
-            except Exception:  # pragma: no cover
-                self.warning("wandb requested but unavailable; disabled")
+            except Exception as e:  # pragma: no cover
+                self.warning(f"wandb requested but unavailable; disabled ({e})")
         self._configured = True
 
     # ------------------------------------------------------------ passthru

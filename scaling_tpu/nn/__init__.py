@@ -1,0 +1,83 @@
+from .activation_function import ActivationFunction, get_activation_function
+from .attention import ParallelSelfAttention, multi_head_attention, repeat_kv
+from .base_layer import BaseLayer, ForwardContext, LayerSpec, TiedLayerSpec
+from .linear import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    normal_init,
+    xavier_normal_init,
+)
+from .lora import LoRAModuleType, LoRaConfig, ParallelLoRa
+from .masked_softmax import MaskedSoftmax, MaskedSoftmaxConfig, MaskedSoftmaxKernel
+from .mlp import ParallelMLP, ParallelSwiGLUMLP
+from .norm import (
+    LayerNorm,
+    LayerNormConfig,
+    LayerNormOptimizationType,
+    NormType,
+    RMSNorm,
+    get_norm,
+)
+from .param import ParamMeta, model_parallel_meta, named_parameters, replicated_meta, tree_prefix, tree_with_layer
+from .rotary import (
+    RelativePositionEmbeddingType,
+    RotaryConfig,
+    RotaryEmbedding,
+    RotaryEmbeddingComplex,
+)
+from .seq_packing import (
+    add_cumulative_seq_lengths_padding,
+    cumulative_seq_lengths_to_segment_ids,
+    get_cumulative_seq_lengths,
+    get_position_ids,
+    remove_cumulative_seq_lengths_padding,
+    segment_ids_to_mask,
+)
+
+__all__ = [
+    "ActivationFunction",
+    "get_activation_function",
+    "ParallelSelfAttention",
+    "multi_head_attention",
+    "repeat_kv",
+    "BaseLayer",
+    "ForwardContext",
+    "LayerSpec",
+    "TiedLayerSpec",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "normal_init",
+    "xavier_normal_init",
+    "LoRAModuleType",
+    "LoRaConfig",
+    "ParallelLoRa",
+    "MaskedSoftmax",
+    "MaskedSoftmaxConfig",
+    "MaskedSoftmaxKernel",
+    "ParallelMLP",
+    "ParallelSwiGLUMLP",
+    "LayerNorm",
+    "LayerNormConfig",
+    "LayerNormOptimizationType",
+    "NormType",
+    "RMSNorm",
+    "get_norm",
+    "ParamMeta",
+    "model_parallel_meta",
+    "named_parameters",
+    "replicated_meta",
+    "tree_prefix",
+    "tree_with_layer",
+    "RelativePositionEmbeddingType",
+    "RotaryConfig",
+    "RotaryEmbedding",
+    "RotaryEmbeddingComplex",
+    "add_cumulative_seq_lengths_padding",
+    "cumulative_seq_lengths_to_segment_ids",
+    "get_cumulative_seq_lengths",
+    "get_position_ids",
+    "remove_cumulative_seq_lengths_padding",
+    "segment_ids_to_mask",
+]

@@ -1,0 +1,33 @@
+"""Activation function registry.
+
+(reference: src/scaling/core/nn/activation_function.py)
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+class ActivationFunction(Enum):
+    GELU = "gelu"
+    SILU = "silu"
+    RELU = "relu"
+    TANH = "tanh"
+    SIGMOID = "sigmoid"
+
+
+_FUNCTIONS: dict[ActivationFunction, Callable] = {
+    ActivationFunction.GELU: jax.nn.gelu,
+    ActivationFunction.SILU: jax.nn.silu,
+    ActivationFunction.RELU: jax.nn.relu,
+    ActivationFunction.TANH: jnp.tanh,
+    ActivationFunction.SIGMOID: jax.nn.sigmoid,
+}
+
+
+def get_activation_function(activation: ActivationFunction) -> Callable:
+    return _FUNCTIONS[activation]

@@ -1,0 +1,104 @@
+"""Layer contract and assembly specs.
+
+The reference's ``BaseLayer`` needs tuple-conversion hooks because pipe
+communication and activation checkpointing move opaque tuples between
+processes (reference: src/scaling/core/nn/parallel_module/base_layer.py:16).
+Under jit everything is a pytree with static treedef, so the contract
+collapses to: ``init(key) -> params``, ``param_metas() -> metas``,
+``__call__(params, x, ctx) -> y`` where x/y are pytrees.
+
+``LayerSpec``/``TiedLayerSpec`` keep the reference's deferred-construction
+API (reference: src/scaling/core/nn/parallel_module/layer_spec.py:8-29) so
+model assembly code reads the same.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Type
+
+import jax
+
+
+@dataclass
+class ForwardContext:
+    """Per-call state threaded through layers (all jit-compatible)."""
+
+    # dropout master key for this microbatch/step; None => deterministic
+    dropout_key: Optional[jax.Array] = None
+    # train vs eval; static under jit
+    deterministic: bool = True
+    # topology flags the layers need (static)
+    sequence_parallel: bool = False
+    model_parallel_size: int = 1
+    # mesh is needed for explicit collectives; None on single device
+    mesh: Optional[Any] = None
+
+    _key_counter: int = 0
+
+    def next_key(self) -> Optional[jax.Array]:
+        """Derive a fresh dropout key; deterministic given call order."""
+        if self.dropout_key is None or self.deterministic:
+            return None
+        self._key_counter += 1
+        return jax.random.fold_in(self.dropout_key, self._key_counter)
+
+    def dropout(self, x: jax.Array, rate: float) -> jax.Array:
+        if rate == 0.0 or self.deterministic:
+            return x
+        key = self.next_key()
+        if key is None:
+            return x
+        keep = 1.0 - rate
+        mask = jax.random.bernoulli(key, p=keep, shape=x.shape)
+        return jax.numpy.where(mask, x / keep, 0).astype(x.dtype)
+
+
+class BaseLayer:
+    """Stateless layer: owns hyperparameters, emits params/metas trees."""
+
+    def init(self, key: jax.Array) -> Any:
+        raise NotImplementedError
+
+    def param_metas(self) -> Any:
+        raise NotImplementedError
+
+    def __call__(self, params: Any, x: Any, ctx: ForwardContext) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class LayerSpec:
+    """Deferred layer construction for pipeline assembly."""
+
+    module_class: Type[BaseLayer]
+    args: tuple = ()
+    kwargs: dict = field(default_factory=dict)
+
+    def __init__(self, module_class: Type[BaseLayer], *args: Any, **kwargs: Any):
+        self.module_class = module_class
+        self.args = args
+        self.kwargs = kwargs
+
+    def initialize(self) -> BaseLayer:
+        return self.module_class(*self.args, **self.kwargs)
+
+
+class TiedLayerSpec(LayerSpec):
+    """LayerSpec whose named params are shared with other specs of same key.
+
+    ``tied_weight_attributes`` lists param-tree paths (dot notation) tied
+    across occurrences, e.g. embedding weight reused by the LM head.
+    """
+
+    def __init__(
+        self,
+        module_class: Type[BaseLayer],
+        *args: Any,
+        key: str,
+        tied_weight_attributes: Optional[list[str]] = None,
+        **kwargs: Any,
+    ):
+        super().__init__(module_class, *args, **kwargs)
+        self.key = key
+        self.tied_weight_attributes = tied_weight_attributes or ["weight"]

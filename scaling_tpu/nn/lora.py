@@ -1,0 +1,138 @@
+"""LoRA adapters.
+
+(reference: src/scaling/core/nn/lora.py:12, lora_config.py). ``ParallelLoRa``
+is A (kaiming-init, column-parallel) -> dropout -> B (zero-init) scaled by
+alpha/rank; injected on query/key/value/dense inside attention. Merge support
+computes the delta weight for folding into the base matrix.
+"""
+
+from __future__ import annotations
+
+import math
+from enum import Enum
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from pydantic import Field
+
+from ..config import BaseConfig
+from ..topology.topology import MODEL_AXIS
+from .base_layer import BaseLayer, ForwardContext
+from .param import ParamMeta, model_parallel_meta
+
+
+class LoRAModuleType(Enum):
+    QUERY = "query"
+    KEY = "key"
+    VALUE = "value"
+    DENSE = "dense"
+
+
+class LoRaConfig(BaseConfig):
+    name: str = Field("default_lora", description="adapter name (used in param keys)")
+    rank: int = Field(8, description="LoRA rank r")
+    alpha: int = Field(8, description="scaling numerator; delta = (alpha/r) B A x")
+    dropout: float = Field(0.0, description="dropout on the input of A")
+    bias: bool = Field(False, description="bias on the B projection")
+    kaiming_a: float = Field(
+        math.sqrt(5.0), description="kaiming-uniform `a` used to init A"
+    )
+    parallel_modules: List[LoRAModuleType] = Field(
+        default_factory=lambda: [
+            LoRAModuleType.QUERY,
+            LoRAModuleType.KEY,
+            LoRAModuleType.VALUE,
+            LoRAModuleType.DENSE,
+        ],
+        description="which attention projections receive adapters",
+    )
+
+
+def _kaiming_uniform(key: jax.Array, shape: tuple, a: float, dtype) -> jax.Array:
+    fan_in = shape[0]
+    gain = math.sqrt(2.0 / (1.0 + a * a))
+    bound = gain * math.sqrt(3.0 / fan_in)
+    return jax.random.uniform(key, shape, minval=-bound, maxval=bound).astype(dtype)
+
+
+class ParallelLoRa(BaseLayer):
+    """x -> (alpha/r) * B(A(dropout(x))); B zero-init so delta starts at 0.
+
+    Sharding follows the host projection: for column-parallel hosts
+    (query/key/value) B's output dim is model-sharded; for the dense
+    (row-parallel) host A's input dim is model-sharded.
+    """
+
+    def __init__(
+        self,
+        in_features: int,
+        out_features: int,
+        rank: int,
+        lora_module_type: LoRAModuleType,
+        alpha: int = 8,
+        dropout: float = 0.0,
+        bias: bool = False,
+        kaiming_a: float = math.sqrt(5.0),
+        dtype=jnp.float32,
+        name: str = "default_lora",
+    ):
+        self.in_features = in_features
+        self.out_features = out_features
+        self.rank = rank
+        self.alpha = alpha
+        self.scaling = alpha / rank
+        self.dropout_rate = dropout
+        self.use_bias = bias
+        self.kaiming_a = kaiming_a
+        self.dtype = dtype
+        self.module_type = lora_module_type
+        self.name = name
+
+    def init(self, key: jax.Array) -> dict:
+        ka, kb = jax.random.split(key)
+        params = {
+            "lora_a": _kaiming_uniform(ka, (self.in_features, self.rank), self.kaiming_a, self.dtype),
+            "lora_b": jnp.zeros((self.rank, self.out_features), dtype=self.dtype),
+        }
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.out_features,), dtype=self.dtype)
+        return params
+
+    def param_metas(self) -> dict:
+        if self.module_type == LoRAModuleType.DENSE:
+            # host is row-parallel: input sharded, B replicated on out
+            metas = {
+                "lora_a": model_parallel_meta(0, parameter_name="lora_a", no_weight_decay=True),
+                "lora_b": ParamMeta(
+                    parameter_name="lora_b", partition_spec=(None, None),
+                    is_model_parallel_duplicate=True, no_weight_decay=True,
+                ),
+            }
+        else:
+            metas = {
+                "lora_a": ParamMeta(
+                    parameter_name="lora_a", partition_spec=(None, None),
+                    is_model_parallel_duplicate=True, no_weight_decay=True,
+                ),
+                "lora_b": model_parallel_meta(1, parameter_name="lora_b", no_weight_decay=True),
+            }
+        if self.use_bias:
+            metas["bias"] = ParamMeta(
+                parameter_name="bias",
+                partition_spec=(MODEL_AXIS,) if self.module_type != LoRAModuleType.DENSE else (None,),
+                no_weight_decay=True,
+            )
+        return metas
+
+    def __call__(self, params: dict, x: jax.Array, ctx: ForwardContext) -> jax.Array:
+        h = ctx.dropout(x, self.dropout_rate)
+        delta = (h @ params["lora_a"].astype(x.dtype)) @ params["lora_b"].astype(x.dtype)
+        delta = delta * self.scaling
+        if self.use_bias:
+            delta = delta + params["bias"].astype(x.dtype)
+        return delta
+
+    def get_delta_weights(self, params: dict) -> jax.Array:
+        """(in, out) weight delta for merging into the host matrix."""
+        return (params["lora_a"] @ params["lora_b"]) * self.scaling

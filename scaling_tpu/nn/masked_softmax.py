@@ -1,0 +1,65 @@
+"""Masked softmax with a kernel switch.
+
+(reference: src/scaling/core/nn/masked_softmax/masked_softmax.py:8-49,
+masked_softmax_config.py:8-37). Kernels:
+
+- ``torch``: the reference's plain path — here the XLA path (fp32 upcast
+  option, pre-softmax scale, additive -10000 mask fill). Name kept so
+  reference configs load unchanged.
+- ``flash_attention``: selects the fused attention path (Pallas on TPU);
+  the softmax module itself becomes a no-op marker, as in the reference.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+
+import jax
+import jax.numpy as jnp
+from pydantic import Field
+
+from ..config import BaseConfig
+
+
+class MaskedSoftmaxKernel(Enum):
+    TORCH = "torch"  # plain XLA path (name kept for config parity)
+    FLASH_ATTENTION = "flash_attention"  # fused path (Pallas on TPU)
+
+
+class MaskedSoftmaxConfig(BaseConfig):
+    kernel: MaskedSoftmaxKernel = Field(
+        MaskedSoftmaxKernel.TORCH,
+        description="attention kernel: 'torch' = unfused XLA path, "
+        "'flash_attention' = fused Pallas flash attention",
+    )
+    softmax_in_fp32: bool = Field(
+        False,
+        description="Cast scores to fp32 before softmax for higher precision",
+    )
+    scale: float = Field(
+        1.0,
+        description="Scale scores are multiplied by (not divided!) before softmax",
+    )
+    deterministic_flash_attn_bwd: bool = Field(
+        False,
+        description="deterministic backward for the fused kernel (parity knob; "
+        "the Pallas kernel is always deterministic)",
+    )
+
+
+class MaskedSoftmax:
+    def __init__(self, config: MaskedSoftmaxConfig):
+        self.config = config
+
+    def __call__(self, scores: jax.Array, mask: jax.Array) -> jax.Array:
+        """scores: (b, n, s_q, s_k); mask: True where attention is FORBIDDEN."""
+        input_dtype = scores.dtype
+        if self.config.softmax_in_fp32 and scores.dtype != jnp.float32:
+            scores = scores.astype(jnp.float32)
+        if self.config.scale != 1.0:
+            scores = scores * self.config.scale
+        scores = jnp.where(mask, jnp.asarray(-10000.0, dtype=scores.dtype), scores)
+        probs = jax.nn.softmax(scores, axis=-1)
+        if self.config.softmax_in_fp32 and probs.dtype != input_dtype:
+            probs = probs.astype(input_dtype)
+        return probs

@@ -1,0 +1,143 @@
+"""Rotary position embeddings, both variants.
+
+Parity with the reference (reference: src/scaling/core/nn/rotary.py:142-255):
+
+- ``RotaryEmbedding``: GPT-NeoX-style half-rotation with precomputed cos/sin
+  tables, partial application via ``rotary_percentage`` (dimensions < head
+  dim), position-id gather;
+- ``RotaryEmbeddingComplex``: llama-style pairwise complex multiplication
+  (``freqs_cis``), which pairs adjacent dims instead of split halves.
+
+Layout is batch-major (b, s, n_heads, head_dim), vs the reference's
+(s, b, n, h). Tables are computed in fp32 and applied in the activation
+dtype (neox path) / fp32 (complex path), matching reference numerics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from pydantic import Field
+
+from ..config import BaseConfig
+
+
+class RotaryConfig(BaseConfig):
+    dimensions: int = Field(0, description="number of leading head dims to rotate")
+    base: int = Field(10000, description="rotary frequency base")
+    max_seq_length: int = Field(2048, description="table length")
+
+
+def _cos_sin_tables(dimensions: int, max_seq_length: int, base: float):
+    inv_freq = 1.0 / (base ** (jnp.arange(0, dimensions, 2, dtype=jnp.float32) / dimensions))
+    t = jnp.arange(max_seq_length, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # (s, d/2)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # (s, d)
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def rotate_half(x: jax.Array) -> jax.Array:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary_pos_emb(
+    x: jax.Array,  # (b, s, n, d_rot)
+    cos: jax.Array,  # (s_table, d_rot)
+    sin: jax.Array,
+    position_ids: Optional[jax.Array],  # (b, s) or None
+) -> jax.Array:
+    if position_ids is None:
+        s = x.shape[1]
+        cos_g = cos[None, :s, None, :]
+        sin_g = sin[None, :s, None, :]
+    else:
+        cos_g = cos[position_ids][:, :, None, :]  # (b, s, 1, d)
+        sin_g = sin[position_ids][:, :, None, :]
+    return x * cos_g.astype(x.dtype) + rotate_half(x) * sin_g.astype(x.dtype)
+
+
+class RotaryEmbedding:
+    """Half-rotation rotary, optionally applied to a leading slice of dims."""
+
+    def __init__(self, config: RotaryConfig):
+        assert config.dimensions > 1, "RotaryEmbedding cannot use dimensions <= 1"
+        self.dimensions = config.dimensions
+        self.cos, self.sin = _cos_sin_tables(config.dimensions, config.max_seq_length, config.base)
+
+    def __call__(
+        self,
+        query: jax.Array,  # (b, s, n, h)
+        key: jax.Array,  # (b, s, n_kv, h)
+        query_position_ids: Optional[jax.Array] = None,
+        key_position_ids: Optional[jax.Array] = None,
+    ) -> tuple[jax.Array, jax.Array]:
+        d = self.dimensions
+        if query.shape[-1] != d:
+            assert query.shape[-1] > d, f"query dims {query.shape[-1]} < rotary dims {d}"
+            q_rot = apply_rotary_pos_emb(query[..., :d], self.cos, self.sin, query_position_ids)
+            k_rot = apply_rotary_pos_emb(key[..., :d], self.cos, self.sin, key_position_ids)
+            query = jnp.concatenate([q_rot, query[..., d:]], axis=-1)
+            key = jnp.concatenate([k_rot, key[..., d:]], axis=-1)
+            return query, key
+        return (
+            apply_rotary_pos_emb(query, self.cos, self.sin, query_position_ids),
+            apply_rotary_pos_emb(key, self.cos, self.sin, key_position_ids),
+        )
+
+
+def precompute_freqs_cis(dim: int, end: int, theta: float) -> jax.Array:
+    """Complex rotation factors e^{i t f} as a (end, dim/2) complex64 array."""
+    freqs = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32)[: dim // 2] / dim))
+    t = jnp.arange(end, dtype=jnp.float32)
+    angles = jnp.outer(t, freqs)
+    return jax.lax.complex(jnp.cos(angles), jnp.sin(angles))
+
+
+def apply_complex_rotary_emb(
+    x: jax.Array,  # (b, s, n, h)
+    freqs_cis: jax.Array,  # (s_table, h/2) complex
+    position_ids: Optional[jax.Array],
+) -> jax.Array:
+    b, s, n, h = x.shape
+    xc = jax.lax.complex(
+        x.astype(jnp.float32)[..., 0::2], x.astype(jnp.float32)[..., 1::2]
+    )  # (b, s, n, h/2) pairing adjacent dims
+    if position_ids is None:
+        f = freqs_cis[None, :s, None, :]
+    else:
+        f = freqs_cis[position_ids][:, :, None, :]
+    rotated = xc * f
+    out = jnp.stack([jnp.real(rotated), jnp.imag(rotated)], axis=-1).reshape(b, s, n, h)
+    return out.astype(x.dtype)
+
+
+class RotaryEmbeddingComplex:
+    """Llama-style rotary via complex multiplication (adjacent-dim pairs)."""
+
+    def __init__(self, config: RotaryConfig):
+        assert config.dimensions > 1, "RotaryEmbedding cannot use dimensions <= 1"
+        self.freqs_cis = precompute_freqs_cis(
+            config.dimensions, config.max_seq_length, float(config.base)
+        )
+
+    def __call__(
+        self,
+        query: jax.Array,
+        key: jax.Array,
+        query_position_ids: Optional[jax.Array] = None,
+        key_position_ids: Optional[jax.Array] = None,
+    ) -> tuple[jax.Array, jax.Array]:
+        return (
+            apply_complex_rotary_emb(query, self.freqs_cis, query_position_ids),
+            apply_complex_rotary_emb(key, self.freqs_cis, key_position_ids),
+        )
+
+
+class RelativePositionEmbeddingType:
+    NONE = "none"
+    ROTARY = "rotary"
+    ROTARY_COMPLEX = "rotary_complex"

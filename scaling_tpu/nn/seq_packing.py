@@ -1,0 +1,127 @@
+"""Sequence-packing representations.
+
+The reference threads ``cumulative_seq_lengths`` (flash-attn varlen cu_seqlens)
+through the whole stack (reference: src/scaling/transformer/data/utils.py:4-108,
+core/nn/attention/attention.py:69-93). Under jit's static shapes the natural
+TPU representation is per-token **segment ids**: token t belongs to packed
+document ``segment_ids[b, t]``; attention is allowed only within equal
+segment ids. Both forms are supported — cu_seqlens (padded with -1, the
+reference's pipe-comm trick) converts to segment ids losslessly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def cumulative_seq_lengths_to_segment_ids(
+    cumulative_seq_lengths: jax.Array | np.ndarray,
+    batch_size: int,
+    seq_length: int,
+) -> jax.Array:
+    """cu_seqlens over the flattened (b*s) token stream -> (b, s) segment ids.
+
+    ``cumulative_seq_lengths`` is [0, e_1, e_2, ..., b*s] with -1 padding
+    allowed after the final entry (static-shape padding).
+    """
+    cu = jnp.asarray(cumulative_seq_lengths)
+    flat_positions = jnp.arange(batch_size * seq_length)
+    # segment id of a token = number of boundaries <= position (ignore pads)
+    valid = cu >= 0
+    boundaries = jnp.where(valid, cu, jnp.iinfo(jnp.int32).max)
+    seg = jnp.searchsorted(boundaries, flat_positions, side="right")
+    return seg.reshape(batch_size, seq_length).astype(jnp.int32)
+
+
+def segment_ids_to_mask(
+    segment_ids_q: jax.Array,  # (b, s_q)
+    segment_ids_k: Optional[jax.Array] = None,  # (b, s_k)
+    causal: bool = True,
+    positions_q: Optional[jax.Array] = None,  # (b, s_q) absolute positions
+    positions_k: Optional[jax.Array] = None,
+    local_window: Optional[int] = None,
+) -> jax.Array:
+    """Boolean mask (b, 1, s_q, s_k), True where attention is FORBIDDEN."""
+    if segment_ids_k is None:
+        segment_ids_k = segment_ids_q
+    b, s_q = segment_ids_q.shape
+    s_k = segment_ids_k.shape[1]
+    same_segment = segment_ids_q[:, :, None] == segment_ids_k[:, None, :]
+    allowed = same_segment
+    if causal or local_window is not None:
+        if positions_q is None:
+            positions_q = jnp.broadcast_to(jnp.arange(s_q)[None, :], (b, s_q))
+        if positions_k is None:
+            positions_k = jnp.broadcast_to(jnp.arange(s_k)[None, :], (b, s_k))
+        rel = positions_q[:, :, None] - positions_k[:, None, :]
+        if causal:
+            allowed = allowed & (rel >= 0)
+        if local_window is not None:
+            allowed = allowed & (jnp.abs(rel) <= local_window)
+    return ~allowed[:, None, :, :]
+
+
+def get_cumulative_seq_lengths(
+    token_ids: np.ndarray, reset_attention_mask: bool = True, eod_token: int = 0
+) -> np.ndarray:
+    """EOD-token splits over the flattened batch -> cu_seqlens.
+
+    (reference: src/scaling/transformer/data/utils.py:40-75). If
+    ``reset_attention_mask`` is False, one segment per batch row.
+    """
+    batch_size, seq_length = token_ids.shape
+    if not reset_attention_mask:
+        return np.arange(0, (batch_size + 1) * seq_length, seq_length, dtype=np.int32)
+    boundaries = [0]
+    flat = token_ids.reshape(-1)
+    for row in range(batch_size):
+        row_tokens = token_ids[row]
+        eods = np.where(row_tokens == eod_token)[0]
+        for e in eods:
+            pos = row * seq_length + int(e) + 1
+            if pos != boundaries[-1] and pos < flat.size:
+                boundaries.append(pos)
+        row_end = (row + 1) * seq_length
+        if boundaries[-1] != row_end:
+            boundaries.append(row_end)
+    return np.asarray(boundaries, dtype=np.int32)
+
+
+def get_position_ids(
+    token_ids: np.ndarray, reset_position_ids: bool = True, eod_token: int = 0
+) -> np.ndarray:
+    """Per-token positions, restarting at 0 after each EOD when resetting.
+
+    (reference: src/scaling/transformer/data/utils.py:78-108)
+    """
+    batch_size, seq_length = token_ids.shape
+    if not reset_position_ids:
+        return np.tile(np.arange(seq_length, dtype=np.int64), (batch_size, 1))
+    position_ids = np.zeros((batch_size, seq_length), dtype=np.int64)
+    for row in range(batch_size):
+        pos = 0
+        for t in range(seq_length):
+            position_ids[row, t] = pos
+            pos += 1
+            if token_ids[row, t] == eod_token:
+                pos = 0
+    return position_ids
+
+
+def add_cumulative_seq_lengths_padding(cu: np.ndarray, pad_to: int) -> np.ndarray:
+    """-1-pad to a fixed length (static shape under jit).
+
+    (reference: src/scaling/transformer/data/utils.py:4-38)
+    """
+    assert cu.size <= pad_to, f"cu_seqlens size {cu.size} exceeds pad length {pad_to}"
+    out = np.full((pad_to,), -1, dtype=np.int32)
+    out[: cu.size] = cu
+    return out
+
+
+def remove_cumulative_seq_lengths_padding(cu: np.ndarray) -> np.ndarray:
+    return np.asarray(cu)[np.asarray(cu) >= 0]

@@ -1,0 +1,17 @@
+from .sharding import (
+    constrain,
+    shard_activation_replicated_h,
+    shard_activation_sp,
+    shard_activation_tp,
+    shard_batch,
+    shard_param,
+)
+
+__all__ = [
+    "constrain",
+    "shard_activation_replicated_h",
+    "shard_activation_sp",
+    "shard_activation_tp",
+    "shard_batch",
+    "shard_param",
+]

@@ -1,0 +1,65 @@
+"""Sharding helpers: constraint-driven tensor parallelism.
+
+The reference implements TP with hand-written autograd collectives
+(reference: src/scaling/core/nn/linear/utils.py:20-361). On TPU the idiomatic
+equivalent is GSPMD: parameters and activations carry ``PartitionSpec``
+annotations and XLA inserts the all-reduce/all-gather/reduce-scatter pairs —
+including the transposed collectives for the backward pass — choosing
+ICI-friendly schedules. These helpers apply constraints only when a mesh with
+the named axis is active, so the same layer code runs on a single device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..topology.topology import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
+
+
+def _axis_in_mesh(mesh: Optional[Mesh], axis: str) -> bool:
+    return mesh is not None and axis in mesh.axis_names
+
+
+def constrain(x: jax.Array, mesh: Optional[Mesh], *spec) -> jax.Array:
+    """with_sharding_constraint that degrades to identity without a mesh."""
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
+def shard_batch(x: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
+    """Batch-leading activation: shard batch over the data axis."""
+    if not _axis_in_mesh(mesh, DATA_AXIS):
+        return x
+    return constrain(x, mesh, DATA_AXIS, *([None] * (x.ndim - 1)))
+
+
+def shard_activation_tp(x: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
+    """(b, s, h) activation inside a TP region: h sharded over model axis."""
+    if not _axis_in_mesh(mesh, MODEL_AXIS):
+        return x
+    return constrain(x, mesh, DATA_AXIS, None, MODEL_AXIS)
+
+
+def shard_activation_replicated_h(x: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
+    """(b, s, h) activation with h replicated (after TP all-reduce)."""
+    if mesh is None:
+        return x
+    return constrain(x, mesh, DATA_AXIS, None, None)
+
+
+def shard_activation_sp(x: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
+    """(b, s, h) activation between TP regions under sequence parallelism:
+    sequence sharded over the model axis (Megatron-style SP)."""
+    if not _axis_in_mesh(mesh, MODEL_AXIS):
+        return x
+    return constrain(x, mesh, DATA_AXIS, MODEL_AXIS, None)
+
+
+def shard_param(x: jax.Array, mesh: Optional[Mesh], spec: tuple) -> jax.Array:
+    if mesh is None:
+        return x
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))

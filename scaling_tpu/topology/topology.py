@@ -19,7 +19,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .config import ActivationCheckpointingType, PipePartitionMethod, TopologyConfig
+from .config import ActivationCheckpointingType, TopologyConfig
 
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
@@ -165,10 +165,3 @@ class Topology:
     def activate(self) -> Iterator[Mesh]:
         with self.mesh:
             yield self.mesh
-
-
-def build_device_grid(world_size: int) -> list[jax.Device]:
-    devices = jax.devices()
-    if len(devices) < world_size:
-        raise ValueError(f"need {world_size} devices, have {len(devices)}")
-    return list(devices[:world_size])

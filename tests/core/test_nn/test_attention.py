@@ -1,0 +1,181 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scaling_tpu.nn import (
+    ForwardContext,
+    LoRaConfig,
+    MaskedSoftmaxConfig,
+    ParallelSelfAttention,
+    RelativePositionEmbeddingType,
+    RotaryConfig,
+    cumulative_seq_lengths_to_segment_ids,
+    get_cumulative_seq_lengths,
+    get_position_ids,
+    segment_ids_to_mask,
+)
+
+CTX = ForwardContext()
+
+
+def make_attention(**kwargs):
+    defaults = dict(
+        hidden_size=32,
+        num_attention_heads=4,
+        rotary_config=RotaryConfig(dimensions=8, max_seq_length=64),
+        relative_position_embedding_type=RelativePositionEmbeddingType.ROTARY,
+        bias=True,
+    )
+    defaults.update(kwargs)
+    return ParallelSelfAttention(**defaults)
+
+
+def test_causality():
+    """Changing a future token must not change past outputs."""
+    attn = make_attention()
+    params = attn.init(jax.random.PRNGKey(0))
+    x1 = jax.random.normal(jax.random.PRNGKey(1), (1, 8, 32))
+    x2 = x1.at[:, 6].set(99.0)
+    y1 = attn(params, x1, CTX)
+    y2 = attn(params, x2, CTX)
+    np.testing.assert_allclose(np.asarray(y1[:, :6]), np.asarray(y2[:, :6]), atol=1e-5)
+    assert not np.allclose(np.asarray(y1[:, 6:]), np.asarray(y2[:, 6:]))
+
+
+def test_segment_isolation():
+    """Packed documents must not attend across segment boundaries."""
+    attn = make_attention(relative_position_embedding_type=RelativePositionEmbeddingType.NONE)
+    params = attn.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 8, 32))
+    seg = jnp.array([[0, 0, 0, 0, 1, 1, 1, 1]])
+    # perturb a token in segment 0; segment 1 outputs must be unchanged
+    x2 = x.at[:, 1].set(50.0)
+    y1 = attn(params, x, CTX, segment_ids=seg)
+    y2 = attn(params, x2, CTX, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(y1[:, 4:]), np.asarray(y2[:, 4:]), atol=1e-5)
+    assert not np.allclose(np.asarray(y1[:, 1:4]), np.asarray(y2[:, 1:4]))
+
+
+def test_gqa_matches_mha_when_kv_repeated():
+    """GQA with kv weights replicated equals full MHA."""
+    mha = make_attention(qkv_in_one=False)
+    gqa = make_attention(qkv_in_one=False, num_kv_heads=2)
+    params = gqa.init(jax.random.PRNGKey(0))
+    # build MHA params by repeating each kv head's slice
+    head_dim = 8
+    mp = {k: dict(v) for k, v in params.items()}
+    for name in ("key", "value"):
+        w = np.asarray(params[name]["weight"]).reshape(32, 2, head_dim)
+        w_rep = np.repeat(w, 2, axis=1).reshape(32, 32)
+        b = np.asarray(params[name]["bias"]).reshape(2, head_dim)
+        b_rep = np.repeat(b, 2, axis=0).reshape(32)
+        mp[name]["weight"] = jnp.asarray(w_rep)
+        mp[name]["bias"] = jnp.asarray(b_rep)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 32))
+    np.testing.assert_allclose(
+        np.asarray(gqa(params, x, CTX)), np.asarray(mha(mp, x, CTX)), atol=1e-5
+    )
+
+
+def test_local_window_limits_range():
+    attn = make_attention(
+        relative_position_embedding_type=RelativePositionEmbeddingType.NONE,
+        num_local_attention_heads=4,
+        local_attention_window_size=2,
+    )
+    params = attn.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 10, 32))
+    # token 9 attends to [7, 9]; perturbing token 0 must not affect it
+    x2 = x.at[:, 0].set(77.0)
+    y1 = attn(params, x, CTX)
+    y2 = attn(params, x2, CTX)
+    np.testing.assert_allclose(np.asarray(y1[:, 9]), np.asarray(y2[:, 9]), atol=1e-5)
+    assert not np.allclose(np.asarray(y1[:, 1]), np.asarray(y2[:, 1]))
+
+
+def test_mixed_local_global_heads_differ_from_all_global():
+    base = make_attention(relative_position_embedding_type=RelativePositionEmbeddingType.NONE)
+    mixed = make_attention(
+        relative_position_embedding_type=RelativePositionEmbeddingType.NONE,
+        num_local_attention_heads=2,
+        local_attention_window_size=1,
+    )
+    params = base.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 12, 32))
+    assert not np.allclose(np.asarray(base(params, x, CTX)), np.asarray(mixed(params, x, CTX)))
+
+
+def test_kv_cache_matches_full_forward():
+    """Incremental decode with KV cache == full recompute."""
+    attn = make_attention()
+    params = attn.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 6, 32))
+    pos = jnp.arange(6)[None, :]
+    full = attn(params, x, CTX, position_ids=pos)
+
+    max_len = 8
+    cache = (
+        jnp.zeros((1, max_len, 4, 8)),
+        jnp.zeros((1, max_len, 4, 8)),
+    )
+    outs = []
+    for t in range(6):
+        y, cache = attn(
+            params,
+            x[:, t : t + 1],
+            CTX,
+            position_ids=jnp.array([[t]]),
+            kv_cache=cache,
+            cache_offset=jnp.int32(t),
+        )
+        outs.append(y)
+    incremental = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(incremental), atol=1e-4)
+
+
+def test_lora_zero_init_is_identity_and_merge():
+    lora_cfg = LoRaConfig(rank=4, alpha=4)
+    plain = make_attention(qkv_in_one=False)
+    lora = make_attention(qkv_in_one=False, lora_config=lora_cfg)
+    params = lora.init(jax.random.PRNGKey(0))
+    plain_params = {k: v for k, v in params.items() if "default_lora" not in k}
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 8, 32))
+    # B zero-init -> output identical to plain attention
+    np.testing.assert_allclose(
+        np.asarray(lora(params, x, CTX)), np.asarray(plain(plain_params, x, CTX)), atol=1e-6
+    )
+    # train-like perturbation of B, then merge must equal unmerged forward
+    params2 = jax.tree.map(lambda p: p, params)
+    for name in list(params2):
+        if "default_lora" in name:
+            params2[name] = dict(params2[name])
+            params2[name]["lora_b"] = (
+                jax.random.normal(jax.random.PRNGKey(2), params2[name]["lora_b"].shape) * 0.02
+            )
+    y_unmerged = lora(params2, x, CTX)
+    merged = lora.merge_lora_weights(params2)
+    merged_plain = {k: v for k, v in merged.items() if "default_lora" not in k}
+    y_merged = plain(merged_plain, x, CTX)
+    np.testing.assert_allclose(np.asarray(y_unmerged), np.asarray(y_merged), atol=1e-5)
+
+
+def test_cu_seqlens_to_segment_ids():
+    cu = np.array([0, 3, 8, 16, -1, -1])
+    seg = cumulative_seq_lengths_to_segment_ids(cu, batch_size=2, seq_length=8)
+    np.testing.assert_array_equal(
+        np.asarray(seg),
+        [[1, 1, 1, 2, 2, 2, 2, 2], [3, 3, 3, 3, 3, 3, 3, 3]],
+    )
+
+
+def test_get_cumulative_seq_lengths_eod():
+    tokens = np.array([[5, 0, 7, 8], [1, 2, 3, 0]])
+    cu = get_cumulative_seq_lengths(tokens, eod_token=0)
+    np.testing.assert_array_equal(cu, [0, 2, 4, 8])
+
+
+def test_get_position_ids_reset():
+    tokens = np.array([[5, 0, 7, 8], [1, 2, 3, 4]])
+    pos = get_position_ids(tokens, eod_token=0)
+    np.testing.assert_array_equal(pos, [[0, 1, 0, 1], [0, 1, 2, 3]])

@@ -1,0 +1,107 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scaling_tpu.nn import (
+    ForwardContext,
+    LayerNorm,
+    LayerNormConfig,
+    RMSNorm,
+    RotaryConfig,
+    RotaryEmbedding,
+    RotaryEmbeddingComplex,
+)
+
+CTX = ForwardContext()
+
+
+def test_layernorm_matches_reference_semantics():
+    ln = LayerNorm(16)
+    params = ln.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 4, 16))
+    y = ln(params, x, CTX)
+    np.testing.assert_allclose(np.asarray(y).mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y).std(-1), 1.0, atol=1e-2)
+
+
+def test_layernorm_affine():
+    ln = LayerNorm(8)
+    params = {"weight": jnp.full((8,), 2.0), "bias": jnp.full((8,), 1.0)}
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 8))
+    y = ln(params, x, CTX)
+    base = ln({"weight": jnp.ones(8), "bias": jnp.zeros(8)}, x, CTX)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(base) * 2 + 1, atol=1e-5)
+
+
+def test_rmsnorm():
+    rn = RMSNorm(16, LayerNormConfig(layernorm_epsilon=1e-6))
+    params = rn.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 4, 16))
+    y = rn(params, x, CTX)
+    want = np.asarray(x) / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(np.asarray(y), want, atol=1e-5)
+
+
+def test_rotary_preserves_inner_products_under_shift():
+    """Rotary is relative: <q_i, k_j> depends only on i - j."""
+    cfg = RotaryConfig(dimensions=16, base=10000, max_seq_length=64)
+    rot = RotaryEmbedding(cfg)
+    q = jnp.ones((1, 64, 1, 16))
+    k = jnp.ones((1, 64, 1, 16))
+    qr, kr = rot(q, k)
+    scores = np.einsum("bqnh,bknh->bqk", np.asarray(qr), np.asarray(kr))[0]
+    # same relative offset -> same score
+    np.testing.assert_allclose(scores[10, 5], scores[20, 15], rtol=1e-5)
+    np.testing.assert_allclose(scores[3, 1], scores[33, 31], rtol=1e-5)
+
+
+def test_rotary_partial_dims_passthrough():
+    cfg = RotaryConfig(dimensions=8, base=10000, max_seq_length=32)
+    rot = RotaryEmbedding(cfg)
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 2, 16))
+    k = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 2, 16))
+    qr, kr = rot(q, k)
+    # dims beyond `dimensions` untouched
+    np.testing.assert_array_equal(np.asarray(qr[..., 8:]), np.asarray(q[..., 8:]))
+    assert not np.allclose(np.asarray(qr[..., :8]), np.asarray(q[..., :8]))
+
+
+def test_rotary_position_ids_gather():
+    cfg = RotaryConfig(dimensions=16, base=10000, max_seq_length=64)
+    rot = RotaryEmbedding(cfg)
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 4, 1, 16))
+    k = q
+    # positions [5, 6, 7, 8] should equal slicing a longer sequence
+    pos = jnp.array([[5, 6, 7, 8]])
+    qr_pos, _ = rot(q, k, query_position_ids=pos, key_position_ids=pos)
+    q_long = jnp.zeros((1, 9, 1, 16)).at[:, 5:9].set(q)
+    qr_long, _ = rot(q_long, q_long)
+    np.testing.assert_allclose(np.asarray(qr_pos), np.asarray(qr_long[:, 5:9]), atol=1e-5)
+
+
+def test_rotary_complex_relative():
+    cfg = RotaryConfig(dimensions=16, base=10000, max_seq_length=64)
+    rot = RotaryEmbeddingComplex(cfg)
+    q = jnp.ones((1, 64, 1, 16))
+    qr, kr = rot(q, q)
+    scores = np.einsum("bqnh,bknh->bqk", np.asarray(qr), np.asarray(kr))[0]
+    np.testing.assert_allclose(scores[10, 5], scores[20, 15], rtol=1e-5)
+
+
+def test_rotary_complex_matches_torch_reference_formula():
+    """Cross-check the complex rotary against a direct torch-style impl."""
+    import torch
+
+    dim, seq = 8, 12
+    theta = 10000.0
+    freqs = 1.0 / (theta ** (torch.arange(0, dim, 2)[: dim // 2].float() / dim))
+    t = torch.arange(seq)
+    freqs_cis = torch.polar(torch.ones(seq, dim // 2), torch.outer(t.float(), freqs))
+    x = torch.randn(1, seq, 2, dim)
+    xc = torch.view_as_complex(x.reshape(1, seq, 2, dim // 2, 2))
+    want = torch.view_as_real(xc * freqs_cis.view(1, seq, 1, dim // 2)).flatten(3)
+
+    rot = RotaryEmbeddingComplex(RotaryConfig(dimensions=dim, base=10000, max_seq_length=seq))
+    got, _ = rot(jnp.asarray(x.numpy()), jnp.asarray(x.numpy()))
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), atol=1e-5)
