@@ -1,0 +1,61 @@
+"""MLP example config tree (reference: examples/mlp_example/config.py)."""
+
+from __future__ import annotations
+
+from pydantic import Field
+
+from scaling_tpu.config import BaseConfig
+from scaling_tpu.logging import LoggerConfig
+from scaling_tpu.optimizer import (
+    LearningRateSchedulerConfig,
+    OptimizerConfig,
+)
+from scaling_tpu.topology import TopologyConfig
+from scaling_tpu.trainer import TrainerConfig
+
+
+class TrainingConfig(BaseConfig):
+    weight_decay: float = Field(0.0001, description="")
+
+
+class MLPArchitectureConfig(BaseConfig):
+    n_hidden_layers: int = Field(3, description="number of hidden layers")
+    hidden_dim: int = Field(128, description="hidden dimension")
+    input_dim: int = Field(784, description="input dimension (28*28)")
+    num_classes: int = Field(10, description="")
+
+
+class RunnerConfig(BaseConfig):
+    """Kept for config-file parity; single-controller launch ignores it."""
+
+    runner_type: str = Field("pdsh", description="Type of the runner to be invoked.")
+    hostsfile: str | None = Field(None, description="")
+    hosts: list | None = Field(None, description="")
+    master_port: int = Field(29500, description="")
+    master_addr: str | None = Field(None, description="")
+    script: str | None = Field(None, description="")
+    default_gpu_count: int = Field(8, description="")
+    docker_config: dict | None = Field(None, description="")
+    use_determined: bool = Field(False, description="")
+
+
+class MLPConfig(BaseConfig):
+    runner: RunnerConfig = Field(RunnerConfig(), description="")
+    topology: TopologyConfig = Field(
+        TopologyConfig(
+            model_parallel_size=1,
+            pipe_parallel_size=1,
+            data_parallel_size=1,
+            micro_batch_size=256,
+            gradient_accumulation_steps=1,
+        ),
+        description="",
+    )
+    optimizer: OptimizerConfig = Field(OptimizerConfig(), description="")
+    learning_rate_scheduler: LearningRateSchedulerConfig = Field(
+        LearningRateSchedulerConfig(), description=""
+    )
+    training: TrainingConfig = Field(TrainingConfig(), description="")
+    trainer: TrainerConfig = Field(TrainerConfig(), description="")
+    logger: LoggerConfig = Field(LoggerConfig(), description="")
+    architecture: MLPArchitectureConfig = Field(MLPArchitectureConfig(), description="")

@@ -1,0 +1,7 @@
+"""(reference: examples/mlp_example/context.py)"""
+
+from scaling_tpu.context import BaseContext
+
+
+class MLPContext(BaseContext):
+    pass

@@ -1,0 +1,87 @@
+"""MNIST (or synthetic fallback) dataset for the MLP example.
+
+(reference: examples/mlp_example/data.py). The reference downloads MNIST via
+torchvision; in offline environments a deterministic synthetic "digits"
+classification set is generated instead — structured so losses fall under
+training (class-dependent gaussian blobs over 784 dims).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from scaling_tpu.data import BaseDataset
+
+
+class MNISTDatasetBatch:
+    def __init__(self, inputs=None, targets=None):
+        self.inputs = inputs
+        self.targets = targets
+
+    def only_inputs(self):
+        return MNISTDatasetBatch(inputs=self.inputs)
+
+    def only_targets(self):
+        return MNISTDatasetBatch(targets=self.targets)
+
+
+def _load_mnist(root: Path, train: bool):
+    try:  # pragma: no cover - requires local MNIST
+        import torchvision
+        from torchvision import transforms
+
+        t = transforms.Compose(
+            [transforms.ToTensor(), transforms.Normalize((0.5,), (0.5,))]
+        )
+        ds = torchvision.datasets.MNIST(
+            root=root, train=train, transform=t, download=False
+        )
+        xs = np.stack([np.asarray(ds[i][0]).reshape(-1) for i in range(len(ds))])
+        ys = np.asarray([ds[i][1] for i in range(len(ds))])
+        return xs.astype(np.float32), ys.astype(np.int32)
+    except Exception:
+        return None
+
+
+def _synthetic_digits(n: int, seed: int):
+    # class centers are a fixed property of the "dataset", shared between
+    # train and eval splits; only the sample noise differs by seed
+    centers = np.random.RandomState(1234).randn(10, 784).astype(np.float32) * 1.5
+    rng = np.random.RandomState(seed)
+    ys = rng.randint(0, 10, size=n).astype(np.int32)
+    xs = centers[ys] + rng.randn(n, 784).astype(np.float32)
+    return xs, ys
+
+
+class MNISTDataset(BaseDataset):
+    def __init__(self, root: Path = Path("./.data"), train: bool = True, seed: int = 42):
+        loaded = _load_mnist(root, train)
+        if loaded is None:
+            loaded = _synthetic_digits(60000 if train else 10000, seed if train else seed + 1)
+        self.xs, self.ys = loaded
+        self._order = np.arange(len(self.ys))
+        super().__init__(seed=seed)
+
+    def ident(self) -> str:
+        return "MNIST"
+
+    def __len__(self) -> int:
+        return len(self.ys)
+
+    def __getitem__(self, index: int):
+        i = int(self._order[index])
+        return (self.xs[i], self.ys[i])
+
+    def set_seed(self, seed: int, shuffle: bool = True) -> None:
+        self.seed = seed
+        self._order = np.arange(len(getattr(self, "ys", [])))
+        if shuffle and len(self._order):
+            np.random.RandomState(seed).shuffle(self._order)
+
+    def collate(self, batch: list) -> MNISTDatasetBatch:
+        return MNISTDatasetBatch(
+            inputs=np.stack([b[0] for b in batch]),
+            targets=np.stack([b[1] for b in batch]),
+        )

@@ -1,0 +1,114 @@
+"""MLP model assembled from framework layers.
+
+(reference: examples/mlp_example/model.py) — column-parallel input layer,
+row-parallel hidden layers, cross-entropy loss; the batch travels as a dict
+pytree through the layer stack.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from scaling_tpu.nn import (
+    BaseLayer,
+    ColumnParallelLinear,
+    ForwardContext,
+    LayerSpec,
+    RowParallelLinear,
+    tree_prefix,
+)
+from scaling_tpu.optimizer import Optimizer, OptimizerParamGroup
+from scaling_tpu.parallel.parallel_module import ParallelModule
+
+from .config import MLPConfig
+
+
+class InputLayer(BaseLayer):
+    """Carries (inputs, targets) dict in; emits activations + targets."""
+
+    def __init__(self, input_dim: int, hidden_dim: int):
+        self.linear = ColumnParallelLinear(input_dim, hidden_dim, parallel_output=False)
+
+    def init(self, key):
+        return {"linear": self.linear.init(key)}
+
+    def param_metas(self):
+        return {"linear": tree_prefix(self.linear.param_metas(), "linear")}
+
+    def __call__(self, params, x: dict, ctx: ForwardContext):
+        h = self.linear(params["linear"], x["inputs"], ctx)
+        return {"activations": jax.nn.relu(h), "targets": x["targets"]}
+
+
+class HiddenLayer(BaseLayer):
+    def __init__(self, hidden_dim: int):
+        self.linear = RowParallelLinear(hidden_dim, hidden_dim, parallel_input=False)
+
+    def init(self, key):
+        return {"linear": self.linear.init(key)}
+
+    def param_metas(self):
+        return {"linear": tree_prefix(self.linear.param_metas(), "linear")}
+
+    def __call__(self, params, x: dict, ctx: ForwardContext):
+        h = self.linear(params["linear"], x["activations"], ctx)
+        return {"activations": jax.nn.relu(h), "targets": x["targets"]}
+
+
+class HeadLayer(BaseLayer):
+    def __init__(self, hidden_dim: int, num_classes: int):
+        self.linear = ColumnParallelLinear(hidden_dim, num_classes, parallel_output=False)
+
+    def init(self, key):
+        return {"linear": self.linear.init(key)}
+
+    def param_metas(self):
+        return {"linear": tree_prefix(self.linear.param_metas(), "linear")}
+
+    def __call__(self, params, x: dict, ctx: ForwardContext):
+        logits = self.linear(params["linear"], x["activations"], ctx)
+        return {"logits": logits, "targets": x["targets"]}
+
+
+def loss_function(output: dict, _batch: Any):
+    logits = output["logits"].astype(jnp.float32)
+    targets = output["targets"].astype(jnp.int32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    loss = -jnp.take_along_axis(logp, targets[:, None], axis=-1).mean()
+    accuracy = (logits.argmax(-1) == targets).mean()
+    return loss, {"accuracy": accuracy}
+
+
+def get_layer_specs(config: MLPConfig) -> list[LayerSpec]:
+    arch = config.architecture
+    specs = [LayerSpec(InputLayer, arch.input_dim, arch.hidden_dim)]
+    for _ in range(arch.n_hidden_layers):
+        specs.append(LayerSpec(HiddenLayer, arch.hidden_dim))
+    specs.append(LayerSpec(HeadLayer, arch.hidden_dim, arch.num_classes))
+    return specs
+
+
+def init_model(config: MLPConfig, topology) -> ParallelModule:
+    return ParallelModule(get_layer_specs(config), topology=topology)
+
+
+def init_optimizer(config: MLPConfig, module: ParallelModule, topology) -> Optimizer:
+    metas = module.param_metas()
+    from scaling_tpu.nn.param import ParamMeta
+
+    keys = {
+        m.key
+        for m in jax.tree.leaves(metas, is_leaf=lambda x: isinstance(x, ParamMeta))
+    }
+    groups = [
+        OptimizerParamGroup(
+            keys=keys,
+            weight_decay=config.training.weight_decay,
+            learning_rate_scheduler=config.learning_rate_scheduler,
+            name="param_group",
+        )
+    ]
+    return Optimizer(config.optimizer, groups, metas, topology=topology)

@@ -1,0 +1,13 @@
+"""Launch the MLP example: ``python -m examples.mlp_example.run [config.yml]``"""
+
+import sys
+
+from .config import MLPConfig
+from .train import main
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1:
+        config = MLPConfig.from_yaml(sys.argv[1])
+    else:
+        config = MLPConfig()
+    main(config)

@@ -1,0 +1,39 @@
+"""MLP example training entry (reference: examples/mlp_example/train.py)."""
+
+from __future__ import annotations
+
+from scaling_tpu.logging import logger
+from scaling_tpu.topology import Topology
+from scaling_tpu.trainer import BaseTrainer
+
+from .config import MLPConfig
+from .context import MLPContext
+from .data import MNISTDataset
+from .model import init_model, init_optimizer, loss_function
+
+
+def batch_to_model_input(batch):
+    return {"inputs": batch.inputs, "targets": batch.targets}
+
+
+def main(config: MLPConfig) -> BaseTrainer:
+    topology = Topology(config.topology)
+    logger.configure(config.logger, name="mlp_example")
+    logger.log_config(config)
+    context = MLPContext(config=config, topology=topology)
+    module = init_model(config, topology)
+    optimizer = init_optimizer(config, module, topology)
+    dataset = MNISTDataset(train=True, seed=config.trainer.seed)
+    trainer = BaseTrainer(
+        config=config.trainer,
+        context=context,
+        parallel_module=module,
+        optimizer=optimizer,
+        loss_function=loss_function,
+        dataset=dataset,
+        dataset_evaluation=MNISTDataset(train=False, seed=config.trainer.seed),
+        batch_to_model_input=batch_to_model_input,
+    )
+    trainer.initialize(load_checkpoint=config.trainer.load_dir is not None)
+    trainer.run_training()
+    return trainer

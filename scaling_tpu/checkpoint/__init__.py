@@ -1,0 +1,13 @@
+from .checkpoint import (
+    load_model_checkpoint,
+    load_optimizer_checkpoint,
+    save_model_checkpoint,
+    save_optimizer_checkpoint,
+)
+
+__all__ = [
+    "load_model_checkpoint",
+    "load_optimizer_checkpoint",
+    "save_model_checkpoint",
+    "save_optimizer_checkpoint",
+]

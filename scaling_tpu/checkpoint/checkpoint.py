@@ -1,0 +1,230 @@
+"""Layout-independent checkpoints.
+
+Mirrors the reference's artifact families
+(reference: src/scaling/core/nn/parallel_module/partitioned_module.py:197-371,
+optimizer.py:335-734): per-layer model files named
+``model_state_layer_{i}_{ClassName}.npz`` holding merged (unsharded) arrays
+keyed by parameter path; per-layer optimizer files
+``optimizer_state_layer_{i}.npz`` with master/exp_avg/exp_avg_sq; parameters
+matched by ``ParamMeta.key`` so checkpoints survive topology changes (jax
+re-shards on load via the current metas — the reference's merge/split
+broadcast loops disappear).
+
+Non-strict loading supports the reference's PEFT workflows: regex lists of
+allowed-missing keys (fresh adapters), allowed-unexpected keys (dropping a
+finetune), and ignored keys (reinit parts of a pretrained model).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..logging import logger
+from ..nn.param import ParamMeta
+
+
+def _meta_leaves(metas: Any) -> list[ParamMeta]:
+    return jax.tree.leaves(metas, is_leaf=lambda x: isinstance(x, ParamMeta))
+
+
+def _grouped_by_layer(params: Any, metas: Any):
+    """-> {(layer_index, layer_class): {param_name: array}}"""
+    p_leaves = jax.tree.leaves(params)
+    m_leaves = _meta_leaves(metas)
+    assert len(p_leaves) == len(m_leaves), (
+        f"params/metas mismatch: {len(p_leaves)} vs {len(m_leaves)}"
+    )
+    groups: dict = {}
+    for p, m in zip(p_leaves, m_leaves):
+        groups.setdefault((m.layer_index, m.layer_class_name), {})[m.parameter_name] = p
+    return groups
+
+
+def save_model_checkpoint(
+    dir: Path | str,
+    params: Any,
+    metas: Any,
+    separate_file_for_parameters: Optional[List[str]] = None,
+) -> None:
+    """One npz per layer; PEFT params split into ``..._{name}.npz`` files."""
+    path = Path(dir)
+    path.mkdir(parents=True, exist_ok=True)
+    for (layer_index, layer_class), group in _grouped_by_layer(params, metas).items():
+        main = {}
+        separate: dict[str, dict] = {}
+        for name, arr in group.items():
+            target = None
+            for sep in separate_file_for_parameters or []:
+                if sep in name:
+                    target = sep
+                    break
+            np_arr = np.asarray(jax.device_get(arr))
+            if target is None:
+                main[name] = np_arr
+            else:
+                separate.setdefault(target, {})[name] = np_arr
+        fname = f"model_state_layer_{layer_index}_{layer_class}.npz"
+        if main:
+            np.savez(path / fname, **main)
+        # double underscore separates the PEFT suffix from the class name so
+        # the loader can recover the class unambiguously
+        for sep, group_arrs in separate.items():
+            sep_name = f"model_state_layer_{layer_index}_{layer_class}__{sep}.npz"
+            np.savez(path / sep_name, **group_arrs)
+
+
+def _compile_patterns(patterns: Optional[List[str]]) -> list:
+    return [re.compile(p) for p in (patterns or [])]
+
+
+def _matches_any(key: str, patterns: list) -> bool:
+    return any(p.search(key) for p in patterns)
+
+
+def load_model_checkpoint(
+    dir: Path | str,
+    params: Any,
+    metas: Any,
+    allowed_missing_keys: Optional[List[str]] = None,
+    allowed_unexpected_keys: Optional[List[str]] = None,
+    ignore_keys: Optional[List[str]] = None,
+) -> Any:
+    """Returns a new params tree with checkpoint values loaded by key.
+
+    Missing/unexpected keys raise unless matched by the corresponding
+    allow-list regexes; ``ignore_keys`` keeps current (re-initialised)
+    values even when the checkpoint has them.
+    """
+    path = Path(dir)
+    allowed_missing = _compile_patterns(allowed_missing_keys)
+    allowed_unexpected = _compile_patterns(allowed_unexpected_keys)
+    ignore = _compile_patterns(ignore_keys)
+
+    # index checkpoint contents: key -> (file, param_name)
+    available: dict[str, tuple[Path, str]] = {}
+    for f in sorted(path.glob("model_state_layer_*.npz")):
+        with np.load(f) as z:
+            stem = f.stem  # model_state_layer_{i}_{Class}[_{sep}]
+            m = re.match(r"model_state_layer_(\d+)_(.+)", stem)
+            layer_index = int(m.group(1))
+            layer_class = m.group(2).split("__")[0]
+            for name in z.files:
+                key = f"layer_{layer_index}_{layer_class}.{name}"
+                available[key] = (f, name)
+
+    p_leaves, treedef = jax.tree.flatten(params)
+    m_leaves = _meta_leaves(metas)
+    model_keys = [m.key for m in m_leaves]
+
+    missing = [
+        k for k in model_keys if k not in available and not _matches_any(k, allowed_missing)
+    ]
+    unexpected = [
+        k for k in available if k not in set(model_keys) and not _matches_any(k, allowed_unexpected)
+    ]
+    if missing:
+        raise KeyError(f"checkpoint missing parameters: {missing[:8]}{'...' if len(missing) > 8 else ''}")
+    if unexpected:
+        raise KeyError(f"checkpoint has unexpected parameters: {unexpected[:8]}{'...' if len(unexpected) > 8 else ''}")
+
+    # load per-file lazily
+    cache: dict[Path, Any] = {}
+    new_leaves = []
+    for p, m in zip(p_leaves, m_leaves):
+        key = m.key
+        if key not in available or _matches_any(key, ignore):
+            new_leaves.append(p)
+            continue
+        f, name = available[key]
+        if f not in cache:
+            cache[f] = np.load(f)
+        arr = cache[f][name]
+        if tuple(arr.shape) != tuple(p.shape):
+            raise ValueError(
+                f"shape mismatch for {key}: checkpoint {arr.shape} vs model {p.shape}"
+            )
+        new_leaves.append(
+            jax.device_put(jnp.asarray(arr, dtype=p.dtype), p.sharding)
+            if hasattr(p, "sharding")
+            else jnp.asarray(arr, dtype=p.dtype)
+        )
+    for z in cache.values():
+        z.close()
+    return jax.tree.unflatten(treedef, new_leaves)
+
+
+def save_optimizer_checkpoint(dir: Path | str, opt_state, metas: Any) -> None:
+    path = Path(dir)
+    path.mkdir(parents=True, exist_ok=True)
+    m_leaves = _meta_leaves(metas)
+
+    for field in ("master", "exp_avg", "exp_avg_sq"):
+        tree = getattr(opt_state, field)
+        groups = _grouped_by_layer(tree, metas)
+        for (layer_index, _layer_class), group in groups.items():
+            fname = path / f"optimizer_state_layer_{layer_index}_{field}.npz"
+            existing = {}
+            if fname.exists():
+                with np.load(fname) as z:
+                    existing = {k: z[k] for k in z.files}
+            existing.update({k: np.asarray(jax.device_get(v)) for k, v in group.items()})
+            np.savez(fname, **existing)
+
+    scalars = {
+        "step": int(opt_state.step),
+        "loss_scaler": {
+            "current_scale": float(opt_state.loss_scaler.current_scale),
+            "current_hysteresis": float(opt_state.loss_scaler.current_hysteresis),
+            "no_overflow_steps": int(opt_state.loss_scaler.no_overflow_steps),
+        },
+    }
+    (path / "optimizer_state.json").write_text(json.dumps(scalars))
+
+
+def load_optimizer_checkpoint(dir: Path | str, opt_state, metas: Any):
+    """Returns a new OptimizerState with loaded master/moments/scalars."""
+    from ..optimizer.optimizer import OptimizerState
+    from ..optimizer.loss_scaler import LossScalerState
+
+    path = Path(dir)
+    m_leaves = _meta_leaves(metas)
+
+    def load_tree(field: str, current):
+        c_leaves, treedef = jax.tree.flatten(current)
+        new_leaves = []
+        cache: dict[Path, Any] = {}
+        for p, m in zip(c_leaves, m_leaves):
+            f = path / f"optimizer_state_layer_{m.layer_index}_{field}.npz"
+            if not f.exists():
+                raise FileNotFoundError(f"optimizer checkpoint file missing: {f}")
+            if f not in cache:
+                cache[f] = np.load(f)
+            arr = cache[f][m.parameter_name]
+            new_leaves.append(
+                jax.device_put(jnp.asarray(arr, dtype=p.dtype), p.sharding)
+                if hasattr(p, "sharding")
+                else jnp.asarray(arr, dtype=p.dtype)
+            )
+        for z in cache.values():
+            z.close()
+        return jax.tree.unflatten(treedef, new_leaves)
+
+    scalars = json.loads((path / "optimizer_state.json").read_text())
+    return OptimizerState(
+        step=jnp.asarray(scalars["step"], jnp.int32),
+        master=load_tree("master", opt_state.master),
+        exp_avg=load_tree("exp_avg", opt_state.exp_avg),
+        exp_avg_sq=load_tree("exp_avg_sq", opt_state.exp_avg_sq),
+        loss_scaler=LossScalerState(
+            current_scale=jnp.asarray(scalars["loss_scaler"]["current_scale"], jnp.float32),
+            current_hysteresis=jnp.asarray(scalars["loss_scaler"]["current_hysteresis"], jnp.float32),
+            no_overflow_steps=jnp.asarray(scalars["loss_scaler"]["no_overflow_steps"], jnp.int32),
+        ),
+    )

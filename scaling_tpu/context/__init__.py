@@ -1,0 +1,3 @@
+from .context import BaseContext, ContextConfig
+
+__all__ = ["BaseContext", "ContextConfig"]

@@ -1,0 +1,72 @@
+"""Per-run state: iteration counters and RNG.
+
+(reference: src/scaling/core/context/context.py:31-162). The reference
+checkpoints the full CUDA/torch RNG state per global rank; with stateless
+jax keys the whole RNG state is (seed, iteration counters) — keys are
+re-derived, so resume is exact by construction and the MAX-allreduce resync
+for relayouts disappears.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+from ..config import BaseConfig
+from ..topology import RngTracker, Topology
+
+
+class ContextConfig(BaseConfig):
+    """Marker base for trainer-facing config trees (subclasses add fields)."""
+
+
+class BaseContext:
+    def __init__(self, config: Any, topology: Topology):
+        self.config = config
+        self.topology = topology
+        self.iterations = 0
+        self.consumed_samples = 0
+        self.consumed_eval_samples = 0
+        self._rng: Optional[RngTracker] = None
+
+    def initialize(self, seed: int) -> None:
+        self.seed = seed
+        self._rng = RngTracker(seed)
+
+    @property
+    def rng(self) -> RngTracker:
+        assert self._rng is not None, "context not initialized; call initialize(seed)"
+        return self._rng
+
+    def step(self) -> None:
+        self.iterations += 1
+        self.consumed_samples += self.topology.config.global_batch_size
+
+    # ---------------------------------------------------------- checkpoint
+    def state_dict(self) -> dict:
+        return {
+            "iterations": self.iterations,
+            "consumed_samples": self.consumed_samples,
+            "consumed_eval_samples": self.consumed_eval_samples,
+            "seed": getattr(self, "seed", None),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.iterations = int(state["iterations"])
+        self.consumed_samples = int(state["consumed_samples"])
+        self.consumed_eval_samples = int(state.get("consumed_eval_samples", 0))
+        if state.get("seed") is not None:
+            self.initialize(int(state["seed"]))
+
+    def save_checkpoint(self, dir: Path | str) -> None:
+        path = Path(dir)
+        path.mkdir(parents=True, exist_ok=True)
+        (path / "context.json").write_text(json.dumps(self.state_dict(), indent=2))
+
+    def load_checkpoint(self, dir: Path | str) -> bool:
+        f = Path(dir) / "context.json"
+        if not f.is_file():
+            return False
+        self.load_state_dict(json.loads(f.read_text()))
+        return True

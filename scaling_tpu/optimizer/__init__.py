@@ -1,0 +1,37 @@
+from .learning_rate_scheduler import (
+    LearningRateDecayStyle,
+    LearningRateScheduler,
+    LearningRateSchedulerConfig,
+)
+from .loss_scaler import (
+    LossScaler,
+    LossScalerConfig,
+    LossScalerOutput,
+    LossScalerState,
+    has_inf_or_nan_tree,
+)
+from .optimizer import (
+    AdamWOptimizerConfig,
+    Optimizer,
+    OptimizerConfig,
+    OptimizerParamGroup,
+    OptimizerState,
+    OptimizerStepOutput,
+)
+
+__all__ = [
+    "LearningRateDecayStyle",
+    "LearningRateScheduler",
+    "LearningRateSchedulerConfig",
+    "LossScaler",
+    "LossScalerConfig",
+    "LossScalerOutput",
+    "LossScalerState",
+    "has_inf_or_nan_tree",
+    "AdamWOptimizerConfig",
+    "Optimizer",
+    "OptimizerConfig",
+    "OptimizerParamGroup",
+    "OptimizerState",
+    "OptimizerStepOutput",
+]

@@ -1,0 +1,84 @@
+"""Learning rate schedules.
+
+Semantics identical to the reference (reference:
+src/scaling/core/optimizer/learning_rate_scheduler/learning_rate_scheduler.py:18-48,
+per https://openreview.net/pdf?id=BJYwwY9ll p.4): linear warmup to the base
+LR, then constant / linear / cosine decay to ``learning_rate_minimum`` at
+``learning_rate_decay_iters``, flat minimum afterwards.
+
+``get_lr`` accepts either a Python int (host-side logging) or a traced jnp
+scalar (inside the jitted train step) — all branching is ``jnp.where``.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+
+import jax.numpy as jnp
+from pydantic import Field
+
+from ..config import BaseConfig
+
+
+class LearningRateDecayStyle(Enum):
+    CONSTANT = "constant"
+    LINEAR = "linear"
+    COSINE = "cosine"
+
+
+class LearningRateSchedulerConfig(BaseConfig):
+    learning_rate: float = Field(
+        0.0, description="Base learning rate; this is also the maximum learning rate."
+    )
+    learning_rate_minimum: float = Field(
+        0.0,
+        description="Minimum learning rate below which a step's learning rate will "
+        "never drop. This is the final learning rate after the schedule has been applied.",
+    )
+    learning_rate_decay_style: LearningRateDecayStyle = Field(
+        LearningRateDecayStyle.COSINE,
+        description="Shape of the learning rate decay after warm up",
+    )
+    learning_rate_decay_iters: int = Field(
+        0,
+        description="Number of iterations within which the learning rate follows the "
+        "schedule. Warmup iterations are included.",
+    )
+    learning_rate_warmup_steps: int = Field(
+        0,
+        description="Number of warmup steps during which the learning rate is linearly "
+        "increased to the maximum learning rate.",
+    )
+
+
+class LearningRateScheduler:
+    def __init__(self, config: LearningRateSchedulerConfig):
+        self.config = config
+
+    def get_lr(self, step_index):
+        c = self.config
+        step = jnp.asarray(step_index, dtype=jnp.float32)
+
+        warmup_lr = c.learning_rate * step / max(float(c.learning_rate_warmup_steps), 1.0)
+
+        if c.learning_rate_decay_style == LearningRateDecayStyle.CONSTANT:
+            post_warmup = jnp.asarray(c.learning_rate, dtype=jnp.float32)
+        else:
+            decay_span = max(float(c.learning_rate_decay_iters - c.learning_rate_warmup_steps), 1.0)
+            decay_ratio = jnp.clip(
+                (step - c.learning_rate_warmup_steps) / decay_span, 0.0, 1.0
+            )
+            if c.learning_rate_decay_style == LearningRateDecayStyle.LINEAR:
+                coeff = 1.0 - decay_ratio
+            else:  # cosine
+                coeff = 0.5 * (jnp.cos(jnp.pi * decay_ratio) + 1.0)
+            delta = c.learning_rate - c.learning_rate_minimum
+            post_warmup = c.learning_rate_minimum + coeff * delta
+            post_warmup = jnp.where(
+                step > c.learning_rate_decay_iters,
+                c.learning_rate_minimum,
+                post_warmup,
+            )
+
+        in_warmup = (c.learning_rate_warmup_steps > 0) & (step <= c.learning_rate_warmup_steps)
+        return jnp.where(in_warmup, warmup_lr, post_warmup)

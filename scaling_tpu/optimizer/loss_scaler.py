@@ -1,0 +1,115 @@
+"""Dynamic fp16 loss scaling, as a functional jit-compatible state machine.
+
+Semantics identical to the reference
+(reference: src/scaling/core/optimizer/loss_scaler.py:50-132): ride the edge
+of the highest non-overflowing scale — on overflow burn a hysteresis credit
+or back off by ``factor`` (floored at ``min_scale``); after ``window``
+consecutive clean steps scale back up by ``factor``. The reference's
+global MAX-allreduce overflow check becomes a plain jnp reduction (grads are
+globally visible under SPMD).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from pydantic import Field
+
+from ..config import BaseConfig
+
+
+class LossScalerConfig(BaseConfig):
+    enable: bool = Field(False, description="")
+    initial_scale: float = Field(2.0**32, description="Initial loss scale")
+    window: int = Field(1000, description="")
+    hysteresis: float = Field(2, description="")
+    consecutive_hysteresis: bool = Field(False, description="")
+    min_scale: float = Field(1.0, description="")
+    factor: float = Field(2.0, description="")
+
+
+class LossScalerState(NamedTuple):
+    current_scale: jax.Array  # f32 scalar
+    current_hysteresis: jax.Array  # f32 scalar
+    no_overflow_steps: jax.Array  # i32 scalar
+
+
+class LossScalerOutput(NamedTuple):
+    overflow: jax.Array  # bool scalar
+    no_overflow_steps: jax.Array
+    current_loss_scale: jax.Array
+
+
+def has_inf_or_nan_tree(grads) -> jax.Array:
+    leaves = jax.tree.leaves(grads)
+    flags = [~jnp.isfinite(g.astype(jnp.float32)).all() for g in leaves]
+    return jnp.any(jnp.stack(flags)) if flags else jnp.asarray(False)
+
+
+class LossScaler:
+    def __init__(self, config: LossScalerConfig):
+        self.config = config
+
+    def init_state(self) -> LossScalerState:
+        return LossScalerState(
+            current_scale=jnp.asarray(self.config.initial_scale, jnp.float32),
+            current_hysteresis=jnp.asarray(self.config.hysteresis, jnp.float32),
+            no_overflow_steps=jnp.asarray(0, jnp.int32),
+        )
+
+    def scale_loss(self, loss: jax.Array, state: LossScalerState) -> jax.Array:
+        if not self.config.enable:
+            return loss
+        return loss * state.current_scale.astype(loss.dtype)
+
+    def step(
+        self, state: LossScalerState, overflow: jax.Array
+    ) -> tuple[LossScalerState, LossScalerOutput]:
+        c = self.config
+        if not c.enable:
+            out = LossScalerOutput(
+                overflow=jnp.asarray(False),
+                no_overflow_steps=state.no_overflow_steps,
+                current_loss_scale=state.current_scale,
+            )
+            return state, out
+
+        # ---- overflow branch
+        burn_credit = (c.hysteresis != 1) & (state.current_hysteresis > 1)
+        scale_on_overflow = jnp.where(
+            burn_credit,
+            state.current_scale,
+            jnp.maximum(state.current_scale / c.factor, c.min_scale),
+        )
+        hyst_on_overflow = jnp.where(
+            burn_credit, state.current_hysteresis - 1, state.current_hysteresis
+        )
+
+        # ---- clean branch
+        window_hit = (state.no_overflow_steps > 0) & (
+            state.no_overflow_steps % c.window == 0
+        )
+        scale_on_clean = jnp.where(
+            window_hit, state.current_scale * c.factor, state.current_scale
+        )
+        hyst_on_clean = jnp.where(
+            jnp.asarray(c.consecutive_hysteresis) | window_hit,
+            jnp.asarray(float(c.hysteresis), jnp.float32),
+            state.current_hysteresis,
+        )
+
+        new_state = LossScalerState(
+            current_scale=jnp.where(overflow, scale_on_overflow, scale_on_clean),
+            current_hysteresis=jnp.where(overflow, hyst_on_overflow, hyst_on_clean),
+            no_overflow_steps=jnp.where(
+                overflow, jnp.asarray(0, jnp.int32), state.no_overflow_steps + 1
+            ),
+        )
+        out = LossScalerOutput(
+            overflow=overflow,
+            no_overflow_steps=new_state.no_overflow_steps,
+            current_loss_scale=new_state.current_scale,
+        )
+        return new_state, out

@@ -1,0 +1,321 @@
+"""ParallelModule: layer-spec assembly + the jitted train/eval step.
+
+The reference's ParallelModule interprets a precomputed 1F1B instruction
+list per step, moving micro-batches through buffers and NCCL P2P
+(reference: src/scaling/core/nn/parallel_module/parallel_module.py:89-747).
+Under single-controller SPMD the entire train step — grad accumulation over
+micro-batches, forward/backward, optimizer update, ZeRO collectives — is ONE
+jitted program: the instruction loop becomes a ``lax.scan`` over stacked
+micro-batches and XLA schedules the communication. Pipeline parallelism
+(pp > 1) runs the layer stack through the pipelined executor in
+``pipeline.py`` (collective-permute over the ``pipe`` axis) inside the same
+step function.
+
+Weight tying (reference: tied_layer_index.py:74-224) becomes structural:
+tied attributes live once in the owner layer's params; consumer layers get
+them injected at call time, so gradients flow to a single array and no
+tied-grad all-reduce exists.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.base_layer import BaseLayer, ForwardContext, LayerSpec, TiedLayerSpec
+from ..nn.param import ParamMeta, named_parameters, tree_with_layer
+from ..optimizer.optimizer import Optimizer, OptimizerState, OptimizerStepOutput
+from ..topology import ActivationCheckpointingType, Topology
+from .sharding import shard_batch
+
+
+class TrainStepOutput(NamedTuple):
+    loss: Any
+    metrics: Dict[str, Any]
+    global_grad_norm: Optional[Any] = None
+    learning_rates: Optional[Dict[str, Any]] = None
+    overflow: Optional[Any] = None
+    no_overflow_steps: Optional[Any] = None
+    current_loss_scale: Optional[Any] = None
+    debug_dict: Optional[Dict[str, Any]] = None
+    step_duration: Optional[float] = None
+
+
+class EvaluationStepOutput(NamedTuple):
+    loss: Any
+    metrics: Dict[str, Any]
+    step_duration: Optional[float] = None
+
+
+def _get_path(tree: dict, path: str):
+    node = tree
+    for part in path.split("."):
+        node = node[part]
+    return node
+
+
+def _set_path(tree: dict, path: str, value) -> dict:
+    parts = path.split(".")
+    tree = dict(tree)
+    node = tree
+    for part in parts[:-1]:
+        node[part] = dict(node[part])
+        node = node[part]
+    node[parts[-1]] = value
+    return tree
+
+
+def _del_path(tree: dict, path: str) -> dict:
+    parts = path.split(".")
+    tree = dict(tree)
+    node = tree
+    for part in parts[:-1]:
+        node[part] = dict(node[part])
+        node = node[part]
+    del node[parts[-1]]
+    return tree
+
+
+@dataclass
+class TiedInfo:
+    key: str
+    owner_layer: int
+    attributes: List[str]
+    consumers: List[int]
+
+
+class ParallelModule:
+    """Assembles a LayerSpec list into params/metas trees + step functions."""
+
+    def __init__(
+        self,
+        layer_specs: List[LayerSpec],
+        topology: Optional[Topology] = None,
+        compute_dtype=jnp.float32,
+    ):
+        self.layer_specs = layer_specs
+        self.topology = topology
+        self.compute_dtype = compute_dtype
+        self.layers: List[BaseLayer] = [spec.initialize() for spec in layer_specs]
+
+        # tied-weight bookkeeping
+        self.tied: Dict[str, TiedInfo] = {}
+        for i, spec in enumerate(layer_specs):
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in self.tied:
+                    self.tied[spec.key] = TiedInfo(
+                        key=spec.key, owner_layer=i,
+                        attributes=spec.tied_weight_attributes, consumers=[],
+                    )
+                else:
+                    assert self.tied[spec.key].attributes == spec.tied_weight_attributes
+                    self.tied[spec.key].consumers.append(i)
+
+    # ----------------------------------------------------------- params
+    def layer_name(self, i: int) -> str:
+        return f"layer_{i}"
+
+    def init_params(self, key: jax.Array) -> dict:
+        params = {}
+        for i, layer in enumerate(self.layers):
+            params[self.layer_name(i)] = layer.init(jax.random.fold_in(key, i))
+        # drop tied attrs from consumers; owner holds the single copy
+        for info in self.tied.values():
+            for c in info.consumers:
+                for attr in info.attributes:
+                    params[self.layer_name(c)] = _del_path(params[self.layer_name(c)], attr)
+        return params
+
+    def param_metas(self) -> dict:
+        metas = {}
+        for i, layer in enumerate(self.layers):
+            m = layer.param_metas()
+            m = tree_with_layer(m, i, type(layer).__name__)
+            metas[self.layer_name(i)] = m
+        for info in self.tied.values():
+            owner_name = self.layer_name(info.owner_layer)
+            for attr in info.attributes:
+                meta = _get_path(metas[owner_name], attr)
+                metas[owner_name] = _set_path(
+                    metas[owner_name], attr,
+                    type(meta)(**{**meta.__dict__, "tied_key": info.key}),
+                )
+            for c in info.consumers:
+                for attr in info.attributes:
+                    metas[self.layer_name(c)] = _del_path(metas[self.layer_name(c)], attr)
+        return metas
+
+    def named_parameters(self, params: dict) -> list:
+        return named_parameters(params, self.param_metas())
+
+    def parameter_count(self, params: dict) -> int:
+        return sum(int(p.size) for p in jax.tree.leaves(params))
+
+    # ---------------------------------------------------------- forward
+    def _layer_params(self, params: dict, i: int) -> dict:
+        p = params[self.layer_name(i)]
+        for info in self.tied.values():
+            if i in info.consumers:
+                for attr in info.attributes:
+                    owner_p = _get_path(params[self.layer_name(info.owner_layer)], attr)
+                    p = _set_path(p, attr, owner_p)
+        return p
+
+    def forward(self, params: dict, x: Any, ctx: ForwardContext) -> Any:
+        ckpt_type = (
+            self.topology.activation_checkpointing_type
+            if self.topology is not None
+            else ActivationCheckpointingType.DISABLED
+        )
+        for i, layer in enumerate(self.layers):
+            layer_p = self._layer_params(params, i)
+            if ckpt_type == ActivationCheckpointingType.EVERY_LAYER:
+                x = jax.checkpoint(
+                    lambda p, xx, _layer=layer: _layer(p, xx, ctx)
+                )(layer_p, x)
+            else:
+                x = layer(layer_p, x, ctx)
+        return x
+
+    def _make_ctx(self, deterministic: bool, dropout_key) -> ForwardContext:
+        topo = self.topology
+        return ForwardContext(
+            dropout_key=dropout_key,
+            deterministic=deterministic,
+            sequence_parallel=bool(topo and topo.sequence_parallel),
+            model_parallel_size=topo.model_parallel_size if topo else 1,
+            mesh=topo.mesh if topo else None,
+        )
+
+    # ------------------------------------------------------- train step
+    def build_train_step(
+        self,
+        optimizer: Optimizer,
+        loss_function: Callable[[Any, Any], tuple],
+        donate: bool = True,
+    ) -> Callable:
+        """Returns jitted ``step(params, opt_state, micro_batches, dropout_key)``.
+
+        ``micro_batches``: pytree whose leaves are stacked
+        (grad_accumulation_steps, dp * micro_batch_size, ...) arrays.
+        Output loss/metrics are means over micro batches (reference:
+        parallel_module.py:288, optimizer.py:99-105).
+        """
+        gas = self.topology.gradient_accumulation_steps if self.topology else 1
+
+        scaler_enabled = optimizer.config.loss_scaler.enable
+
+        def microbatch_loss(params, mb, dropout_key, loss_scale):
+            ctx = self._make_ctx(deterministic=False, dropout_key=dropout_key)
+            out = self.forward(params, mb, ctx)
+            loss, metrics = loss_function(out, mb)
+            scaled = loss.astype(jnp.float32) / gas
+            if scaler_enabled:
+                scaled = scaled * loss_scale
+            return scaled, (loss, metrics)
+
+        def step(params, opt_state, micro_batches, dropout_key):
+            loss_scale = opt_state.loss_scaler.current_scale
+
+            grad_fn = jax.value_and_grad(microbatch_loss, has_aux=True)
+
+            def body(carry, mb_and_idx):
+                grads_acc, loss_acc, metrics_acc = carry
+                mb, idx = mb_and_idx
+                mb_key = jax.random.fold_in(dropout_key, idx)
+                (_, (loss, metrics)), grads = grad_fn(params, mb, mb_key, loss_scale)
+                grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+                loss_acc = loss_acc + loss.astype(jnp.float32)
+                metrics_acc = jax.tree.map(
+                    lambda a, b: a + jnp.asarray(b, jnp.float32), metrics_acc, metrics
+                )
+                return (grads_acc, loss_acc, metrics_acc), None
+
+            zero_grads = jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+            first_mb = jax.tree.map(lambda x: x[0], micro_batches)
+            # learn the metrics structure without burning flops
+            metrics0 = jax.eval_shape(
+                lambda p, mb, k, s: microbatch_loss(p, mb, k, s)[1][1],
+                params,
+                first_mb,
+                dropout_key,
+                loss_scale,
+            )
+            zero_metrics = jax.tree.map(lambda m: jnp.zeros((), jnp.float32), metrics0)
+
+            if gas == 1:
+                (grads, loss_sum, metrics_sum), _ = body(
+                    (zero_grads, jnp.float32(0), zero_metrics),
+                    (first_mb, jnp.int32(0)),
+                )
+            else:
+                idxs = jnp.arange(gas)
+                (grads, loss_sum, metrics_sum), _ = jax.lax.scan(
+                    body, (zero_grads, jnp.float32(0), zero_metrics), (micro_batches, idxs)
+                )
+
+            new_params, new_opt_state, opt_out = optimizer.step(
+                params, grads, opt_state, compute_dtype=self.compute_dtype
+            )
+            loss = loss_sum / gas
+            metrics = jax.tree.map(lambda m: m / gas, metrics_sum)
+            return new_params, new_opt_state, loss, metrics, opt_out
+
+        return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+    def build_eval_step(self, loss_function: Callable) -> Callable:
+        def eval_step(params, micro_batch):
+            ctx = self._make_ctx(deterministic=True, dropout_key=None)
+            out = self.forward(params, micro_batch, ctx)
+            loss, metrics = loss_function(out, micro_batch)
+            return loss, metrics
+
+        return jax.jit(eval_step)
+
+    # ------------------------------------------------ inference forward
+    def build_forward(self, deterministic: bool = True) -> Callable:
+        def fwd(params, x):
+            ctx = self._make_ctx(deterministic=deterministic, dropout_key=None)
+            return self.forward(params, x, ctx)
+
+        return jax.jit(fwd)
+
+    def shard_params(self, params: dict) -> dict:
+        """Place params on the mesh according to their metas."""
+        if self.topology is None:
+            return params
+        from jax.sharding import NamedSharding
+
+        metas = self.param_metas()
+        return jax.tree.map(
+            lambda p, m: jax.device_put(
+                p, NamedSharding(self.topology.mesh, m.spec())
+            ),
+            params,
+            metas,
+            is_leaf=lambda x: isinstance(x, ParamMeta),
+        )
+
+    def shard_batch(self, batch: Any, stacked: bool = True) -> Any:
+        """Place a batch on the mesh: the batch axis shards over ``data``.
+
+        ``stacked=True`` for train batches with a leading grad-accum axis
+        (gas, dp*mbs, ...); False for single micro batches (dp*mbs, ...).
+        """
+        if self.topology is None:
+            return batch
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        lead = (None, "data") if stacked else ("data",)
+
+        def put(x):
+            if not hasattr(x, "ndim") or x.ndim < len(lead):
+                return x
+            spec = lead + (None,) * (x.ndim - len(lead))
+            return jax.device_put(x, NamedSharding(self.topology.mesh, P(*spec)))
+
+        return jax.tree.map(put, batch)

@@ -1,0 +1,3 @@
+from .trainer import BaseTrainer, TrainerConfig
+
+__all__ = ["BaseTrainer", "TrainerConfig"]

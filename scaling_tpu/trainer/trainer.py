@@ -1,0 +1,326 @@
+"""Generic training loop + checkpoint orchestration.
+
+(reference: src/scaling/core/trainer/trainer.py:33-558). ``run_training``
+drives: jitted train step -> periodic save -> periodic eval -> rank-0 metric
+logging. Checkpoint directories follow the reference layout:
+``save_dir/global_step{N}/`` with model/optimizer/context artifacts plus a
+``latest`` pointer file, so tooling built around reference checkpoints keeps
+working.
+"""
+
+from __future__ import annotations
+
+import shutil
+import time
+from pathlib import Path
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from pydantic import Field
+
+from ..checkpoint import (
+    load_model_checkpoint,
+    load_optimizer_checkpoint,
+    save_model_checkpoint,
+    save_optimizer_checkpoint,
+)
+from ..config import BaseConfig
+from ..context import BaseContext
+from ..data import DataLoader
+from ..logging import logger
+from ..optimizer.optimizer import Optimizer, OptimizerState
+from ..parallel.parallel_module import (
+    EvaluationStepOutput,
+    ParallelModule,
+    TrainStepOutput,
+)
+
+
+class TrainerConfig(BaseConfig):
+    save_dir: Optional[str] = Field(None, description="directory for saving checkpoints")
+    save_interval: Optional[int] = Field(
+        None,
+        description="save a checkpoint every 'save_interval' steps to save_dir, "
+        "iff save_dir is defined",
+    )
+    load_dir: Optional[str] = Field(None, description="directory for loading checkpoints")
+    train_iterations: Optional[int] = Field(None, description="train for this number of iterations")
+    assert_checkpoint_loaded: bool = Field(
+        True, description="error out if a checkpoint could not be loaded"
+    )
+    load_optimizer_states: bool = Field(
+        True, description="load optimizer states on checkpoint load"
+    )
+    delete_past_optimizer_states: bool = Field(
+        True,
+        description="Deletes optimizer states on the last n-1 checkpoints right "
+        "after saving the nth checkpoint",
+    )
+    load_context: bool = Field(
+        True,
+        description="load context state, i.e. train iterations, consumed train "
+        "and eval samples on checkpoint load",
+    )
+    allowed_missing_keys_in_checkpoint: Optional[List[str]] = Field(
+        None,
+        description="list of parameter name regexes that may not be present in an "
+        "existing checkpoint (e.g. fresh adapters)",
+    )
+    allowed_unexpected_keys_in_checkpoint: Optional[List[str]] = Field(
+        None,
+        description="list of parameter name regexes that may be present in an "
+        "existing checkpoint but not be loaded",
+    )
+    ignore_keys_in_checkpoint: Optional[List[str]] = Field(
+        None,
+        description="list of parameter name regexes for which pretrained weights "
+        "are not loaded (reinitialise parts of a model)",
+    )
+    merge_lora_after_loading_checkpoint: bool = Field(
+        False, description="merge LoRa weights after loading"
+    )
+    seed: int = Field(42, description="")
+    eval_iterations: int = Field(0, description="number of eval micro batches per eval pass")
+    eval_interval: Optional[int] = Field(None, description="evaluate every n train steps")
+    dataloader_num_workers: int = Field(0, description="kept for config parity")
+    dataloader_pin_memory: bool = Field(True, description="kept for config parity")
+    dataloader_prefetch_factor: Optional[int] = Field(None, description="kept for config parity")
+
+
+class BaseTrainer:
+    """Wires module/optimizer/datasets; owns the train loop."""
+
+    def __init__(
+        self,
+        config: TrainerConfig,
+        context: BaseContext,
+        parallel_module: ParallelModule,
+        optimizer: Optimizer,
+        loss_function: Callable,
+        dataset: Any = None,
+        dataset_evaluation: Any = None,
+        metrics_aggregation_fn: Optional[Callable] = None,
+        batch_to_model_input: Callable = lambda b: b,
+    ):
+        self.config = config
+        self.context = context
+        self.module = parallel_module
+        self.optimizer = optimizer
+        self.loss_function = loss_function
+        self.dataset = dataset
+        self.dataset_evaluation = dataset_evaluation
+        self.batch_to_model_input = batch_to_model_input
+        self.topology = context.topology
+
+        self.params: Any = None
+        self.opt_state: Optional[OptimizerState] = None
+        self._train_step = None
+        self._eval_step = None
+        self.dataloader: Optional[DataLoader] = None
+        self.dataloader_evaluation: Optional[DataLoader] = None
+
+    # ------------------------------------------------------------ lifecycle
+    def initialize(self, load_checkpoint: bool = True) -> None:
+        self.context.initialize(self.config.seed)
+        key = self.context.rng.key("model_init")
+        params = self.module.init_params(key)
+        params = jax.tree.map(
+            lambda p: p.astype(self.module.compute_dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating)
+            else p,
+            params,
+        )
+        self.params = self.module.shard_params(params)
+        self.opt_state = self.optimizer.init_state(self.params)
+
+        loaded = False
+        if load_checkpoint and self.config.load_dir is not None:
+            loaded = self.load_checkpoint(self.config.load_dir)
+            if self.config.assert_checkpoint_loaded and not loaded:
+                raise AssertionError(
+                    f"could not load checkpoint from {self.config.load_dir}"
+                )
+
+        self._build_dataloaders()
+        self._train_step = self.module.build_train_step(self.optimizer, self.loss_function)
+        self._eval_step = self.module.build_eval_step(self.loss_function)
+
+    def _build_dataloaders(self) -> None:
+        if self.dataset is not None:
+            self.dataloader = DataLoader(
+                seed=self.config.seed,
+                consumed_samples=self.context.consumed_samples,
+                dataset=self.dataset,
+                topology=self.topology,
+            )
+        if self.dataset_evaluation is not None:
+            self.dataloader_evaluation = DataLoader(
+                seed=self.config.seed,
+                consumed_samples=self.context.consumed_eval_samples,
+                dataset=self.dataset_evaluation,
+                topology=self.topology,
+            )
+
+    # ----------------------------------------------------------- train step
+    def _next_micro_batches(self):
+        """Stack grad-accum micro batches along a new leading axis."""
+        gas = self.topology.gradient_accumulation_steps
+        batches = [
+            self.batch_to_model_input(next(self.dataloader)) for _ in range(gas)
+        ]
+        stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *batches)
+        return self.module.shard_batch(stacked)
+
+    def train_step(self) -> TrainStepOutput:
+        start = time.time()
+        micro_batches = self._next_micro_batches()
+        dropout_key = self.context.rng.key("dropout", self.context.iterations)
+        self.params, self.opt_state, loss, metrics, opt_out = self._train_step(
+            self.params, self.opt_state, micro_batches, dropout_key
+        )
+        self.context.step()
+        loss = float(loss)
+        return TrainStepOutput(
+            loss=loss,
+            metrics={k: float(v) for k, v in metrics.items()},
+            global_grad_norm=_maybe_float(opt_out.global_grad_norm),
+            learning_rates={k: float(v) for k, v in (opt_out.learning_rates or {}).items()},
+            overflow=_maybe_bool(opt_out.overflow),
+            no_overflow_steps=_maybe_int(opt_out.no_overflow_steps),
+            current_loss_scale=_maybe_float(opt_out.current_loss_scale),
+            step_duration=time.time() - start,
+        )
+
+    def eval_step(self) -> EvaluationStepOutput:
+        start = time.time()
+        assert self.dataloader_evaluation is not None, "no evaluation dataset"
+        losses, metric_list = [], []
+        for _ in range(max(self.config.eval_iterations, 1)):
+            batch = self.batch_to_model_input(next(self.dataloader_evaluation))
+            batch = self.module.shard_batch(batch, stacked=False)
+            loss, metrics = self._eval_step(self.params, batch)
+            losses.append(float(loss))
+            metric_list.append({k: float(v) for k, v in metrics.items()})
+            self.context.consumed_eval_samples += (
+                self.topology.config.micro_batch_size
+                * self.topology.config.data_parallel_size
+            )
+        mean_metrics = {
+            k: float(np.mean([m[k] for m in metric_list])) for k in metric_list[0]
+        } if metric_list else {}
+        return EvaluationStepOutput(
+            loss=float(np.mean(losses)),
+            metrics=mean_metrics,
+            step_duration=time.time() - start,
+        )
+
+    # ----------------------------------------------------------- train loop
+    def run_training(self, log_metrics_fn: Optional[Callable] = None) -> None:
+        assert self.config.train_iterations is not None
+        while self.context.iterations < self.config.train_iterations:
+            output = self.train_step()
+            if (
+                self.config.save_dir is not None
+                and self.config.save_interval is not None
+                and self.context.iterations % self.config.save_interval == 0
+            ):
+                self.save_checkpoint()
+            if (
+                self.config.eval_interval is not None
+                and self.dataset_evaluation is not None
+                and self.context.iterations % self.config.eval_interval == 0
+            ):
+                eval_out = self.eval_step()
+                logger.log_metrics(
+                    {"eval_loss": eval_out.loss, **{f"eval_{k}": v for k, v in eval_out.metrics.items()}},
+                    self.context.iterations,
+                )
+            metrics = {
+                "loss": output.loss,
+                **output.metrics,
+                **(output.learning_rates or {}),
+            }
+            if output.global_grad_norm is not None:
+                metrics["global_grad_norm"] = output.global_grad_norm
+            if output.current_loss_scale is not None:
+                metrics["loss_scale"] = output.current_loss_scale
+            metrics["step_duration"] = output.step_duration
+            if log_metrics_fn is not None:
+                metrics = log_metrics_fn(self, output, metrics)
+            logger.log_metrics(metrics, self.context.iterations)
+
+    # ----------------------------------------------------------- checkpoint
+    def _step_dir(self, base: Path, iterations: int) -> Path:
+        return base / f"global_step{iterations}"
+
+    def save_checkpoint(self, dir: Optional[Path | str] = None) -> Path:
+        base = Path(dir or self.config.save_dir)
+        step_dir = self._step_dir(base, self.context.iterations)
+        step_dir.mkdir(parents=True, exist_ok=True)
+        metas = self.module.param_metas()
+        save_model_checkpoint(
+            step_dir, self.params, metas,
+            separate_file_for_parameters=getattr(
+                self.module, "separate_file_for_parameters", None
+            ),
+        )
+        save_optimizer_checkpoint(step_dir, self.opt_state, metas)
+        self.context.save_checkpoint(step_dir)
+        (base / "latest").write_text(f"global_step{self.context.iterations}")
+        logger.info(f"saved checkpoint {step_dir}")
+        if self.config.delete_past_optimizer_states:
+            for old in sorted(base.glob("global_step*")):
+                if old != step_dir:
+                    for f in old.glob("optimizer_state_*"):
+                        f.unlink()
+        return step_dir
+
+    def load_checkpoint(self, dir: Optional[Path | str] = None) -> bool:
+        base = Path(dir or self.config.load_dir)
+        latest_file = base / "latest"
+        if latest_file.is_file():
+            step_dir = base / latest_file.read_text().strip()
+        elif (base / "context.json").is_file() or list(base.glob("model_state_layer_*.npz")):
+            step_dir = base
+        else:
+            logger.warning(f"no checkpoint found at {base}")
+            return False
+        metas = self.module.param_metas()
+        self.params = load_model_checkpoint(
+            step_dir,
+            self.params,
+            metas,
+            allowed_missing_keys=self.config.allowed_missing_keys_in_checkpoint,
+            allowed_unexpected_keys=self.config.allowed_unexpected_keys_in_checkpoint,
+            ignore_keys=self.config.ignore_keys_in_checkpoint,
+        )
+        optimizer_states_loaded = False
+        if self.config.load_optimizer_states:
+            try:
+                self.opt_state = load_optimizer_checkpoint(step_dir, self.opt_state, metas)
+                optimizer_states_loaded = True
+            except FileNotFoundError:
+                logger.warning(f"optimizer states absent in {step_dir}")
+        if not optimizer_states_loaded:
+            # fp32 masters were copied from the random init; re-derive them
+            # from the loaded params or the first step would revert the model
+            self.opt_state = self.optimizer.init_state(self.params)
+            logger.info("re-derived fresh optimizer state from loaded parameters")
+        if self.config.load_context:
+            self.context.load_checkpoint(step_dir)
+        logger.info(f"loaded checkpoint {step_dir}")
+        return True
+
+
+def _maybe_float(v):
+    return None if v is None else float(v)
+
+
+def _maybe_int(v):
+    return None if v is None else int(v)
+
+
+def _maybe_bool(v):
+    return None if v is None else bool(v)

@@ -1,0 +1,241 @@
+"""AdamW parity vs torch.optim.AdamW (reference wraps torch AdamW directly,
+so matching torch is matching the reference; mirrors tests/core/test_optimizer/
+test_adamw.py in the reference repo)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from scaling_tpu.nn import ParamMeta
+from scaling_tpu.optimizer import (
+    LearningRateDecayStyle,
+    LearningRateScheduler,
+    LearningRateSchedulerConfig,
+    LossScalerConfig,
+    Optimizer,
+    OptimizerConfig,
+    OptimizerParamGroup,
+)
+
+
+def make_problem(seed=0, n=16, d=8):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d, 4).astype(np.float32) * 0.1
+    b = np.zeros(4, dtype=np.float32)
+    x = rng.randn(n, d).astype(np.float32)
+    y = rng.randn(n, 4).astype(np.float32)
+    return w, b, x, y
+
+
+def metas():
+    return {
+        "weight": ParamMeta(parameter_name="weight", layer_index=0, layer_class_name="Linear"),
+        "bias": ParamMeta(parameter_name="bias", layer_index=0, layer_class_name="Linear"),
+    }
+
+
+def const_lr(lr):
+    return LearningRateSchedulerConfig(
+        learning_rate=lr,
+        learning_rate_decay_style=LearningRateDecayStyle.CONSTANT,
+        learning_rate_warmup_steps=0,
+    )
+
+
+@pytest.mark.parametrize("weight_decay", [0.0, 0.1])
+def test_adamw_matches_torch(weight_decay):
+    w0, b0, x, y = make_problem()
+    lr, beta1, beta2, eps = 1e-2, 0.9, 0.95, 1e-8
+
+    # ---- torch reference
+    wt = torch.nn.Parameter(torch.tensor(w0))
+    bt = torch.nn.Parameter(torch.tensor(b0))
+    opt = torch.optim.AdamW(
+        [wt, bt], lr=lr, betas=(beta1, beta2), eps=eps, weight_decay=weight_decay
+    )
+    xt, yt = torch.tensor(x), torch.tensor(y)
+    for _ in range(10):
+        opt.zero_grad()
+        loss = ((xt @ wt + bt - yt) ** 2).mean()
+        loss.backward()
+        opt.step()
+
+    # ---- scaling_tpu
+    m = metas()
+    groups = [
+        OptimizerParamGroup(
+            keys={m["weight"].key, m["bias"].key},
+            weight_decay=weight_decay,
+            learning_rate_scheduler=const_lr(lr),
+        )
+    ]
+    cfg = OptimizerConfig(beta1=beta1, beta2=beta2, eps=eps)
+    optimizer = Optimizer(cfg, groups, m)
+    params = {"weight": jnp.asarray(w0), "bias": jnp.asarray(b0)}
+    state = optimizer.init_state(params)
+
+    def loss_fn(p):
+        return jnp.mean((x @ p["weight"] + p["bias"] - y) ** 2)
+
+    step = jax.jit(
+        lambda p, s: optimizer.step(p, jax.grad(loss_fn)(p), s)[:2]
+    )
+    for _ in range(10):
+        params, state = step(params, state)
+
+    np.testing.assert_allclose(np.asarray(params["weight"]), wt.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(params["bias"]), bt.detach().numpy(), atol=1e-5)
+
+
+def test_gradient_clipping_matches_torch():
+    w0, b0, x, y = make_problem(seed=3)
+    clip = 0.05
+    lr = 1e-2
+
+    wt = torch.nn.Parameter(torch.tensor(w0))
+    bt = torch.nn.Parameter(torch.tensor(b0))
+    opt = torch.optim.AdamW([wt, bt], lr=lr, betas=(0.9, 0.95), eps=1e-8, weight_decay=0.0)
+    xt, yt = torch.tensor(x), torch.tensor(y)
+    for _ in range(5):
+        opt.zero_grad()
+        loss = ((xt @ wt + bt - yt) ** 2).mean()
+        loss.backward()
+        torch.nn.utils.clip_grad_norm_([wt, bt], clip)
+        opt.step()
+
+    m = metas()
+    groups = [
+        OptimizerParamGroup(
+            keys={m["weight"].key, m["bias"].key},
+            learning_rate_scheduler=const_lr(lr),
+        )
+    ]
+    optimizer = Optimizer(OptimizerConfig(gradient_clipping=clip), groups, m)
+    params = {"weight": jnp.asarray(w0), "bias": jnp.asarray(b0)}
+    state = optimizer.init_state(params)
+
+    def loss_fn(p):
+        return jnp.mean((x @ p["weight"] + p["bias"] - y) ** 2)
+
+    for _ in range(5):
+        grads = jax.grad(loss_fn)(params)
+        params, state, out = optimizer.step(params, grads, state)
+
+    np.testing.assert_allclose(np.asarray(params["weight"]), wt.detach().numpy(), atol=2e-5)
+
+
+def test_frozen_params_not_updated():
+    m = metas()
+    groups = [
+        OptimizerParamGroup(keys={m["weight"].key}, learning_rate_scheduler=const_lr(0.1))
+    ]
+    optimizer = Optimizer(OptimizerConfig(), groups, m)
+    params = {"weight": jnp.ones((4, 4)), "bias": jnp.ones((4,))}
+    state = optimizer.init_state(params)
+    grads = {"weight": jnp.ones((4, 4)), "bias": jnp.ones((4,))}
+    new_params, state, _ = optimizer.step(params, grads, state)
+    assert not np.allclose(np.asarray(new_params["weight"]), 1.0)
+    np.testing.assert_array_equal(np.asarray(new_params["bias"]), 1.0)
+
+
+def test_separate_group_lrs():
+    m = metas()
+    groups = [
+        OptimizerParamGroup(keys={m["weight"].key}, learning_rate_scheduler=const_lr(0.1), name="w"),
+        OptimizerParamGroup(keys={m["bias"].key}, learning_rate_scheduler=const_lr(0.0), name="b"),
+    ]
+    optimizer = Optimizer(OptimizerConfig(), groups, m)
+    params = {"weight": jnp.ones((4, 4)), "bias": jnp.ones((4,))}
+    state = optimizer.init_state(params)
+    grads = {"weight": jnp.ones((4, 4)), "bias": jnp.ones((4,))}
+    new_params, state, out = optimizer.step(params, grads, state)
+    assert not np.allclose(np.asarray(new_params["weight"]), 1.0)
+    np.testing.assert_array_equal(np.asarray(new_params["bias"]), 1.0)  # lr 0
+    assert float(out.learning_rates["w"]) == pytest.approx(0.1)
+
+
+def test_unknown_group_key_rejected():
+    m = metas()
+    with pytest.raises(ValueError):
+        Optimizer(OptimizerConfig(), [OptimizerParamGroup(keys={"layer_9_Nope.weight"})], m)
+
+
+def test_overflow_skips_step_and_backs_off_scale():
+    m = metas()
+    groups = [
+        OptimizerParamGroup(
+            keys={m["weight"].key, m["bias"].key}, learning_rate_scheduler=const_lr(0.1)
+        )
+    ]
+    cfg = OptimizerConfig(
+        loss_scaler=LossScalerConfig(enable=True, initial_scale=2.0**16, hysteresis=1)
+    )
+    optimizer = Optimizer(cfg, groups, m)
+    params = {"weight": jnp.ones((4, 4)), "bias": jnp.ones((4,))}
+    state = optimizer.init_state(params)
+    bad_grads = {"weight": jnp.full((4, 4), jnp.inf), "bias": jnp.ones((4,))}
+    new_params, new_state, out = optimizer.step(params, bad_grads, state)
+    np.testing.assert_array_equal(np.asarray(new_params["weight"]), 1.0)
+    assert bool(out.overflow)
+    assert float(new_state.loss_scaler.current_scale) == 2.0**15
+    assert int(new_state.step) == 0
+
+
+def test_loss_scale_grows_after_window():
+    from scaling_tpu.optimizer import LossScaler, LossScalerConfig
+
+    scaler = LossScaler(LossScalerConfig(enable=True, initial_scale=4.0, window=3, factor=2.0))
+    state = scaler.init_state()
+    import jax.numpy as jnp
+
+    for i in range(7):
+        state, out = scaler.step(state, jnp.asarray(False))
+    # growth at no_overflow_steps hitting multiples of window (steps 4 and 7)
+    assert float(state.current_scale) == 16.0
+
+
+def test_lr_scheduler_shapes():
+    cfg = LearningRateSchedulerConfig(
+        learning_rate=1.0,
+        learning_rate_minimum=0.1,
+        learning_rate_decay_style=LearningRateDecayStyle.COSINE,
+        learning_rate_decay_iters=100,
+        learning_rate_warmup_steps=10,
+    )
+    s = LearningRateScheduler(cfg)
+    assert float(s.get_lr(0)) == 0.0
+    assert float(s.get_lr(5)) == pytest.approx(0.5)
+    assert float(s.get_lr(10)) == pytest.approx(1.0)
+    assert float(s.get_lr(55)) == pytest.approx(0.55, abs=0.01)
+    assert float(s.get_lr(100)) == pytest.approx(0.1)
+    assert float(s.get_lr(1000)) == pytest.approx(0.1)
+
+
+def test_zero_shards_master_over_data_axis(devices):
+    from scaling_tpu.topology import Topology, TopologyConfig
+
+    topo = Topology(
+        TopologyConfig(
+            model_parallel_size=1,
+            pipe_parallel_size=1,
+            data_parallel_size=8,
+            micro_batch_size=1,
+            gradient_accumulation_steps=1,
+        )
+    )
+    m = metas()
+    groups = [
+        OptimizerParamGroup(
+            keys={m["weight"].key, m["bias"].key}, learning_rate_scheduler=const_lr(0.1)
+        )
+    ]
+    optimizer = Optimizer(OptimizerConfig(zero=True), groups, m, topology=topo)
+    params = {"weight": jnp.ones((16, 4)), "bias": jnp.ones((4,))}
+    state = optimizer.init_state(params)
+    # weight (16, 4): dim0 divisible by dp=8 -> sharded over data axis
+    shard_shape = state.master["weight"].sharding.shard_shape((16, 4))
+    assert shard_shape == (2, 4)
+    # moments too
+    assert state.exp_avg["weight"].sharding.shard_shape((16, 4)) == (2, 4)
