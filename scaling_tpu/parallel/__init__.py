@@ -1,3 +1,15 @@
+from .parallel_module import EvaluationStepOutput, ParallelModule, TrainStepOutput
+from .pipeline import (
+    PipelinedBody,
+    pipe_partition_balanced,
+    pipe_partition_from_indices,
+    pipe_partition_uniform,
+)
+from .pipeline_schedule import (
+    PipelineScheduleInference,
+    PipelineScheduleTrain,
+    SimulationEngine,
+)
 from .sharding import (
     constrain,
     shard_activation_replicated_h,
@@ -8,6 +20,16 @@ from .sharding import (
 )
 
 __all__ = [
+    "EvaluationStepOutput",
+    "ParallelModule",
+    "TrainStepOutput",
+    "PipelinedBody",
+    "pipe_partition_balanced",
+    "pipe_partition_from_indices",
+    "pipe_partition_uniform",
+    "PipelineScheduleInference",
+    "PipelineScheduleTrain",
+    "SimulationEngine",
     "constrain",
     "shard_activation_replicated_h",
     "shard_activation_sp",

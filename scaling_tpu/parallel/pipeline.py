@@ -1,0 +1,243 @@
+"""Pipeline parallelism: spatial GPipe over the ``pipe`` mesh axis.
+
+The reference implements PP as a 1F1B instruction interpreter with NCCL
+send/recv transport (reference: src/scaling/core/nn/pipeline_schedule/train.py:33-174,
+communicator.py:193-510). Inside one jitted SPMD program the idiomatic TPU
+formulation is *spatial* pipelining:
+
+- the homogeneous transformer body is stacked ``(pp, layers_per_stage, ...)``
+  and sharded ``P('pipe')`` on the stage dim;
+- an in-flight state buffer ``(pp, mbs, ...)`` holds one micro-batch per
+  stage; each tick shifts it one stage down (XLA lowers the shift on a
+  pipe-sharded dim to an ICI collective-permute) and applies every stage in
+  parallel via ``vmap``;
+- ``n_micro + pp - 1`` ticks drain the pipeline; ``jax.grad`` through the
+  scan gives the backward schedule, with ``jax.checkpoint`` on the stage
+  body bounding activation memory (GPipe + remat — the jit-native equivalent
+  of the reference's 1F1B memory profile).
+
+The 1F1B instruction DSL and its simulator survive as the pure-Python
+planning/visualisation tool in ``pipeline_schedule.py``.
+
+Heterogeneous edges (embedding, final norm, lm head) run outside the
+pipelined region, replicated over the pipe axis: their FLOPs are negligible
+next to the body, and replication avoids idle bubbles on edge stages.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.base_layer import BaseLayer, ForwardContext
+from ..nn.param import ParamMeta
+from ..topology.topology import PIPE_AXIS, Topology
+
+
+# --------------------------------------------------------------- partitioning
+def pipe_partition_uniform(num_items: int, num_partitions: int) -> List[int]:
+    """Boundaries [b_0..b_pp]: even split, residual spread from the front.
+
+    (reference: pipeline_partitioning.py:38-57)
+    """
+    base = num_items // num_partitions
+    residual = num_items % num_partitions
+    sizes = [base + (1 if i < residual else 0) for i in range(num_partitions)]
+    bounds = [0]
+    for s in sizes:
+        bounds.append(bounds[-1] + s)
+    return bounds
+
+
+def pipe_partition_balanced(weights: List[int], num_partitions: int) -> List[int]:
+    """Boundaries minimising the heaviest partition (binary search over the
+    bottleneck, reference: pipeline_partitioning.py:60-136)."""
+    weights_arr = np.asarray(weights, dtype=np.int64)
+    prefix = np.concatenate([[0], np.cumsum(weights_arr)])
+
+    def partitions_needed(limit: int) -> Optional[List[int]]:
+        bounds = [0]
+        start = 0
+        for _ in range(num_partitions):
+            # furthest end with sum(start..end) <= limit
+            end = int(np.searchsorted(prefix, prefix[start] + limit, side="right")) - 1
+            if end <= start and start < len(weights_arr):
+                return None  # single item exceeds limit
+            end = min(end, len(weights_arr))
+            bounds.append(end)
+            start = end
+            if start >= len(weights_arr):
+                bounds.extend([len(weights_arr)] * (num_partitions - (len(bounds) - 1)))
+                return bounds[: num_partitions + 1]
+        return bounds if start >= len(weights_arr) else None
+
+    lo, hi = int(weights_arr.max(initial=0)), int(prefix[-1])
+    best = None
+    while lo <= hi:
+        mid = (lo + hi) // 2
+        b = partitions_needed(mid)
+        if b is not None:
+            best = b
+            hi = mid - 1
+        else:
+            lo = mid + 1
+    assert best is not None
+    return best
+
+
+def pipe_partition_from_indices(bounds: List[int], num_items: int, num_partitions: int) -> List[int]:
+    assert len(bounds) == num_partitions + 1
+    assert bounds[0] == 0 and bounds[-1] == num_items
+    assert all(b2 >= b1 for b1, b2 in zip(bounds, bounds[1:]))
+    return list(bounds)
+
+
+# ----------------------------------------------------------------- pipelining
+class PipelinedBody:
+    """A homogeneous layer repeated ``num_layers`` times, stage-stacked.
+
+    ``template`` supplies init/param_metas/__call__ for one layer; the whole
+    stack's params get a leading (pp, layers_per_stage) pair of dims with the
+    stage dim sharded over the pipe axis. Requires num_layers % pp == 0 (the
+    uniform partition); the balanced planner remains available for the
+    schedule simulator.
+    """
+
+    def __init__(self, template: BaseLayer, num_layers: int, topology: Optional[Topology]):
+        self.template = template
+        self.num_layers = num_layers
+        self.topology = topology
+        self.pp = topology.pipe_parallel_size if topology else 1
+        assert num_layers % max(self.pp, 1) == 0, (
+            f"spatial pipelining needs num_layers ({num_layers}) divisible by "
+            f"pipe_parallel_size ({self.pp})"
+        )
+        self.layers_per_stage = num_layers // max(self.pp, 1)
+
+    # params: every leaf gains leading dims (pp, layers_per_stage)
+    def init(self, key: jax.Array) -> Any:
+        per_layer = [
+            self.template.init(jax.random.fold_in(key, i)) for i in range(self.num_layers)
+        ]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *per_layer)
+        return jax.tree.map(
+            lambda x: x.reshape(self.pp, self.layers_per_stage, *x.shape[1:]), stacked
+        )
+
+    def param_metas(self) -> Any:
+        def lift(m: ParamMeta) -> ParamMeta:
+            spec = (PIPE_AXIS, None) + tuple(m.partition_spec)
+            return ParamMeta(**{**m.__dict__, "partition_spec": spec})
+
+        return jax.tree.map(
+            lift, self.template.param_metas(), is_leaf=lambda x: isinstance(x, ParamMeta)
+        )
+
+    def __call__(
+        self,
+        params: Any,
+        x_microbatches: jax.Array,  # pytree with leaves (n_micro, mbs, ...)
+        ctx: ForwardContext,
+        layer_call: Optional[Callable] = None,
+        remat: bool = True,
+    ) -> jax.Array:
+        """Run all micro-batches through the pipelined stack.
+
+        Returns outputs stacked (n_micro, mbs, ...). ``layer_call(params,
+        x, ctx, layer_index)`` defaults to the template's __call__.
+        """
+        call = layer_call or (lambda p, xx, c, _i: self.template(p, xx, c))
+        pp, per_stage = self.pp, self.layers_per_stage
+
+        if pp == 1:
+            def run_all(x):
+                def body(h, wi):
+                    w, i = wi
+                    return call(w, h, ctx, i), None
+                squeezed = jax.tree.map(lambda p: p.reshape(self.num_layers, *p.shape[2:]), params)
+                h, _ = jax.lax.scan(body, x, (squeezed, jnp.arange(self.num_layers)))
+                return h
+
+            return jax.vmap(run_all)(x_microbatches) if _leading(x_microbatches) else run_all(x_microbatches)
+
+        n_micro = _leading(x_microbatches)
+        assert n_micro is not None, "pipelined body expects stacked micro-batches"
+
+        mesh = ctx.mesh
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def constrain_state(s):
+            if mesh is None:
+                return s
+            return jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x,
+                    NamedSharding(mesh, P(PIPE_AXIS, "data", *([None] * (x.ndim - 2)))),
+                ),
+                s,
+            )
+
+        stage_indices = jnp.arange(pp)
+
+        def stage_fn(stage_params, x, stage_idx, tick_key):
+            # decorrelate dropout: micro-batch m meets stage s at tick
+            # t = m + s, so folding the tick key gives distinct,
+            # deterministic keys per (stage, micro-batch)
+            if ctx.dropout_key is not None and not ctx.deterministic:
+                from dataclasses import replace as _replace
+
+                stage_ctx = _replace(ctx, dropout_key=tick_key)
+            else:
+                stage_ctx = ctx
+
+            def body(h, wi):
+                w, j = wi
+                layer_index = stage_idx * per_stage + j
+                return call(w, h, stage_ctx, layer_index), None
+
+            h, _ = jax.lax.scan(body, x, (stage_params, jnp.arange(per_stage)))
+            return h
+
+        if remat:
+            stage_fn = jax.checkpoint(stage_fn, static_argnums=())
+
+        base_key = (
+            ctx.dropout_key
+            if ctx.dropout_key is not None
+            else jax.random.PRNGKey(0)
+        )
+
+        def tick(state, t):
+            tick_key = jax.random.fold_in(base_key, t)
+            inp = jax.tree.map(
+                lambda xs: jax.lax.dynamic_index_in_dim(
+                    xs, jnp.clip(t, 0, n_micro - 1), keepdims=False
+                ),
+                x_microbatches,
+            )
+            shifted = jax.tree.map(
+                lambda i, s: jnp.concatenate([i[None], s[:-1]], axis=0), inp, state
+            )
+            shifted = constrain_state(shifted)
+            tick_keys = jax.vmap(lambda s: jax.random.fold_in(tick_key, s))(stage_indices)
+            new_state = jax.vmap(stage_fn)(params, shifted, stage_indices, tick_keys)
+            new_state = constrain_state(new_state)
+            out = jax.tree.map(lambda s: s[-1], new_state)
+            return new_state, out
+
+        zero_state = jax.tree.map(
+            lambda xs: jnp.zeros((pp,) + xs.shape[1:], dtype=xs.dtype), x_microbatches
+        )
+        zero_state = constrain_state(zero_state)
+        _, outs = jax.lax.scan(tick, zero_state, jnp.arange(n_micro + pp - 1))
+        return jax.tree.map(lambda o: o[pp - 1 :], outs)
+
+
+def _leading(tree: Any) -> Optional[int]:
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return None
+    return leaves[0].shape[0] if leaves[0].ndim > 0 else None

@@ -1,0 +1,276 @@
+"""Pipeline schedule instruction DSL + simulator.
+
+The reference precomputes per-rank 1F1B instruction lists and ships a
+simulator that replays a recorded profile to predict idle time
+(reference: src/scaling/core/nn/pipeline_schedule/instructions.py:5-61,
+train.py:32-174, inference.py:16-75, base.py:276-595). On TPU the *executor*
+is the jitted spatial pipeline in ``pipeline.py``, but the instruction DSL
+remains valuable: it documents the schedule, drives the simulator for
+capacity planning, and keeps parity with reference tooling. All pure Python
+— no devices needed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, NamedTuple, Optional
+
+
+# ------------------------------------------------------------- instructions
+class Instruction(NamedTuple):
+    name: str
+    micro_batch_id: Optional[int] = None
+    buffer_id: Optional[int] = None
+
+
+def InstructionLoadMicroBatch(micro_batch_id, buffer_id):
+    return Instruction("load_micro_batch", micro_batch_id, buffer_id)
+
+
+def InstructionRecvActivation(micro_batch_id, buffer_id):
+    return Instruction("recv_activation", micro_batch_id, buffer_id)
+
+
+def InstructionSendActivation(micro_batch_id, buffer_id):
+    return Instruction("send_activation", micro_batch_id, buffer_id)
+
+
+def InstructionForwardPass(micro_batch_id, buffer_id):
+    return Instruction("forward_pass", micro_batch_id, buffer_id)
+
+
+def InstructionLoss(micro_batch_id, buffer_id):
+    return Instruction("loss", micro_batch_id, buffer_id)
+
+
+def InstructionBackwardPass(micro_batch_id, buffer_id):
+    return Instruction("backward_pass", micro_batch_id, buffer_id)
+
+
+def InstructionSendGrad(micro_batch_id, buffer_id):
+    return Instruction("send_grad", micro_batch_id, buffer_id)
+
+
+def InstructionRecvGrad(micro_batch_id, buffer_id):
+    return Instruction("recv_grad", micro_batch_id, buffer_id)
+
+
+def InstructionReduceTiedGrads():
+    return Instruction("reduce_tied_grads")
+
+
+def InstructionOptimizerStep():
+    return Instruction("optimizer_step")
+
+
+def InstructionStoreMicroBatch(micro_batch_id, buffer_id):
+    return Instruction("store_micro_batch", micro_batch_id, buffer_id)
+
+
+# ---------------------------------------------------------------- schedules
+@dataclass
+class PipelineScheduleBase:
+    pipe_parallel_size: int
+    pipe_parallel_rank: int
+    gradient_accumulation_steps: int
+
+    @property
+    def num_buffers(self) -> int:
+        return max(
+            2,
+            min(
+                self.pipe_parallel_size - self.pipe_parallel_rank + 1,
+                self.gradient_accumulation_steps,
+            ),
+        )
+
+    def buffer_for(self, micro_batch_id: int) -> int:
+        return micro_batch_id % self.num_buffers
+
+    def instructions(self) -> List[Instruction]:
+        raise NotImplementedError
+
+
+class PipelineScheduleTrain(PipelineScheduleBase):
+    """1F1B: warmup forwards, steady 1F1B interleave, cooldown backwards.
+
+    Per-rank step count is ``2 * (grad_accum + pp - 1)`` (reference:
+    train.py:41-43); each step slot is a forward or backward opportunity
+    offset by the rank so neighbouring ranks interleave.
+    """
+
+    def instructions(self) -> List[Instruction]:
+        pp = self.pipe_parallel_size
+        rank = self.pipe_parallel_rank
+        gas = self.gradient_accumulation_steps
+        is_first = rank == 0
+        is_last = rank == pp - 1
+
+        # number of warmup forwards before the 1F1B steady state
+        warmup = min(pp - rank - 1, gas)
+        instructions: List[Instruction] = []
+        fwd_id = 0
+        bwd_id = 0
+
+        def forward(mb: int):
+            buf = self.buffer_for(mb)
+            if is_first:
+                instructions.append(InstructionLoadMicroBatch(mb, buf))
+            else:
+                instructions.append(InstructionRecvActivation(mb, buf))
+            instructions.append(InstructionForwardPass(mb, buf))
+            if is_last:
+                instructions.append(InstructionLoss(mb, buf))
+            else:
+                instructions.append(InstructionSendActivation(mb, buf))
+
+        def backward(mb: int):
+            buf = self.buffer_for(mb)
+            if not is_last:
+                instructions.append(InstructionRecvGrad(mb, buf))
+            instructions.append(InstructionBackwardPass(mb, buf))
+            if not is_first:
+                instructions.append(InstructionSendGrad(mb, buf))
+
+        for _ in range(warmup):
+            forward(fwd_id)
+            fwd_id += 1
+        while fwd_id < gas:
+            forward(fwd_id)
+            fwd_id += 1
+            backward(bwd_id)
+            bwd_id += 1
+        while bwd_id < gas:
+            backward(bwd_id)
+            bwd_id += 1
+
+        instructions.append(InstructionReduceTiedGrads())
+        instructions.append(InstructionOptimizerStep())
+        return instructions
+
+
+class PipelineScheduleInference(PipelineScheduleBase):
+    """Forward-only, alternating two buffers (reference: inference.py:16-75)."""
+
+    def instructions(self) -> List[Instruction]:
+        pp = self.pipe_parallel_size
+        rank = self.pipe_parallel_rank
+        gas = self.gradient_accumulation_steps
+        instructions: List[Instruction] = []
+        for mb in range(gas):
+            buf = mb % 2
+            if rank == 0:
+                instructions.append(InstructionLoadMicroBatch(mb, buf))
+            else:
+                instructions.append(InstructionRecvActivation(mb, buf))
+            instructions.append(InstructionForwardPass(mb, buf))
+            if rank == pp - 1:
+                instructions.append(InstructionStoreMicroBatch(mb, buf))
+            else:
+                instructions.append(InstructionSendActivation(mb, buf))
+        return instructions
+
+
+# ----------------------------------------------------------------- simulator
+@dataclass
+class SimulationEngine:
+    """Replays a profile (instruction durations) into per-rank timelines.
+
+    ``durations``: {instruction_name: seconds}, optionally overridden per
+    (name, rank). Communication instructions synchronise sender/receiver.
+    Produces total time and per-rank idle fraction — the reference renders
+    this as a PNG timeline; here the data structure is returned for tooling.
+    (reference: pipeline_schedule/base.py:276-595)
+    """
+
+    pipe_parallel_size: int
+    gradient_accumulation_steps: int
+    durations: Dict[str, float] = field(default_factory=dict)
+
+    DEFAULTS = {
+        "load_micro_batch": 0.1,
+        "recv_activation": 0.1,
+        "send_activation": 0.1,
+        "forward_pass": 1.0,
+        "loss": 0.1,
+        "backward_pass": 2.0,
+        "send_grad": 0.1,
+        "recv_grad": 0.1,
+        "reduce_tied_grads": 0.2,
+        "optimizer_step": 0.5,
+        "store_micro_batch": 0.1,
+    }
+
+    def duration(self, name: str) -> float:
+        return self.durations.get(name, self.DEFAULTS.get(name, 0.0))
+
+    def simulate(self, schedule_cls=PipelineScheduleTrain) -> dict:
+        pp = self.pipe_parallel_size
+        schedules = [
+            schedule_cls(
+                pipe_parallel_size=pp,
+                pipe_parallel_rank=r,
+                gradient_accumulation_steps=self.gradient_accumulation_steps,
+            ).instructions()
+            for r in range(pp)
+        ]
+        cursors = [0] * pp
+        times = [0.0] * pp
+        busy = [0.0] * pp
+        timeline: List[dict] = []
+        # comm matching: sends/recvs of (kind, mb) pair between neighbours
+        pending: Dict[tuple, float] = {}
+
+        def comm_peer(name: str, rank: int) -> Optional[int]:
+            if name in ("send_activation", "recv_grad"):
+                return rank + 1
+            if name in ("recv_activation", "send_grad"):
+                return rank - 1
+            return None
+
+        progressed = True
+        while progressed:
+            progressed = False
+            for r in range(pp):
+                while cursors[r] < len(schedules[r]):
+                    ins = schedules[r][cursors[r]]
+                    peer = comm_peer(ins.name, r)
+                    if peer is None:
+                        start = times[r]
+                        end = start + self.duration(ins.name)
+                        timeline.append(
+                            {"rank": r, "name": ins.name, "micro_batch": ins.micro_batch_id,
+                             "start": start, "end": end}
+                        )
+                        busy[r] += end - start
+                        times[r] = end
+                        cursors[r] += 1
+                        progressed = True
+                        continue
+                    # communication: ready when the matching half is posted
+                    mb = ins.micro_batch_id
+                    kind = "act" if "activation" in ins.name else "grad"
+                    lo, hi = min(r, peer), max(r, peer)
+                    key = (kind, mb, lo, hi)
+                    if key in pending:
+                        other_time = pending.pop(key)
+                        start = max(times[r], other_time)
+                        end = start + self.duration(ins.name)
+                        busy[r] += self.duration(ins.name)
+                        times[r] = end
+                        timeline.append(
+                            {"rank": r, "name": ins.name, "micro_batch": mb,
+                             "start": start, "end": end}
+                        )
+                        cursors[r] += 1
+                        progressed = True
+                        continue
+                    else:
+                        pending[key] = times[r]
+                        cursors[r] += 1
+                        progressed = True
+                        continue
+                # rank done
+        total = max(times)
+        idle = [1.0 - (b / total if total else 0.0) for b in busy]
+        return {"total_time": total, "idle_fraction": idle, "timeline": timeline}

@@ -1,0 +1,214 @@
+"""Pipeline parallelism: pp>1 spatial pipeline == sequential execution,
+partitioning math, 1F1B schedule structure."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scaling_tpu.nn import BaseLayer, ForwardContext, ParamMeta, RMSNorm, tree_prefix
+from scaling_tpu.parallel.pipeline import (
+    PipelinedBody,
+    pipe_partition_balanced,
+    pipe_partition_from_indices,
+    pipe_partition_uniform,
+)
+from scaling_tpu.parallel.pipeline_schedule import (
+    PipelineScheduleInference,
+    PipelineScheduleTrain,
+    SimulationEngine,
+)
+from scaling_tpu.topology import Topology, TopologyConfig
+
+
+class ToyBlock(BaseLayer):
+    """Residual tanh block — same pytree shape every layer (homogeneous)."""
+
+    def __init__(self, hidden: int):
+        self.hidden = hidden
+        self.norm = RMSNorm(hidden)
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        return {
+            "w": jax.random.normal(k1, (self.hidden, self.hidden)) * 0.1,
+            "norm": self.norm.init(k2),
+        }
+
+    def param_metas(self):
+        return {
+            "w": ParamMeta(parameter_name="w", partition_spec=(None, None)),
+            "norm": tree_prefix(self.norm.param_metas(), "norm"),
+        }
+
+    def __call__(self, params, x, ctx):
+        h = self.norm(params["norm"], x, ctx)
+        return x + jnp.tanh(h @ params["w"])
+
+
+def make_topology(pp, dp=2):
+    return Topology(
+        TopologyConfig(
+            model_parallel_size=1,
+            pipe_parallel_size=pp,
+            data_parallel_size=dp,
+            micro_batch_size=2,
+            gradient_accumulation_steps=4,
+        )
+    )
+
+
+@pytest.mark.parametrize("pp", [2, 4])
+def test_pipeline_forward_matches_sequential(devices, pp):
+    topo = make_topology(pp)
+    body = PipelinedBody(ToyBlock(16), num_layers=8, topology=topo)
+    params = body.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 2, 8, 16))  # (n_micro, mbs, s, h)
+
+    # sequential reference on unstacked params
+    flat = jax.tree.map(lambda p: p.reshape(8, *p.shape[2:]), params)
+    block = ToyBlock(16)
+    ctx = ForwardContext()
+
+    def seq(mb):
+        h = mb
+        for i in range(8):
+            h = block(jax.tree.map(lambda p: p[i], flat), h, ctx)
+        return h
+
+    ref = jax.vmap(seq)(x)
+
+    sharded = jax.tree.map(
+        lambda p, m: jax.device_put(
+            p, jax.sharding.NamedSharding(topo.mesh, m.spec())
+        ),
+        params,
+        body.param_metas(),
+        is_leaf=lambda v: isinstance(v, ParamMeta),
+    )
+
+    def run(p, xx):
+        c = ForwardContext(mesh=topo.mesh)
+        return body(p, xx, c)
+
+    out = jax.jit(run)(sharded, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_pipeline_gradients_match_sequential(devices):
+    pp = 4
+    topo = make_topology(pp)
+    body = PipelinedBody(ToyBlock(16), num_layers=8, topology=topo)
+    params = body.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 2, 8, 16))
+
+    flat = jax.tree.map(lambda p: p.reshape(8, *p.shape[2:]), params)
+    block = ToyBlock(16)
+
+    def loss_seq(fp):
+        def seq(mb):
+            h = mb
+            for i in range(8):
+                h = block(jax.tree.map(lambda p: p[i], fp), h, ForwardContext())
+            return h
+
+        return jnp.mean(jax.vmap(seq)(x) ** 2)
+
+    g_seq = jax.grad(loss_seq)(flat)
+
+    def loss_pipe(p):
+        out = body(p, x, ForwardContext(mesh=topo.mesh))
+        return jnp.mean(out ** 2)
+
+    g_pipe = jax.jit(jax.grad(loss_pipe))(params)
+    g_pipe_flat = jax.tree.map(lambda p: p.reshape(8, *p.shape[2:]), g_pipe)
+    for a, b in zip(jax.tree.leaves(g_seq), jax.tree.leaves(g_pipe_flat)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_pipeline_rejects_indivisible_layers(devices):
+    topo = make_topology(4)
+    with pytest.raises(AssertionError):
+        PipelinedBody(ToyBlock(16), num_layers=6, topology=topo)
+
+
+# ------------------------------------------------------------- partitioning
+def test_partition_uniform():
+    assert pipe_partition_uniform(8, 4) == [0, 2, 4, 6, 8]
+    assert pipe_partition_uniform(10, 4) == [0, 3, 6, 8, 10]
+    assert pipe_partition_uniform(3, 4) == [0, 1, 2, 3, 3]
+
+
+def test_partition_balanced():
+    # heavy first item: balanced puts it alone
+    bounds = pipe_partition_balanced([100, 1, 1, 1], 2)
+    assert bounds == [0, 1, 4]
+    bounds = pipe_partition_balanced([1, 1, 1, 1, 1, 1], 3)
+    assert bounds == [0, 2, 4, 6]
+
+
+def test_partition_from_indices_validates():
+    assert pipe_partition_from_indices([0, 2, 4], 4, 2) == [0, 2, 4]
+    with pytest.raises(AssertionError):
+        pipe_partition_from_indices([0, 3], 4, 2)
+
+
+# ----------------------------------------------------------------- schedule
+def test_1f1b_instruction_structure():
+    pp, gas = 4, 8
+    for rank in range(pp):
+        ins = PipelineScheduleTrain(
+            pipe_parallel_size=pp, pipe_parallel_rank=rank,
+            gradient_accumulation_steps=gas,
+        ).instructions()
+        names = [i.name for i in ins]
+        assert names.count("forward_pass") == gas
+        assert names.count("backward_pass") == gas
+        assert names[-1] == "optimizer_step"
+        assert names[-2] == "reduce_tied_grads"
+        # every forward of a micro batch precedes its backward
+        for mb in range(gas):
+            f = next(k for k, i in enumerate(ins) if i.name == "forward_pass" and i.micro_batch_id == mb)
+            b = next(k for k, i in enumerate(ins) if i.name == "backward_pass" and i.micro_batch_id == mb)
+            assert f < b
+        if rank == 0:
+            assert "load_micro_batch" in names and "recv_activation" not in names
+        else:
+            assert "recv_activation" in names and "load_micro_batch" not in names
+        if rank == pp - 1:
+            assert "loss" in names and "send_activation" not in names
+
+
+def test_1f1b_warmup_depth():
+    """Rank r runs (pp - r - 1) warmup forwards before its first backward."""
+    pp, gas = 4, 8
+    for rank in range(pp):
+        ins = PipelineScheduleTrain(
+            pipe_parallel_size=pp, pipe_parallel_rank=rank,
+            gradient_accumulation_steps=gas,
+        ).instructions()
+        first_bwd = next(k for k, i in enumerate(ins) if i.name == "backward_pass")
+        forwards_before = sum(1 for i in ins[:first_bwd] if i.name == "forward_pass")
+        assert forwards_before == min(pp - rank - 1, gas) + 1  # warmup + the 1F1B partner
+
+
+def test_inference_schedule():
+    ins = PipelineScheduleInference(
+        pipe_parallel_size=2, pipe_parallel_rank=1, gradient_accumulation_steps=3
+    ).instructions()
+    names = [i.name for i in ins]
+    assert names.count("forward_pass") == 3
+    assert names.count("store_micro_batch") == 3
+    buffers = [i.buffer_id for i in ins if i.name == "forward_pass"]
+    assert buffers == [0, 1, 0]
+
+
+def test_simulator_idle_fraction():
+    sim = SimulationEngine(pipe_parallel_size=4, gradient_accumulation_steps=8)
+    result = sim.simulate()
+    assert result["total_time"] > 0
+    assert len(result["idle_fraction"]) == 4
+    # more micro batches -> lower bubble fraction
+    sim_big = SimulationEngine(pipe_parallel_size=4, gradient_accumulation_steps=32)
+    big = sim_big.simulate()
+    assert max(big["idle_fraction"]) < max(result["idle_fraction"]) + 1e-6
