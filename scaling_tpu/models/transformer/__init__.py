@@ -1,0 +1,44 @@
+from .config import (
+    AdapterConfig,
+    BitfitConfig,
+    EmbeddingHeadConfig,
+    MLPType,
+    Precision,
+    RelativePositionEmbeddingType,
+    SoftpromptConfig,
+    TrainingConfig,
+    TransformerArchitectureConfig,
+    TransformerConfig,
+)
+from .context import TransformerContext
+from .model import (
+    get_parameter_groups,
+    get_transformer_layer_specs,
+    init_model,
+    init_optimizer,
+    loss_function,
+    metrics_aggregation_fn,
+)
+from .tokenizer import Tokenizer, load_tokenizers
+
+__all__ = [
+    "AdapterConfig",
+    "BitfitConfig",
+    "EmbeddingHeadConfig",
+    "MLPType",
+    "Precision",
+    "RelativePositionEmbeddingType",
+    "SoftpromptConfig",
+    "TrainingConfig",
+    "TransformerArchitectureConfig",
+    "TransformerConfig",
+    "TransformerContext",
+    "get_parameter_groups",
+    "get_transformer_layer_specs",
+    "init_model",
+    "init_optimizer",
+    "loss_function",
+    "metrics_aggregation_fn",
+    "Tokenizer",
+    "load_tokenizers",
+]

@@ -1,0 +1,282 @@
+"""Transformer suite configuration.
+
+TPU-native re-design of the reference's transformer config composition
+(reference: src/scaling/transformer/context/config.py:28-459): one frozen
+pydantic tree wiring topology + optimizer + LR schedules + trainer + data +
+architecture. ``Precision`` maps straight onto jnp dtypes (bf16 is the TPU
+native compute type); fp16 keeps the dynamic loss scaler for parity.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from pathlib import Path
+from typing import Any, List, Optional
+
+import jax.numpy as jnp
+from pydantic import Field, model_validator
+
+from ...config import BaseConfig
+from ...context.context import ContextConfig
+from ...logging import LoggerConfig
+from ...nn.activation_function import ActivationFunction
+from ...nn.lora import LoRaConfig
+from ...nn.masked_softmax import MaskedSoftmaxConfig
+from ...nn.norm import LayerNormConfig, NormType
+from ...optimizer import LearningRateSchedulerConfig, OptimizerConfig
+from ...topology import TopologyConfig
+from ...trainer import TrainerConfig
+
+
+class Precision(Enum):
+    FLOAT16 = "float16"
+    BFLOAT16 = "bfloat16"
+    FLOAT32 = "float32"
+
+    @property
+    def dtype(self):
+        return {
+            Precision.FLOAT16: jnp.float16,
+            Precision.BFLOAT16: jnp.bfloat16,
+            Precision.FLOAT32: jnp.float32,
+        }[self]
+
+
+class MLPType(Enum):
+    DEFAULT = "default"
+    SWIGLU = "swiglu"
+
+
+class RelativePositionEmbeddingType(Enum):
+    NONE = "none"
+    ROTARY = "rotary"
+    ROTARY_COMPLEX = "rotary_complex"
+
+
+class UMuPConfig(BaseConfig):
+    """Unit-scaled maximal update parametrisation flags (kept for config
+    parity with the reference architecture surface; off by default)."""
+
+    enable: bool = Field(False, description="enable u-mup scaling rules")
+    normalize_depth_to_num_layers: bool = Field(True, description="")
+
+
+class BitfitConfig(BaseConfig):
+    """BitFit fine-tuning: fresh named bias parameters on linears/norms
+    (reference: config.py:72-78)."""
+
+    name: str = Field("bitfit", description="name suffix of the fresh bias parameters")
+
+
+class AdapterConfig(BaseConfig):
+    """Bottleneck adapters inserted after attention and/or MLP blocks
+    (reference: config.py:80-97, layers/layer.py:140-187)."""
+
+    name: str = Field("adapter", description="adapter parameter name suffix")
+    attention_downsampling_factor: Optional[int] = Field(
+        None, description="hidden // factor bottleneck after the attention block"
+    )
+    mlp_downsampling_factor: Optional[int] = Field(
+        None, description="hidden // factor bottleneck after the mlp block"
+    )
+    init_std: float = Field(1.0e-3, description="std of the adapter init")
+
+
+class SoftpromptConfig(BaseConfig):
+    """Learned prompt embeddings overwriting the first ``n_tokens``
+    positions (reference: config.py:99-105, layers/embedding.py:63-81)."""
+
+    name: str = Field("softprompt", description="softprompt parameter name suffix")
+    n_tokens: int = Field(8, description="number of learned prompt positions", gt=0)
+
+
+class EmbeddingHeadConfig(BaseConfig):
+    """Projection stack on weighted-mean-pooled hidden states for
+    embedding models (reference: config.py:107-124, embedding_head.py:12-80)."""
+
+    name: str = Field("embedding_head", description="")
+    proj_layers: List[int] = Field(
+        default_factory=list,
+        description="hidden sizes of the projection stack; last entry is the "
+        "embedding dimension",
+    )
+
+
+class TransformerArchitectureConfig(BaseConfig):
+    """Model shape + feature switches
+    (reference: src/scaling/transformer/context/config.py:126-330)."""
+
+    vocab_size: int = Field(description="size of the vocabulary", gt=0)
+    vocab_file: Optional[Path] = Field(None, description="tokenizer vocab json")
+    hidden_size: int = Field(description="transformer hidden size", gt=0)
+    num_layers: int = Field(description="number of transformer layers", ge=0)
+    num_attention_heads: int = Field(description="number of attention heads", gt=0)
+    attention_num_kv_heads: Optional[int] = Field(
+        None, description="number of kv heads for grouped-query attention"
+    )
+    attention_qkv_in_one: bool = Field(
+        True, description="store q,k,v projections in one fused weight"
+    )
+    num_local_attention_heads: int = Field(
+        0, description="number of heads restricted to a local window", ge=0
+    )
+    local_attention_window_size: Optional[int] = Field(
+        None, description="window size of local attention heads"
+    )
+    rotary_embedding_base: int = Field(10000, description="rotary base theta")
+    rotary_percentage: float = Field(
+        1.0, description="fraction of head dim that is rotated", gt=0.0, le=1.0
+    )
+    sequence_length: int = Field(2048, description="training sequence length", gt=0)
+    norm_type: NormType = Field(NormType.LAYERNORM, description="")
+    relative_position_embedding_type: RelativePositionEmbeddingType = Field(
+        RelativePositionEmbeddingType.ROTARY, description=""
+    )
+    mlp_type: MLPType = Field(MLPType.DEFAULT, description="")
+    mlp_factor: float = Field(4.0, description="mlp intermediate = factor * hidden", gt=0)
+    activation_function: ActivationFunction = Field(ActivationFunction.GELU, description="")
+    precision: Precision = Field(Precision.FLOAT32, description="compute/param dtype")
+    layernorm: LayerNormConfig = Field(LayerNormConfig(), description="")
+    masked_softmax: MaskedSoftmaxConfig = Field(MaskedSoftmaxConfig(), description="")
+    causal: bool = Field(True, description="use a causal attention mask")
+    key_query_norm: bool = Field(False, description="normalise q/k per head")
+    weight_tying: bool = Field(False, description="tie lm head to the embedding")
+    masked_softmax_fusion: bool = Field(True, description="kept for config parity")
+    layernorm_epsilon: float = Field(1.0e-5, description="kept for config parity")
+
+    dropout_embedding: float = Field(0.0, description="", ge=0.0, le=1.0)
+    dropout_attention_probs: float = Field(0.0, description="", ge=0.0, le=1.0)
+    dropout_after_attention: float = Field(0.0, description="", ge=0.0, le=1.0)
+    dropout_after_mlp: float = Field(0.0, description="", ge=0.0, le=1.0)
+
+    # fine tuning / PEFT
+    bitfit_bias_config: Optional[BitfitConfig] = Field(None, description="")
+    adapter_config: Optional[AdapterConfig] = Field(None, description="")
+    softprompt_config: Optional[SoftpromptConfig] = Field(None, description="")
+    lora_config: Optional[LoRaConfig] = Field(None, description="")
+    embedding_head_config: Optional[EmbeddingHeadConfig] = Field(None, description="")
+    finetunable_token_ids: List[int] = Field(
+        default_factory=list,
+        description="restrict embedding gradients to these token ids",
+    )
+    image_encoder: bool = Field(
+        False, description="multimodal CLIP image encoder (not supported on TPU build yet)"
+    )
+    umup: UMuPConfig = Field(UMuPConfig(), description="")
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.image_encoder:
+            raise NotImplementedError(
+                "the CLIP image encoder path is gated off in the TPU build"
+            )
+        if self.num_local_attention_heads > 0 and self.local_attention_window_size is None:
+            raise ValueError("local attention heads require local_attention_window_size")
+        return self
+
+    @property
+    def dtype(self):
+        return self.precision.dtype
+
+    @property
+    def peft_names(self) -> List[str]:
+        """Names of active PEFT modules — drives separate checkpoint files
+        (reference: config.py:426-459)."""
+        names = []
+        if self.bitfit_bias_config:
+            names.append(self.bitfit_bias_config.name)
+        if self.adapter_config:
+            names.append(self.adapter_config.name)
+        if self.softprompt_config:
+            names.append(self.softprompt_config.name)
+        if self.lora_config:
+            names.append(self.lora_config.name)
+        if self.embedding_head_config:
+            names.append(self.embedding_head_config.name)
+        return names
+
+
+class TrainingConfig(BaseConfig):
+    weight_decay: float = Field(1.0e-4, description="weight decay for linear weights")
+    finetune: bool = Field(
+        False, description="train only parameters matched by finetunable_parameters"
+    )
+    finetunable_parameters: List[str] = Field(
+        default_factory=list,
+        description="regexes of parameter names to train when finetune is set",
+    )
+    parameters_exclude: List[str] = Field(
+        default_factory=list,
+        description="regexes of parameter names to exclude from training",
+    )
+    use_deterministic_torch_algorithms: bool = Field(
+        False, description="kept for config parity; XLA is deterministic by default"
+    )
+    use_separate_lr_on_embeddings: bool = Field(
+        False, description="use embedding_learning_rate_scheduler on embedding weights"
+    )
+
+
+class DataConfig(BaseConfig):
+    data_prefixes: Optional[List[Path]] = Field(
+        None, description="prefixes of memory-map dataset files"
+    )
+    blended_dataset: "BlendedDatasetConfig" = Field(
+        None, description="blending over data_prefixes"
+    )
+    validation_data_prefixes: Optional[List[Path]] = Field(None, description="")
+    legacy_dataset: bool = Field(False, description="load Megatron-format .bin/.idx data")
+    finetuning_dataset: bool = Field(False, description="prompt/completion jsonl data")
+    finetuning_chat_dataset: bool = Field(False, description="chat jsonl data")
+    finetuning_dataset_memory_map: bool = Field(False, description="")
+    use_mmap: bool = Field(True, description="")
+    load_mmap_index_to_memory: bool = Field(False, description="")
+    load_data_item_mmap_index_to_memory: bool = Field(False, description="")
+    only_full_sequences: bool = Field(False, description="")
+    allow_incomplete_sequences_every_n: int = Field(0, description="", ge=0)
+    embedding_dataset: bool = Field(False, description="")
+    embedding_dataset_memory_map: bool = Field(False, description="")
+
+
+from ...data.blended_dataset import BlendedDatasetConfig  # noqa: E402
+
+DataConfig.model_rebuild()
+
+
+class ProfilerConfig(BaseConfig):
+    profile_steps: int = Field(0, description="number of steps to profile")
+    profile_start_at_step: int = Field(10, description="start profiling at this step")
+    profiler_output: Optional[Path] = Field(None, description="trace output path")
+
+
+class TransformerConfig(BaseConfig):
+    """Composition root (reference: config.py:364-425)."""
+
+    version: str = Field("0.1.0", description="")
+    runner: "RunnerConfig" = Field(None, description="")
+    logger: LoggerConfig = Field(LoggerConfig(), description="")
+    topology: TopologyConfig = Field(description="")
+    optimizer: OptimizerConfig = Field(OptimizerConfig(), description="")
+    learning_rate_scheduler: LearningRateSchedulerConfig = Field(
+        LearningRateSchedulerConfig(), description=""
+    )
+    embedding_learning_rate_scheduler: LearningRateSchedulerConfig = Field(
+        LearningRateSchedulerConfig(), description=""
+    )
+    training: TrainingConfig = Field(TrainingConfig(), description="")
+    trainer: TrainerConfig = Field(TrainerConfig(), description="")
+    profiler: ProfilerConfig = Field(ProfilerConfig(), description="")
+    transformer_architecture: TransformerArchitectureConfig = Field(description="")
+    data: DataConfig = Field(DataConfig(), description="")
+    determined_experiment_id: Optional[int] = Field(None, description="")
+    determined_trial_id: Optional[int] = Field(None, description="")
+    context: ContextConfig = Field(ContextConfig(), description="")
+
+    @classmethod
+    def from_dict(cls, d: dict, overwrite_values: Optional[dict] = None):
+        return super().from_dict(d, overwrite_values=overwrite_values)
+
+
+from ...runner.config import RunnerConfig  # noqa: E402
+
+TransformerConfig.model_rebuild()

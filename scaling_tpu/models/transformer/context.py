@@ -1,0 +1,9 @@
+"""Transformer context (reference: src/scaling/transformer/context/context.py:6-15)."""
+
+from __future__ import annotations
+
+from ...context import BaseContext
+
+
+class TransformerContext(BaseContext):
+    pass

@@ -1,0 +1,13 @@
+from .text_dataset import (
+    TextBlendedDataset,
+    TextDataset,
+    TextDatasetBatch,
+    TextDatasetItem,
+)
+
+__all__ = [
+    "TextBlendedDataset",
+    "TextDataset",
+    "TextDatasetBatch",
+    "TextDatasetItem",
+]

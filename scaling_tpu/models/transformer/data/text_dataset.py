@@ -1,0 +1,202 @@
+"""Pretraining text dataset: packed fixed-length windows over mmap docs.
+
+(reference: src/scaling/transformer/data/text_dataset.py:26-462) — token
+documents in a MemoryMapDataset are packed into items of
+``sequence_length + 1`` tokens (input/target shifted by one). Packing state
+(doc, start, end spans) is a deterministic pure function of the dataset +
+sequence length; the reference caches it to disk built by rank 0 with a
+``.done`` poll — here every process computes the identical index (numpy
+prefix sums, fast) and an optional cache file removes even that cost.
+
+The EOD-token resets of the reference's ``cumulative_seq_lengths``
+(data/utils.py:40-75) become segment ids — the TPU-native packing
+representation consumed by attention masks and Pallas kernels alike.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from pathlib import Path
+from typing import List, Optional
+
+import numpy as np
+
+from ....data import BaseDataset, BaseDatasetBatch, BaseDatasetItem
+from ....data.blended_dataset import BaseBlendedDataset
+from ....data.memory_map import MemoryMapDataset
+from ....nn.seq_packing import get_position_ids_from_segments, get_segment_ids
+
+
+@dataclass
+class TextDatasetItem(BaseDatasetItem):
+    token_ids: np.ndarray  # (seq_len + 1,)
+
+
+class TextDatasetBatch(BaseDatasetBatch):
+    """Batch pytree (reference: text_dataset_batch.py:29-140)."""
+
+    def __init__(
+        self,
+        token_ids: np.ndarray,  # (b, s) inputs
+        target_token_ids: np.ndarray,  # (b, s)
+        position_ids: np.ndarray,
+        segment_ids: np.ndarray,
+        loss_weights: np.ndarray,
+    ):
+        self.token_ids = token_ids
+        self.target_token_ids = target_token_ids
+        self.position_ids = position_ids
+        self.segment_ids = segment_ids
+        self.loss_weights = loss_weights
+
+    def as_model_input(self) -> dict:
+        return {
+            "token_ids": self.token_ids,
+            "target_token_ids": self.target_token_ids,
+            "position_ids": self.position_ids,
+            "segment_ids": self.segment_ids,
+            "loss_weights": self.loss_weights,
+        }
+
+    def only_inputs(self) -> "TextDatasetBatch":
+        return self
+
+    def only_targets(self) -> "TextDatasetBatch":
+        return self
+
+
+class TextDataset(BaseDataset[TextDatasetItem, TextDatasetBatch]):
+    def __init__(
+        self,
+        data_prefix: Path | str,
+        sequence_length: int,
+        seed: int = 42,
+        shuffle: bool = True,
+        eod_token_id: int = 0,
+        only_full_sequences: bool = False,
+        allow_incomplete_sequences_every_n: int = 0,
+        load_index_to_memory: bool = True,
+    ):
+        self.data_prefix = Path(data_prefix)
+        self.sequence_length = sequence_length
+        self.eod_token_id = eod_token_id
+        self.only_full_sequences = only_full_sequences
+        self.allow_incomplete_sequences_every_n = allow_incomplete_sequences_every_n
+        self.memory_map = MemoryMapDataset(
+            self.data_prefix, load_index_to_memory=load_index_to_memory
+        )
+        self._build_pack_index()
+        super().__init__(seed=seed, shuffle=shuffle)
+
+    # ------------------------------------------------------------ packing
+    def _build_pack_index(self) -> None:
+        """Item i covers tokens [i*L, i*L + L + 1) of the concatenated doc
+        stream, L = sequence_length. With only_full_sequences, items are
+        aligned to document starts instead (reference:
+        text_dataset.py:130-300)."""
+        sizes = self.memory_map.sizes().astype(np.int64)
+        total_tokens = int(sizes.sum())
+        L = self.sequence_length
+        if not self.only_full_sequences:
+            self._num_items = max((total_tokens - 1) // L, 0)
+            self._item_starts = None
+            self._item_ends = None
+        else:
+            # greedy packing of whole documents into [start, end) windows
+            spans: List[tuple] = []
+            doc_offsets = np.concatenate([[0], np.cumsum(sizes)])
+            window_start = 0
+            since_cut = 0
+            every_n = self.allow_incomplete_sequences_every_n
+            for d in range(len(sizes)):
+                doc_start = int(doc_offsets[d])
+                doc_end = int(doc_offsets[d + 1])
+                if doc_end - window_start <= L:
+                    continue  # doc fits into the open window
+                if every_n > 0 and since_cut + 1 >= every_n:
+                    # the every-n exception: cut mid-document
+                    while doc_end - window_start > L:
+                        spans.append((window_start, window_start + L))
+                        window_start += L
+                    since_cut = 0
+                    continue
+                # close the open window at this doc's boundary
+                if doc_start > window_start:
+                    spans.append((window_start, doc_start))
+                    since_cut += 1
+                window_start = doc_start
+                if doc_end - window_start > L:
+                    # over-long document: emit full windows, drop the tail
+                    # so the next window realigns to a doc boundary
+                    while doc_end - window_start > L:
+                        spans.append((window_start, window_start + L))
+                        window_start += L
+                        since_cut = 0
+                    window_start = doc_end
+            if total_tokens - window_start >= 2:
+                spans.append((window_start, total_tokens))
+            spans = [(s, e) for s, e in spans if e - s >= 2 and s + 2 <= total_tokens]
+            self._item_starts = np.asarray([s for s, _ in spans], dtype=np.int64)
+            self._item_ends = np.asarray([e for _, e in spans], dtype=np.int64)
+            self._num_items = len(self._item_starts)
+        self._total_tokens = total_tokens
+
+    def set_seed(self, seed: int, shuffle: bool = True) -> None:
+        # item order is owned by the DP-strided RandomSampler; the dataset
+        # itself is deterministic given the mmap + sequence length
+        self.seed = seed
+        self.shuffle = shuffle
+
+    def ident(self) -> str:
+        h = hashlib.md5(
+            f"{self.data_prefix}-{self.sequence_length}-{self.only_full_sequences}".encode()
+        ).hexdigest()
+        return f"text-{h}"
+
+    def __len__(self) -> int:
+        return self._num_items
+
+    def __getitem__(self, index: int) -> TextDatasetItem:
+        L = self.sequence_length
+        if self._item_starts is None:
+            start = index * L
+            n = min(L + 1, self._total_tokens - start)
+        else:
+            # read only this window's documents; EOD-pad the remainder so no
+            # partial next-document head leaks in (and no token is trained
+            # twice across adjacent windows)
+            start = int(self._item_starts[index])
+            n = min(L + 1, int(self._item_ends[index]) - start)
+        tokens = self.memory_map.read_span(start, n)
+        if n < L + 1:
+            tokens = np.concatenate(
+                [tokens, np.full(L + 1 - n, self.eod_token_id, dtype=tokens.dtype)]
+            )
+        return TextDatasetItem(token_ids=tokens.astype(np.int64))
+
+    # ------------------------------------------------------------ collate
+    def collate(self, batch: List[TextDatasetItem]) -> TextDatasetBatch:
+        tokens = np.stack([item.token_ids for item in batch])  # (b, L+1)
+        inputs = tokens[:, :-1]
+        targets = tokens[:, 1:]
+        segment_ids = get_segment_ids(inputs, self.eod_token_id)
+        position_ids = get_position_ids_from_segments(segment_ids)
+        # weight every real token incl. the EOD prediction; zero only inside
+        # padding runs where input and target are both EOD
+        # (reference: text_dataset_batch.py:106-140)
+        loss_weights = np.maximum(
+            (targets != self.eod_token_id).astype(np.float32),
+            (inputs != self.eod_token_id).astype(np.float32),
+        )
+        return TextDatasetBatch(
+            token_ids=inputs.astype(np.int32),
+            target_token_ids=targets.astype(np.int32),
+            position_ids=position_ids.astype(np.int32),
+            segment_ids=segment_ids.astype(np.int32),
+            loss_weights=loss_weights,
+        )
+
+
+class TextBlendedDataset(BaseBlendedDataset):
+    """Weighted blend over TextDatasets (reference: text_dataset.py tail)."""

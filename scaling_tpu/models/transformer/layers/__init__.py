@@ -1,0 +1,20 @@
+from .base import make_layer_io
+from .embedding import EmbeddingInput
+from .layer import Adapter, TransformerLayer
+from .lm_head import (
+    LayerNormWrapper,
+    TransformerEmbeddingHead,
+    TransformerLMHead,
+    TransformerLMHeadTied,
+)
+
+__all__ = [
+    "make_layer_io",
+    "EmbeddingInput",
+    "Adapter",
+    "TransformerLayer",
+    "LayerNormWrapper",
+    "TransformerEmbeddingHead",
+    "TransformerLMHead",
+    "TransformerLMHeadTied",
+]

@@ -1,0 +1,44 @@
+"""Transformer layer IO.
+
+The reference threads a ``TransformerLayerIO`` dataclass through the stack
+with tuple-conversion manifests for pipe communication
+(reference: src/scaling/transformer/model/layers/base.py:12-124). Under jit
+the IO is a plain dict pytree with static treedef — no manifests needed.
+Non-tensor inference settings travel as jit-static layer attributes, not as
+runtime payload (the reference pickles them through the pipe, a pattern that
+cannot exist under XLA's static shapes).
+
+Keys:
+  activations     (b, s, hidden)
+  position_ids    (b, s) int32
+  segment_ids     (b, s) int32 — TPU-native packing representation; the
+                  reference's ``cumulative_seq_lengths`` converts to/from
+                  this via nn.seq_packing
+  loss_weights    (b, s) float32 or None
+  embeddings      recorded final hidden state for embedding heads, or None
+  attention_scores_manipulation  optional additive mask bias or None
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+
+
+def make_layer_io(
+    activations: jax.Array,
+    position_ids: jax.Array,
+    segment_ids: jax.Array,
+    loss_weights: Optional[jax.Array] = None,
+    embeddings: Optional[jax.Array] = None,
+    attention_scores_manipulation: Optional[jax.Array] = None,
+) -> Dict[str, Any]:
+    return {
+        "activations": activations,
+        "position_ids": position_ids,
+        "segment_ids": segment_ids,
+        "loss_weights": loss_weights,
+        "embeddings": embeddings,
+        "attention_scores_manipulation": attention_scores_manipulation,
+    }

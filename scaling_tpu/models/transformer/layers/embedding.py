@@ -1,0 +1,92 @@
+"""Token embedding input layer.
+
+(reference: src/scaling/transformer/model/layers/embedding.py:29-160) —
+VocabParallelEmbedding + embedding dropout, optional softprompt splice.
+The batch arrives as the dict the dataset collates
+(token_ids/position_ids/segment_ids/loss_weights); this layer turns it into
+the transformer IO dict. The image-encoder splice is gated off (config
+raises), matching the TPU build's scope.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ....nn import (
+    BaseLayer,
+    ForwardContext,
+    ParamMeta,
+    VocabParallelEmbedding,
+    tree_prefix,
+)
+from ..config import SoftpromptConfig, TransformerArchitectureConfig
+from .base import make_layer_io
+
+
+class EmbeddingInput(BaseLayer):
+    def __init__(self, architecture: TransformerArchitectureConfig):
+        self.architecture = architecture
+        self.embedding = VocabParallelEmbedding(
+            num_embeddings=architecture.vocab_size,
+            embedding_dim=architecture.hidden_size,
+            dtype=architecture.dtype,
+            finetunable_token_ids=architecture.finetunable_token_ids or None,
+        )
+        self.dropout_rate = architecture.dropout_embedding
+        self.softprompt_config: Optional[SoftpromptConfig] = architecture.softprompt_config
+
+    def init(self, key: jax.Array) -> dict:
+        params = {"embedding": self.embedding.init(key)}
+        if self.softprompt_config is not None:
+            sp_key = jax.random.fold_in(key, 1)
+            params[f"softprompt_{self.softprompt_config.name}"] = jax.random.normal(
+                sp_key,
+                (self.softprompt_config.n_tokens, self.architecture.hidden_size),
+                dtype=self.architecture.dtype,
+            ) * 0.5
+        return params
+
+    def param_metas(self) -> dict:
+        metas = {"embedding": tree_prefix(self.embedding.param_metas(), "embedding")}
+        if self.softprompt_config is not None:
+            name = f"softprompt_{self.softprompt_config.name}"
+            metas[name] = ParamMeta(
+                parameter_name=name,
+                partition_spec=(None, None),
+                is_model_parallel_duplicate=True,
+            )
+        return metas
+
+    def __call__(self, params: dict, batch: dict, ctx: ForwardContext) -> dict:
+        token_ids = batch["token_ids"]
+        embeddings = self.embedding(params["embedding"], token_ids, ctx)
+
+        if self.softprompt_config is not None:
+            # overwrite the first n_tokens positions with the learned prompt
+            # (reference: embedding.py:146-160 splices at placeholder ids)
+            n = self.softprompt_config.n_tokens
+            sp = params[f"softprompt_{self.softprompt_config.name}"]
+            sp = jnp.broadcast_to(sp[None], (embeddings.shape[0], n, embeddings.shape[2]))
+            embeddings = jax.lax.dynamic_update_slice_in_dim(
+                embeddings, sp.astype(embeddings.dtype), 0, axis=1
+            )
+
+        embeddings = ctx.dropout(embeddings, self.dropout_rate)
+
+        b, s = token_ids.shape
+        position_ids = batch.get("position_ids")
+        if position_ids is None:
+            position_ids = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+        segment_ids = batch.get("segment_ids")
+        if segment_ids is None:
+            segment_ids = jnp.zeros((b, s), dtype=jnp.int32)
+        return make_layer_io(
+            activations=embeddings,
+            position_ids=position_ids,
+            segment_ids=segment_ids,
+            loss_weights=batch.get("loss_weights"),
+            attention_scores_manipulation=batch.get("attention_scores_manipulation"),
+        )

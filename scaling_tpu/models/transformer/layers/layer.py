@@ -1,0 +1,219 @@
+"""The transformer block.
+
+(reference: src/scaling/transformer/model/layers/layer.py:44-291) —
+pre-norm attention with residual, pre-norm MLP with residual, dropout after
+each block, optional bottleneck adapters after each block. Dropout keys come
+from the ForwardContext, which derives them deterministically per call —
+that is the whole of the reference's CudaRNGStateTracker on TPU: the same
+key is computed on every model-parallel shard, so masks agree by
+construction (reference: rng_tracker.py:59-96).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ....nn import (
+    BaseLayer,
+    ForwardContext,
+    ParallelMLP,
+    ParallelSelfAttention,
+    ParallelSwiGLUMLP,
+    ParamMeta,
+    get_norm,
+    normal_init,
+    tree_prefix,
+)
+from ....nn.rotary import RotaryConfig
+from ..config import (
+    AdapterConfig,
+    MLPType,
+    RelativePositionEmbeddingType,
+    TransformerArchitectureConfig,
+)
+
+
+class Adapter(BaseLayer):
+    """Bottleneck adapter: down-proj -> gelu -> up-proj, residual outside
+    (reference: layers/layer.py:140-187). Replicated params (adapters are
+    small; sharding them would waste ICI)."""
+
+    def __init__(self, hidden_size: int, downsampling_factor: int, init_std: float, dtype):
+        self.hidden_size = hidden_size
+        self.bottleneck = hidden_size // downsampling_factor
+        self.init_std = init_std
+        self.dtype = dtype
+
+    def init(self, key: jax.Array) -> dict:
+        k1, k2 = jax.random.split(key)
+        init = normal_init(self.init_std)
+        return {
+            "down": init(k1, (self.hidden_size, self.bottleneck), self.dtype),
+            "up": init(k2, (self.bottleneck, self.hidden_size), self.dtype),
+        }
+
+    def param_metas(self) -> dict:
+        return {
+            "down": ParamMeta(parameter_name="down", partition_spec=(None, None),
+                              is_model_parallel_duplicate=True),
+            "up": ParamMeta(parameter_name="up", partition_spec=(None, None),
+                            is_model_parallel_duplicate=True),
+        }
+
+    def __call__(self, params: dict, x: jax.Array, ctx: ForwardContext) -> jax.Array:
+        h = jax.nn.gelu(x @ params["down"].astype(x.dtype))
+        return h @ params["up"].astype(x.dtype)
+
+
+class TransformerLayer(BaseLayer):
+    def __init__(self, architecture: TransformerArchitectureConfig, layer_index: int = 0):
+        arch = architecture
+        self.architecture = arch
+        self.layer_index = layer_index
+        dtype = arch.dtype
+        bitfit = arch.bitfit_bias_config.name if arch.bitfit_bias_config else None
+
+        self.input_layernorm = get_norm(
+            arch.norm_type, arch.hidden_size, arch.layernorm, dtype, bitfit
+        )
+        rotary_config = None
+        if arch.relative_position_embedding_type != RelativePositionEmbeddingType.NONE:
+            head_dim = arch.hidden_size // arch.num_attention_heads
+            rotary_config = RotaryConfig(
+                dimensions=max(2, int(head_dim * arch.rotary_percentage)),
+                base=arch.rotary_embedding_base,
+                max_seq_length=arch.sequence_length,
+            )
+        self.attention = ParallelSelfAttention(
+            hidden_size=arch.hidden_size,
+            num_attention_heads=arch.num_attention_heads,
+            masked_softmax_config=arch.masked_softmax,
+            causal=arch.causal,
+            num_local_attention_heads=arch.num_local_attention_heads,
+            local_attention_window_size=arch.local_attention_window_size,
+            dropout_attention_probs=arch.dropout_attention_probs,
+            rotary_config=rotary_config,
+            relative_position_embedding_type=arch.relative_position_embedding_type.value,
+            bias=arch.mlp_type == MLPType.DEFAULT,
+            dtype=dtype,
+            bitfit_bias_name=bitfit,
+            lora_config=arch.lora_config,
+            norm_type=arch.norm_type,
+            key_query_norm=arch.key_query_norm,
+            layernorm_config=arch.layernorm,
+            qkv_in_one=arch.attention_qkv_in_one
+            and arch.attention_num_kv_heads is None,
+            num_kv_heads=arch.attention_num_kv_heads,
+        )
+        self.post_attention_layernorm = get_norm(
+            arch.norm_type, arch.hidden_size, arch.layernorm, dtype, bitfit
+        )
+        if arch.mlp_type == MLPType.SWIGLU:
+            self.mlp: BaseLayer = ParallelSwiGLUMLP(
+                io_features=arch.hidden_size,
+                intermediate_feature_factor=arch.mlp_factor,
+                bias=False,
+                dtype=dtype,
+                bitfit_bias_name=bitfit,
+            )
+        else:
+            self.mlp = ParallelMLP(
+                io_features=arch.hidden_size,
+                intermediate_feature_factor=arch.mlp_factor,
+                activation=arch.activation_function,
+                dtype=dtype,
+                bitfit_bias_name=bitfit,
+            )
+
+        self.adapter_attention: Optional[Adapter] = None
+        self.adapter_mlp: Optional[Adapter] = None
+        self.adapter_name = None
+        if arch.adapter_config is not None:
+            cfg: AdapterConfig = arch.adapter_config
+            self.adapter_name = cfg.name
+            if cfg.attention_downsampling_factor:
+                self.adapter_attention = Adapter(
+                    arch.hidden_size, cfg.attention_downsampling_factor, cfg.init_std, dtype
+                )
+            if cfg.mlp_downsampling_factor:
+                self.adapter_mlp = Adapter(
+                    arch.hidden_size, cfg.mlp_downsampling_factor, cfg.init_std, dtype
+                )
+
+    # ------------------------------------------------------------------ init
+    def init(self, key: jax.Array) -> dict:
+        keys = jax.random.split(key, 6)
+        params = {
+            "input_layernorm": self.input_layernorm.init(keys[0]),
+            "attention": self.attention.init(keys[1]),
+            "post_attention_layernorm": self.post_attention_layernorm.init(keys[2]),
+            "mlp": self.mlp.init(keys[3]),
+        }
+        if self.adapter_attention is not None:
+            params[f"adapter_attention_{self.adapter_name}"] = self.adapter_attention.init(keys[4])
+        if self.adapter_mlp is not None:
+            params[f"adapter_mlp_{self.adapter_name}"] = self.adapter_mlp.init(keys[5])
+        return params
+
+    def param_metas(self) -> dict:
+        metas = {
+            "input_layernorm": tree_prefix(self.input_layernorm.param_metas(), "input_layernorm"),
+            "attention": tree_prefix(self.attention.param_metas(), "attention"),
+            "post_attention_layernorm": tree_prefix(
+                self.post_attention_layernorm.param_metas(), "post_attention_layernorm"
+            ),
+            "mlp": tree_prefix(self.mlp.param_metas(), "mlp"),
+        }
+        if self.adapter_attention is not None:
+            name = f"adapter_attention_{self.adapter_name}"
+            metas[name] = tree_prefix(self.adapter_attention.param_metas(), name)
+        if self.adapter_mlp is not None:
+            name = f"adapter_mlp_{self.adapter_name}"
+            metas[name] = tree_prefix(self.adapter_mlp.param_metas(), name)
+        return metas
+
+    # --------------------------------------------------------------- forward
+    def __call__(self, params: dict, x: dict, ctx: ForwardContext,
+                 kv_cache=None, cache_offset=None, return_kv: bool = False):
+        arch = self.architecture
+        h = x["activations"]
+
+        normed = self.input_layernorm(params["input_layernorm"], h, ctx)
+        attn = self.attention(
+            params["attention"],
+            normed,
+            ctx,
+            segment_ids=x["segment_ids"],
+            position_ids=x["position_ids"],
+            kv_cache=kv_cache,
+            cache_offset=cache_offset,
+            attention_scores_manipulation=x.get("attention_scores_manipulation"),
+            return_kv=return_kv,
+        )
+        new_kv = None
+        if return_kv or kv_cache is not None:
+            attn, new_kv = attn
+        attn = ctx.dropout(attn, arch.dropout_after_attention)
+        if self.adapter_attention is not None:
+            attn = attn + self.adapter_attention(
+                params[f"adapter_attention_{self.adapter_name}"], attn, ctx
+            )
+        h = h + attn.astype(h.dtype)
+
+        normed = self.post_attention_layernorm(params["post_attention_layernorm"], h, ctx)
+        mlp_out = self.mlp(params["mlp"], normed, ctx)
+        mlp_out = ctx.dropout(mlp_out, arch.dropout_after_mlp)
+        if self.adapter_mlp is not None:
+            mlp_out = mlp_out + self.adapter_mlp(
+                params[f"adapter_mlp_{self.adapter_name}"], mlp_out, ctx
+            )
+        h = h + mlp_out.astype(h.dtype)
+
+        out = dict(x)
+        out["activations"] = h
+        if new_kv is not None:
+            return out, new_kv
+        return out

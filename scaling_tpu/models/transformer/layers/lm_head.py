@@ -1,0 +1,175 @@
+"""Final norm + LM heads.
+
+(reference: src/scaling/transformer/model/layers/layernorm.py:13-56,
+lm_head.py:16-66, lm_head_tied.py:17-55, embedding_head.py:12-80)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ....nn import (
+    BaseLayer,
+    ColumnParallelLinear,
+    ForwardContext,
+    ParamMeta,
+    get_norm,
+    normal_init,
+    tree_prefix,
+    xavier_normal_init,
+)
+from ....parallel.sharding import constrain
+from ....topology.topology import MODEL_AXIS
+from ..config import EmbeddingHeadConfig, TransformerArchitectureConfig
+
+
+class LayerNormWrapper(BaseLayer):
+    """Final norm; records the normed hidden state into ``embeddings`` for
+    downstream embedding heads (reference: layernorm.py:13-56)."""
+
+    def __init__(self, architecture: TransformerArchitectureConfig,
+                 record_embeddings: bool = False):
+        arch = architecture
+        bitfit = arch.bitfit_bias_config.name if arch.bitfit_bias_config else None
+        self.norm = get_norm(arch.norm_type, arch.hidden_size, arch.layernorm,
+                             arch.dtype, bitfit)
+        self.record_embeddings = record_embeddings
+
+    def init(self, key: jax.Array) -> dict:
+        return {"norm": self.norm.init(key)}
+
+    def param_metas(self) -> dict:
+        return {"norm": tree_prefix(self.norm.param_metas(), "norm")}
+
+    def __call__(self, params: dict, x: dict, ctx: ForwardContext) -> dict:
+        out = dict(x)
+        out["activations"] = self.norm(params["norm"], x["activations"], ctx)
+        if self.record_embeddings:
+            out["embeddings"] = out["activations"]
+        return out
+
+
+class TransformerLMHead(BaseLayer):
+    """Untied head: column-parallel projection to the vocabulary
+    (reference: lm_head.py:16-66)."""
+
+    def __init__(self, architecture: TransformerArchitectureConfig):
+        arch = architecture
+        self.linear = ColumnParallelLinear(
+            arch.hidden_size,
+            arch.vocab_size,
+            bias=False,
+            dtype=arch.dtype,
+            parallel_output=False,
+        )
+
+    def init(self, key: jax.Array) -> dict:
+        return {"linear": self.linear.init(key)}
+
+    def param_metas(self) -> dict:
+        return {"linear": tree_prefix(self.linear.param_metas(), "linear")}
+
+    def __call__(self, params: dict, x: dict, ctx: ForwardContext) -> dict:
+        out = dict(x)
+        out["activations"] = self.linear(params["linear"], x["activations"], ctx)
+        return out
+
+
+class TransformerLMHeadTied(BaseLayer):
+    """Weight-tied head reusing the embedding table. Assembled as a
+    TiedLayerSpec with key "embedding_lm_head" and tied attribute
+    ``embedding.weight``, so the params alias the EmbeddingInput table —
+    gradients flow into one array and the reference's tied-grad all-reduce
+    (tied_layer_index.py:74-224) has no equivalent to need.
+    """
+
+    def __init__(self, architecture: TransformerArchitectureConfig):
+        self.architecture = architecture
+        self.dtype = architecture.dtype
+
+    def init(self, key: jax.Array) -> dict:
+        arch = self.architecture
+        return {
+            "embedding": {
+                "weight": xavier_normal_init(
+                    key, (arch.vocab_size, arch.hidden_size), self.dtype
+                )
+            }
+        }
+
+    def param_metas(self) -> dict:
+        return {
+            "embedding": {
+                "weight": ParamMeta(
+                    parameter_name="embedding.weight",
+                    partition_spec=(MODEL_AXIS, None),
+                    is_model_parallel=True,
+                    model_parallel_dimension=0,
+                    lr_group="embedding",
+                )
+            }
+        }
+
+    def __call__(self, params: dict, x: dict, ctx: ForwardContext) -> dict:
+        weight = params["embedding"]["weight"].astype(self.dtype)
+        h = x["activations"]
+        logits = jnp.einsum("bsh,vh->bsv", h, weight)
+        # vocab-sharded matmul output -> gathered full logits (the
+        # reference's all-concat, lm_head_tied.py:41-53); XLA emits the
+        # all-gather from the sharding constraint
+        logits = constrain(logits, ctx.mesh, None, None, None)
+        out = dict(x)
+        out["activations"] = logits
+        return out
+
+
+class TransformerEmbeddingHead(BaseLayer):
+    """Weighted-mean-pool over the sequence + projection stack for
+    embedding models (reference: embedding_head.py:12-80)."""
+
+    def __init__(self, architecture: TransformerArchitectureConfig):
+        arch = architecture
+        assert arch.embedding_head_config is not None
+        cfg: EmbeddingHeadConfig = arch.embedding_head_config
+        self.name = cfg.name
+        self.dims = [arch.hidden_size] + list(cfg.proj_layers)
+        self.dtype = arch.dtype
+
+    def init(self, key: jax.Array) -> dict:
+        params = {}
+        for i, (d_in, d_out) in enumerate(zip(self.dims[:-1], self.dims[1:])):
+            params[f"proj_{i}_{self.name}"] = xavier_normal_init(
+                jax.random.fold_in(key, i), (d_in, d_out), self.dtype
+            )
+        return params
+
+    def param_metas(self) -> dict:
+        metas = {}
+        for i, _ in enumerate(self.dims[:-1]):
+            name = f"proj_{i}_{self.name}"
+            metas[name] = ParamMeta(
+                parameter_name=name,
+                partition_spec=(None, None),
+                is_model_parallel_duplicate=True,
+            )
+        return metas
+
+    def __call__(self, params: dict, x: dict, ctx: ForwardContext) -> dict:
+        h = x["embeddings"] if x.get("embeddings") is not None else x["activations"]
+        weights = x.get("loss_weights")
+        if weights is None:
+            weights = jnp.ones(h.shape[:2], dtype=jnp.float32)
+        weights = weights.astype(jnp.float32)
+        denom = jnp.maximum(weights.sum(axis=1, keepdims=True), 1.0)
+        pooled = (h.astype(jnp.float32) * weights[..., None]).sum(axis=1) / denom
+        pooled = pooled.astype(h.dtype)
+        for i, _ in enumerate(self.dims[:-1]):
+            pooled = pooled @ params[f"proj_{i}_{self.name}"].astype(pooled.dtype)
+            if i < len(self.dims) - 2:
+                pooled = jax.nn.gelu(pooled)
+        out = dict(x)
+        out["embeddings"] = pooled
+        return out

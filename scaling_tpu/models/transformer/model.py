@@ -1,0 +1,199 @@
+"""Transformer model assembly.
+
+(reference: src/scaling/transformer/model/model.py:43-408) — layer-spec
+list, loss, parameter groups, init_model/init_optimizer. The reference's
+``TransformerParallelModule`` subclass exists only to strip non-tensor
+fields around pipe sends (model.py:96-119); under jit the IO dict is a
+static-treedef pytree, so the plain ParallelModule works as-is.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...nn import LayerSpec, ParamMeta, TiedLayerSpec
+from ...optimizer import Optimizer, OptimizerParamGroup
+from ...parallel.parallel_module import ParallelModule
+from ...topology import Topology
+from .config import TransformerConfig, TransformerArchitectureConfig
+from .layers.embedding import EmbeddingInput
+from .layers.layer import TransformerLayer
+from .layers.lm_head import (
+    LayerNormWrapper,
+    TransformerEmbeddingHead,
+    TransformerLMHead,
+    TransformerLMHeadTied,
+)
+
+TIED_KEY = "embedding_lm_head"
+
+
+def get_transformer_layer_specs(
+    architecture: TransformerArchitectureConfig,
+) -> List[LayerSpec]:
+    """EmbeddingInput -> N x TransformerLayer -> final norm -> LM head
+    [-> embedding head] (reference: model.py:122-216)."""
+    has_embedding_head = architecture.embedding_head_config is not None
+    if architecture.weight_tying:
+        specs: List[LayerSpec] = [
+            TiedLayerSpec(
+                EmbeddingInput,
+                architecture,
+                key=TIED_KEY,
+                tied_weight_attributes=["embedding.weight"],
+            )
+        ]
+    else:
+        specs = [LayerSpec(EmbeddingInput, architecture)]
+
+    for layer_index in range(architecture.num_layers):
+        specs.append(LayerSpec(TransformerLayer, architecture, layer_index))
+
+    specs.append(
+        LayerSpec(LayerNormWrapper, architecture, record_embeddings=has_embedding_head)
+    )
+
+    if architecture.weight_tying:
+        specs.append(
+            TiedLayerSpec(
+                TransformerLMHeadTied,
+                architecture,
+                key=TIED_KEY,
+                tied_weight_attributes=["embedding.weight"],
+            )
+        )
+    else:
+        specs.append(LayerSpec(TransformerLMHead, architecture))
+
+    if has_embedding_head:
+        specs.append(LayerSpec(TransformerEmbeddingHead, architecture))
+    return specs
+
+
+def loss_function(output: Dict[str, Any], batch: Dict[str, Any]):
+    """Cross entropy with per-token loss weights + accuracy
+    (reference: model.py:43-76)."""
+    logits = output["activations"].astype(jnp.float32)
+    targets = batch["target_token_ids"].astype(jnp.int32)
+    loss_weights = batch.get("loss_weights")
+    if loss_weights is None:
+        loss_weights = jnp.ones(targets.shape, dtype=jnp.float32)
+    loss_weights = loss_weights.astype(jnp.float32)
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    token_loss = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(loss_weights.sum(), 1.0)
+    loss = (token_loss * loss_weights).sum() / denom
+    correct = (logits.argmax(-1) == targets).astype(jnp.float32)
+    accuracy = (correct * loss_weights).sum() / denom
+    return loss, {"accuracy": accuracy}
+
+
+def metrics_aggregation_fn(metrics_list: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Mean over collected step metrics (reference: model.py:79-93; the DP
+    mean happens inside the jitted step on TPU)."""
+    if not metrics_list:
+        return {}
+    keys = metrics_list[0].keys()
+    return {k: float(sum(m[k] for m in metrics_list) / len(metrics_list)) for k in keys}
+
+
+NO_WEIGHT_DECAY_SUBSTRINGS = ("norm", "bias")
+
+
+def get_parameter_groups(
+    config: TransformerConfig, module: ParallelModule
+) -> List[OptimizerParamGroup]:
+    """weight-decay / no-decay / embedding groups + finetune filtering
+    (reference: model.py:238-386)."""
+    training = config.training
+    metas = [
+        m
+        for m in jax.tree.leaves(
+            module.param_metas(), is_leaf=lambda x: isinstance(x, ParamMeta)
+        )
+    ]
+
+    include_patterns = [re.compile(p) for p in training.finetunable_parameters]
+    exclude_patterns = [re.compile(p) for p in training.parameters_exclude]
+    peft_names = config.transformer_architecture.peft_names
+
+    def trainable(meta: ParamMeta) -> bool:
+        name = meta.key
+        if exclude_patterns and any(p.search(name) for p in exclude_patterns):
+            return False
+        if training.finetune:
+            if any(p.search(name) for p in include_patterns):
+                return True
+            # PEFT params are always trainable in finetune mode
+            # (reference: config.py:426-459 auto-separates them)
+            return any(n in name for n in peft_names)
+        return True
+
+    decay_keys, no_decay_keys, embedding_keys = set(), set(), set()
+    for meta in metas:
+        if not trainable(meta):
+            continue
+        if (
+            training.use_separate_lr_on_embeddings
+            and meta.lr_group == "embedding"
+        ):
+            embedding_keys.add(meta.key)
+        elif meta.no_weight_decay or any(
+            s in meta.parameter_name.lower() for s in NO_WEIGHT_DECAY_SUBSTRINGS
+        ) or meta.lr_group == "embedding":
+            no_decay_keys.add(meta.key)
+        else:
+            decay_keys.add(meta.key)
+
+    groups = []
+    if decay_keys:
+        groups.append(
+            OptimizerParamGroup(
+                keys=decay_keys,
+                weight_decay=training.weight_decay,
+                learning_rate_scheduler=config.learning_rate_scheduler,
+                name="weight_decay_params",
+            )
+        )
+    if no_decay_keys:
+        groups.append(
+            OptimizerParamGroup(
+                keys=no_decay_keys,
+                weight_decay=0.0,
+                learning_rate_scheduler=config.learning_rate_scheduler,
+                name="no_weight_decay_params",
+            )
+        )
+    if embedding_keys:
+        groups.append(
+            OptimizerParamGroup(
+                keys=embedding_keys,
+                weight_decay=0.0,
+                learning_rate_scheduler=config.embedding_learning_rate_scheduler,
+                name="embedding_params",
+            )
+        )
+    if not groups:
+        raise ValueError("no trainable parameters selected")
+    return groups
+
+
+def init_model(config: TransformerConfig, topology: Optional[Topology] = None) -> ParallelModule:
+    specs = get_transformer_layer_specs(config.transformer_architecture)
+    return ParallelModule(
+        specs,
+        topology=topology,
+        compute_dtype=config.transformer_architecture.dtype,
+    )
+
+
+def init_optimizer(
+    config: TransformerConfig, module: ParallelModule, topology: Optional[Topology] = None
+) -> Optimizer:
+    groups = get_parameter_groups(config, module)
+    return Optimizer(config.optimizer, groups, module.param_metas(), topology=topology)

@@ -1,0 +1,74 @@
+"""HF-tokenizers wrapper.
+
+(reference: src/scaling/transformer/tokenizer/tokenizer.py:7-103) — eos
+detection, encode/decode, and the (normal, no-prefix-space) pair used by
+finetuning chat templating.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+EOS_CANDIDATES = ("<|endoftext|>", "</s>")
+
+
+class Tokenizer:
+    def __init__(self, tokenizer) -> None:
+        self.tokenizer = tokenizer
+        self.eos_token = None
+        self.eos_token_id: Optional[int] = None
+        for candidate in EOS_CANDIDATES:
+            token_id = self.tokenizer.token_to_id(candidate)
+            if token_id is not None:
+                self.eos_token = candidate
+                self.eos_token_id = token_id
+                break
+
+    @classmethod
+    def from_file(cls, vocab_file: Path | str) -> "Tokenizer":
+        from tokenizers import Tokenizer as HFTokenizer
+
+        return cls(HFTokenizer.from_file(str(vocab_file)))
+
+    @property
+    def vocab_size(self) -> int:
+        return self.tokenizer.get_vocab_size()
+
+    def encode(self, text: str) -> List[int]:
+        return self.tokenizer.encode(text, add_special_tokens=False).ids
+
+    def decode(self, token_ids: List[int]) -> str:
+        return self.tokenizer.decode(list(token_ids), skip_special_tokens=False)
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        return self.tokenizer.token_to_id(token)
+
+
+def load_tokenizers(vocab_file: Path | str) -> Tuple[Tokenizer, Tokenizer]:
+    """(normal, no-prefix-space) pair; llama2-style tokenizer jsons get the
+    prefix-space surgery of the reference (tokenizer.py:64-103)."""
+    tokenizer = Tokenizer.from_file(vocab_file)
+
+    data = json.loads(Path(vocab_file).read_text())
+    changed = False
+    decoder = data.get("decoder") or {}
+    for entry in decoder.get("decoders", []) if decoder else []:
+        if entry.get("type") == "Metaspace" and entry.get("add_prefix_space", True):
+            entry["add_prefix_space"] = False
+            changed = True
+    pre = data.get("pre_tokenizer") or {}
+    candidates = [pre] + list(pre.get("pretokenizers", []) or [])
+    for entry in candidates:
+        if entry.get("type") == "Metaspace" and entry.get("add_prefix_space", True):
+            entry["add_prefix_space"] = False
+            changed = True
+
+    if changed:
+        from tokenizers import Tokenizer as HFTokenizer
+
+        no_prefix = Tokenizer(HFTokenizer.from_str(json.dumps(data)))
+    else:
+        no_prefix = tokenizer
+    return tokenizer, no_prefix

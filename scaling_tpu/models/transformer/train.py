@@ -1,0 +1,136 @@
+"""Transformer training entry.
+
+(reference: src/scaling/transformer/train.py:80-304) — config -> topology
+-> context -> model -> optimizer -> datasets -> trainer.run_training, with
+the per-step TFLOPs/MFU instrumentation riding on the trainer's metric hook.
+Runnable per host: ``python -m scaling_tpu.models.transformer.train
+--payload=<b64 config>`` or programmatically via ``main(config)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ...data.blended_dataset import BlendedDatasetConfig
+from ...logging import logger
+from ...runner import LaunchConfig, initialize_distributed
+from ...topology import Topology
+from ...trainer import BaseTrainer
+from .config import TransformerConfig
+from .context import TransformerContext
+from .data.text_dataset import TextBlendedDataset, TextDataset
+from .model import init_model, init_optimizer, loss_function
+from .utils.get_tflops import (
+    HardwareType,
+    get_model_parameter_count,
+    get_palm_mfu,
+    get_tflops_aleph_alpha,
+    get_tflops_bloom,
+    get_tflops_electra,
+    get_tflops_megatron,
+)
+
+
+def batch_to_model_input(batch) -> dict:
+    return batch.as_model_input()
+
+
+def log_metrics_fn(trainer: BaseTrainer, output, metrics: dict) -> dict:
+    """Adds tokens/s, the 4 TFLOPs estimators and PaLM MFU
+    (reference: train.py:80-136)."""
+    config: TransformerConfig = trainer.context.config
+    arch = config.transformer_architecture
+    topo = trainer.topology.config
+    step_time = output.step_duration or 1e-9
+    tokens = topo.global_batch_size * arch.sequence_length
+    glu = arch.mlp_type.value == "swiglu"
+    param_count = get_model_parameter_count(
+        arch.hidden_size, arch.num_layers, arch.vocab_size, arch.mlp_factor, glu
+    )
+    metrics["tokens_per_second"] = tokens / step_time
+    metrics["tflops_megatron"] = get_tflops_megatron(
+        param_count, step_time, topo.global_batch_size, arch.sequence_length
+    )
+    metrics["tflops_bloom"] = get_tflops_bloom(
+        arch.hidden_size, arch.num_layers, arch.vocab_size, step_time,
+        topo.global_batch_size, arch.sequence_length,
+        activation_checkpointing=topo.activation_checkpointing_type.value != "disabled",
+    )
+    metrics["tflops_electra"] = get_tflops_electra(
+        arch.hidden_size, arch.num_layers, arch.num_attention_heads,
+        arch.vocab_size, arch.sequence_length, step_time,
+        topo.global_batch_size, arch.mlp_factor,
+    )
+    metrics["tflops_aleph_alpha"] = get_tflops_aleph_alpha(
+        arch.hidden_size, arch.num_layers, arch.num_attention_heads,
+        arch.vocab_size, arch.sequence_length, step_time,
+        topo.global_batch_size, arch.mlp_factor,
+    )
+    metrics["palm_mfu"] = get_palm_mfu(
+        param_count, arch.num_layers, arch.hidden_size, arch.sequence_length,
+        metrics["tokens_per_second"], topo.world_size,
+        hardware=HardwareType.TPU_V5P,
+    )
+    return metrics
+
+
+def _read_dataset(config: TransformerConfig, prefixes: Optional[List[Any]]):
+    if not prefixes:
+        return None
+    arch = config.transformer_architecture
+    datasets = [
+        TextDataset(
+            data_prefix=p,
+            sequence_length=arch.sequence_length,
+            seed=config.trainer.seed,
+            only_full_sequences=config.data.only_full_sequences,
+            allow_incomplete_sequences_every_n=config.data.allow_incomplete_sequences_every_n,
+            load_index_to_memory=config.data.load_mmap_index_to_memory,
+        )
+        for p in prefixes
+    ]
+    if len(datasets) == 1:
+        return datasets[0]
+    blended_config = config.data.blended_dataset or BlendedDatasetConfig()
+    return TextBlendedDataset(
+        seed=config.trainer.seed, config=blended_config, datasets=datasets
+    )
+
+
+class TransformerTrainer(BaseTrainer):
+    def run_training(self, log_metrics_fn_=None) -> None:  # noqa: D102
+        super().run_training(log_metrics_fn=log_metrics_fn_ or log_metrics_fn)
+
+
+def main(config: TransformerConfig) -> TransformerTrainer:
+    topology = Topology(config.topology)
+    logger.configure(config.logger, name="transformer")
+    logger.log_config(config)
+    context = TransformerContext(config=config, topology=topology)
+    module = init_model(config, topology)
+    optimizer = init_optimizer(config, module, topology)
+    dataset = _read_dataset(config, config.data.data_prefixes)
+    dataset_evaluation = _read_dataset(config, config.data.validation_data_prefixes)
+    trainer = TransformerTrainer(
+        config=config.trainer,
+        context=context,
+        parallel_module=module,
+        optimizer=optimizer,
+        loss_function=loss_function,
+        dataset=dataset,
+        dataset_evaluation=dataset_evaluation,
+        batch_to_model_input=batch_to_model_input,
+    )
+    trainer.initialize(
+        load_checkpoint=config.trainer.load_dir is not None
+    )
+    trainer.run_training()
+    return trainer
+
+
+if __name__ == "__main__":
+    launch_config = LaunchConfig.from_launcher_args()
+    initialize_distributed(launch_config)
+    assert launch_config.payload is not None, "--payload required"
+    config = TransformerConfig.from_dict(launch_config.payload)
+    main(config)

@@ -1,0 +1,19 @@
+from .get_tflops import (
+    HardwareType,
+    get_model_parameter_count,
+    get_palm_mfu,
+    get_tflops_aleph_alpha,
+    get_tflops_bloom,
+    get_tflops_electra,
+    get_tflops_megatron,
+)
+
+__all__ = [
+    "HardwareType",
+    "get_model_parameter_count",
+    "get_palm_mfu",
+    "get_tflops_aleph_alpha",
+    "get_tflops_bloom",
+    "get_tflops_electra",
+    "get_tflops_megatron",
+]

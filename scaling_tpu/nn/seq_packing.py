@@ -112,6 +112,29 @@ def get_position_ids(
     return position_ids
 
 
+def get_segment_ids(token_ids: np.ndarray, eod_token: int = 0) -> np.ndarray:
+    """Per-token packed-document ids: increments after each EOD token.
+
+    Vectorised equivalent of the reference's EOD-split bookkeeping
+    (reference: src/scaling/transformer/data/utils.py:40-75) in the
+    TPU-native segment-id representation.
+    """
+    after_eod = np.zeros(token_ids.shape, dtype=np.int32)
+    after_eod[:, 1:] = token_ids[:, :-1] == eod_token
+    return np.cumsum(after_eod, axis=1).astype(np.int32)
+
+
+def get_position_ids_from_segments(segment_ids: np.ndarray) -> np.ndarray:
+    """Positions restarting at 0 at each segment boundary (vectorised)."""
+    b, s = segment_ids.shape
+    idx = np.arange(s, dtype=np.int64)[None, :]
+    is_start = np.zeros((b, s), dtype=bool)
+    is_start[:, 0] = True
+    is_start[:, 1:] = segment_ids[:, 1:] != segment_ids[:, :-1]
+    start_idx = np.maximum.accumulate(np.where(is_start, idx, 0), axis=1)
+    return idx - start_idx
+
+
 def add_cumulative_seq_lengths_padding(cu: np.ndarray, pad_to: int) -> np.ndarray:
     """-1-pad to a fixed length (static shape under jit).
 

@@ -26,6 +26,7 @@ next to the body, and replication avoids idle bubbles on edge stages.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Callable, List, Optional
 
 import jax
@@ -34,7 +35,7 @@ import numpy as np
 
 from ..nn.base_layer import BaseLayer, ForwardContext
 from ..nn.param import ParamMeta
-from ..topology.topology import PIPE_AXIS, Topology
+from ..topology.topology import DATA_AXIS, PIPE_AXIS, Topology
 
 
 # --------------------------------------------------------------- partitioning
@@ -156,7 +157,18 @@ class PipelinedBody:
             def run_all(x):
                 def body(h, wi):
                     w, i = wi
-                    return call(w, h, ctx, i), None
+                    # fold the traced layer index into the dropout key: the
+                    # Python-side key counter is baked once at trace time, so
+                    # without this every scan iteration would reuse the same
+                    # masks (reference per-layer RNG: rng_tracker.py:59-96)
+                    layer_ctx = ctx
+                    if ctx.dropout_key is not None and not ctx.deterministic:
+                        layer_ctx = dataclasses.replace(
+                            ctx, dropout_key=jax.random.fold_in(ctx.dropout_key, i)
+                        )
+                    return call(w, h, layer_ctx, i), None
+                if remat:
+                    body = jax.checkpoint(body)
                 squeezed = jax.tree.map(lambda p: p.reshape(self.num_layers, *p.shape[2:]), params)
                 h, _ = jax.lax.scan(body, x, (squeezed, jnp.arange(self.num_layers)))
                 return h
@@ -175,7 +187,7 @@ class PipelinedBody:
             return jax.tree.map(
                 lambda x: jax.lax.with_sharding_constraint(
                     x,
-                    NamedSharding(mesh, P(PIPE_AXIS, "data", *([None] * (x.ndim - 2)))),
+                    NamedSharding(mesh, P(PIPE_AXIS, DATA_AXIS, *([None] * (x.ndim - 2)))),
                 ),
                 s,
             )
@@ -196,7 +208,18 @@ class PipelinedBody:
             def body(h, wi):
                 w, j = wi
                 layer_index = stage_idx * per_stage + j
-                return call(w, h, stage_ctx, layer_index), None
+                # fold the traced layer index so layers within a stage draw
+                # distinct dropout masks (the Python key counter is baked
+                # once when this scan body is traced)
+                layer_ctx = stage_ctx
+                if stage_ctx.dropout_key is not None and not stage_ctx.deterministic:
+                    from dataclasses import replace as _replace2
+
+                    layer_ctx = _replace2(
+                        stage_ctx,
+                        dropout_key=jax.random.fold_in(stage_ctx.dropout_key, layer_index),
+                    )
+                return call(w, h, layer_ctx, layer_index), None
 
             h, _ = jax.lax.scan(body, x, (stage_params, jnp.arange(per_stage)))
             return h

@@ -247,14 +247,29 @@ class SimulationEngine:
                         cursors[r] += 1
                         progressed = True
                         continue
-                    # communication: ready when the matching half is posted
                     mb = ins.micro_batch_id
                     kind = "act" if "activation" in ins.name else "grad"
                     lo, hi = min(r, peer), max(r, peer)
                     key = (kind, mb, lo, hi)
+                    if ins.name.startswith("send"):
+                        # sends are async: post completion time and continue
+                        end = times[r] + self.duration(ins.name)
+                        pending[key] = end
+                        busy[r] += self.duration(ins.name)
+                        timeline.append(
+                            {"rank": r, "name": ins.name, "micro_batch": mb,
+                             "start": times[r], "end": end}
+                        )
+                        times[r] = end
+                        cursors[r] += 1
+                        progressed = True
+                        continue
+                    # recvs BLOCK until the matching send has completed —
+                    # this is what creates the pipeline bubble the simulator
+                    # exists to predict
                     if key in pending:
-                        other_time = pending.pop(key)
-                        start = max(times[r], other_time)
+                        data_ready = pending.pop(key)
+                        start = max(times[r], data_ready)
                         end = start + self.duration(ins.name)
                         busy[r] += self.duration(ins.name)
                         times[r] = end
@@ -265,12 +280,13 @@ class SimulationEngine:
                         cursors[r] += 1
                         progressed = True
                         continue
-                    else:
-                        pending[key] = times[r]
-                        cursors[r] += 1
-                        progressed = True
-                        continue
-                # rank done
+                    break  # blocked on an unposted send; retry next sweep
         total = max(times)
+        deadlocked = any(cursors[r] < len(schedules[r]) for r in range(pp))
         idle = [1.0 - (b / total if total else 0.0) for b in busy]
-        return {"total_time": total, "idle_fraction": idle, "timeline": timeline}
+        return {
+            "total_time": total,
+            "idle_fraction": idle,
+            "timeline": timeline,
+            "deadlocked": deadlocked,
+        }

@@ -1,0 +1,11 @@
+from .config import LaunchConfig, RunnerConfig, RunnerType
+from .runner import get_resource_pool, initialize_distributed, runner_main
+
+__all__ = [
+    "LaunchConfig",
+    "RunnerConfig",
+    "RunnerType",
+    "get_resource_pool",
+    "initialize_distributed",
+    "runner_main",
+]

@@ -1,0 +1,76 @@
+"""Runner configuration.
+
+The reference launches one Python per GPU over pdsh/ssh/docker
+(reference: src/scaling/core/runner/runner_config.py, runner.py:41-115).
+On TPU pods the runtime is one process per host and rendezvous goes through
+``jax.distributed.initialize(coordinator, num_processes, process_id)``, so
+the config keeps the same user surface (hosts, docker knobs retained for
+parity) but resolves to coordinator-based bootstrap.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from pathlib import Path
+from typing import List, Optional
+
+from pydantic import Field
+
+from ..config import BaseConfig
+
+
+class RunnerType(Enum):
+    PDSH = "pdsh"
+    PDSH_DOCKER = "pdsh_docker"
+
+
+class RunnerConfig(BaseConfig):
+    runner_type: RunnerType = Field(RunnerType.PDSH, description="launch mechanism")
+    hostsfile: Optional[Path] = Field(
+        None, description="file with one hostname (+ optional slot count) per line"
+    )
+    hosts: Optional[List[str]] = Field(None, description="inline host list")
+    master_port: int = Field(29500, description="coordinator port")
+    master_addr: Optional[str] = Field(None, description="coordinator address")
+    script: str = Field(
+        "scaling_tpu.models.transformer.train", description="module to run per host"
+    )
+    default_gpu_count: int = Field(
+        8, description="devices per host when the hostsfile gives no slot counts"
+    )
+    docker_config: Optional[dict] = Field(None, description="kept for config parity")
+    use_determined: bool = Field(False, description="kept for config parity")
+
+
+class LaunchConfig(BaseConfig):
+    """Per-process launch parameters, read back from env/args
+    (reference: src/scaling/core/runner/launch_config.py:40-83)."""
+
+    master_addr: str = Field("127.0.0.1", description="")
+    master_port: int = Field(29500, description="")
+    world_size: int = Field(1, description="total number of devices")
+    global_rank: int = Field(0, description="")
+    local_slot: int = Field(0, description="")
+    payload: Optional[dict] = Field(None, description="base64/json config payload")
+
+    @classmethod
+    def from_launcher_args(cls) -> "LaunchConfig":
+        import argparse
+        import base64
+        import json
+        import os
+
+        parser = argparse.ArgumentParser()
+        parser.add_argument("--payload", type=str, default=None)
+        args, _ = parser.parse_known_args()
+        payload = None
+        if args.payload:
+            payload = json.loads(base64.urlsafe_b64decode(args.payload).decode())
+        return cls(
+            master_addr=os.environ.get("MASTER_ADDR", "127.0.0.1"),
+            master_port=int(os.environ.get("MASTER_PORT", "29500")),
+            world_size=int(os.environ.get("WORLD_SIZE", "1")),
+            global_rank=int(os.environ.get("RANK", "0")),
+            local_slot=int(os.environ.get("LOCAL_SLOT", "0")),
+            payload=payload,
+        )

@@ -1,0 +1,214 @@
+"""Transformer model assembly unit tests (reference:
+tests/transformer/test_training.py model-shape coverage + test_nn parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scaling_tpu.models.transformer import (
+    TransformerConfig,
+    get_transformer_layer_specs,
+    init_model,
+    init_optimizer,
+    loss_function,
+)
+from scaling_tpu.models.transformer.layers import (
+    EmbeddingInput,
+    LayerNormWrapper,
+    TransformerLayer,
+    TransformerLMHead,
+    TransformerLMHeadTied,
+)
+from scaling_tpu.topology import Topology
+
+
+def make_config(
+    vocab_size=128,
+    hidden_size=32,
+    num_layers=2,
+    num_attention_heads=4,
+    sequence_length=16,
+    mp=1,
+    dp=1,
+    mbs=2,
+    gas=1,
+    **arch_overrides,
+):
+    return TransformerConfig.from_dict(
+        {
+            "topology": {
+                "model_parallel_size": mp,
+                "pipe_parallel_size": 1,
+                "data_parallel_size": dp,
+                "micro_batch_size": mbs,
+                "gradient_accumulation_steps": gas,
+            },
+            "transformer_architecture": {
+                "vocab_size": vocab_size,
+                "hidden_size": hidden_size,
+                "num_layers": num_layers,
+                "num_attention_heads": num_attention_heads,
+                "sequence_length": sequence_length,
+                **arch_overrides,
+            },
+            "trainer": {"train_iterations": 5, "assert_checkpoint_loaded": False},
+            "learning_rate_scheduler": {
+                "learning_rate": 0.01,
+                "learning_rate_decay_style": "constant",
+            },
+            "logger": {"log_dir": None},
+        }
+    )
+
+
+def make_batch(rng, vocab_size=128, b=2, s=16, stacked_gas=None):
+    tokens = rng.integers(1, vocab_size, size=(b, s + 1))
+    batch = {
+        "token_ids": tokens[:, :-1].astype(np.int32),
+        "target_token_ids": tokens[:, 1:].astype(np.int32),
+        "position_ids": np.tile(np.arange(s, dtype=np.int32), (b, 1)),
+        "segment_ids": np.zeros((b, s), np.int32),
+        "loss_weights": np.ones((b, s), np.float32),
+    }
+    if stacked_gas:
+        batch = {k: np.stack([v] * stacked_gas) for k, v in batch.items()}
+    return batch
+
+
+def test_layer_specs_assembly():
+    config = make_config()
+    specs = get_transformer_layer_specs(config.transformer_architecture)
+    classes = [s.module_class for s in specs]
+    assert classes[0] is EmbeddingInput
+    assert classes[1] is TransformerLayer and classes[2] is TransformerLayer
+    assert classes[3] is LayerNormWrapper
+    assert classes[4] is TransformerLMHead
+    assert len(specs) == 5
+
+
+def test_weight_tying_shares_one_array():
+    config = make_config(weight_tying=True)
+    specs = get_transformer_layer_specs(config.transformer_architecture)
+    assert specs[-1].module_class is TransformerLMHeadTied
+    module = init_model(config, None)
+    params = module.init_params(jax.random.PRNGKey(0))
+    # consumer's tied param dropped from the tree: only one copy exists
+    assert "weight" not in params[module.layer_name(len(specs) - 1)].get("embedding", {})
+    n_total = module.parameter_count(params)
+    config_untied = make_config(weight_tying=False)
+    untied = init_model(config_untied, None)
+    n_untied = untied.parameter_count(untied.init_params(jax.random.PRNGKey(0)))
+    arch = config.transformer_architecture
+    assert n_untied - n_total == arch.vocab_size * arch.hidden_size
+
+
+@pytest.mark.parametrize(
+    "arch",
+    [
+        {},
+        {"weight_tying": True},
+        {"mlp_type": "swiglu", "mlp_factor": 2.0, "norm_type": "rms"},
+        {"attention_num_kv_heads": 2, "attention_qkv_in_one": False},
+        {"num_local_attention_heads": 2, "local_attention_window_size": 4},
+        {"key_query_norm": True},
+        {"relative_position_embedding_type": "rotary_complex"},
+        {"precision": "bfloat16"},
+    ],
+    ids=[
+        "default",
+        "tied",
+        "swiglu_rms",
+        "gqa",
+        "local_attention",
+        "kq_norm",
+        "rotary_complex",
+        "bf16",
+    ],
+)
+def test_train_loss_decreases(arch):
+    config = make_config(**arch)
+    topo = Topology(config.topology)
+    module = init_model(config, topo)
+    params = module.init_params(jax.random.PRNGKey(0))
+    if config.transformer_architecture.precision.value == "bfloat16":
+        params = jax.tree.map(
+            lambda p: p.astype(jnp.bfloat16)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p,
+            params,
+        )
+    optimizer = init_optimizer(config, module, topo)
+    state = optimizer.init_state(params)
+    step = module.build_train_step(optimizer, loss_function)
+    rng = np.random.default_rng(0)
+    batch = make_batch(rng, stacked_gas=1)
+    losses = []
+    for i in range(8):
+        params, state, loss, metrics, _ = step(params, state, batch, jax.random.PRNGKey(i))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.5, losses
+    assert 0.0 <= float(metrics["accuracy"]) <= 1.0
+
+
+def test_tensor_parallel_matches_single_device():
+    """mp=4 and mp=1 must produce the same loss from the same init
+    (reference: tests/core/test_nn/test_parallel_linear.py pattern)."""
+    losses = {}
+    for mp in (1, 4):
+        config = make_config(mp=mp)
+        topo = Topology(config.topology)
+        module = init_model(config, topo)
+        params = module.init_params(jax.random.PRNGKey(7))
+        params = module.shard_params(params)
+        optimizer = init_optimizer(config, module, topo)
+        state = optimizer.init_state(params)
+        step = module.build_train_step(optimizer, loss_function)
+        rng = np.random.default_rng(3)
+        batch = module.shard_batch(make_batch(rng, stacked_gas=1))
+        run = []
+        for i in range(3):
+            params, state, loss, _, _ = step(params, state, batch, jax.random.PRNGKey(i))
+            run.append(float(loss))
+        losses[mp] = run
+    np.testing.assert_allclose(losses[1], losses[4], rtol=2e-4)
+
+
+def test_gqa_kv_head_count():
+    config = make_config(attention_num_kv_heads=2, attention_qkv_in_one=False)
+    module = init_model(config, None)
+    params = module.init_params(jax.random.PRNGKey(0))
+    layer1 = params["layer_1"]["attention"]
+    arch = config.transformer_architecture
+    head_dim = arch.hidden_size // arch.num_attention_heads
+    assert layer1["key"]["weight"].shape == (arch.hidden_size, 2 * head_dim)
+    assert layer1["query"]["weight"].shape == (arch.hidden_size, arch.hidden_size)
+
+
+def test_packed_sequences_respect_segments():
+    """Tokens in segment B must not attend to segment A: replacing segment
+    A's content must not change segment B's logits."""
+    config = make_config(num_layers=1, dropout_embedding=0.0)
+    module = init_model(config, None)
+    params = module.init_params(jax.random.PRNGKey(0))
+    fwd = module.build_forward()
+
+    s = 16
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(1, 128, size=(1, s)).astype(np.int32)
+    segment_ids = np.concatenate([np.zeros((1, 8)), np.ones((1, 8))], axis=1).astype(np.int32)
+    position_ids = np.concatenate([np.arange(8), np.arange(8)])[None].astype(np.int32)
+    base = {
+        "token_ids": tokens,
+        "target_token_ids": tokens,
+        "position_ids": position_ids,
+        "segment_ids": segment_ids,
+        "loss_weights": np.ones((1, s), np.float32),
+    }
+    out1 = fwd(params, base)["activations"]
+    tokens2 = tokens.copy()
+    tokens2[0, :8] = rng.integers(1, 128, size=8)
+    out2 = fwd(params, {**base, "token_ids": tokens2})["activations"]
+    np.testing.assert_allclose(
+        np.asarray(out1[0, 8:]), np.asarray(out2[0, 8:]), rtol=1e-5, atol=1e-5
+    )
+    assert not np.allclose(np.asarray(out1[0, :8]), np.asarray(out2[0, :8]))

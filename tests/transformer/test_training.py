@@ -1,0 +1,152 @@
+"""End-to-end transformer training: checkpoint-resume loss exactness across
+topologies (reference: tests/transformer/test_training.py:57-117)."""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from scaling_tpu.data.memory_map import MemoryMapDatasetBuilder
+from scaling_tpu.models.transformer import TransformerConfig
+from scaling_tpu.models.transformer.train import main
+
+
+@pytest.fixture(scope="module")
+def data_prefix(tmp_path_factory):
+    """Tokenized mmap dataset fixture (reference:
+    tests/transformer/files/dataset/)."""
+    prefix = tmp_path_factory.mktemp("dataset") / "data"
+    rng = np.random.default_rng(17)
+    with MemoryMapDatasetBuilder(prefix, dtype=np.uint16) as builder:
+        for _ in range(64):
+            doc = rng.integers(1, 96, size=rng.integers(8, 64))
+            builder.add(np.append(doc, 0).astype(np.uint16))
+    return prefix
+
+
+def make_config(tmp_path, data_prefix, mp=1, dp=1, gas=1, train_iterations=10,
+                save_interval=6, load_dir=None, **arch_overrides):
+    return TransformerConfig.from_dict(
+        {
+            "topology": {
+                "model_parallel_size": mp,
+                "pipe_parallel_size": 1,
+                "data_parallel_size": dp,
+                "micro_batch_size": 2,
+                "gradient_accumulation_steps": gas,
+            },
+            "transformer_architecture": {
+                "vocab_size": 96,
+                "hidden_size": 32,
+                "num_layers": 2,
+                "num_attention_heads": 4,
+                "sequence_length": 24,
+                **arch_overrides,
+            },
+            "optimizer": {"gradient_clipping": 1.0},
+            "learning_rate_scheduler": {
+                "learning_rate": 0.01,
+                "learning_rate_warmup_steps": 2,
+                "learning_rate_decay_iters": 50,
+            },
+            "trainer": {
+                "train_iterations": train_iterations,
+                "seed": 42,
+                "save_dir": str(tmp_path / "ckpt"),
+                "save_interval": save_interval,
+                "load_dir": str(load_dir) if load_dir else None,
+                "assert_checkpoint_loaded": load_dir is not None,
+                "delete_past_optimizer_states": False,
+            },
+            "data": {"data_prefixes": [str(data_prefix)]},
+            "logger": {"log_dir": None},
+        }
+    )
+
+
+def test_main_entry_runs(tmp_path, data_prefix):
+    """The reference's examples/transformer_example entry shape: main(config)
+    trains to completion (reference: train.py:173-210)."""
+    config = make_config(tmp_path, data_prefix, train_iterations=3, save_interval=3)
+    trainer = main(config)
+    assert trainer.context.iterations == 3
+    assert (Path(config.trainer.save_dir) / "latest").is_file()
+
+
+@pytest.mark.parametrize(
+    "topo,arch",
+    [
+        ((1, 1, 1), {}),
+        ((2, 1, 1), {}),
+        ((1, 2, 2), {}),
+        ((2, 2, 1), {"weight_tying": True}),
+        ((1, 1, 1), {"mlp_type": "swiglu", "mlp_factor": 2.0, "norm_type": "rms",
+                     "weight_tying": True}),
+    ],
+    ids=["1x1", "mp2", "dp2_gas2", "mp2dp2_tied", "swiglu_tied"],
+)
+def test_training_resume_loss_exact(tmp_path, data_prefix, topo, arch):
+    """Train 10 steps saving at 6; relaunch from the checkpoint and the
+    losses of steps 7-10 must match exactly
+    (reference: test_training.py:91-117)."""
+    mp, dp, gas = topo
+    config = make_config(tmp_path, data_prefix, mp=mp, dp=dp, gas=gas, **arch)
+    trainer = build_capturing_trainer(config)
+    losses_full = train_capture(trainer, 10)
+
+    config_resumed = make_config(
+        tmp_path / "resume", data_prefix, mp=mp, dp=dp, gas=gas,
+        load_dir=Path(config.trainer.save_dir), **arch
+    )
+    trainer_resumed = build_capturing_trainer(config_resumed, load=True)
+    assert trainer_resumed.context.iterations == 6
+    losses_resumed = train_capture(trainer_resumed, 4)
+    np.testing.assert_array_equal(
+        np.asarray(losses_full[6:], dtype=np.float32),
+        np.asarray(losses_resumed, dtype=np.float32),
+    )
+
+
+def build_capturing_trainer(config, load=False):
+    from scaling_tpu.models.transformer.context import TransformerContext
+    from scaling_tpu.models.transformer.model import (
+        init_model,
+        init_optimizer,
+        loss_function,
+    )
+    from scaling_tpu.models.transformer.train import (
+        TransformerTrainer,
+        _read_dataset,
+        batch_to_model_input,
+    )
+    from scaling_tpu.topology import Topology
+
+    topology = Topology(config.topology)
+    context = TransformerContext(config=config, topology=topology)
+    module = init_model(config, topology)
+    optimizer = init_optimizer(config, module, topology)
+    dataset = _read_dataset(config, config.data.data_prefixes)
+    trainer = TransformerTrainer(
+        config=config.trainer,
+        context=context,
+        parallel_module=module,
+        optimizer=optimizer,
+        loss_function=loss_function,
+        dataset=dataset,
+        batch_to_model_input=batch_to_model_input,
+    )
+    trainer.initialize(load_checkpoint=load)
+    return trainer
+
+
+def train_capture(trainer, steps):
+    losses = []
+    for _ in range(steps):
+        out = trainer.train_step()
+        losses.append(out.loss)
+        if (
+            trainer.config.save_interval is not None
+            and trainer.context.iterations % trainer.config.save_interval == 0
+        ):
+            trainer.save_checkpoint()
+    return losses
