@@ -1,0 +1,169 @@
+"""Benchmark: train-step throughput of the flagship transformer on one chip.
+
+Runs a GQA + RoPE + SwiGLU decoder (the BASELINE.md config-#3 shape scaled
+to one chip) through the real jitted train step — forward, backward, AdamW —
+and prints ONE JSON line with tokens/sec/chip and MFU. ``vs_baseline`` is
+MFU against the 45% target from BASELINE.json (the reference publishes no
+numbers of its own — BASELINE.md "Reference-published numbers").
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scaling_tpu.models.transformer import TransformerConfig
+from scaling_tpu.models.transformer.model import (
+    init_model,
+    init_optimizer,
+    loss_function,
+)
+from scaling_tpu.models.transformer.utils.get_tflops import (
+    HardwareType,
+    get_model_parameter_count,
+    get_palm_mfu,
+)
+from scaling_tpu.topology import Topology
+
+MFU_TARGET = 0.45  # BASELINE.json: ">=45% MFU on a 7B on v5p-128"
+
+
+def detect_hardware() -> HardwareType:
+    kind = jax.devices()[0].device_kind.lower().replace(" ", "")
+    # device_kind spellings: "TPU v4", "TPU v5 lite", "TPU v5p", "TPU v6 lite"
+    if "v6" in kind:
+        return HardwareType.TPU_V6E
+    if "v5" in kind:
+        return HardwareType.TPU_V5E if ("lite" in kind or "v5e" in kind) else HardwareType.TPU_V5P
+    if "v4" in kind:
+        return HardwareType.TPU_V4
+    return HardwareType.TPU_V5E  # CPU fallback: report against a modest peak
+
+
+def build(seq_len: int, micro_batch_size: int, hidden: int, layers: int):
+    config = TransformerConfig.from_dict(
+        {
+            "topology": {
+                "model_parallel_size": 1,
+                "pipe_parallel_size": 1,
+                "data_parallel_size": 1,
+                "micro_batch_size": micro_batch_size,
+                "gradient_accumulation_steps": 1,
+            },
+            "transformer_architecture": {
+                "vocab_size": 32768,
+                "hidden_size": hidden,
+                "num_layers": layers,
+                "num_attention_heads": hidden // 128,
+                "attention_num_kv_heads": max(1, hidden // 512),
+                "sequence_length": seq_len,
+                "precision": "bfloat16",
+                "mlp_type": "swiglu",
+                "mlp_factor": 2.75,  # llama-style 8/3 rounded to an integer width
+                "norm_type": "rms",
+                "relative_position_embedding_type": "rotary_complex",
+                "causal": True,
+                "weight_tying": False,
+                "attention_qkv_in_one": False,
+                "dropout_embedding": 0.0,
+                "dropout_attention_probs": 0.0,
+                "dropout_after_attention": 0.0,
+                "dropout_after_mlp": 0.0,
+            },
+            "optimizer": {"gradient_clipping": 1.0, "loss_scaler": {"enable": False}},
+            "learning_rate_scheduler": {
+                "learning_rate": 3e-4,
+                "learning_rate_warmup_steps": 10,
+                "learning_rate_decay_iters": 1000,
+            },
+            "trainer": {"train_iterations": 10, "seed": 0},
+            "data": {},
+            "logger": {"log_dir": None},
+        }
+    )
+    topology = Topology(config.topology)
+    module = init_model(config, topology)
+    optimizer = init_optimizer(config, module, topology)
+    return config, topology, module, optimizer
+
+
+def synth_batch(rng: np.random.Generator, batch: int, seq_len: int, vocab: int, gas: int):
+    tokens = rng.integers(1, vocab, size=(gas, batch, seq_len), dtype=np.int64)
+    pos = np.broadcast_to(np.arange(seq_len, dtype=np.int32), (gas, batch, seq_len))
+    return {
+        "token_ids": jnp.asarray(tokens, jnp.int32),
+        "target_token_ids": jnp.asarray(np.roll(tokens, -1, axis=-1), jnp.int32),
+        "position_ids": jnp.asarray(pos),
+        "segment_ids": jnp.zeros((gas, batch, seq_len), jnp.int32),
+        "loss_weights": jnp.ones((gas, batch, seq_len), jnp.float32),
+    }
+
+
+def main() -> None:
+    seq_len, mbs = 2048, 4
+    # ~0.5B: params bf16 + fp32 master/moments + fp32 grads ~ 9G, inside the
+    # 16G HBM of the smallest current chip (v5e)
+    hidden, layers = 2048, 8
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        # keep the CPU smoke path fast; numbers only meaningful on TPU
+        seq_len, mbs, hidden, layers = 512, 2, 512, 4
+
+    config, topology, module, optimizer = build(seq_len, mbs, hidden, layers)
+    arch = config.transformer_architecture
+
+    key = jax.random.PRNGKey(0)
+    params = module.shard_params(module.init_params(key))
+    opt_state = optimizer.init_state(params)
+    step = module.build_train_step(optimizer, loss_function)
+
+    rng = np.random.default_rng(0)
+    batch = module.shard_batch(
+        synth_batch(rng, mbs, seq_len, arch.vocab_size, 1), stacked=True
+    )
+
+    # warmup / compile
+    params, opt_state, loss, _, _ = step(params, opt_state, batch, key)
+    jax.block_until_ready(loss)
+
+    iters = 10 if on_tpu else 3
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, opt_state, loss, _, _ = step(
+            params, opt_state, batch, jax.random.fold_in(key, i)
+        )
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_sec = mbs * seq_len / dt
+    param_count = get_model_parameter_count(
+        arch.hidden_size, arch.num_layers, arch.vocab_size, arch.mlp_factor, glu=True
+    )
+    hardware = detect_hardware()
+    mfu = get_palm_mfu(
+        param_count, arch.num_layers, arch.hidden_size, arch.sequence_length,
+        tokens_per_sec, world_size=1, hardware=hardware,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(mfu / MFU_TARGET, 4),
+                "mfu": round(mfu, 4),
+                "hardware": hardware.value,
+                "params": param_count,
+                "step_ms": round(dt * 1000, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

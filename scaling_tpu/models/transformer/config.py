@@ -221,8 +221,12 @@ class DataConfig(BaseConfig):
     data_prefixes: Optional[List[Path]] = Field(
         None, description="prefixes of memory-map dataset files"
     )
-    blended_dataset: "BlendedDatasetConfig" = Field(
+    blended_dataset: Optional["BlendedDatasetConfig"] = Field(
         None, description="blending over data_prefixes"
+    )
+    eod_token_id: int = Field(
+        0, description="token id marking end-of-document in tokenized data; "
+        "drives segmenting, position resets and loss masking", ge=0
     )
     validation_data_prefixes: Optional[List[Path]] = Field(None, description="")
     legacy_dataset: bool = Field(False, description="load Megatron-format .bin/.idx data")
@@ -253,7 +257,7 @@ class TransformerConfig(BaseConfig):
     """Composition root (reference: config.py:364-425)."""
 
     version: str = Field("0.1.0", description="")
-    runner: "RunnerConfig" = Field(None, description="")
+    runner: Optional["RunnerConfig"] = Field(None, description="")
     logger: LoggerConfig = Field(LoggerConfig(), description="")
     topology: TopologyConfig = Field(description="")
     optimizer: OptimizerConfig = Field(OptimizerConfig(), description="")

@@ -115,9 +115,12 @@ class TextDataset(BaseDataset[TextDatasetItem, TextDatasetBatch]):
                 if doc_end - window_start <= L:
                     continue  # doc fits into the open window
                 if every_n > 0 and since_cut + 1 >= every_n:
-                    # the every-n exception: cut mid-document
+                    # the every-n exception: cut mid-document. Windows span
+                    # L+1 tokens with a 1-token overlap so the boundary token
+                    # is target of one window and first input of the next —
+                    # no EOD padding mid-document
                     while doc_end - window_start > L:
-                        spans.append((window_start, window_start + L))
+                        spans.append((window_start, window_start + L + 1))
                         window_start += L
                     since_cut = 0
                     continue
@@ -127,10 +130,11 @@ class TextDataset(BaseDataset[TextDatasetItem, TextDatasetBatch]):
                     since_cut += 1
                 window_start = doc_start
                 if doc_end - window_start > L:
-                    # over-long document: emit full windows, drop the tail
-                    # so the next window realigns to a doc boundary
+                    # over-long document: emit full L+1-token windows (same
+                    # 1-token overlap); the <L-token tail is dropped so the
+                    # next window realigns to a doc boundary
                     while doc_end - window_start > L:
-                        spans.append((window_start, window_start + L))
+                        spans.append((window_start, window_start + L + 1))
                         window_start += L
                         since_cut = 0
                     window_start = doc_end

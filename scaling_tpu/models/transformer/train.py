@@ -83,6 +83,7 @@ def _read_dataset(config: TransformerConfig, prefixes: Optional[List[Any]]):
             data_prefix=p,
             sequence_length=arch.sequence_length,
             seed=config.trainer.seed,
+            eod_token_id=config.data.eod_token_id,
             only_full_sequences=config.data.only_full_sequences,
             allow_incomplete_sequences_every_n=config.data.allow_incomplete_sequences_every_n,
             load_index_to_memory=config.data.load_mmap_index_to_memory,

@@ -19,6 +19,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from pydantic import Field
 
 from ..config import BaseConfig
@@ -31,11 +32,13 @@ class RotaryConfig(BaseConfig):
 
 
 def _cos_sin_tables(dimensions: int, max_seq_length: int, base: float):
-    inv_freq = 1.0 / (base ** (jnp.arange(0, dimensions, 2, dtype=jnp.float32) / dimensions))
-    t = jnp.arange(max_seq_length, dtype=jnp.float32)
-    freqs = jnp.outer(t, inv_freq)  # (s, d/2)
-    emb = jnp.concatenate([freqs, freqs], axis=-1)  # (s, d)
-    return jnp.cos(emb), jnp.sin(emb)
+    # host-side numpy: the tables embed into jitted programs as constants,
+    # which must not require a device->host fetch at trace time
+    inv_freq = 1.0 / (base ** (np.arange(0, dimensions, 2, dtype=np.float32) / dimensions))
+    t = np.arange(max_seq_length, dtype=np.float32)
+    freqs = np.outer(t, inv_freq)  # (s, d/2)
+    emb = np.concatenate([freqs, freqs], axis=-1)  # (s, d)
+    return np.cos(emb), np.sin(emb)
 
 
 def rotate_half(x: jax.Array) -> jax.Array:
@@ -50,6 +53,7 @@ def apply_rotary_pos_emb(
     sin: jax.Array,
     position_ids: Optional[jax.Array],  # (b, s) or None
 ) -> jax.Array:
+    cos, sin = jnp.asarray(cos), jnp.asarray(sin)
     if position_ids is None:
         s = x.shape[1]
         cos_g = cos[None, :s, None, :]
@@ -89,12 +93,16 @@ class RotaryEmbedding:
         )
 
 
-def precompute_freqs_cis(dim: int, end: int, theta: float) -> jax.Array:
-    """Complex rotation factors e^{i t f} as a (end, dim/2) complex64 array."""
-    freqs = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32)[: dim // 2] / dim))
-    t = jnp.arange(end, dtype=jnp.float32)
-    angles = jnp.outer(t, freqs)
-    return jax.lax.complex(jnp.cos(angles), jnp.sin(angles))
+def precompute_freqs_cis(dim: int, end: int, theta: float) -> np.ndarray:
+    """Complex rotation factors e^{i t f} as a (end, dim/2) complex64 array.
+
+    Host-side numpy (see _cos_sin_tables); stored as cos/sin would be too,
+    but complex64 keeps the llama pairing arithmetic one multiply.
+    """
+    freqs = 1.0 / (theta ** (np.arange(0, dim, 2, dtype=np.float32)[: dim // 2] / dim))
+    t = np.arange(end, dtype=np.float32)
+    angles = np.outer(t, freqs)
+    return (np.cos(angles) + 1j * np.sin(angles)).astype(np.complex64)
 
 
 def apply_complex_rotary_emb(
@@ -106,6 +114,7 @@ def apply_complex_rotary_emb(
     xc = jax.lax.complex(
         x.astype(jnp.float32)[..., 0::2], x.astype(jnp.float32)[..., 1::2]
     )  # (b, s, n, h/2) pairing adjacent dims
+    freqs_cis = jnp.asarray(freqs_cis)
     if position_ids is None:
         f = freqs_cis[None, :s, None, :]
     else:

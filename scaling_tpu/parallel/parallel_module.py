@@ -26,10 +26,14 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from typing import TYPE_CHECKING
+
 from ..nn.base_layer import BaseLayer, ForwardContext, LayerSpec, TiedLayerSpec
 from ..nn.param import ParamMeta, named_parameters, tree_with_layer
-from ..optimizer.optimizer import Optimizer, OptimizerState, OptimizerStepOutput
 from ..topology import ActivationCheckpointingType, Topology
+
+if TYPE_CHECKING:  # break the optimizer <-> parallel import cycle
+    from ..optimizer.optimizer import Optimizer
 from .sharding import shard_batch
 
 

@@ -97,6 +97,13 @@ def pipe_partition_from_indices(bounds: List[int], num_items: int, num_partition
 
 
 # ----------------------------------------------------------------- pipelining
+def _fold_key(ctx: ForwardContext, key: jax.Array, idx) -> ForwardContext:
+    """Context with dropout key folded with ``idx``; no-op when deterministic."""
+    if ctx.dropout_key is None or ctx.deterministic:
+        return ctx
+    return dataclasses.replace(ctx, dropout_key=jax.random.fold_in(key, idx))
+
+
 class PipelinedBody:
     """A homogeneous layer repeated ``num_layers`` times, stage-stacked.
 
@@ -152,31 +159,28 @@ class PipelinedBody:
         """
         call = layer_call or (lambda p, xx, c, _i: self.template(p, xx, c))
         pp, per_stage = self.pp, self.layers_per_stage
+        n_micro = _leading(x_microbatches)
+        assert n_micro is not None, "pipelined body expects stacked micro-batches"
 
         if pp == 1:
-            def run_all(x):
+            def run_all(x, mb_key):
                 def body(h, wi):
                     w, i = wi
-                    # fold the traced layer index into the dropout key: the
-                    # Python-side key counter is baked once at trace time, so
-                    # without this every scan iteration would reuse the same
-                    # masks (reference per-layer RNG: rng_tracker.py:59-96)
-                    layer_ctx = ctx
-                    if ctx.dropout_key is not None and not ctx.deterministic:
-                        layer_ctx = dataclasses.replace(
-                            ctx, dropout_key=jax.random.fold_in(ctx.dropout_key, i)
-                        )
-                    return call(w, h, layer_ctx, i), None
+                    # fold the traced layer index into the per-micro-batch
+                    # key: the Python-side key counter is baked once at trace
+                    # time, so without this every scan iteration would reuse
+                    # the same masks (reference per-layer RNG:
+                    # rng_tracker.py:59-96)
+                    return call(w, h, _fold_key(ctx, mb_key, i), i), None
                 if remat:
                     body = jax.checkpoint(body)
                 squeezed = jax.tree.map(lambda p: p.reshape(self.num_layers, *p.shape[2:]), params)
                 h, _ = jax.lax.scan(body, x, (squeezed, jnp.arange(self.num_layers)))
                 return h
 
-            return jax.vmap(run_all)(x_microbatches) if _leading(x_microbatches) else run_all(x_microbatches)
-
-        n_micro = _leading(x_microbatches)
-        assert n_micro is not None, "pipelined body expects stacked micro-batches"
+            base = ctx.dropout_key if ctx.dropout_key is not None else jax.random.PRNGKey(0)
+            mb_keys = jax.vmap(lambda m: jax.random.fold_in(base, m))(jnp.arange(n_micro))
+            return jax.vmap(run_all)(x_microbatches, mb_keys)
 
         mesh = ctx.mesh
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -196,30 +200,13 @@ class PipelinedBody:
 
         def stage_fn(stage_params, x, stage_idx, tick_key):
             # decorrelate dropout: micro-batch m meets stage s at tick
-            # t = m + s, so folding the tick key gives distinct,
-            # deterministic keys per (stage, micro-batch)
-            if ctx.dropout_key is not None and not ctx.deterministic:
-                from dataclasses import replace as _replace
-
-                stage_ctx = _replace(ctx, dropout_key=tick_key)
-            else:
-                stage_ctx = ctx
-
+            # t = m + s, so the per-(tick, stage) key is distinct and
+            # deterministic per (stage, micro-batch); folding the layer index
+            # on top gives each layer within the stage its own masks
             def body(h, wi):
                 w, j = wi
                 layer_index = stage_idx * per_stage + j
-                # fold the traced layer index so layers within a stage draw
-                # distinct dropout masks (the Python key counter is baked
-                # once when this scan body is traced)
-                layer_ctx = stage_ctx
-                if stage_ctx.dropout_key is not None and not stage_ctx.deterministic:
-                    from dataclasses import replace as _replace2
-
-                    layer_ctx = _replace2(
-                        stage_ctx,
-                        dropout_key=jax.random.fold_in(stage_ctx.dropout_key, layer_index),
-                    )
-                return call(w, h, layer_ctx, layer_index), None
+                return call(w, h, _fold_key(ctx, tick_key, layer_index), layer_index), None
 
             h, _ = jax.lax.scan(body, x, (stage_params, jnp.arange(per_stage)))
             return h

@@ -212,3 +212,26 @@ def test_packed_sequences_respect_segments():
         np.asarray(out1[0, 8:]), np.asarray(out2[0, 8:]), rtol=1e-5, atol=1e-5
     )
     assert not np.allclose(np.asarray(out1[0, :8]), np.asarray(out2[0, :8]))
+
+
+def test_config_round_trip():
+    """model_dump -> from_dict round-trip: the runner payload path re-parses
+    a dumped config (reference: runner.py:199-203, launch_config.py:60-72)."""
+    from scaling_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig.from_dict(
+        {
+            "topology": {
+                "model_parallel_size": 1, "pipe_parallel_size": 1,
+                "data_parallel_size": 1, "micro_batch_size": 2,
+                "gradient_accumulation_steps": 1,
+            },
+            "transformer_architecture": {
+                "vocab_size": 96, "hidden_size": 32, "num_layers": 2,
+                "num_attention_heads": 4, "sequence_length": 24,
+            },
+        }
+    )
+    cfg2 = TransformerConfig.from_dict(cfg.model_dump(mode="json"))
+    assert cfg2.topology.world_size == cfg.topology.world_size
+    assert cfg2.transformer_architecture.hidden_size == 32

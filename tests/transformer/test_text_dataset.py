@@ -76,17 +76,44 @@ def test_only_full_sequences(data_prefix):
 
 def test_only_full_sequences_no_leak_or_overlap(data_prefix):
     """A window must not contain the head of a document belonging to the
-    next window (truncated partial doc) nor double-train tokens."""
+    next window (truncated partial doc) nor predict any token twice.
+    Mid-document cuts overlap by exactly the 1 input/target-shift token."""
     L = 32
     ds = TextDataset(data_prefix, sequence_length=L, seed=1, only_full_sequences=True)
     for i in range(len(ds) - 1):
         start, end = int(ds._item_starts[i]), int(ds._item_ends[i])
         next_start = int(ds._item_starts[i + 1])
-        assert end <= next_start, f"windows {i},{i+1} overlap"
+        # predicted positions are start+1..end; they must not overlap the
+        # next window's predictions (next_start+1..)
+        assert end <= next_start + 1, f"windows {i},{i+1} double-predict"
         tokens = ds[i].token_ids
         span = end - start
         # everything past this window's own tokens is EOD padding
         assert (tokens[min(span, L + 1):] == ds.eod_token_id).all()
+
+
+def test_only_full_sequences_long_doc_windows(tmp_path):
+    """Mid-document windows of an over-long doc carry L+1 real tokens —
+    no spurious EOD is ever a weighted prediction target mid-document."""
+    L = 16
+    prefix = tmp_path / "long"
+    rng = np.random.default_rng(9)
+    with MemoryMapDatasetBuilder(prefix, dtype=np.uint16) as builder:
+        builder.add(np.append(rng.integers(1, 200, size=70), 0).astype(np.uint16))
+        builder.add(np.append(rng.integers(1, 200, size=5), 0).astype(np.uint16))
+    ds = TextDataset(prefix, sequence_length=L, seed=1, only_full_sequences=True,
+                     allow_incomplete_sequences_every_n=1)
+    mm_tokens = np.concatenate([ds.memory_map[i] for i in range(len(ds.memory_map))])
+    for i in range(len(ds)):
+        start, end = int(ds._item_starts[i]), int(ds._item_ends[i])
+        item = ds[i].token_ids
+        np.testing.assert_array_equal(item[: end - start], mm_tokens[start:end])
+        if end < len(mm_tokens) and mm_tokens[end - 1] != ds.eod_token_id:
+            # mid-document cut: the window must be full L+1 real tokens so
+            # collate never sees a padded EOD target with weight 1
+            assert end - start == L + 1, (i, start, end)
+    # consecutive mid-doc windows overlap by exactly one token
+    assert int(ds._item_starts[1]) == int(ds._item_ends[0]) - 1
 
 
 def test_deterministic_order(data_prefix):
