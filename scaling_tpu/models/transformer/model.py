@@ -15,7 +15,7 @@ from typing import Any, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
-from ...nn import LayerSpec, ParamMeta, TiedLayerSpec
+from ...nn import LayerSpec, ParamMeta, PipelineBodySpec, TiedLayerSpec
 from ...optimizer import Optimizer, OptimizerParamGroup
 from ...parallel.parallel_module import ParallelModule
 from ...topology import Topology
@@ -34,9 +34,14 @@ TIED_KEY = "embedding_lm_head"
 
 def get_transformer_layer_specs(
     architecture: TransformerArchitectureConfig,
+    topology: Optional[Topology] = None,
 ) -> List[LayerSpec]:
     """EmbeddingInput -> N x TransformerLayer -> final norm -> LM head
-    [-> embedding head] (reference: model.py:122-216)."""
+    [-> embedding head] (reference: model.py:122-216).
+
+    With pipe_parallel_size > 1 the homogeneous TransformerLayer run becomes
+    one PipelineBodySpec executed as a stage-stacked spatial pipeline; edge
+    layers stay replicated over the pipe axis."""
     has_embedding_head = architecture.embedding_head_config is not None
     if architecture.weight_tying:
         specs: List[LayerSpec] = [
@@ -50,8 +55,14 @@ def get_transformer_layer_specs(
     else:
         specs = [LayerSpec(EmbeddingInput, architecture)]
 
-    for layer_index in range(architecture.num_layers):
-        specs.append(LayerSpec(TransformerLayer, architecture, layer_index))
+    pp = topology.pipe_parallel_size if topology is not None else 1
+    if pp > 1:
+        specs.append(
+            PipelineBodySpec(TransformerLayer, architecture.num_layers, architecture)
+        )
+    else:
+        for layer_index in range(architecture.num_layers):
+            specs.append(LayerSpec(TransformerLayer, architecture, layer_index))
 
     specs.append(
         LayerSpec(LayerNormWrapper, architecture, record_embeddings=has_embedding_head)
@@ -184,7 +195,7 @@ def get_parameter_groups(
 
 
 def init_model(config: TransformerConfig, topology: Optional[Topology] = None) -> ParallelModule:
-    specs = get_transformer_layer_specs(config.transformer_architecture)
+    specs = get_transformer_layer_specs(config.transformer_architecture, topology)
     return ParallelModule(
         specs,
         topology=topology,

@@ -1,6 +1,6 @@
 from .activation_function import ActivationFunction, get_activation_function
 from .attention import ParallelSelfAttention, multi_head_attention, repeat_kv
-from .base_layer import BaseLayer, ForwardContext, LayerSpec, TiedLayerSpec
+from .base_layer import BaseLayer, ForwardContext, LayerSpec, PipelineBodySpec, TiedLayerSpec
 from .linear import (
     ColumnParallelLinear,
     RowParallelLinear,
@@ -44,6 +44,7 @@ __all__ = [
     "BaseLayer",
     "ForwardContext",
     "LayerSpec",
+    "PipelineBodySpec",
     "TiedLayerSpec",
     "ColumnParallelLinear",
     "RowParallelLinear",

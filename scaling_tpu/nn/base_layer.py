@@ -84,6 +84,24 @@ class LayerSpec:
         return self.module_class(*self.args, **self.kwargs)
 
 
+class PipelineBodySpec(LayerSpec):
+    """A homogeneous run of ``num_layers`` identical layers, executed as one
+    stage-stacked pipelined body (spatial GPipe over the ``pipe`` mesh axis).
+
+    Replaces ``num_layers`` consecutive LayerSpecs of the same class; the
+    constructed template layer supplies init/param_metas/__call__ for one
+    layer. Checkpoints still see the individual layers (the ParallelModule
+    un-stacks them into per-layer files), so a checkpoint written at one
+    pipe_parallel_size loads at any other
+    (reference partitioning: pipeline_partitioning.py:38-136).
+    """
+
+    def __init__(self, module_class: Type[BaseLayer], num_layers: int,
+                 *args: Any, **kwargs: Any):
+        super().__init__(module_class, *args, **kwargs)
+        self.num_layers = num_layers
+
+
 class TiedLayerSpec(LayerSpec):
     """LayerSpec whose named params are shared with other specs of same key.
 

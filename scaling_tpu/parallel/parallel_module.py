@@ -28,9 +28,16 @@ import jax.numpy as jnp
 
 from typing import TYPE_CHECKING
 
-from ..nn.base_layer import BaseLayer, ForwardContext, LayerSpec, TiedLayerSpec
+from ..nn.base_layer import (
+    BaseLayer,
+    ForwardContext,
+    LayerSpec,
+    PipelineBodySpec,
+    TiedLayerSpec,
+)
 from ..nn.param import ParamMeta, named_parameters, tree_with_layer
 from ..topology import ActivationCheckpointingType, Topology
+from .pipeline import PipelinedBody
 
 if TYPE_CHECKING:  # break the optimizer <-> parallel import cycle
     from ..optimizer.optimizer import Optimizer
@@ -104,7 +111,26 @@ class ParallelModule:
         self.layer_specs = layer_specs
         self.topology = topology
         self.compute_dtype = compute_dtype
-        self.layers: List[BaseLayer] = [spec.initialize() for spec in layer_specs]
+        # body specs expand to PipelinedBody executors; logical layer indices
+        # count through them so checkpoints name each inner layer like the
+        # per-layer assembly would (reference: partitioned_module.py:249-257)
+        self.layers: List[Any] = []
+        self._logical_start: List[int] = []
+        logical = 0
+        for spec in layer_specs:
+            self._logical_start.append(logical)
+            if isinstance(spec, PipelineBodySpec):
+                self.layers.append(
+                    PipelinedBody(spec.initialize(), spec.num_layers, topology)
+                )
+                logical += spec.num_layers
+            else:
+                self.layers.append(spec.initialize())
+                logical += 1
+        self.num_logical_layers = logical
+        self._has_spatial_pp = any(
+            isinstance(l, PipelinedBody) and l.pp > 1 for l in self.layers
+        )
 
         # tied-weight bookkeeping
         self.tied: Dict[str, TiedInfo] = {}
@@ -121,7 +147,13 @@ class ParallelModule:
 
     # ----------------------------------------------------------- params
     def layer_name(self, i: int) -> str:
-        return f"layer_{i}"
+        return f"layer_{self._logical_start[i]}"
+
+    def _layer_class_name(self, i: int) -> str:
+        layer = self.layers[i]
+        if isinstance(layer, PipelinedBody):
+            return type(layer.template).__name__
+        return type(layer).__name__
 
     def init_params(self, key: jax.Array) -> dict:
         params = {}
@@ -138,7 +170,7 @@ class ParallelModule:
         metas = {}
         for i, layer in enumerate(self.layers):
             m = layer.param_metas()
-            m = tree_with_layer(m, i, type(layer).__name__)
+            m = tree_with_layer(m, self._logical_start[i], self._layer_class_name(i))
             metas[self.layer_name(i)] = m
         for info in self.tied.values():
             owner_name = self.layer_name(info.owner_layer)
@@ -155,6 +187,88 @@ class ParallelModule:
 
     def named_parameters(self, params: dict) -> list:
         return named_parameters(params, self.param_metas())
+
+    # ------------------------------------------------- checkpoint views
+    # Stage-stacked body params are unstacked into per-logical-layer trees
+    # before hitting disk, so checkpoint files are identical no matter the
+    # pipe_parallel_size they were written under (the reference gets the
+    # same property from merged layer files, partitioned_module.py:197-257).
+    def ckpt_view(self, tree: dict) -> dict:
+        view: dict = {}
+        for i, layer in enumerate(self.layers):
+            name = self.layer_name(i)
+            sub = tree[name]
+            if isinstance(layer, PipelinedBody):
+                start = self._logical_start[i]
+                L = layer.num_layers
+                # empty (0,) leaves are frozen-param placeholders in
+                # optimizer-state trees: not stacked, pass through per layer
+                flat = jax.tree.map(
+                    lambda x: x.reshape(L, *x.shape[2:]) if x.size else x, sub
+                )
+                for j in range(L):
+                    view[f"layer_{start + j}"] = jax.tree.map(
+                        lambda x, _j=j: x[_j] if x.size else x, flat
+                    )
+            else:
+                view[name] = sub
+        return view
+
+    def ckpt_unview(self, view: dict, like: dict) -> dict:
+        """Inverse of ckpt_view; ``like`` supplies sharding/placement."""
+        out: dict = {}
+        for i, layer in enumerate(self.layers):
+            name = self.layer_name(i)
+            if isinstance(layer, PipelinedBody):
+                start = self._logical_start[i]
+                L, pp = layer.num_layers, max(layer.pp, 1)
+                per_layer = [view[f"layer_{start + j}"] for j in range(L)]
+
+                def restack(old, *xs):
+                    if old.size == 0:  # frozen-param placeholder
+                        return old
+                    new = jnp.stack(xs, axis=0).reshape(pp, L // pp, *xs[0].shape)
+                    return (
+                        jax.device_put(new, old.sharding)
+                        if hasattr(old, "sharding")
+                        else new
+                    )
+
+                out[name] = jax.tree.map(restack, like[name], *per_layer)
+            else:
+                out[name] = view[name]
+        return out
+
+    def ckpt_metas(self) -> dict:
+        metas: dict = {}
+        for i, layer in enumerate(self.layers):
+            name = self.layer_name(i)
+            start = self._logical_start[i]
+            if isinstance(layer, PipelinedBody):
+                template_metas = layer.template.param_metas()
+                cls = self._layer_class_name(i)
+                for j in range(layer.num_layers):
+                    metas[f"layer_{start + j}"] = tree_with_layer(
+                        template_metas, start + j, cls
+                    )
+            else:
+                m = tree_with_layer(
+                    layer.param_metas(), start, self._layer_class_name(i)
+                )
+                metas[name] = m
+        # mirror the tied-attribute dropping of param_metas()
+        for info in self.tied.values():
+            owner_name = self.layer_name(info.owner_layer)
+            for attr in info.attributes:
+                meta = _get_path(metas[owner_name], attr)
+                metas[owner_name] = _set_path(
+                    metas[owner_name], attr,
+                    type(meta)(**{**meta.__dict__, "tied_key": info.key}),
+                )
+            for c in info.consumers:
+                for attr in info.attributes:
+                    metas[self.layer_name(c)] = _del_path(metas[self.layer_name(c)], attr)
+        return metas
 
     def parameter_count(self, params: dict) -> int:
         return sum(int(p.size) for p in jax.tree.leaves(params))
@@ -177,7 +291,13 @@ class ParallelModule:
         )
         for i, layer in enumerate(self.layers):
             layer_p = self._layer_params(params, i)
-            if ckpt_type == ActivationCheckpointingType.EVERY_LAYER:
+            if isinstance(layer, PipelinedBody):
+                # the body remats its own stage/layer scans
+                x = layer(
+                    layer_p, x, ctx, stacked=False,
+                    remat=ckpt_type != ActivationCheckpointingType.DISABLED,
+                )
+            elif ckpt_type == ActivationCheckpointingType.EVERY_LAYER:
                 x = jax.checkpoint(
                     lambda p, xx, _layer=layer: _layer(p, xx, ctx)
                 )(layer_p, x)
@@ -212,6 +332,9 @@ class ParallelModule:
         gas = self.topology.gradient_accumulation_steps if self.topology else 1
 
         scaler_enabled = optimizer.config.loss_scaler.enable
+
+        if self._has_spatial_pp:
+            return self._build_spatial_train_step(optimizer, loss_function, donate)
 
         def microbatch_loss(params, mb, dropout_key, loss_scale):
             ctx = self._make_ctx(deterministic=False, dropout_key=dropout_key)
@@ -267,6 +390,96 @@ class ParallelModule:
             )
             loss = loss_sum / gas
             metrics = jax.tree.map(lambda m: m / gas, metrics_sum)
+            return new_params, new_opt_state, loss, metrics, opt_out
+
+        return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+    def _build_spatial_train_step(
+        self, optimizer, loss_function: Callable, donate: bool
+    ) -> Callable:
+        """Train step for pipe_parallel_size > 1: all micro-batches flow
+        through the stage-stacked body at once (spatial GPipe); edge layers
+        and the loss run per micro-batch under vmap/scan. Gradients come
+        from ONE backward over the whole pipelined program — XLA schedules
+        the collective-permutes, matching the reference's 1F1B+grad-accum
+        semantics (reference: pipeline_schedule/train.py:33-174) without the
+        instruction interpreter.
+        """
+        topo = self.topology
+        gas = topo.gradient_accumulation_steps
+        scaler_enabled = optimizer.config.loss_scaler.enable
+        remat = (
+            topo.activation_checkpointing_type != ActivationCheckpointingType.DISABLED
+        )
+        body_ids = [
+            i for i, l in enumerate(self.layers) if isinstance(l, PipelinedBody)
+        ]
+        if len(body_ids) != 1:
+            raise NotImplementedError(
+                f"spatial pipelining expects exactly one PipelineBodySpec, got {len(body_ids)}"
+            )
+        body_idx = body_ids[0]
+        pre_ids = list(range(body_idx))
+        post_ids = list(range(body_idx + 1, len(self.layers)))
+
+        def spatial_loss(params, micro_batches, dropout_key, loss_scale):
+            mb_keys = jax.vmap(
+                lambda m: jax.random.fold_in(dropout_key, m)
+            )(jnp.arange(gas))
+
+            def run_pre(mb, k):
+                ctx = self._make_ctx(deterministic=False, dropout_key=k)
+                x = mb
+                for i in pre_ids:
+                    x = self.layers[i](self._layer_params(params, i), x, ctx)
+                return x
+
+            xs = jax.vmap(run_pre)(micro_batches, mb_keys)
+
+            body_ctx = self._make_ctx(
+                deterministic=False,
+                dropout_key=jax.random.fold_in(dropout_key, 0x0B0D),
+            )
+            xs = self.layers[body_idx](
+                self._layer_params(params, body_idx), xs, body_ctx, remat=remat
+            )
+
+            def run_post(x, mb, k):
+                ctx = self._make_ctx(
+                    deterministic=False, dropout_key=jax.random.fold_in(k, 1)
+                )
+                for i in post_ids:
+                    x = self.layers[i](self._layer_params(params, i), x, ctx)
+                loss, metrics = loss_function(x, mb)
+                return (
+                    loss.astype(jnp.float32),
+                    jax.tree.map(lambda v: jnp.asarray(v, jnp.float32), metrics),
+                )
+
+            # scan (not vmap) over micro-batches + remat: only one
+            # micro-batch worth of vocab-sized logits is ever live
+            run_post_ck = jax.checkpoint(run_post)
+
+            def post_scan(_, inp):
+                x, mb, k = inp
+                return None, run_post_ck(x, mb, k)
+
+            _, (losses, metrics) = jax.lax.scan(
+                post_scan, None, (xs, micro_batches, mb_keys)
+            )
+            loss = losses.mean()
+            metrics = jax.tree.map(lambda v: v.mean(axis=0), metrics)
+            scaled = loss * loss_scale if scaler_enabled else loss
+            return scaled, (loss, metrics)
+
+        def step(params, opt_state, micro_batches, dropout_key):
+            loss_scale = opt_state.loss_scaler.current_scale
+            (_, (loss, metrics)), grads = jax.value_and_grad(
+                spatial_loss, has_aux=True
+            )(params, micro_batches, dropout_key, loss_scale)
+            new_params, new_opt_state, opt_out = optimizer.step(
+                params, grads, opt_state, compute_dtype=self.compute_dtype
+            )
             return new_params, new_opt_state, loss, metrics, opt_out
 
         return jax.jit(step, donate_argnums=(0, 1) if donate else ())

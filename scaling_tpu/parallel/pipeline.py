@@ -151,14 +151,24 @@ class PipelinedBody:
         ctx: ForwardContext,
         layer_call: Optional[Callable] = None,
         remat: bool = True,
+        stacked: bool = True,
     ) -> jax.Array:
         """Run all micro-batches through the pipelined stack.
 
-        Returns outputs stacked (n_micro, mbs, ...). ``layer_call(params,
-        x, ctx, layer_index)`` defaults to the template's __call__.
+        Returns outputs stacked (n_micro, mbs, ...); with ``stacked=False``
+        the input is one micro-batch and the output is unstacked too.
+        ``layer_call(params, x, ctx, layer_index)`` defaults to the
+        template's __call__.
         """
         call = layer_call or (lambda p, xx, c, _i: self.template(p, xx, c))
         pp, per_stage = self.pp, self.layers_per_stage
+
+        if not stacked:
+            # single micro-batch (eval/inference): run it as a 1-deep stack
+            lifted = jax.tree.map(lambda x: x[None], x_microbatches)
+            out = self(params, lifted, ctx, layer_call=layer_call, remat=remat)
+            return jax.tree.map(lambda x: x[0], out)
+
         n_micro = _leading(x_microbatches)
         assert n_micro is not None, "pipelined body expects stacked micro-batches"
 

@@ -259,14 +259,21 @@ class BaseTrainer:
         base = Path(dir or self.config.save_dir)
         step_dir = self._step_dir(base, self.context.iterations)
         step_dir.mkdir(parents=True, exist_ok=True)
-        metas = self.module.param_metas()
+        # checkpoint-view trees: stage-stacked pipeline bodies un-stack into
+        # per-layer files so checkpoints are pipe-layout independent
+        metas = self.module.ckpt_metas()
         save_model_checkpoint(
-            step_dir, self.params, metas,
+            step_dir, self.module.ckpt_view(self.params), metas,
             separate_file_for_parameters=getattr(
                 self.module, "separate_file_for_parameters", None
             ),
         )
-        save_optimizer_checkpoint(step_dir, self.opt_state, metas)
+        viewed_opt = self.opt_state._replace(
+            master=self.module.ckpt_view(self.opt_state.master),
+            exp_avg=self.module.ckpt_view(self.opt_state.exp_avg),
+            exp_avg_sq=self.module.ckpt_view(self.opt_state.exp_avg_sq),
+        )
+        save_optimizer_checkpoint(step_dir, viewed_opt, metas)
         self.context.save_checkpoint(step_dir)
         (base / "latest").write_text(f"global_step{self.context.iterations}")
         logger.info(f"saved checkpoint {step_dir}")
@@ -287,19 +294,32 @@ class BaseTrainer:
         else:
             logger.warning(f"no checkpoint found at {base}")
             return False
-        metas = self.module.param_metas()
-        self.params = load_model_checkpoint(
+        metas = self.module.ckpt_metas()
+        params_view = load_model_checkpoint(
             step_dir,
-            self.params,
+            self.module.ckpt_view(self.params),
             metas,
             allowed_missing_keys=self.config.allowed_missing_keys_in_checkpoint,
             allowed_unexpected_keys=self.config.allowed_unexpected_keys_in_checkpoint,
             ignore_keys=self.config.ignore_keys_in_checkpoint,
         )
+        self.params = self.module.ckpt_unview(params_view, self.params)
         optimizer_states_loaded = False
         if self.config.load_optimizer_states:
             try:
-                self.opt_state = load_optimizer_checkpoint(step_dir, self.opt_state, metas)
+                viewed_current = self.opt_state._replace(
+                    master=self.module.ckpt_view(self.opt_state.master),
+                    exp_avg=self.module.ckpt_view(self.opt_state.exp_avg),
+                    exp_avg_sq=self.module.ckpt_view(self.opt_state.exp_avg_sq),
+                )
+                loaded = load_optimizer_checkpoint(step_dir, viewed_current, metas)
+                self.opt_state = loaded._replace(
+                    master=self.module.ckpt_unview(loaded.master, self.opt_state.master),
+                    exp_avg=self.module.ckpt_unview(loaded.exp_avg, self.opt_state.exp_avg),
+                    exp_avg_sq=self.module.ckpt_unview(
+                        loaded.exp_avg_sq, self.opt_state.exp_avg_sq
+                    ),
+                )
                 optimizer_states_loaded = True
             except FileNotFoundError:
                 logger.warning(f"optimizer states absent in {step_dir}")
