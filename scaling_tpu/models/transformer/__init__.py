@@ -11,6 +11,12 @@ from .config import (
     TransformerConfig,
 )
 from .context import TransformerContext
+from .inference import (
+    CompletionOutput,
+    TransformerInferenceModule,
+    make_sampler,
+    sample_argmax,
+)
 from .model import (
     get_parameter_groups,
     get_transformer_layer_specs,
@@ -33,6 +39,10 @@ __all__ = [
     "TransformerArchitectureConfig",
     "TransformerConfig",
     "TransformerContext",
+    "CompletionOutput",
+    "TransformerInferenceModule",
+    "make_sampler",
+    "sample_argmax",
     "get_parameter_groups",
     "get_transformer_layer_specs",
     "init_model",

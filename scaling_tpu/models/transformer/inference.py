@@ -1,0 +1,264 @@
+"""Inference: checkpoint -> logits / generate with KV cache.
+
+(reference: src/scaling/transformer/inference/inference_model.py:30-263,
+core/nn/parallel_module/inference_module.py). The reference hops layer
+slices across GPUs with ``.to_(device)`` and grows a KV cache by
+concatenation; under jit both collapse: layers run in one XLA program and
+the cache is a fixed-capacity buffer written with ``dynamic_update_slice``
+(static shapes — one compiled decode step serves the whole generation).
+
+Cached vs uncached generate (reference: inference_model.py:159-235):
+- cached: one prefill over the prompt, then a jitted 1-token decode step.
+- uncached: the whole padded sequence is re-fed each step (parity baseline).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Callable, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import yaml
+
+from .config import TransformerConfig
+from .layers.layer import TransformerLayer
+from .model import get_transformer_layer_specs
+from .tokenizer import Tokenizer
+from ...checkpoint import load_model_checkpoint
+from ...parallel.parallel_module import ParallelModule
+
+
+class CompletionOutput(NamedTuple):
+    completion_ids: List[int]
+    completion: Optional[str]
+    logits: Optional[jax.Array] = None
+
+
+def sample_argmax(logits: jax.Array, key: Optional[jax.Array] = None) -> jax.Array:
+    """Greedy sampling (reference: inference/sample.py)."""
+    return jnp.argmax(logits, axis=-1)
+
+
+def make_sampler(
+    temperature: float = 1.0, top_k: Optional[int] = None
+) -> Callable[[jax.Array, jax.Array], jax.Array]:
+    def sample(logits: jax.Array, key: jax.Array) -> jax.Array:
+        scaled = logits.astype(jnp.float32) / max(temperature, 1e-6)
+        if top_k is not None:
+            kth = jnp.sort(scaled, axis=-1)[..., -top_k][..., None]
+            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+        return jax.random.categorical(key, scaled, axis=-1)
+
+    return sample
+
+
+class TransformerInferenceModule:
+    """Single-host inference over a trained checkpoint."""
+
+    def __init__(
+        self,
+        config: TransformerConfig,
+        module: ParallelModule,
+        params: Any,
+        tokenizer: Optional[Tokenizer] = None,
+    ):
+        self.config = config
+        self.architecture = config.transformer_architecture
+        self.module = module
+        self.params = params
+        self.tokenizer = tokenizer
+        self._logits_fn = None
+        self._decode_fn = None
+        self._decode_len: Optional[int] = None
+
+    # ------------------------------------------------------------- loading
+    @classmethod
+    def from_checkpoint(
+        cls,
+        checkpoint_dir: Path | str,
+        vocab_file: Optional[Path | str] = None,
+        overwrite_config: Optional[dict] = None,
+    ) -> "TransformerInferenceModule":
+        """Reads ``config.yml`` + per-layer npz files from a checkpoint dir
+        (reference: inference_model.py:55-87)."""
+        ckpt = Path(checkpoint_dir)
+        latest = ckpt / "latest"
+        if latest.is_file():
+            ckpt = ckpt / latest.read_text().strip()
+        config_file = ckpt / "config.yml"
+        if not config_file.is_file():
+            raise FileNotFoundError(f"no config.yml in {ckpt}")
+        config = TransformerConfig.from_dict(
+            yaml.safe_load(config_file.read_text()), overwrite_values=overwrite_config
+        )
+        specs = get_transformer_layer_specs(config.transformer_architecture)
+        module = ParallelModule(
+            specs, topology=None, compute_dtype=config.transformer_architecture.dtype
+        )
+        params = module.init_params(jax.random.PRNGKey(0))
+        params = module.ckpt_unview(
+            load_model_checkpoint(ckpt, module.ckpt_view(params), module.ckpt_metas()),
+            params,
+        )
+        tokenizer = None
+        vocab = Path(vocab_file) if vocab_file else ckpt / "vocab.json"
+        if vocab.is_file():
+            tokenizer = Tokenizer.from_file(vocab)
+        return cls(config, module, params, tokenizer)
+
+    # ------------------------------------------------------------- forward
+    def _run_layers(self, params, batch, caches, offset):
+        """One pass through the stack; TransformerLayers consume/produce the
+        KV caches, edge layers run as in training (deterministic)."""
+        ctx = self.module._make_ctx(deterministic=True, dropout_key=None)
+        x = batch
+        new_caches = []
+        li = 0
+        for i, layer in enumerate(self.module.layers):
+            p = self.module._layer_params(params, i)
+            if isinstance(layer, TransformerLayer):
+                if caches is None:
+                    x = layer(p, x, ctx)
+                else:
+                    x, kv = layer(p, x, ctx, kv_cache=caches[li], cache_offset=offset)
+                    new_caches.append(kv)
+                    li += 1
+            else:
+                x = layer(p, x, ctx)
+        return x["activations"], new_caches
+
+    def _make_batch(self, token_ids: jax.Array, position_ids: jax.Array) -> dict:
+        b, s = token_ids.shape
+        return {
+            "token_ids": token_ids.astype(jnp.int32),
+            "target_token_ids": jnp.zeros((b, s), jnp.int32),
+            "position_ids": position_ids.astype(jnp.int32),
+            "segment_ids": jnp.zeros((b, s), jnp.int32),
+            "loss_weights": None,
+            "embeddings": None,
+            "attention_scores_manipulation": None,
+        }
+
+    def logits(self, token_ids) -> jax.Array:
+        """Full-sequence logits (b, s, vocab)."""
+        token_ids = jnp.asarray(token_ids)
+        if token_ids.ndim == 1:
+            token_ids = token_ids[None]
+        b, s = token_ids.shape
+        pos = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+        if self._logits_fn is None:
+            self._logits_fn = jax.jit(
+                lambda p, t, po: self._run_layers(p, self._make_batch(t, po), None, None)[0]
+            )
+        return self._logits_fn(self.params, token_ids, pos)
+
+    # ------------------------------------------------------------ generate
+    def _alloc_caches(self, kvs, max_len: int):
+        caches = []
+        for k, v in kvs:
+            b, s = k.shape[0], k.shape[1]
+            ck = jnp.zeros((b, max_len) + k.shape[2:], k.dtype)
+            cv = jnp.zeros((b, max_len) + v.shape[2:], v.dtype)
+            caches.append(
+                (
+                    jax.lax.dynamic_update_slice_in_dim(ck, k, 0, axis=1),
+                    jax.lax.dynamic_update_slice_in_dim(cv, v, 0, axis=1),
+                )
+            )
+        return caches
+
+    def _prefill(self, token_ids: jax.Array, max_len: int):
+        """Prompt pass collecting per-layer KV, then seed fixed-size caches."""
+        b, s = token_ids.shape
+        pos = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+        ctx = self.module._make_ctx(deterministic=True, dropout_key=None)
+
+        def run(params, t, po):
+            x = self._make_batch(t, po)
+            kvs = []
+            for i, layer in enumerate(self.module.layers):
+                p = self.module._layer_params(params, i)
+                if isinstance(layer, TransformerLayer):
+                    x, kv = layer(p, x, ctx, return_kv=True)
+                    kvs.append(kv)
+                else:
+                    x = layer(p, x, ctx)
+            return x["activations"], kvs
+
+        logits, kvs = jax.jit(run)(self.params, token_ids, pos)
+        return logits, self._alloc_caches(kvs, max_len)
+
+    def generate(
+        self,
+        input_ids,
+        max_tokens: int = 32,
+        sample_fn: Optional[Callable] = None,
+        use_cache: bool = True,
+        eos_token_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> CompletionOutput:
+        """Autoregressive decode (reference: inference_model.py:195-263)."""
+        if isinstance(input_ids, str):
+            assert self.tokenizer is not None, "text prompt needs a tokenizer"
+            input_ids = self.tokenizer.encode(input_ids)
+        prompt = jnp.asarray(input_ids, jnp.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None]
+        b, prompt_len = prompt.shape
+        assert b == 1, "generate supports batch size 1 (reference: attention.py:491)"
+        if eos_token_id is None and self.tokenizer is not None:
+            eos_token_id = self.tokenizer.eos_token_id
+        sample = sample_fn or sample_argmax
+        key = jax.random.PRNGKey(seed)
+
+        if use_cache:
+            max_len = prompt_len + max_tokens
+            logits, caches = self._prefill(prompt, max_len)
+            next_tok = sample(logits[:, -1], key)
+            out_tokens = [int(next_tok[0])]
+
+            if self._decode_fn is None or self._decode_len != max_len:
+                def decode(params, caches, tok, offset, k):
+                    pos = jnp.broadcast_to(offset[None, None], (1, 1))
+                    batch = self._make_batch(tok[:, None], pos)
+                    logits, new_caches = self._run_layers(params, batch, caches, offset)
+                    nxt = sample(logits[:, -1], k)
+                    return nxt, new_caches
+
+                self._decode_fn = jax.jit(decode)
+                self._decode_len = max_len
+
+            tok = next_tok
+            for t in range(1, max_tokens):
+                if eos_token_id is not None and out_tokens[-1] == eos_token_id:
+                    break
+                key, sub = jax.random.split(key)
+                tok, caches = self._decode_fn(
+                    self.params, caches, tok, jnp.asarray(prompt_len + t - 1, jnp.int32), sub
+                )
+                out_tokens.append(int(tok[0]))
+        else:
+            # refeed the whole (fixed-size) buffer each step: one compile
+            max_len = prompt_len + max_tokens
+            buf = jnp.zeros((1, max_len), jnp.int32)
+            buf = jax.lax.dynamic_update_slice_in_dim(buf, prompt, 0, axis=1)
+            fwd = jax.jit(
+                lambda p, t, po: self._run_layers(p, self._make_batch(t, po), None, None)[0]
+            )
+            pos = jnp.broadcast_to(jnp.arange(max_len)[None], (1, max_len))
+            out_tokens = []
+            cur = prompt_len
+            for _ in range(max_tokens):
+                logits = fwd(self.params, buf, pos)
+                key, sub = jax.random.split(key)
+                nxt = sample(logits[:, cur - 1], sub)
+                out_tokens.append(int(nxt[0]))
+                if eos_token_id is not None and out_tokens[-1] == eos_token_id:
+                    break
+                buf = jax.lax.dynamic_update_slice(buf, nxt[:, None].astype(jnp.int32), (0, cur))
+                cur += 1
+
+        text = self.tokenizer.decode(out_tokens) if self.tokenizer else None
+        return CompletionOutput(completion_ids=out_tokens, completion=text)

@@ -275,6 +275,15 @@ class BaseTrainer:
         )
         save_optimizer_checkpoint(step_dir, viewed_opt, metas)
         self.context.save_checkpoint(step_dir)
+        # full config travels with the weights so inference can rebuild the
+        # architecture (reference: context.py:113-125 config.yml copy)
+        cfg = getattr(self.context, "config", None)
+        if cfg is not None and hasattr(cfg, "model_dump"):
+            import yaml as _yaml
+
+            (step_dir / "config.yml").write_text(
+                _yaml.safe_dump(cfg.model_dump(mode="json"), sort_keys=False)
+            )
         (base / "latest").write_text(f"global_step{self.context.iterations}")
         logger.info(f"saved checkpoint {step_dir}")
         if self.config.delete_past_optimizer_states:
