@@ -10,6 +10,7 @@ numbers of its own — BASELINE.md "Reference-published numbers").
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -31,6 +32,33 @@ from scaling_tpu.models.transformer.utils.get_tflops import (
 from scaling_tpu.topology import Topology
 
 MFU_TARGET = 0.45  # BASELINE.json: ">=45% MFU on a 7B on v5p-128"
+
+
+def measure_achievable_tflops() -> float:
+    """Sustained large-matmul bf16 throughput on THIS device.
+
+    Virtualized/shared chips (e.g. tunneled dev slices) can deliver a small
+    fraction of the nominal peak; reporting MFU against the measured ceiling
+    separates framework efficiency from hardware provisioning.
+    """
+    a = jax.random.normal(jax.random.PRNGKey(0), (4096, 4096), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(1), (4096, 4096), jnp.bfloat16)
+
+    @jax.jit
+    def chain(a, b):
+        def body(x, _):
+            return x @ b, None
+
+        x, _ = jax.lax.scan(body, a, None, length=32)
+        return x.sum()
+
+    float(chain(a, b))  # compile
+    best = float("inf")
+    for i in range(3):  # best-of-3: the chip may be time-shared
+        t0 = time.perf_counter()
+        float(chain(a + float(i), b))  # scalar fetch forces completion
+        best = min(best, time.perf_counter() - t0)
+    return 32 * 2 * 4096**3 / best / 1e12
 
 
 def detect_hardware() -> HardwareType:
@@ -66,8 +94,12 @@ def build(seq_len: int, micro_batch_size: int, hidden: int, layers: int):
                 "mlp_type": "swiglu",
                 "mlp_factor": 2.75,  # llama-style 8/3 rounded to an integer width
                 "norm_type": "rms",
-                "relative_position_embedding_type": "rotary_complex",
+                "relative_position_embedding_type": os.environ.get("BENCH_ROTARY", "rotary"),
                 "causal": True,
+                # XLA attention beats the Pallas flash kernel at seq 2048 on
+                # this chip (flash wins on memory at longer contexts); both
+                # stay selectable
+                "masked_softmax": {"kernel": os.environ.get("BENCH_KERNEL", "torch")},
                 "weight_tying": False,
                 "attention_qkv_in_one": False,
                 "dropout_embedding": 0.0,
@@ -149,6 +181,10 @@ def main() -> None:
         param_count, arch.num_layers, arch.hidden_size, arch.sequence_length,
         tokens_per_sec, world_size=1, hardware=hardware,
     )
+    achievable = measure_achievable_tflops() if on_tpu else None
+    mfu_achievable = (
+        round(mfu * hardware.max_tflops / achievable, 4) if achievable else None
+    )
     print(
         json.dumps(
             {
@@ -157,6 +193,8 @@ def main() -> None:
                 "unit": "tokens/s",
                 "vs_baseline": round(mfu / MFU_TARGET, 4),
                 "mfu": round(mfu, 4),
+                "mfu_vs_measured_peak": mfu_achievable,
+                "measured_peak_tflops": round(achievable, 1) if achievable else None,
                 "hardware": hardware.value,
                 "params": param_count,
                 "step_ms": round(dt * 1000, 2),

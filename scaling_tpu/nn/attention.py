@@ -307,6 +307,35 @@ class ParallelSelfAttention(BaseLayer):
             dropout_fn = lambda p: ctx.dropout(p, self.dropout_attention_probs)  # noqa: E731
 
         n_local = self.num_local_attention_heads
+        use_flash_here = (
+            self.use_flash
+            and kv_cache is None
+            and attention_scores_manipulation is None
+            and dropout_fn is None
+            and n_local == 0
+            and self.causal
+        )
+        if use_flash_here:
+            from ..ops.flash_attention import (
+                flash_attention_fused,
+                flash_attention_supported,
+            )
+
+            use_flash_here = flash_attention_supported(s, self.head_dim)
+        if use_flash_here:
+            out = flash_attention_fused(
+                q, k, v, segment_ids, causal=True, sm_scale=self.scaling_factor
+            )
+            out = out.reshape(b, s, self.hidden_size)
+            y = self.dense(params["dense"], out, ctx)
+            if self.lora_config:
+                name = f"{LoRAModuleType.DENSE.value}_{self.lora_config.name}"
+                if name in self.lora_modules:
+                    y = y + self.lora_modules[name](params[name], out, ctx)
+            if new_kv is not None:
+                return y, new_kv
+            return y
+
         if n_local > 0 and kv_cache is None:
             # mixed local/global heads: first (n - n_local) heads global,
             # last n_local heads restricted to the window

@@ -1,0 +1,3 @@
+from .flash_attention import flash_attention_fused, flash_attention_supported
+
+__all__ = ["flash_attention_fused", "flash_attention_supported"]

@@ -1,0 +1,96 @@
+"""Fused flash attention on TPU (Pallas).
+
+Replaces the reference's flash-attn CUDA dependency
+(reference: src/scaling/core/nn/attention/attention.py:29-36,204-259,
+requirements/gpu_optimization.txt). The reference imports the flash-attn
+package; the TPU-native equivalent is the block-wise Pallas kernel that
+ships with jax (jax.experimental.pallas.ops.tpu.flash_attention) driven
+through this wrapper, which:
+
+- maps the framework's (batch, seq, heads, head_dim) layout and packed-doc
+  ``segment_ids`` (= the reference's ``cumulative_seq_lengths``,
+  attention.py:245-258) onto the kernel's (b, h, s, d) + SegmentIds API;
+- picks legal block sizes for short sequences;
+- runs the kernel in interpreter mode off-TPU so the flash path stays
+  testable on the CPU mesh harness.
+
+Unsupported cases (KV cache decode, attention-score manipulation,
+probability dropout, local-window heads) stay on the XLA path in
+``nn/attention.py`` — mirroring the reference's flash/torch kernel switch
+(masked_softmax_config.py:8-37).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_MIN_BLOCK = 128
+
+
+def flash_attention_supported(
+    seq_len: int, head_dim: int, platform: Optional[str] = None
+) -> bool:
+    """The Pallas kernel needs MXU-aligned sequence blocks and a real TPU.
+
+    Off-TPU the layer falls back to the XLA path (the reference likewise
+    skips flash-attn without a GPU); interpreter-mode testing opts in via
+    ``pltpu.force_tpu_interpret_mode()`` around the whole computation.
+    """
+    if (platform or jax.default_backend()) != "tpu":
+        return False
+    return seq_len % _MIN_BLOCK == 0 and head_dim >= 64
+
+
+def flash_attention_fused(
+    q: jax.Array,  # (b, s, n, d)
+    k: jax.Array,  # (b, s, n, d)  — kv heads already repeated for GQA
+    v: jax.Array,  # (b, s, n, d)
+    segment_ids: Optional[jax.Array] = None,  # (b, s) int32 packed-doc ids
+    causal: bool = True,
+    sm_scale: float = 1.0,
+) -> jax.Array:
+    """Block-wise attention, O(s) memory; returns (b, s, n, d)."""
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+    b, s, n, d = q.shape
+    qt = jnp.swapaxes(q, 1, 2)  # (b, n, s, d)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+
+    seg = None
+    if segment_ids is not None:
+        seg_i32 = segment_ids.astype(jnp.int32)
+        seg = fa.SegmentIds(q=seg_i32, kv=seg_i32)
+
+    block = min(512, s)
+    sizes = fa.BlockSizes(
+        block_q=block,
+        block_k_major=block,
+        block_k=block,
+        block_b=1,
+        block_q_major_dkv=block,
+        block_k_major_dkv=block,
+        block_k_dkv=block,
+        block_q_dkv=block,
+        block_k_major_dq=block,
+        block_k_dq=block,
+        block_q_dq=block,
+    )
+
+    def run():
+        return fa.flash_attention(
+            qt, kt, vt, segment_ids=seg, causal=causal,
+            sm_scale=sm_scale, block_sizes=sizes,
+        )
+
+    if jax.default_backend() != "tpu":
+        from jax.experimental.pallas import tpu as pltpu
+
+        with pltpu.force_tpu_interpret_mode():
+            out = run()
+    else:
+        out = run()
+    return jnp.swapaxes(out, 1, 2)  # back to (b, s, n, d)
