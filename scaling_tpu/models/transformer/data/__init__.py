@@ -1,3 +1,10 @@
+from .finetuning import (
+    FinetuningChatBlendedDataset,
+    FinetuningChatDataset,
+    FinetuningItem,
+    FinetuningTextBlendedDataset,
+    FinetuningTextDataset,
+)
 from .text_dataset import (
     TextBlendedDataset,
     TextDataset,
@@ -6,6 +13,11 @@ from .text_dataset import (
 )
 
 __all__ = [
+    "FinetuningChatBlendedDataset",
+    "FinetuningChatDataset",
+    "FinetuningItem",
+    "FinetuningTextBlendedDataset",
+    "FinetuningTextDataset",
     "TextBlendedDataset",
     "TextDataset",
     "TextDatasetBatch",

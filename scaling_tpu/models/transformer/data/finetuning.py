@@ -1,0 +1,250 @@
+"""Finetuning datasets: prompt/completion and chat.
+
+(reference: src/scaling/transformer/data/finetuning_text_dataset.py:59-218,
+finetuning_chat_dataset.py:27-355). Same on-disk formats so existing data
+works unchanged:
+
+- text jsonl: ``{"prompt": str, "completion": str}`` per line (prompt may be
+  a list of strings; image entries are not yet supported on TPU)
+- text mmap: each record ``[len_prompt, prompt..., completion...]``
+- chat jsonl: each line a LIST of ``{"type": "text", "content": str,
+  "has_loss": bool}`` elements; tokens of has_loss elements are trained
+
+Loss masking (reference: finetuning_text_dataset.py:192-198): weight 0 on
+prompt tokens and padding, 1 on completion tokens + the closing EOS. Items
+are padded to ``sequence_length`` with EOS; over-long items are truncated
+from the front of the prompt so the completion survives.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ....data.base_dataset import BaseDataset
+from ....data.blended_dataset import BaseBlendedDataset
+from ....data.memory_map import MemoryMapDataset
+from ..tokenizer import Tokenizer, load_tokenizers
+from .text_dataset import TextDatasetBatch
+from ....nn.seq_packing import get_position_ids_from_segments, get_segment_ids
+
+
+class FinetuningItem:
+    __slots__ = ("token_ids", "target_token_ids", "loss_weights")
+
+    def __init__(self, token_ids, target_token_ids, loss_weights):
+        self.token_ids = token_ids
+        self.target_token_ids = target_token_ids
+        self.loss_weights = loss_weights
+
+
+class _FinetuningBase(BaseDataset):
+    """Shared item assembly + collate for both finetuning datasets."""
+
+    def __init__(self, sequence_length: int, eod_token_id: int,
+                 seed: int = 42, shuffle: bool = True):
+        self.sequence_length = sequence_length
+        self.eod_token_id = eod_token_id
+        super().__init__(seed=seed, shuffle=shuffle)
+
+    def set_seed(self, seed: int, shuffle: bool = True) -> None:
+        # item order is owned by the DP-strided RandomSampler (the reference
+        # shuffles in-place, finetuning_text_dataset.py:127-144; our loader
+        # derives order from the seed instead)
+        self.seed = seed
+        self.shuffle = shuffle
+
+    def _assemble(
+        self, input_ids: List[int], target_ids: List[int], loss_mask: List[int]
+    ) -> FinetuningItem:
+        L = self.sequence_length
+        if len(input_ids) > L:
+            # keep the tail: the trained completion lives there
+            input_ids = input_ids[-L:]
+            target_ids = target_ids[-L:]
+            loss_mask = loss_mask[-L:]
+        pad = L - len(input_ids)
+        eod = self.eod_token_id
+        token_ids = np.asarray(input_ids + [eod] * pad, dtype=np.int64)
+        target = np.asarray(target_ids + [eod] * pad, dtype=np.int64)
+        weights = np.asarray(loss_mask + [0] * pad, dtype=np.float32)
+        return FinetuningItem(token_ids, target, weights)
+
+    def collate(self, batch: List[FinetuningItem]) -> TextDatasetBatch:
+        tokens = np.stack([b.token_ids for b in batch])
+        targets = np.stack([b.target_token_ids for b in batch])
+        weights = np.stack([b.loss_weights for b in batch])
+        # one document per item: positions count up, padding masked by weight
+        segment_ids = np.zeros(tokens.shape, dtype=np.int32)
+        position_ids = np.broadcast_to(
+            np.arange(tokens.shape[1], dtype=np.int32), tokens.shape
+        ).copy()
+        return TextDatasetBatch(
+            token_ids=tokens.astype(np.int32),
+            target_token_ids=targets.astype(np.int32),
+            position_ids=position_ids,
+            segment_ids=segment_ids,
+            loss_weights=weights,
+        )
+
+
+class FinetuningTextDataset(_FinetuningBase):
+    """Prompt/completion pairs from jsonl or a memory map
+    (reference: finetuning_text_dataset.py:59-218)."""
+
+    def __init__(
+        self,
+        data_prefix: Path | str,
+        sequence_length: int,
+        vocab_file: Path | str,
+        seed: int = 42,
+        shuffle: bool = True,
+        memory_map_dataset: bool = False,
+        softprompt_n_tokens: int = 0,
+    ):
+        self.data_prefix = Path(data_prefix)
+        self.vocab_file = Path(vocab_file)
+        self.tokenizer, self.tokenizer_no_prefix_space = load_tokenizers(self.vocab_file)
+        self.memory_map_dataset = memory_map_dataset
+        self.softprompt_n_tokens = softprompt_n_tokens
+        if memory_map_dataset:
+            self.mmap: Optional[MemoryMapDataset] = MemoryMapDataset(self.data_prefix)
+            self._records: List[Any] = list(range(len(self.mmap)))
+        else:
+            self.mmap = None
+            path = self.data_prefix
+            if path.suffix != ".jsonl" and not path.exists():
+                path = path.with_suffix(".jsonl")
+            self._records = [
+                json.loads(line)
+                for line in Path(path).read_text().splitlines()
+                if line.strip()
+            ]
+        super().__init__(sequence_length, self.tokenizer.eos_token_id or 0,
+                         seed=seed, shuffle=shuffle)
+
+    def ident(self) -> str:
+        h = hashlib.md5(
+            f"{self.data_prefix}-{self.sequence_length}-{self.vocab_file}".encode()
+        ).hexdigest()
+        return f"finetune-text-{h}"
+
+    def __len__(self) -> int:
+        return len(self._records)
+
+    def _token_ids(self, index: int) -> tuple[List[int], List[int]]:
+        if self.mmap is not None:
+            rec = np.asarray(self.mmap[self._records[index]]).tolist()
+            n_prompt = int(rec[0])
+            return rec[1 : n_prompt + 1], rec[n_prompt + 1 :]
+        item = self._records[index]
+        prompt = item["prompt"]
+        if isinstance(prompt, list):
+            prompt_ids: List[int] = []
+            for i, p in enumerate(prompt):
+                if not isinstance(p, str):
+                    raise NotImplementedError(
+                        "image prompt entries need the image encoder "
+                        "(transformer_architecture.image_encoder)"
+                    )
+                tok = self.tokenizer if i == 0 else self.tokenizer_no_prefix_space
+                prompt_ids.extend(tok.encode(p))
+        else:
+            prompt_ids = self.tokenizer.encode(prompt)
+        completion_ids = self.tokenizer_no_prefix_space.encode(item["completion"])
+        return prompt_ids, completion_ids
+
+    def __getitem__(self, index: int) -> FinetuningItem:
+        eos = self.eod_token_id
+        prompt_ids, completion_ids = self._token_ids(index)
+        if self.softprompt_n_tokens > 0:
+            # placeholder ids the softprompt layer overwrites in-embedding
+            # (reference: finetuning_text_dataset.py:165-175)
+            prompt_ids = [0] * self.softprompt_n_tokens + prompt_ids
+        stream = prompt_ids + completion_ids + [eos]
+        input_ids = stream[:-1]
+        target_ids = stream[1:]
+        # predict completion + eos; the last prompt token predicts the first
+        # completion token, so weights start at len(prompt) - 1
+        loss_mask = [0] * (len(prompt_ids) - 1) + [1] * (len(completion_ids) + 1)
+        return self._assemble(input_ids, target_ids, loss_mask)
+
+
+class FinetuningChatDataset(_FinetuningBase):
+    """Chat transcripts with per-element loss flags
+    (reference: finetuning_chat_dataset.py:27-241)."""
+
+    def __init__(
+        self,
+        data_prefix: Path | str,
+        sequence_length: int,
+        vocab_file: Path | str,
+        seed: int = 42,
+        shuffle: bool = True,
+    ):
+        self.data_prefix = Path(data_prefix)
+        self.vocab_file = Path(vocab_file)
+        self.tokenizer, self.tokenizer_no_prefix_space = load_tokenizers(self.vocab_file)
+        path = self.data_prefix
+        if path.suffix != ".jsonl" and not path.exists():
+            path = path.with_suffix(".jsonl")
+        self._samples: List[Dict[str, Any]] = []
+        eos = self.tokenizer.eos_token_id
+        for line in Path(path).read_text().splitlines():
+            if not line.strip():
+                continue
+            elements = json.loads(line)
+            tokens: List[int] = []
+            mask: List[int] = []
+            first = True
+            for el in elements:
+                if el["type"] != "text":
+                    raise NotImplementedError(
+                        f"chat content type {el['type']!r} needs the image encoder"
+                    )
+                tok = self.tokenizer if first else self.tokenizer_no_prefix_space
+                ids = tok.encode(el["content"])
+                tokens.extend(ids)
+                mask.extend([int(bool(el.get("has_loss", False)))] * len(ids))
+                first = False
+            # the chat format carries its own EOS (reference warns, we do too)
+            if eos is not None and eos not in tokens:
+                import warnings
+
+                warnings.warn(
+                    "finetuning_chat_dataset does not add EOS automatically; "
+                    "append it in your data.jsonl"
+                )
+            self._samples.append(
+                {
+                    "input": tokens[:-1],
+                    "target": tokens[1:],
+                    "mask": mask[1:],
+                }
+            )
+        super().__init__(sequence_length, eos or 0, seed=seed, shuffle=shuffle)
+
+    def ident(self) -> str:
+        h = hashlib.md5(
+            f"{self.data_prefix}-{self.sequence_length}-{self.vocab_file}".encode()
+        ).hexdigest()
+        return f"finetune-chat-{h}"
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    def __getitem__(self, index: int) -> FinetuningItem:
+        s = self._samples[index]
+        return self._assemble(list(s["input"]), list(s["target"]), list(s["mask"]))
+
+
+class FinetuningTextBlendedDataset(BaseBlendedDataset):
+    pass
+
+
+class FinetuningChatBlendedDataset(BaseBlendedDataset):
+    pass

@@ -18,6 +18,12 @@ from ...topology import Topology
 from ...trainer import BaseTrainer
 from .config import TransformerConfig
 from .context import TransformerContext
+from .data.finetuning import (
+    FinetuningChatBlendedDataset,
+    FinetuningChatDataset,
+    FinetuningTextBlendedDataset,
+    FinetuningTextDataset,
+)
 from .data.text_dataset import TextBlendedDataset, TextDataset
 from .model import init_model, init_optimizer, loss_function
 from .utils.get_tflops import (
@@ -78,22 +84,53 @@ def _read_dataset(config: TransformerConfig, prefixes: Optional[List[Any]]):
     if not prefixes:
         return None
     arch = config.transformer_architecture
-    datasets = [
-        TextDataset(
-            data_prefix=p,
-            sequence_length=arch.sequence_length,
-            seed=config.trainer.seed,
-            eod_token_id=config.data.eod_token_id,
-            only_full_sequences=config.data.only_full_sequences,
-            allow_incomplete_sequences_every_n=config.data.allow_incomplete_sequences_every_n,
-            load_index_to_memory=config.data.load_mmap_index_to_memory,
-        )
-        for p in prefixes
-    ]
+    data = config.data
+    if data.finetuning_dataset or data.finetuning_chat_dataset:
+        if arch.vocab_file is None:
+            raise ValueError("finetuning datasets need transformer_architecture.vocab_file")
+        if data.finetuning_chat_dataset:
+            datasets: List[Any] = [
+                FinetuningChatDataset(
+                    data_prefix=p,
+                    sequence_length=arch.sequence_length,
+                    vocab_file=arch.vocab_file,
+                    seed=config.trainer.seed,
+                )
+                for p in prefixes
+            ]
+            blended_cls: Any = FinetuningChatBlendedDataset
+        else:
+            softprompt = arch.softprompt_config
+            datasets = [
+                FinetuningTextDataset(
+                    data_prefix=p,
+                    sequence_length=arch.sequence_length,
+                    vocab_file=arch.vocab_file,
+                    seed=config.trainer.seed,
+                    memory_map_dataset=data.finetuning_dataset_memory_map,
+                    softprompt_n_tokens=softprompt.n_tokens if softprompt else 0,
+                )
+                for p in prefixes
+            ]
+            blended_cls = FinetuningTextBlendedDataset
+    else:
+        datasets = [
+            TextDataset(
+                data_prefix=p,
+                sequence_length=arch.sequence_length,
+                seed=config.trainer.seed,
+                eod_token_id=data.eod_token_id,
+                only_full_sequences=data.only_full_sequences,
+                allow_incomplete_sequences_every_n=data.allow_incomplete_sequences_every_n,
+                load_index_to_memory=data.load_mmap_index_to_memory,
+            )
+            for p in prefixes
+        ]
+        blended_cls = TextBlendedDataset
     if len(datasets) == 1:
         return datasets[0]
-    blended_config = config.data.blended_dataset or BlendedDatasetConfig()
-    return TextBlendedDataset(
+    blended_config = data.blended_dataset or BlendedDatasetConfig()
+    return blended_cls(
         seed=config.trainer.seed, config=blended_config, datasets=datasets
     )
 

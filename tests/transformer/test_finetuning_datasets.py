@@ -1,0 +1,141 @@
+"""Finetuning datasets: loss masking, padding, chat flags, e2e training
+(reference: tests/transformer/test_finetuning*.py coverage)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from scaling_tpu.data.memory_map import MemoryMapDatasetBuilder
+from scaling_tpu.models.transformer.data.finetuning import (
+    FinetuningChatDataset,
+    FinetuningTextDataset,
+)
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    """Minimal word-level tokenizer with an <|endoftext|> token."""
+    from tokenizers import Tokenizer as HFTokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    words = ["hello", "world", "foo", "bar", "baz", "question", "answer", "the"]
+    vocab = {"<|endoftext|>": 0, "<unk>": 1}
+    for i, w in enumerate(words):
+        vocab[w] = i + 2
+    tok = HFTokenizer(WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = Whitespace()
+    path = tmp_path_factory.mktemp("tok") / "vocab.json"
+    tok.save(str(path))
+    return path
+
+
+@pytest.fixture()
+def text_jsonl(tmp_path):
+    path = tmp_path / "data.jsonl"
+    rows = [
+        {"prompt": "question foo bar", "completion": "answer baz"},
+        {"prompt": "hello", "completion": "world world"},
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in rows))
+    return path
+
+
+def test_text_loss_masking(vocab_file, text_jsonl):
+    ds = FinetuningTextDataset(text_jsonl, sequence_length=12, vocab_file=vocab_file,
+                               shuffle=False)
+    assert len(ds) == 2
+    item = ds[0]
+    # prompt "question foo bar" = 3 tokens, completion "answer baz" = 2 + eos
+    assert item.token_ids.shape == (12,)
+    w = item.loss_weights
+    # weights: 0 on first len(prompt)-1 = 2, then 1 on completion+eos = 3
+    np.testing.assert_array_equal(w[:2], 0)
+    np.testing.assert_array_equal(w[2:5], 1)
+    np.testing.assert_array_equal(w[5:], 0)  # padding
+    # shifted next-token pairs: target[i] == input[i+1] inside the stream
+    np.testing.assert_array_equal(item.target_token_ids[:4], item.token_ids[1:5])
+
+
+def test_text_truncation_keeps_completion(vocab_file, tmp_path):
+    path = tmp_path / "long.jsonl"
+    row = {"prompt": " ".join(["the"] * 30), "completion": "answer"}
+    path.write_text(json.dumps(row))
+    ds = FinetuningTextDataset(path, sequence_length=8, vocab_file=vocab_file)
+    item = ds[0]
+    assert item.token_ids.shape == (8,)
+    # the trained completion token survives truncation
+    assert item.loss_weights.sum() >= 1
+
+
+def test_text_memory_map_variant(vocab_file, tmp_path):
+    prefix = tmp_path / "ft"
+    with MemoryMapDatasetBuilder(prefix, dtype=np.uint16) as b:
+        # record = [len_prompt, prompt..., completion...]
+        b.add(np.asarray([3, 5, 6, 7, 8, 9], dtype=np.uint16))
+    ds = FinetuningTextDataset(prefix, sequence_length=10, vocab_file=vocab_file,
+                               memory_map_dataset=True)
+    item = ds[0]
+    np.testing.assert_array_equal(item.token_ids[:5], [5, 6, 7, 8, 9])
+    np.testing.assert_array_equal(item.loss_weights[:5], [0, 0, 1, 1, 1])
+
+
+def test_chat_has_loss_flags(vocab_file, tmp_path):
+    path = tmp_path / "chat.jsonl"
+    convo = [
+        {"type": "text", "content": "question foo", "has_loss": False},
+        {"type": "text", "content": "answer bar <|endoftext|>", "has_loss": True},
+    ]
+    path.write_text(json.dumps(convo))
+    ds = FinetuningChatDataset(path, sequence_length=10, vocab_file=vocab_file)
+    item = ds[0]
+    # 2 prompt tokens (no loss) then loss on the answer part
+    w = item.loss_weights
+    assert w[0] == 0
+    assert w[1:4].sum() >= 2  # answer tokens trained
+
+
+def test_collate_shapes(vocab_file, text_jsonl):
+    ds = FinetuningTextDataset(text_jsonl, sequence_length=12, vocab_file=vocab_file)
+    batch = ds.collate([ds[0], ds[1]])
+    assert batch.token_ids.shape == (2, 12)
+    assert batch.loss_weights.dtype == np.float32
+    assert (batch.position_ids[:, 0] == 0).all()
+
+
+def test_finetuning_end_to_end(vocab_file, text_jsonl, tmp_path):
+    """Train a few steps through the standard entry with the finetuning flag
+    (reference: test_finetuning.py life-cycle)."""
+    from scaling_tpu.models.transformer import TransformerConfig
+    from scaling_tpu.models.transformer.train import main
+
+    config = TransformerConfig.from_dict(
+        {
+            "topology": {
+                "model_parallel_size": 1, "pipe_parallel_size": 1,
+                "data_parallel_size": 1, "micro_batch_size": 2,
+                "gradient_accumulation_steps": 1,
+            },
+            "transformer_architecture": {
+                "vocab_size": 16, "hidden_size": 32, "num_layers": 2,
+                "num_attention_heads": 4, "sequence_length": 12,
+                "vocab_file": str(vocab_file),
+            },
+            "learning_rate_scheduler": {
+                "learning_rate": 0.01, "learning_rate_warmup_steps": 1,
+                "learning_rate_decay_iters": 10,
+            },
+            "trainer": {
+                "train_iterations": 3, "seed": 7,
+                "save_dir": str(tmp_path / "ckpt"), "save_interval": 3,
+            },
+            "data": {
+                "data_prefixes": [str(text_jsonl)],
+                "finetuning_dataset": True,
+            },
+            "logger": {"log_dir": None},
+        }
+    )
+    trainer = main(config)
+    assert trainer.context.iterations == 3
