@@ -247,10 +247,7 @@ from ...data.blended_dataset import BlendedDatasetConfig  # noqa: E402
 DataConfig.model_rebuild()
 
 
-class ProfilerConfig(BaseConfig):
-    profile_steps: int = Field(0, description="number of steps to profile")
-    profile_start_at_step: int = Field(10, description="start profiling at this step")
-    profiler_output: Optional[Path] = Field(None, description="trace output path")
+from ...profiler import ProfilerConfig  # noqa: E402
 
 
 class TransformerConfig(BaseConfig):

@@ -149,6 +149,8 @@ def main(config: TransformerConfig) -> TransformerTrainer:
     optimizer = init_optimizer(config, module, topology)
     dataset = _read_dataset(config, config.data.data_prefixes)
     dataset_evaluation = _read_dataset(config, config.data.validation_data_prefixes)
+    from ...profiler import Profiler
+
     trainer = TransformerTrainer(
         config=config.trainer,
         context=context,
@@ -158,6 +160,7 @@ def main(config: TransformerConfig) -> TransformerTrainer:
         dataset=dataset,
         dataset_evaluation=dataset_evaluation,
         batch_to_model_input=batch_to_model_input,
+        profiler=Profiler(config.profiler),
     )
     trainer.initialize(
         load_checkpoint=config.trainer.load_dir is not None

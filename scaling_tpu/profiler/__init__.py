@@ -1,0 +1,3 @@
+from .profiler import Profiler, ProfilerConfig, SynchronizedTimer
+
+__all__ = ["Profiler", "ProfilerConfig", "SynchronizedTimer"]

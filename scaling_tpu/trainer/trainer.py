@@ -103,7 +103,9 @@ class BaseTrainer:
         dataset_evaluation: Any = None,
         metrics_aggregation_fn: Optional[Callable] = None,
         batch_to_model_input: Callable = lambda b: b,
+        profiler: Any = None,
     ):
+        self.profiler = profiler
         self.config = config
         self.context = context
         self.module = parallel_module
@@ -174,14 +176,24 @@ class BaseTrainer:
         return self.module.shard_batch(stacked)
 
     def train_step(self) -> TrainStepOutput:
+        step_idx = self.context.iterations
+        if self.profiler is not None:
+            self.profiler.begin_step(step_idx)
         start = time.time()
         micro_batches = self._next_micro_batches()
+        t_data = time.time() - start
         dropout_key = self.context.rng.key("dropout", self.context.iterations)
         self.params, self.opt_state, loss, metrics, opt_out = self._train_step(
             self.params, self.opt_state, micro_batches, dropout_key
         )
         self.context.step()
-        loss = float(loss)
+        loss = float(loss)  # host sync: the step's device work is drained
+        if self.profiler is not None:
+            self.profiler.record(
+                step_idx,
+                {"data_load": t_data, "step_time": time.time() - start - t_data},
+            )
+            self.profiler.end_step(step_idx)
         return TrainStepOutput(
             loss=loss,
             metrics={k: float(v) for k, v in metrics.items()},

@@ -1,0 +1,35 @@
+"""Profiler window/observation behavior (reference: core/profiler tests)."""
+
+import json
+
+from scaling_tpu.profiler import Profiler, ProfilerConfig, SynchronizedTimer
+
+
+def test_window_gating(tmp_path):
+    out = tmp_path / "profile.json"
+    p = Profiler(ProfilerConfig(profile_steps=2, profile_start_at_step=3,
+                                profiler_output=out))
+    for step in range(6):
+        p.begin_step(step)
+        p.record(step, {"step_time": 0.1 * (step + 1)})
+        p.end_step(step)
+    obs = json.loads(out.read_text())
+    assert [o["step"] for o in obs] == [3, 4]
+
+
+def test_disabled_writes_nothing(tmp_path):
+    out = tmp_path / "profile.json"
+    p = Profiler(ProfilerConfig(profile_steps=0, profiler_output=out))
+    p.record(5, {"step_time": 1.0})
+    p.flush()
+    assert not out.exists()
+
+
+def test_synchronized_timer():
+    import jax.numpy as jnp
+
+    t = SynchronizedTimer("op")
+    t.start()
+    x = jnp.ones((64, 64)) @ jnp.ones((64, 64))
+    d = t.stop(wait_for=x)
+    assert d > 0 and t.durations == [d]
