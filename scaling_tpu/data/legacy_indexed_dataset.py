@@ -1,0 +1,160 @@
+"""Megatron-format indexed dataset reader (clean-room, numpy only).
+
+(reference: src/scaling/transformer/data/legacy_dataset/indexed_dataset.py
+— torch-based loader for the two public Megatron-LM binary layouts). Both
+formats store a flat ``.bin`` of concatenated token arrays plus an ``.idx``:
+
+- **MMIDIDX** (mmap impl): 9-byte magic ``MMIDIDX\\x00\\x00``, version u64,
+  dtype-code u8, sequence count u64, document count u64, then
+  sizes i32[count], pointers i64[count] (byte offsets), doc_idx i64[docs].
+- **TNTIDX** (cached impl): 8-byte magic ``TNTIDX\\x00\\x00``, version u64,
+  (dtype-code, element_size) u64 pair, (count, size-entries) u64 pair,
+  doc_count u64, then dim_offsets i64[count+1], data_offsets i64[count+1]
+  (element offsets), sizes i64[s], doc_idx i64[docs].
+
+Exposes the same document-store interface as ``MemoryMapDataset`` (sizes /
+__getitem__ / read_span) so ``TextDataset`` can pack legacy data unchanged.
+"""
+
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+
+import numpy as np
+
+_DTYPES = {
+    1: np.uint8,
+    2: np.int8,
+    3: np.int16,
+    4: np.int32,
+    5: np.int64,
+    6: np.float32,
+    7: np.float64,
+    8: np.uint16,
+}
+_MMAP_MAGIC = b"MMIDIDX\x00\x00"
+_CACHED_MAGIC = b"TNTIDX\x00\x00"
+
+
+class LegacyIndexedDataset:
+    """Reads either Megatron binary layout; documents are the items."""
+
+    def __init__(self, prefix: Path | str, load_index_to_memory: bool = False):
+        self.prefix = Path(prefix)
+        idx_path = self.prefix.with_suffix(".idx")
+        bin_path = self.prefix.with_suffix(".bin")
+        with open(idx_path, "rb") as f:
+            head = f.read(9)
+        if head == _MMAP_MAGIC:
+            self._read_mmap_index(idx_path)
+        elif head[:8] == _CACHED_MAGIC:
+            self._read_cached_index(idx_path)
+        else:
+            raise ValueError(f"{idx_path}: not a Megatron indexed dataset")
+        self._data = np.memmap(bin_path, dtype=self.dtype, mode="r")
+        if load_index_to_memory:
+            self._sizes = np.asarray(self._sizes)
+            self._element_starts = np.asarray(self._element_starts)
+
+    # ------------------------------------------------------------- parsing
+    def _read_mmap_index(self, path: Path) -> None:
+        with open(path, "rb") as f:
+            assert f.read(9) == _MMAP_MAGIC
+            (version,) = struct.unpack("<Q", f.read(8))
+            assert version == 1, f"unsupported index version {version}"
+            (dtype_code,) = struct.unpack("<B", f.read(1))
+            self.dtype = np.dtype(_DTYPES[dtype_code])
+            (count,) = struct.unpack("<Q", f.read(8))
+            (doc_count,) = struct.unpack("<Q", f.read(8))
+            offset = f.tell()
+        buf = np.memmap(path, mode="r")
+        self._sizes = np.frombuffer(buf, np.int32, count=count, offset=offset)
+        pointers = np.frombuffer(
+            buf, np.int64, count=count, offset=offset + self._sizes.nbytes
+        )
+        # byte pointers -> element offsets into the flat stream
+        self._element_starts = pointers // self.dtype.itemsize
+        self.doc_idx = np.frombuffer(
+            buf, np.int64, count=doc_count,
+            offset=offset + self._sizes.nbytes + pointers.nbytes,
+        )
+
+    def _read_cached_index(self, path: Path) -> None:
+        with open(path, "rb") as f:
+            assert f.read(8) == _CACHED_MAGIC
+            (version,) = struct.unpack("<Q", f.read(8))
+            assert version == 1, f"unsupported index version {version}"
+            dtype_code, element_size = struct.unpack("<QQ", f.read(16))
+            self.dtype = np.dtype(_DTYPES[dtype_code])
+            assert self.dtype.itemsize == element_size
+            count, s = struct.unpack("<QQ", f.read(16))
+            (doc_count,) = struct.unpack("<Q", f.read(8))
+            dim_offsets = np.fromfile(f, np.int64, count + 1)
+            data_offsets = np.fromfile(f, np.int64, count + 1)  # element units
+            sizes = np.fromfile(f, np.int64, s)
+            self.doc_idx = np.fromfile(f, np.int64, doc_count)
+        # flatten possible multi-dim entries to per-item token counts
+        self._sizes = np.asarray(
+            [
+                int(np.prod(sizes[dim_offsets[i] : dim_offsets[i + 1]]))
+                for i in range(count)
+            ],
+            dtype=np.int64,
+        )
+        self._element_starts = data_offsets[:-1]
+
+    # ----------------------------------------------------- store interface
+    def sizes(self) -> np.ndarray:
+        return np.asarray(self._sizes, dtype=np.int64)
+
+    def __len__(self) -> int:
+        return len(self._sizes)
+
+    def __getitem__(self, index: int) -> np.ndarray:
+        start = int(self._element_starts[index])
+        n = int(self._sizes[index])
+        return np.asarray(self._data[start : start + n])
+
+    def read_span(self, start: int, n: int) -> np.ndarray:
+        """Read n tokens from the concatenated document stream."""
+        return np.asarray(self._data[start : start + n])
+
+
+class LegacyMMapIndexWriter:
+    """Writes the MMIDIDX layout (tests + data conversion tooling)."""
+
+    def __init__(self, prefix: Path | str, dtype=np.uint16):
+        self.prefix = Path(prefix)
+        self.dtype = np.dtype(dtype)
+        self._sizes: list[int] = []
+        self._doc_idx: list[int] = [0]
+        self._bin = open(self.prefix.with_suffix(".bin"), "wb")
+
+    def add(self, tokens: np.ndarray) -> None:
+        arr = np.asarray(tokens, dtype=self.dtype)
+        self._bin.write(arr.tobytes(order="C"))
+        self._sizes.append(len(arr))
+        self._doc_idx.append(len(self._sizes))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def close(self) -> None:
+        self._bin.close()
+        code = {np.dtype(v): k for k, v in _DTYPES.items()}[self.dtype]
+        pointers = np.concatenate(
+            [[0], np.cumsum(np.asarray(self._sizes[:-1], np.int64))]
+        ) * self.dtype.itemsize if self._sizes else np.asarray([], np.int64)
+        with open(self.prefix.with_suffix(".idx"), "wb") as f:
+            f.write(_MMAP_MAGIC)
+            f.write(struct.pack("<Q", 1))
+            f.write(struct.pack("<B", code))
+            f.write(struct.pack("<Q", len(self._sizes)))
+            f.write(struct.pack("<Q", len(self._doc_idx)))
+            f.write(np.asarray(self._sizes, np.int32).tobytes(order="C"))
+            f.write(np.asarray(pointers, np.int64).tobytes(order="C"))
+            f.write(np.asarray(self._doc_idx, np.int64).tobytes(order="C"))

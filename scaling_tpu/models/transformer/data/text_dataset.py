@@ -77,15 +77,25 @@ class TextDataset(BaseDataset[TextDatasetItem, TextDatasetBatch]):
         only_full_sequences: bool = False,
         allow_incomplete_sequences_every_n: int = 0,
         load_index_to_memory: bool = True,
+        legacy_dataset: bool = False,
     ):
         self.data_prefix = Path(data_prefix)
         self.sequence_length = sequence_length
         self.eod_token_id = eod_token_id
         self.only_full_sequences = only_full_sequences
         self.allow_incomplete_sequences_every_n = allow_incomplete_sequences_every_n
-        self.memory_map = MemoryMapDataset(
-            self.data_prefix, load_index_to_memory=load_index_to_memory
-        )
+        if legacy_dataset:
+            # Megatron .bin/.idx data packs through the same index; the store
+            # interfaces are identical (reference: legacy_dataset/)
+            from ....data.legacy_indexed_dataset import LegacyIndexedDataset
+
+            self.memory_map = LegacyIndexedDataset(
+                self.data_prefix, load_index_to_memory=load_index_to_memory
+            )
+        else:
+            self.memory_map = MemoryMapDataset(
+                self.data_prefix, load_index_to_memory=load_index_to_memory
+            )
         self._build_pack_index()
         super().__init__(seed=seed, shuffle=shuffle)
 

@@ -123,6 +123,7 @@ def _read_dataset(config: TransformerConfig, prefixes: Optional[List[Any]]):
                 only_full_sequences=data.only_full_sequences,
                 allow_incomplete_sequences_every_n=data.allow_incomplete_sequences_every_n,
                 load_index_to_memory=data.load_mmap_index_to_memory,
+                legacy_dataset=data.legacy_dataset,
             )
             for p in prefixes
         ]

@@ -1,0 +1,40 @@
+"""Megatron legacy format reader (reference: legacy_dataset/indexed_dataset.py
+coverage via tests/transformer/test_training_legacy.py)."""
+
+import numpy as np
+
+from scaling_tpu.data.legacy_indexed_dataset import (
+    LegacyIndexedDataset,
+    LegacyMMapIndexWriter,
+)
+
+
+def make_legacy(tmp_path, docs):
+    prefix = tmp_path / "legacy"
+    with LegacyMMapIndexWriter(prefix, dtype=np.uint16) as w:
+        for d in docs:
+            w.add(np.asarray(d, np.uint16))
+    return prefix
+
+
+def test_round_trip(tmp_path):
+    docs = [[1, 2, 3, 0], [7, 8, 0], [4, 4, 4, 4, 0]]
+    ds = LegacyIndexedDataset(make_legacy(tmp_path, docs))
+    assert len(ds) == 3
+    for i, d in enumerate(docs):
+        np.testing.assert_array_equal(ds[i], d)
+    np.testing.assert_array_equal(ds.sizes(), [4, 3, 5])
+    np.testing.assert_array_equal(ds.read_span(2, 4), [3, 0, 7, 8])
+
+
+def test_text_dataset_over_legacy(tmp_path):
+    from scaling_tpu.models.transformer.data import TextDataset
+
+    rng = np.random.default_rng(3)
+    docs = [np.append(rng.integers(1, 50, size=rng.integers(5, 30)), 0) for _ in range(16)]
+    prefix = make_legacy(tmp_path, docs)
+    ds = TextDataset(prefix, sequence_length=16, seed=1, legacy_dataset=True)
+    assert len(ds) > 0
+    item = ds[0]
+    stream = np.concatenate(docs)
+    np.testing.assert_array_equal(item.token_ids, stream[:17])
