@@ -112,8 +112,12 @@ class TextDataset(BaseDataset[TextDatasetItem, TextDatasetBatch]):
             self._num_items = max((total_tokens - 1) // L, 0)
             self._item_starts = None
             self._item_ends = None
+        elif (native := self._native_spans(sizes)) is not None:
+            self._item_starts, self._item_ends = native
+            self._num_items = len(self._item_starts)
         else:
             # greedy packing of whole documents into [start, end) windows
+            # (Python fallback for the C++ builder in scaling_tpu.native)
             spans: List[tuple] = []
             doc_offsets = np.concatenate([[0], np.cumsum(sizes)])
             window_start = 0
@@ -155,6 +159,14 @@ class TextDataset(BaseDataset[TextDatasetItem, TextDatasetBatch]):
             self._item_ends = np.asarray([e for _, e in spans], dtype=np.int64)
             self._num_items = len(self._item_starts)
         self._total_tokens = total_tokens
+
+    def _native_spans(self, sizes: np.ndarray):
+        """C++ pack-index builder; None -> use the Python loop."""
+        from ....native import build_pack_index
+
+        return build_pack_index(
+            sizes, self.sequence_length, self.allow_incomplete_sequences_every_n
+        )
 
     def set_seed(self, seed: int, shuffle: bool = True) -> None:
         # item order is owned by the DP-strided RandomSampler; the dataset

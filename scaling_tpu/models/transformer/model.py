@@ -141,8 +141,13 @@ def get_parameter_groups(
             if any(p.search(name) for p in include_patterns):
                 return True
             # PEFT params are always trainable in finetune mode
-            # (reference: config.py:426-459 auto-separates them)
-            return any(n in name for n in peft_names)
+            # (reference: config.py:426-459 auto-separates them). Match the
+            # naming convention `..._{name}.` / `...bias_{name}` exactly —
+            # a bare substring test would let a short PEFT name like "ad"
+            # claim unrelated params ("lm_head")
+            return any(
+                re.search(rf"(_|bias_){re.escape(n)}(\.|$)", name) for n in peft_names
+            )
         return True
 
     decay_keys, no_decay_keys, embedding_keys = set(), set(), set()

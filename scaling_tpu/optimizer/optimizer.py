@@ -184,14 +184,16 @@ class Optimizer:
 
         p_leaves = jax.tree.leaves(params)
         masters, avgs, avg_sqs = [], [], []
-        empty = jnp.zeros((0,), dtype=jnp.float32)
+        # one fresh (0,) buffer per slot: a shared placeholder would be the
+        # same buffer donated many times in the jitted step (XLA rejects it)
+        empty = lambda: jnp.zeros((0,), dtype=jnp.float32)  # noqa: E731
         for p, m, gi in zip(p_leaves, self._meta_leaves, self._group_index):
             if gi < 0:
                 # frozen: no fp32 master or moments — a 7B frozen backbone
                 # would otherwise burn 12 bytes/param of device memory
-                masters.append(empty)
-                avgs.append(empty)
-                avg_sqs.append(empty)
+                masters.append(empty())
+                avgs.append(empty())
+                avg_sqs.append(empty())
                 continue
             masters.append(make_master(p, m, gi))
             sh = self._master_sharding(m, p.shape)

@@ -228,11 +228,30 @@ class BaseTrainer:
             step_duration=time.time() - start,
         )
 
+    # ----------------------------------------------------------- preemption
+    def install_preemption_handler(self) -> None:
+        """Save-and-exit on SIGTERM — the TPU-pod equivalent of the
+        reference's Determined preemption hook (reference:
+        trainer.py:449-456): GKE spot/preemptible nodes deliver SIGTERM
+        ahead of reclaim; the next run resumes from the saved step."""
+        import signal
+
+        def handler(signum, frame):
+            self._preempted = True
+
+        self._preempted = False
+        signal.signal(signal.SIGTERM, handler)
+
     # ----------------------------------------------------------- train loop
     def run_training(self, log_metrics_fn: Optional[Callable] = None) -> None:
         assert self.config.train_iterations is not None
         while self.context.iterations < self.config.train_iterations:
             output = self.train_step()
+            if getattr(self, "_preempted", False):
+                if self.config.save_dir is not None:
+                    self.save_checkpoint()
+                    logger.info("preemption: checkpoint saved, exiting cleanly")
+                return
             if (
                 self.config.save_dir is not None
                 and self.config.save_interval is not None

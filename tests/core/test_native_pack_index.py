@@ -1,0 +1,46 @@
+"""C++ pack-index builder agrees with the Python loop exactly
+(scaling_tpu/native/pack_index.cpp vs TextDataset fallback)."""
+
+import numpy as np
+import pytest
+
+from scaling_tpu.native import build_pack_index, native_available
+
+
+@pytest.mark.skipif(not native_available(), reason="no C++ toolchain")
+@pytest.mark.parametrize("every_n", [0, 1, 3])
+@pytest.mark.parametrize("seed", [0, 7])
+def test_native_matches_python(tmp_path, every_n, seed, monkeypatch):
+    from scaling_tpu.data.memory_map import MemoryMapDatasetBuilder
+    from scaling_tpu.models.transformer.data import TextDataset
+
+    prefix = tmp_path / "data"
+    rng = np.random.default_rng(seed)
+    with MemoryMapDatasetBuilder(prefix, dtype=np.uint16) as b:
+        for _ in range(64):
+            b.add(np.append(rng.integers(1, 200, size=rng.integers(3, 90)), 0).astype(np.uint16))
+
+    L = 32
+    # force the Python path for the reference result
+    monkeypatch.setattr(TextDataset, "_native_spans", lambda self, sizes: None)
+    py = TextDataset(prefix, sequence_length=L, seed=1, only_full_sequences=True,
+                     allow_incomplete_sequences_every_n=every_n)
+    monkeypatch.undo()
+    native = build_pack_index(py.memory_map.sizes().astype(np.int64), L, every_n)
+    assert native is not None
+    starts, ends = native
+    np.testing.assert_array_equal(starts, py._item_starts)
+    np.testing.assert_array_equal(ends, py._item_ends)
+
+
+@pytest.mark.skipif(not native_available(), reason="no C++ toolchain")
+def test_native_is_default_path(tmp_path):
+    from scaling_tpu.data.memory_map import MemoryMapDatasetBuilder
+    from scaling_tpu.models.transformer.data import TextDataset
+
+    prefix = tmp_path / "data"
+    with MemoryMapDatasetBuilder(prefix, dtype=np.uint16) as b:
+        for _ in range(8):
+            b.add(np.append(np.arange(1, 20, dtype=np.uint16), 0))
+    ds = TextDataset(prefix, sequence_length=16, seed=1, only_full_sequences=True)
+    assert len(ds) > 0

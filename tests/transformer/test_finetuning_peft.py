@@ -1,0 +1,112 @@
+"""PEFT finetuning life cycle: LoRA / bitfit / adapters over a pretrained
+checkpoint (reference: tests/transformer/test_finetuning.py — adapters,
+bitfit, LoRA grids — and test_load_checkpoint_non_strict.py)."""
+
+import numpy as np
+import pytest
+
+from scaling_tpu.data.memory_map import MemoryMapDatasetBuilder
+
+from .test_training import build_capturing_trainer, make_config, train_capture
+
+
+@pytest.fixture(scope="module")
+def pretrain(tmp_path_factory):
+    """Base model checkpoint to finetune from."""
+    tmp = tmp_path_factory.mktemp("peft")
+    prefix = tmp / "data"
+    rng = np.random.default_rng(11)
+    with MemoryMapDatasetBuilder(prefix, dtype=np.uint16) as builder:
+        for _ in range(48):
+            doc = rng.integers(1, 96, size=rng.integers(8, 64))
+            builder.add(np.append(doc, 0).astype(np.uint16))
+    config = make_config(tmp, prefix, train_iterations=3, save_interval=3)
+    trainer = build_capturing_trainer(config)
+    train_capture(trainer, 3)
+    return config.trainer.save_dir, prefix
+
+
+def finetune_config(tmp_path, pretrain, peft_arch, finetunable=None, missing=None,
+                    unexpected=None):
+    save_dir, prefix = pretrain
+    cfg = make_config(
+        tmp_path, prefix, train_iterations=3, save_interval=100,
+        load_dir=save_dir, **peft_arch,
+    )
+    d = cfg.model_dump(mode="json")
+    d["training"] = {
+        "finetune": True,
+        "finetunable_parameters": finetunable or [],
+    }
+    d["trainer"]["allowed_missing_keys_in_checkpoint"] = missing or []
+    d["trainer"]["allowed_unexpected_keys_in_checkpoint"] = unexpected or []
+    d["trainer"]["load_optimizer_states"] = False
+    d["trainer"]["load_context"] = False
+    return type(cfg).from_dict(d)
+
+
+def trainable_keys(trainer):
+    return {k for g in trainer.optimizer.parameter_groups for k in g.keys}
+
+
+def test_lora_finetune(tmp_path, pretrain):
+    cfg = finetune_config(
+        tmp_path, pretrain,
+        {"lora_config": {"name": "lo", "rank": 2, "alpha": 4}},
+        missing=[r".*_lo\."],
+    )
+    trainer = build_capturing_trainer(cfg, load=True)
+    keys = trainable_keys(trainer)
+    assert keys and all("_lo." in k for k in keys), keys
+    before = {k: np.asarray(p) for k, p, _ in trainer.module.named_parameters(trainer.params)}
+    losses = train_capture(trainer, 3)
+    assert np.isfinite(losses).all()
+    after = {k: np.asarray(p) for k, p, _ in trainer.module.named_parameters(trainer.params)}
+    for k in before:
+        if "_lo." in k and "lora_a" in k.lower() or ("_lo." in k and "a" in k.split(".")[-1]):
+            continue
+    # frozen base weights must be bit-identical; LoRA A params must move
+    moved = {k for k in before if not np.array_equal(before[k], after[k])}
+    assert moved and all("_lo." in k for k in moved), moved
+
+
+def test_bitfit_finetune(tmp_path, pretrain):
+    # bitfit renames trained biases to bias_{name}: fresh params are allowed
+    # missing, the checkpoint's plain biases are allowed unexpected
+    # (reference: config.py:426-459 separate-file PEFT params)
+    cfg = finetune_config(
+        tmp_path, pretrain,
+        {"bitfit_bias_config": {"name": "bf"}},
+        missing=[r".*bias_bf$"],
+        unexpected=[r".*\.bias$"],
+    )
+    trainer = build_capturing_trainer(cfg, load=True)
+    keys = trainable_keys(trainer)
+    assert keys and all("bf" in k for k in keys), keys
+    losses = train_capture(trainer, 3)
+    assert np.isfinite(losses).all()
+
+
+def test_adapter_finetune(tmp_path, pretrain):
+    cfg = finetune_config(
+        tmp_path, pretrain,
+        {"adapter_config": {"name": "ad", "attention_downsampling_factor": 4,
+                            "mlp_downsampling_factor": 4, "init_std": 0.01}},
+        missing=[r".*_ad\."],
+    )
+    trainer = build_capturing_trainer(cfg, load=True)
+    keys = trainable_keys(trainer)
+    assert keys and all("_ad." in k for k in keys), keys
+    losses = train_capture(trainer, 3)
+    assert np.isfinite(losses).all()
+
+
+def test_finetunable_parameters_regex(tmp_path, pretrain):
+    """finetune=True with explicit regexes trains only matching params
+    (reference: test_finetuning_parameter.py)."""
+    cfg = finetune_config(
+        tmp_path, pretrain, {}, finetunable=[r"input_layernorm"],
+    )
+    trainer = build_capturing_trainer(cfg, load=True)
+    keys = trainable_keys(trainer)
+    assert keys and all("input_layernorm" in k for k in keys), keys

@@ -1,0 +1,41 @@
+"""Sequence parallelism: SP on vs off must produce the same losses
+(reference: tests/transformer/test_training_sequence_parallel.py:45-55)."""
+
+import numpy as np
+import pytest
+
+from scaling_tpu.data.memory_map import MemoryMapDatasetBuilder
+
+from .test_training import build_capturing_trainer, make_config, train_capture
+
+
+@pytest.fixture(scope="module")
+def data_prefix(tmp_path_factory):
+    prefix = tmp_path_factory.mktemp("dataset") / "data"
+    rng = np.random.default_rng(41)
+    with MemoryMapDatasetBuilder(prefix, dtype=np.uint16) as builder:
+        for _ in range(64):
+            doc = rng.integers(1, 96, size=rng.integers(8, 64))
+            builder.add(np.append(doc, 0).astype(np.uint16))
+    return prefix
+
+
+def sp_config(tmp_path, data_prefix, sequence_parallel):
+    cfg = make_config(tmp_path, data_prefix, mp=2, train_iterations=5,
+                      save_interval=100)
+    d = cfg.model_dump(mode="json")
+    d["topology"]["sequence_parallel"] = sequence_parallel
+    return type(cfg).from_dict(d)
+
+
+def test_sequence_parallel_loss_parity(tmp_path, data_prefix):
+    losses = {}
+    for sp in (False, True):
+        cfg = sp_config(tmp_path / f"sp{int(sp)}", data_prefix, sp)
+        trainer = build_capturing_trainer(cfg)
+        losses[sp] = train_capture(trainer, 5)
+    np.testing.assert_allclose(
+        np.asarray(losses[False], np.float32),
+        np.asarray(losses[True], np.float32),
+        rtol=2e-4, atol=2e-4,
+    )
