@@ -154,6 +154,37 @@ class TransformerInferenceModule:
             )
         return self._logits_fn(self.params, token_ids, pos)
 
+    def hidden_states(
+        self,
+        token_ids,
+        include: Optional[List[int]] = None,
+        exclude: Optional[List[int]] = None,
+    ) -> dict:
+        """Per-layer hidden states keyed ``layer_{i}_{Class}``; filter with
+        include/exclude layer indices (reference: HiddenStateRecorder,
+        inference_module.py:24-74, inference_model.py:121-135)."""
+        token_ids = jnp.asarray(token_ids)
+        if token_ids.ndim == 1:
+            token_ids = token_ids[None]
+        b, s = token_ids.shape
+        pos = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+
+        def run(params, t, po):
+            ctx = self.module._make_ctx(deterministic=True, dropout_key=None)
+            x = self._make_batch(t, po)
+            recorded = {}
+            for i, layer in enumerate(self.module.layers):
+                p = self.module._layer_params(params, i)
+                x = layer(p, x, ctx)
+                if include is not None and i not in include:
+                    continue
+                if exclude is not None and i in exclude:
+                    continue
+                recorded[f"layer_{i}_{type(layer).__name__}"] = x["activations"]
+            return recorded
+
+        return jax.jit(run)(self.params, token_ids, pos)
+
     # ------------------------------------------------------------ generate
     def _alloc_caches(self, kvs, max_len: int):
         caches = []

@@ -290,3 +290,37 @@ class SimulationEngine:
             "timeline": timeline,
             "deadlocked": deadlocked,
         }
+
+
+def illustrate(
+    pipe_parallel_size: int,
+    gradient_accumulation_steps: int,
+    schedule_cls=PipelineScheduleTrain,
+    width: int = 100,
+    durations: Optional[Dict[str, float]] = None,
+) -> str:
+    """ASCII timeline of a simulated schedule — one row per pipe rank,
+    F/B/· cells (reference renders a PNG, pipeline_schedule/base.py:41-149;
+    the text form diffs cleanly in tests and terminals)."""
+    sim = SimulationEngine(
+        pipe_parallel_size=pipe_parallel_size,
+        gradient_accumulation_steps=gradient_accumulation_steps,
+        durations=durations or {},
+    )
+    result = sim.simulate(schedule_cls)
+    total = result["total_time"] or 1.0
+    rows = [[" "] * width for _ in range(pipe_parallel_size)]
+    glyphs = {"forward_pass": "F", "backward_pass": "B", "optimizer_step": "O",
+              "loss": "L", "load_micro_batch": "d", "store_micro_batch": "s"}
+    for ev in result["timeline"]:
+        g = glyphs.get(ev["name"])
+        if g is None:
+            continue
+        lo = int(ev["start"] / total * (width - 1))
+        hi = max(lo + 1, int(ev["end"] / total * (width - 1)))
+        for c in range(lo, min(hi, width)):
+            rows[ev["rank"]][c] = g
+    lines = [f"rank {r}: |{''.join(row)}|" for r, row in enumerate(rows)]
+    idle = ", ".join(f"{i:.0%}" for i in result["idle_fraction"])
+    lines.append(f"total {result['total_time']:.2f}s  idle per rank: {idle}")
+    return "\n".join(lines)

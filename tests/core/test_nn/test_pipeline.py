@@ -212,3 +212,11 @@ def test_simulator_idle_fraction():
     sim_big = SimulationEngine(pipe_parallel_size=4, gradient_accumulation_steps=32)
     big = sim_big.simulate()
     assert max(big["idle_fraction"]) < max(result["idle_fraction"]) + 1e-6
+
+
+def test_illustrate_renders():
+    from scaling_tpu.parallel.pipeline_schedule import illustrate
+
+    text = illustrate(4, 8, width=60)
+    assert "rank 0" in text and "rank 3" in text and "idle per rank" in text
+    assert "F" in text and "B" in text

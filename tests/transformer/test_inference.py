@@ -56,3 +56,15 @@ def test_generate_matches_trained_params(checkpoint_dir):
     first = int(np.asarray(logits)[0, -1].argmax())
     out = module.generate(prompt, max_tokens=1)
     assert out.completion_ids[0] == first
+
+
+def test_hidden_states_recorder(checkpoint_dir):
+    """Per-layer hidden-state recording with include/exclude filters
+    (reference: HiddenStateRecorder)."""
+    module = TransformerInferenceModule.from_checkpoint(checkpoint_dir)
+    rec = module.hidden_states([3, 7, 11])
+    assert len(rec) == len(module.module.layers)
+    some = module.hidden_states([3, 7, 11], include=[1, 2])
+    assert len(some) == 2
+    h = list(rec.values())[0]
+    assert h.shape[:2] == (1, 3)
