@@ -160,16 +160,18 @@ class TransformerArchitectureConfig(BaseConfig):
         description="restrict embedding gradients to these token ids",
     )
     image_encoder: bool = Field(
-        False, description="multimodal CLIP image encoder (not supported on TPU build yet)"
+        False,
+        description="multimodal image encoder: 384x384 images become 144 "
+        "prefix tokens spliced into the embedding stream (ViT patch "
+        "backbone; the reference uses a CLIP ResNet, image_encoder.py)",
     )
+    image_encoder_width: int = Field(768, description="vision tower width", gt=0)
+    image_encoder_layers: int = Field(6, description="vision tower depth", gt=0)
+    image_encoder_heads: int = Field(12, description="vision tower heads", gt=0)
     umup: UMuPConfig = Field(UMuPConfig(), description="")
 
     @model_validator(mode="after")
     def _validate(self):
-        if self.image_encoder:
-            raise NotImplementedError(
-                "the CLIP image encoder path is gated off in the TPU build"
-            )
         if self.num_local_attention_heads > 0 and self.local_attention_window_size is None:
             raise ValueError("local attention heads require local_attention_window_size")
         return self

@@ -1,0 +1,139 @@
+"""Multimodal image encoder (magma-style prefix tokens).
+
+(reference: src/scaling/transformer/model/image_encoder/image_encoder.py,
+clip.py — a CLIP RN50x16 ResNet producing 144 tokens of 3072 features from
+a 384x384 image, projected to hidden_size and spliced into the embedding
+stream). The TPU-first redesign keeps the exact interface — 384x384 input,
+(384/32)^2 = 144 prefix tokens, linear projection + dropout + layernorm —
+but replaces the convolutional backbone with a ViT-style patch encoder:
+
+- 32x32 patchify is a reshape + one (3072 -> width) matmul: pure MXU work,
+  no BatchNorm state, no conv lowering;
+- the backbone is our own bidirectional attention stack
+  (ParallelSelfAttention with causal=False), so TP sharding of the vision
+  tower comes for free.
+
+Pretrained CLIP weights do not transfer to this backbone; the encoder
+trains jointly (or from a vision checkpoint trained with this framework).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...nn import (
+    BaseLayer,
+    ColumnParallelLinear,
+    ForwardContext,
+    LayerNorm,
+    LayerNormConfig,
+    ParallelMLP,
+    ParallelSelfAttention,
+    RowParallelLinear,
+    tree_prefix,
+)
+
+IMAGE_SIZE = 384
+PATCH_SIZE = 32
+IMAGE_ENCODER_TOKEN_COUNTS = (IMAGE_SIZE // PATCH_SIZE) ** 2  # 144, as reference
+
+
+class _VitBlock(BaseLayer):
+    def __init__(self, width: int, heads: int, dtype):
+        self.norm1 = LayerNorm(width, LayerNormConfig(), dtype)
+        self.attention = ParallelSelfAttention(
+            hidden_size=width, num_attention_heads=heads, causal=False, dtype=dtype,
+            relative_position_embedding_type="none",
+        )
+        self.norm2 = LayerNorm(width, LayerNormConfig(), dtype)
+        self.mlp = ParallelMLP(io_features=width, dtype=dtype)
+
+    def init(self, key: jax.Array) -> dict:
+        ks = jax.random.split(key, 4)
+        return {
+            "norm1": self.norm1.init(ks[0]),
+            "attention": self.attention.init(ks[1]),
+            "norm2": self.norm2.init(ks[2]),
+            "mlp": self.mlp.init(ks[3]),
+        }
+
+    def param_metas(self) -> dict:
+        return {
+            "norm1": tree_prefix(self.norm1.param_metas(), "norm1"),
+            "attention": tree_prefix(self.attention.param_metas(), "attention"),
+            "norm2": tree_prefix(self.norm2.param_metas(), "norm2"),
+            "mlp": tree_prefix(self.mlp.param_metas(), "mlp"),
+        }
+
+    def __call__(self, params: dict, x: jax.Array, ctx: ForwardContext) -> jax.Array:
+        h = x + self.attention(params["attention"], self.norm1(params["norm1"], x, ctx), ctx)
+        return h + self.mlp(params["mlp"], self.norm2(params["norm2"], h, ctx), ctx)
+
+
+class ImageEncoder(BaseLayer):
+    """(b, 384, 384, 3) image -> (b, 144, out_features) prefix tokens."""
+
+    def __init__(
+        self,
+        out_features: int,
+        width: int = 768,
+        layers: int = 6,
+        heads: int = 12,
+        dropout_p: float = 0.0,
+        dtype=jnp.float32,
+    ):
+        self.out_features = out_features
+        self.width = width
+        self.num_layers = layers
+        self.dropout_p = dropout_p
+        self.dtype = dtype
+        patch_dim = PATCH_SIZE * PATCH_SIZE * 3  # 3072, as the reference's feature dim
+        self.patch_proj = ColumnParallelLinear(
+            patch_dim, width, bias=True, dtype=dtype, parallel_output=False
+        )
+        self.blocks = [_VitBlock(width, heads, dtype) for _ in range(layers)]
+        self.out_norm = LayerNorm(width, LayerNormConfig(), dtype)
+        self.proj = RowParallelLinear(width, out_features, bias=True, dtype=dtype)
+        self.final_norm = LayerNorm(out_features, LayerNormConfig(), dtype)
+
+    def init(self, key: jax.Array) -> dict:
+        ks = jax.random.split(key, self.num_layers + 4)
+        params = {
+            "patch_proj": self.patch_proj.init(ks[0]),
+            "out_norm": self.out_norm.init(ks[1]),
+            "proj": self.proj.init(ks[2]),
+            "final_norm": self.final_norm.init(ks[3]),
+        }
+        for i, blk in enumerate(self.blocks):
+            params[f"block_{i}"] = blk.init(ks[4 + i])
+        return params
+
+    def param_metas(self) -> dict:
+        metas = {
+            "patch_proj": tree_prefix(self.patch_proj.param_metas(), "image_encoder.patch_proj"),
+            "out_norm": tree_prefix(self.out_norm.param_metas(), "image_encoder.out_norm"),
+            "proj": tree_prefix(self.proj.param_metas(), "image_encoder.proj"),
+            "final_norm": tree_prefix(self.final_norm.param_metas(), "image_encoder.final_norm"),
+        }
+        for i, blk in enumerate(self.blocks):
+            metas[f"block_{i}"] = tree_prefix(blk.param_metas(), f"image_encoder.block_{i}")
+        return metas
+
+    def patchify(self, images: jax.Array) -> jax.Array:
+        """(b, H, W, 3) -> (b, tokens, patch_dim) via reshape/transpose."""
+        b, h, w, c = images.shape
+        p = PATCH_SIZE
+        x = images.reshape(b, h // p, p, w // p, p, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5)  # (b, gh, gw, p, p, c)
+        return x.reshape(b, (h // p) * (w // p), p * p * c)
+
+    def __call__(self, params: dict, images: jax.Array, ctx: ForwardContext) -> jax.Array:
+        x = self.patchify(images.astype(self.dtype))
+        x = self.patch_proj(params["patch_proj"], x, ctx)
+        for i, blk in enumerate(self.blocks):
+            x = blk(params[f"block_{i}"], x, ctx)
+        x = self.out_norm(params["out_norm"], x, ctx)
+        x = self.proj(params["proj"], x, ctx)
+        x = ctx.dropout(x, self.dropout_p)
+        return self.final_norm(params["final_norm"], x, ctx)

@@ -37,9 +37,23 @@ class EmbeddingInput(BaseLayer):
         )
         self.dropout_rate = architecture.dropout_embedding
         self.softprompt_config: Optional[SoftpromptConfig] = architecture.softprompt_config
+        self.image_encoder = None
+        if architecture.image_encoder:
+            from ..image_encoder import ImageEncoder
+
+            self.image_encoder = ImageEncoder(
+                out_features=architecture.hidden_size,
+                width=architecture.image_encoder_width,
+                layers=architecture.image_encoder_layers,
+                heads=architecture.image_encoder_heads,
+                dropout_p=architecture.dropout_embedding,
+                dtype=architecture.dtype,
+            )
 
     def init(self, key: jax.Array) -> dict:
         params = {"embedding": self.embedding.init(key)}
+        if self.image_encoder is not None:
+            params["image_encoder"] = self.image_encoder.init(jax.random.fold_in(key, 2))
         if self.softprompt_config is not None:
             sp_key = jax.random.fold_in(key, 1)
             params[f"softprompt_{self.softprompt_config.name}"] = jax.random.normal(
@@ -51,6 +65,8 @@ class EmbeddingInput(BaseLayer):
 
     def param_metas(self) -> dict:
         metas = {"embedding": tree_prefix(self.embedding.param_metas(), "embedding")}
+        if self.image_encoder is not None:
+            metas["image_encoder"] = self.image_encoder.param_metas()
         if self.softprompt_config is not None:
             name = f"softprompt_{self.softprompt_config.name}"
             metas[name] = ParamMeta(
@@ -63,6 +79,23 @@ class EmbeddingInput(BaseLayer):
     def __call__(self, params: dict, batch: dict, ctx: ForwardContext) -> dict:
         token_ids = batch["token_ids"]
         embeddings = self.embedding(params["embedding"], token_ids, ctx)
+
+        if self.image_encoder is not None and batch.get("input_images") is not None:
+            # splice 144 encoded prefix tokens per image at its location
+            # (reference: embedding.py:53-61,111-144 magma-style)
+            imgs = batch["input_images"]  # (b, n_img, H, W, 3)
+            locs = batch["input_image_locations"]  # (b, n_img) start positions
+            b_, n_img = imgs.shape[:2]
+            enc = self.image_encoder(
+                params["image_encoder"], imgs.reshape((b_ * n_img,) + imgs.shape[2:]), ctx
+            )
+            enc = enc.reshape(b_, n_img, enc.shape[-2], enc.shape[-1])
+            for j in range(n_img):
+                embeddings = jax.vmap(
+                    lambda e, blk, st: jax.lax.dynamic_update_slice(
+                        e, blk.astype(e.dtype), (st, 0)
+                    )
+                )(embeddings, enc[:, j], locs[:, j].astype(jnp.int32))
 
         if self.softprompt_config is not None:
             # overwrite the first n_tokens positions with the learned prompt

@@ -307,6 +307,31 @@ class ParallelSelfAttention(BaseLayer):
             dropout_fn = lambda p: ctx.dropout(p, self.dropout_attention_probs)  # noqa: E731
 
         n_local = self.num_local_attention_heads
+        if ctx.context_parallel_size > 1 and kv_cache is None:
+            # ring attention: sequence sharded over the context mesh axis,
+            # K/V blocks rotate over ICI (ops/ring_attention.py)
+            assert attention_scores_manipulation is None, (
+                "attention_scores_manipulation is unsupported under context "
+                "parallelism"
+            )
+            assert n_local == 0, "local-window heads are unsupported under CP"
+            assert dropout_fn is None, "attention-prob dropout unsupported under CP"
+            from ..ops.ring_attention import ring_attention
+
+            out = ring_attention(
+                q, k, v, segment_ids, ctx.mesh,
+                causal=self.causal, sm_scale=self.scaling_factor,
+            )
+            out = out.reshape(b, s, self.hidden_size)
+            y = self.dense(params["dense"], out, ctx)
+            if self.lora_config:
+                name = f"{LoRAModuleType.DENSE.value}_{self.lora_config.name}"
+                if name in self.lora_modules:
+                    y = y + self.lora_modules[name](params[name], out, ctx)
+            if new_kv is not None:
+                return y, new_kv
+            return y
+
         use_flash_here = (
             self.use_flash
             and kv_cache is None

@@ -31,6 +31,7 @@ class ForwardContext:
     # topology flags the layers need (static)
     sequence_parallel: bool = False
     model_parallel_size: int = 1
+    context_parallel_size: int = 1
     # mesh is needed for explicit collectives; None on single device
     mesh: Optional[Any] = None
 

@@ -1,0 +1,129 @@
+"""Ring attention: context parallelism over the ``context`` mesh axis.
+
+A capability beyond the reference, which bounds trained context by
+per-device memory (SURVEY §5: "no ring attention, context parallelism,
+blockwise attention, or Ulysses"). Design (Ring Attention with Blockwise
+Transformers, Liu et al. 2023, expressed TPU-natively):
+
+- activations are sharded along the sequence dim over the ``context`` axis;
+- each device keeps its Q shard resident and computes attention against one
+  K/V block at a time, merging with the online-softmax recurrence;
+- K/V blocks (with their segment ids) rotate around the ring via
+  ``lax.ppermute`` — ICI neighbour exchange — inside a ``lax.scan``;
+- causal masking uses absolute sequence indices derived from each block's
+  ring offset, so packing (segment ids) and causality behave exactly like
+  the single-device path;
+- ``jax.grad`` differentiates through scan + ppermute (the transpose of a
+  rotation is the reverse rotation), giving the backward ring for free;
+  ``jax.checkpoint`` on the per-block step bounds residual memory.
+
+Peak memory per device: O(s/cp) for Q/K/V/O + one rotating K/V block —
+sequence length scales linearly with the ring size.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..topology.topology import CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS
+
+_NEG = -1e9
+
+
+def _ring_attention_local(
+    q: jax.Array,  # (b, s_loc, n_loc, d) — this device's shards
+    k: jax.Array,
+    v: jax.Array,
+    seg: jax.Array,  # (b, s_loc) int32 packed-doc ids
+    *,
+    axis_name: str,
+    causal: bool,
+    sm_scale: float,
+) -> jax.Array:
+    ring = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, s_loc, n, d = q.shape
+
+    # absolute sequence indices of this device's queries
+    q_pos = my_idx * s_loc + jnp.arange(s_loc)  # (s_loc,)
+
+    qf = q.astype(jnp.float32) * sm_scale
+
+    def block_scores_mask(k_owner, seg_k):
+        k_pos = k_owner * s_loc + jnp.arange(s_loc)
+        allowed = seg[:, :, None] == seg_k[:, None, :]  # (b, s_q, s_k)
+        if causal:
+            allowed = allowed & (k_pos[None, None, :] <= q_pos[None, :, None])
+        return allowed
+
+    def step(carry, _):
+        m, l, acc, k_blk, v_blk, seg_blk, owner = carry
+        s = jnp.einsum("bqnd,bknd->bnqk", qf, k_blk.astype(jnp.float32))
+        allowed = block_scores_mask(owner, seg_blk)  # (b, sq, sk)
+        s = jnp.where(allowed[:, None, :, :], s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1))  # (b, n, sq)
+        # explicit zeroing: for a fully-masked block s == m_new == _NEG and
+        # exp(0) would be 1 — the mask, not the exp, must kill those terms
+        p = jnp.exp(s - m_new[..., None]) * allowed[:, None, :, :]
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + p.sum(axis=-1)
+        acc_new = (
+            acc * correction.transpose(0, 2, 1)[..., None]
+            + jnp.einsum("bnqk,bknd->bqnd", p, v_blk.astype(jnp.float32))
+        )
+        # rotate the K/V block to the next ring neighbour
+        perm = [(i, (i + 1) % ring) for i in range(ring)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        seg_blk = jax.lax.ppermute(seg_blk, axis_name, perm)
+        owner = jax.lax.ppermute(owner, axis_name, perm)
+        return (m_new, l_new, acc_new, k_blk, v_blk, seg_blk, owner), None
+
+    m0 = jnp.full((b, n, s_loc), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, n, s_loc), jnp.float32)
+    acc0 = jnp.zeros((b, s_loc, n, d), jnp.float32)
+    carry = (m0, l0, acc0, k, v, seg, my_idx)
+    (m, l, acc, *_), _ = jax.lax.scan(
+        jax.checkpoint(step), carry, None, length=ring
+    )
+    out = acc / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,  # (b, s, n, d) GLOBAL logical shapes, context-sharded on s
+    k: jax.Array,
+    v: jax.Array,
+    segment_ids: Optional[jax.Array],
+    mesh: Mesh,
+    causal: bool = True,
+    sm_scale: float = 1.0,
+) -> jax.Array:
+    """shard_map entry: shards q/k/v over (data, context, model) and runs the
+    ring. Requires seq divisible by the context axis size."""
+    from jax.experimental.shard_map import shard_map
+
+    if segment_ids is None:
+        segment_ids = jnp.zeros(q.shape[:2], jnp.int32)
+
+    qkv_spec = P(DATA_AXIS, CONTEXT_AXIS, MODEL_AXIS, None)
+    seg_spec = P(DATA_AXIS, CONTEXT_AXIS)
+
+    fn = shard_map(
+        partial(
+            _ring_attention_local,
+            axis_name=CONTEXT_AXIS,
+            causal=causal,
+            sm_scale=sm_scale,
+        ),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec),
+        out_specs=qkv_spec,
+        check_rep=False,
+    )
+    return fn(q, k, v, segment_ids)

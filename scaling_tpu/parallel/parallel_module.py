@@ -312,6 +312,7 @@ class ParallelModule:
             deterministic=deterministic,
             sequence_parallel=bool(topo and topo.sequence_parallel),
             model_parallel_size=topo.model_parallel_size if topo else 1,
+            context_parallel_size=topo.context_parallel_size if topo else 1,
             mesh=topo.mesh if topo else None,
         )
 
@@ -527,12 +528,14 @@ class ParallelModule:
             return batch
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        lead = (None, "data") if stacked else ("data",)
+        # batch dims shard over data; the sequence dim (first after batch)
+        # shards over the context axis for ring attention (no-op at cp=1)
+        lead = (None, "data", "context") if stacked else ("data", "context")
 
         def put(x):
-            if not hasattr(x, "ndim") or x.ndim < len(lead):
+            if not hasattr(x, "ndim") or x.ndim < len(lead) - 1:
                 return x
-            spec = lead + (None,) * (x.ndim - len(lead))
+            spec = lead[: x.ndim] + (None,) * (x.ndim - len(lead))
             return jax.device_put(x, NamedSharding(self.topology.mesh, P(*spec)))
 
         return jax.tree.map(put, batch)

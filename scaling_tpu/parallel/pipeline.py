@@ -35,7 +35,7 @@ import numpy as np
 
 from ..nn.base_layer import BaseLayer, ForwardContext
 from ..nn.param import ParamMeta
-from ..topology.topology import DATA_AXIS, PIPE_AXIS, Topology
+from ..topology.topology import CONTEXT_AXIS, DATA_AXIS, PIPE_AXIS, Topology
 
 
 # --------------------------------------------------------------- partitioning
@@ -198,10 +198,16 @@ class PipelinedBody:
         def constrain_state(s):
             if mesh is None:
                 return s
+            def spec_for(x):
+                # (pp, mbs, s, ...): stage over pipe, batch over data,
+                # sequence over context (size-1 unless cp>1, which excludes
+                # pp>1 anyway — named for consistency)
+                axes = [PIPE_AXIS, DATA_AXIS, CONTEXT_AXIS][: x.ndim]
+                return P(*axes, *([None] * (x.ndim - len(axes))))
+
             return jax.tree.map(
                 lambda x: jax.lax.with_sharding_constraint(
-                    x,
-                    NamedSharding(mesh, P(PIPE_AXIS, DATA_AXIS, *([None] * (x.ndim - 2)))),
+                    x, NamedSharding(mesh, spec_for(x))
                 ),
                 s,
             )

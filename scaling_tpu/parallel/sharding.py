@@ -16,7 +16,7 @@ from typing import Optional
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..topology.topology import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
+from ..topology.topology import CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS, PIPE_AXIS
 
 
 def _axis_in_mesh(mesh: Optional[Mesh], axis: str) -> bool:
@@ -30,25 +30,32 @@ def constrain(x: jax.Array, mesh: Optional[Mesh], *spec) -> jax.Array:
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
 
+def _seq_axis(mesh: Optional[Mesh]):
+    """Sequence dims shard over the context axis when it exists (ring
+    attention context parallelism); None otherwise."""
+    return CONTEXT_AXIS if _axis_in_mesh(mesh, CONTEXT_AXIS) else None
+
+
 def shard_batch(x: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
-    """Batch-leading activation: shard batch over the data axis."""
+    """(b, s, ...) activation: batch over data, sequence over context."""
     if not _axis_in_mesh(mesh, DATA_AXIS):
         return x
-    return constrain(x, mesh, DATA_AXIS, *([None] * (x.ndim - 1)))
+    seq = [_seq_axis(mesh)] if x.ndim > 1 else []
+    return constrain(x, mesh, DATA_AXIS, *seq, *([None] * (x.ndim - 1 - len(seq))))
 
 
 def shard_activation_tp(x: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
     """(b, s, h) activation inside a TP region: h sharded over model axis."""
     if not _axis_in_mesh(mesh, MODEL_AXIS):
         return x
-    return constrain(x, mesh, DATA_AXIS, None, MODEL_AXIS)
+    return constrain(x, mesh, DATA_AXIS, _seq_axis(mesh), MODEL_AXIS)
 
 
 def shard_activation_replicated_h(x: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
     """(b, s, h) activation with h replicated (after TP all-reduce)."""
     if mesh is None:
         return x
-    return constrain(x, mesh, DATA_AXIS, None, None)
+    return constrain(x, mesh, DATA_AXIS, _seq_axis(mesh), None)
 
 
 def shard_activation_sp(x: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
@@ -56,7 +63,9 @@ def shard_activation_sp(x: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
     sequence sharded over the model axis (Megatron-style SP)."""
     if not _axis_in_mesh(mesh, MODEL_AXIS):
         return x
-    return constrain(x, mesh, DATA_AXIS, MODEL_AXIS, None)
+    seq = _seq_axis(mesh)
+    sp_axes = (seq, MODEL_AXIS) if seq else MODEL_AXIS
+    return constrain(x, mesh, DATA_AXIS, sp_axes, None)
 
 
 def shard_param(x: jax.Array, mesh: Optional[Mesh], spec: tuple) -> jax.Array:

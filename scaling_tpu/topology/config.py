@@ -40,6 +40,16 @@ class TopologyConfig(BaseConfig):
 
     data_parallel_size: int = Field(description="", gt=0)
 
+    context_parallel_size: int = Field(
+        1,
+        description="ring-attention context parallelism: activations shard "
+        "along the sequence dim over a 'context' mesh axis; K/V blocks rotate "
+        "over ICI with collective-permute. A capability beyond the reference "
+        "(which caps context at per-device memory, SURVEY §5). Requires "
+        "pipe_parallel_size == 1.",
+        gt=0,
+    )
+
     global_batch_size: int = Field(
         description="global train batch size including all gradient accumulation steps",
         gt=0,
@@ -88,6 +98,7 @@ class TopologyConfig(BaseConfig):
         mp = values.get("model_parallel_size")
         pp = values.get("pipe_parallel_size")
         dp = values.get("data_parallel_size")
+        cp = values.get("context_parallel_size") or 1
         world = values.get("world_size")
 
         sizes = [mp, pp, dp, world]
@@ -97,17 +108,23 @@ class TopologyConfig(BaseConfig):
                 "pipe_parallel_size, data_parallel_size and world_size) need to be set."
             )
         if world is None:
-            world = mp * pp * dp
+            world = mp * pp * dp * cp
         if mp is None:
-            mp = world // (pp * dp)
+            mp = world // (pp * dp * cp)
         if pp is None:
-            pp = world // (mp * dp)
+            pp = world // (mp * dp * cp)
         if dp is None:
-            dp = world // (mp * pp)
-        if mp * pp * dp != world:
+            dp = world // (mp * pp * cp)
+        if mp * pp * dp * cp != world:
             raise AssertionError(
                 f"world_size {world} does not equal model_parallel_size ({mp}) x "
-                f"pipe_parallel_size ({pp}) x data_parallel_size ({dp})."
+                f"pipe_parallel_size ({pp}) x data_parallel_size ({dp}) x "
+                f"context_parallel_size ({cp})."
+            )
+        if cp > 1 and pp > 1:
+            raise AssertionError(
+                "context_parallel_size > 1 requires pipe_parallel_size == 1 "
+                "(ring attention replaces pipelining for long sequences)"
             )
 
         gbs = values.get("global_batch_size")
@@ -136,6 +153,7 @@ class TopologyConfig(BaseConfig):
             model_parallel_size=mp,
             pipe_parallel_size=pp,
             data_parallel_size=dp,
+            context_parallel_size=cp,
             global_batch_size=gbs,
             micro_batch_size=mbs,
             gradient_accumulation_steps=gas,

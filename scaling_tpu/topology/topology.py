@@ -23,8 +23,9 @@ from .config import ActivationCheckpointingType, TopologyConfig
 
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
+CONTEXT_AXIS = "context"
 MODEL_AXIS = "model"
-MESH_AXES = (PIPE_AXIS, DATA_AXIS, MODEL_AXIS)
+MESH_AXES = (PIPE_AXIS, DATA_AXIS, CONTEXT_AXIS, MODEL_AXIS)
 
 
 class Topology:
@@ -45,6 +46,7 @@ class Topology:
         grid = np.asarray(devices[: config.world_size]).reshape(
             config.pipe_parallel_size,
             config.data_parallel_size,
+            config.context_parallel_size,
             config.model_parallel_size,
         )
         self.mesh = Mesh(grid, MESH_AXES)
@@ -66,6 +68,10 @@ class Topology:
     @property
     def data_parallel_size(self) -> int:
         return self.config.data_parallel_size
+
+    @property
+    def context_parallel_size(self) -> int:
+        return self.config.context_parallel_size
 
     @property
     def micro_batch_size(self) -> int:
@@ -92,23 +98,42 @@ class Topology:
         return True
 
     # -------------------------------------------------------- rank math
-    # Flat-rank layout: rank = ((pp_rank * dp + dp_rank) * mp + mp_rank),
-    # i.e. arange(world).reshape(pp, dp, mp) — same convention as the
-    # reference (topology.py:45-49) so checkpoint artifact names line up.
-    def get_global_rank(self, pipe_parallel_rank: int, data_parallel_rank: int, model_parallel_rank: int) -> int:
+    # Flat-rank layout: rank = (((pp_rank * dp + dp_rank) * cp + cp_rank)
+    # * mp + mp_rank), i.e. arange(world).reshape(pp, dp, cp, mp) — with
+    # cp == 1 this is the reference convention (topology.py:45-49) so
+    # checkpoint artifact names line up.
+    def get_global_rank(
+        self,
+        pipe_parallel_rank: int,
+        data_parallel_rank: int,
+        model_parallel_rank: int,
+        context_parallel_rank: int = 0,
+    ) -> int:
         cfg = self.config
         assert 0 <= pipe_parallel_rank < cfg.pipe_parallel_size
         assert 0 <= data_parallel_rank < cfg.data_parallel_size
+        assert 0 <= context_parallel_rank < cfg.context_parallel_size
         assert 0 <= model_parallel_rank < cfg.model_parallel_size
         return (
-            pipe_parallel_rank * cfg.data_parallel_size + data_parallel_rank
+            (pipe_parallel_rank * cfg.data_parallel_size + data_parallel_rank)
+            * cfg.context_parallel_size
+            + context_parallel_rank
         ) * cfg.model_parallel_size + model_parallel_rank
 
     def pipe_parallel_rank_of(self, global_rank: int) -> int:
-        return global_rank // (self.config.data_parallel_size * self.config.model_parallel_size)
+        cfg = self.config
+        return global_rank // (
+            cfg.data_parallel_size * cfg.context_parallel_size * cfg.model_parallel_size
+        )
 
     def data_parallel_rank_of(self, global_rank: int) -> int:
-        return (global_rank // self.config.model_parallel_size) % self.config.data_parallel_size
+        cfg = self.config
+        return (
+            global_rank // (cfg.context_parallel_size * cfg.model_parallel_size)
+        ) % cfg.data_parallel_size
+
+    def context_parallel_rank_of(self, global_rank: int) -> int:
+        return (global_rank // self.config.model_parallel_size) % self.config.context_parallel_size
 
     def model_parallel_rank_of(self, global_rank: int) -> int:
         return global_rank % self.config.model_parallel_size

@@ -112,5 +112,5 @@ def test_io_ranks(devices):
 
 def test_mesh_axes(devices):
     topo = Topology(make_config())
-    assert topo.mesh.axis_names == ("pipe", "data", "model")
-    assert topo.mesh.devices.shape == (2, 2, 2)
+    assert topo.mesh.axis_names == ("pipe", "data", "context", "model")
+    assert topo.mesh.devices.shape == (2, 2, 1, 2)

@@ -1,0 +1,64 @@
+"""Multimodal image encoder: shape contract + embedding splice
+(reference: image_encoder.py CLIP RN50x16 -> 144 tokens; here a ViT patch
+backbone with the same interface)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scaling_tpu.models.transformer import TransformerConfig
+from scaling_tpu.models.transformer.image_encoder import (
+    IMAGE_ENCODER_TOKEN_COUNTS,
+    ImageEncoder,
+)
+from scaling_tpu.models.transformer.layers.embedding import EmbeddingInput
+from scaling_tpu.nn.base_layer import ForwardContext
+
+
+def test_encoder_token_contract():
+    enc = ImageEncoder(32, width=64, layers=1, heads=4)
+    p = enc.init(jax.random.PRNGKey(0))
+    out = jax.jit(lambda p, i: enc(p, i, ForwardContext()))(
+        p, jnp.ones((1, 384, 384, 3))
+    )
+    assert out.shape == (1, IMAGE_ENCODER_TOKEN_COUNTS, 32)
+    assert IMAGE_ENCODER_TOKEN_COUNTS == 144  # reference interface
+
+
+def test_embedding_splice():
+    config = TransformerConfig.from_dict(
+        {
+            "topology": {"model_parallel_size": 1, "pipe_parallel_size": 1,
+                         "data_parallel_size": 1, "micro_batch_size": 1,
+                         "gradient_accumulation_steps": 1},
+            "transformer_architecture": {
+                "vocab_size": 64, "hidden_size": 32, "num_layers": 1,
+                "num_attention_heads": 4, "sequence_length": 160,
+                "image_encoder": True, "image_encoder_width": 64,
+                "image_encoder_layers": 1, "image_encoder_heads": 4,
+            },
+        }
+    )
+    layer = EmbeddingInput(config.transformer_architecture)
+    params = layer.init(jax.random.PRNGKey(0))
+    s = 160
+    batch = {
+        "token_ids": jnp.zeros((1, s), jnp.int32),
+        "position_ids": jnp.broadcast_to(jnp.arange(s)[None], (1, s)),
+        "segment_ids": jnp.zeros((1, s), jnp.int32),
+        "loss_weights": None,
+        "input_images": jnp.ones((1, 1, 384, 384, 3), jnp.float32),
+        "input_image_locations": jnp.asarray([[4]], jnp.int32),
+    }
+    out = jax.jit(lambda p, b: layer(p, b, ForwardContext()))(params, batch)
+    acts = np.asarray(out["activations"], np.float32)
+    # positions 4..148 carry image tokens: different from the token embedding
+    token_only = np.asarray(
+        jax.jit(lambda p, b: layer(p, {**b, "input_images": None}, ForwardContext()))(
+            params, batch
+        )["activations"],
+        np.float32,
+    )
+    assert not np.allclose(acts[0, 4:148], token_only[0, 4:148])
+    np.testing.assert_array_equal(acts[0, :4], token_only[0, :4])
+    np.testing.assert_array_equal(acts[0, 148:], token_only[0, 148:])

@@ -1,0 +1,49 @@
+"""Context-parallel (ring attention) training: cp=2 loss parity with cp=1
+from identical weights (same pattern as the pipeline parity test)."""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from scaling_tpu.data.memory_map import MemoryMapDatasetBuilder
+
+from .test_training import build_capturing_trainer, make_config, train_capture
+
+
+@pytest.fixture(scope="module")
+def data_prefix(tmp_path_factory):
+    prefix = tmp_path_factory.mktemp("dataset") / "data"
+    rng = np.random.default_rng(53)
+    with MemoryMapDatasetBuilder(prefix, dtype=np.uint16) as builder:
+        for _ in range(64):
+            doc = rng.integers(1, 96, size=rng.integers(8, 64))
+            builder.add(np.append(doc, 0).astype(np.uint16))
+    return prefix
+
+
+def cp_config(tmp_path, data_prefix, cp, load_dir=None):
+    cfg = make_config(tmp_path, data_prefix, train_iterations=5, save_interval=100,
+                      load_dir=load_dir)
+    d = cfg.model_dump(mode="json")
+    d["topology"]["context_parallel_size"] = cp
+    d["topology"]["world_size"] = cp
+    return type(cfg).from_dict(d)
+
+
+def test_cp2_loss_matches_cp1(tmp_path, data_prefix):
+    seed_cfg = make_config(tmp_path / "seed", data_prefix, train_iterations=1,
+                           save_interval=100)
+    t0 = build_capturing_trainer(seed_cfg)
+    t0.save_checkpoint()
+
+    losses = {}
+    for cp in (1, 2):
+        cfg = cp_config(tmp_path / f"cp{cp}", data_prefix, cp,
+                        load_dir=Path(seed_cfg.trainer.save_dir))
+        t = build_capturing_trainer(cfg, load=True)
+        losses[cp] = train_capture(t, 5)
+    np.testing.assert_allclose(
+        np.asarray(losses[1], np.float32), np.asarray(losses[2], np.float32),
+        rtol=2e-4, atol=2e-4,
+    )
