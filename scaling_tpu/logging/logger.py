@@ -38,6 +38,11 @@ class LoggerConfig(BaseConfig):
         None,
         description="global ranks that write to tensorboard. None -> rank 0 only.",
     )
+    determined_metrics_ranks: Optional[List[int]] = Field(
+        None,
+        description="kept for config parity (reference logger_config.py:55); "
+        "there is no Determined master here to report to",
+    )
     wandb_ranks: Optional[List[int]] = Field(
         None, description="global ranks that log to wandb. None -> rank 0 only."
     )

@@ -14,7 +14,7 @@ from pathlib import Path
 from typing import Any, List, Optional
 
 import jax.numpy as jnp
-from pydantic import Field, model_validator
+from pydantic import AliasChoices, Field, model_validator
 
 from ...config import BaseConfig
 from ...context.context import ContextConfig
@@ -51,14 +51,6 @@ class RelativePositionEmbeddingType(Enum):
     NONE = "none"
     ROTARY = "rotary"
     ROTARY_COMPLEX = "rotary_complex"
-
-
-class UMuPConfig(BaseConfig):
-    """Unit-scaled maximal update parametrisation flags (kept for config
-    parity with the reference architecture surface; off by default)."""
-
-    enable: bool = Field(False, description="enable u-mup scaling rules")
-    normalize_depth_to_num_layers: bool = Field(True, description="")
 
 
 class BitfitConfig(BaseConfig):
@@ -117,6 +109,15 @@ class TransformerArchitectureConfig(BaseConfig):
     attention_qkv_in_one: bool = Field(
         True, description="store q,k,v projections in one fused weight"
     )
+    attention_bias: bool = Field(
+        True, description="add bias terms to the attention projections"
+    )
+    attention_use_matmul: bool = Field(
+        False,
+        description="kept for config parity with the reference's "
+        "torch.matmul/baddbmm switch (config.py:215); XLA picks the matmul "
+        "strategy itself, so this has no effect on TPU",
+    )
     num_local_attention_heads: int = Field(
         0, description="number of heads restricted to a local window", ge=0
     )
@@ -134,6 +135,7 @@ class TransformerArchitectureConfig(BaseConfig):
     )
     mlp_type: MLPType = Field(MLPType.DEFAULT, description="")
     mlp_factor: float = Field(4.0, description="mlp intermediate = factor * hidden", gt=0)
+    mlp_bias: bool = Field(True, description="add bias terms to the mlp projections")
     activation_function: ActivationFunction = Field(ActivationFunction.GELU, description="")
     precision: Precision = Field(Precision.FLOAT32, description="compute/param dtype")
     layernorm: LayerNormConfig = Field(LayerNormConfig(), description="")
@@ -168,7 +170,10 @@ class TransformerArchitectureConfig(BaseConfig):
     image_encoder_width: int = Field(768, description="vision tower width", gt=0)
     image_encoder_layers: int = Field(6, description="vision tower depth", gt=0)
     image_encoder_heads: int = Field(12, description="vision tower heads", gt=0)
-    umup: UMuPConfig = Field(UMuPConfig(), description="")
+    dropout_image_encoder: float = Field(
+        0.0, description="dropout applied after the image encoder projection",
+        ge=0.0, le=1.0,
+    )
 
     @model_validator(mode="after")
     def _validate(self):
@@ -215,7 +220,13 @@ class TrainingConfig(BaseConfig):
         False, description="kept for config parity; XLA is deterministic by default"
     )
     use_separate_lr_on_embeddings: bool = Field(
-        False, description="use embedding_learning_rate_scheduler on embedding weights"
+        False,
+        description="use embedding_learning_rate_scheduler on embedding weights",
+        validation_alias=AliasChoices(
+            # the misspelled alias keeps legacy reference configs loading
+            # (reference: context/config.py:55-57)
+            "use_separate_lr_on_embeddings", "use_seperate_lr_on_embeddings"
+        ),
     )
 
 

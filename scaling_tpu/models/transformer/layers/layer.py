@@ -97,7 +97,7 @@ class TransformerLayer(BaseLayer):
             dropout_attention_probs=arch.dropout_attention_probs,
             rotary_config=rotary_config,
             relative_position_embedding_type=arch.relative_position_embedding_type.value,
-            bias=arch.mlp_type == MLPType.DEFAULT,
+            bias=arch.attention_bias,
             dtype=dtype,
             bitfit_bias_name=bitfit,
             lora_config=arch.lora_config,
@@ -115,7 +115,7 @@ class TransformerLayer(BaseLayer):
             self.mlp: BaseLayer = ParallelSwiGLUMLP(
                 io_features=arch.hidden_size,
                 intermediate_feature_factor=arch.mlp_factor,
-                bias=False,
+                bias=arch.mlp_bias,
                 dtype=dtype,
                 bitfit_bias_name=bitfit,
             )
@@ -124,6 +124,7 @@ class TransformerLayer(BaseLayer):
                 io_features=arch.hidden_size,
                 intermediate_feature_factor=arch.mlp_factor,
                 activation=arch.activation_function,
+                bias=arch.mlp_bias,
                 dtype=dtype,
                 bitfit_bias_name=bitfit,
             )
@@ -174,6 +175,13 @@ class TransformerLayer(BaseLayer):
             name = f"adapter_mlp_{self.adapter_name}"
             metas[name] = tree_prefix(self.adapter_mlp.param_metas(), name)
         return metas
+
+    # ----------------------------------------------------------------- merge
+    def merge_lora_weights(self, params: dict) -> dict:
+        """Fold the attention block's LoRA deltas into its base weights."""
+        params = dict(params)
+        params["attention"] = self.attention.merge_lora_weights(params["attention"])
+        return params
 
     # --------------------------------------------------------------- forward
     def __call__(self, params: dict, x: dict, ctx: ForwardContext,

@@ -68,6 +68,12 @@ class OptimizerConfig(BaseConfig):
         description="enable zero stage 1: shard fp32 master weights and moments "
         "over the data axis",
     )
+    zero_save_static: bool = Field(
+        False,
+        description="kept for config parity (reference optimizer_config.py:36): "
+        "checkpoints here always save per-layer unsharded arrays, so there is "
+        "no merge step to skip",
+    )
     debug_log: bool = Field(False, description="per-parameter grad/weight norms")
 
 

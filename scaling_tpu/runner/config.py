@@ -32,8 +32,10 @@ class RunnerConfig(BaseConfig):
     hosts: Optional[List[str]] = Field(None, description="inline host list")
     master_port: int = Field(29500, description="coordinator port")
     master_addr: Optional[str] = Field(None, description="coordinator address")
-    script: str = Field(
-        "scaling_tpu.models.transformer.train", description="module to run per host"
+    script: Optional[str] = Field(
+        "scaling_tpu.models.transformer.train",
+        description="module to run per host; null falls back to the default "
+        "train entry (the reference allows null here, launch_config.py)"
     )
     default_gpu_count: int = Field(
         8, description="devices per host when the hostsfile gives no slot counts"

@@ -69,7 +69,8 @@ def runner_main(config: RunnerConfig, payload: Any) -> int:
             "JAX_NUM_PROCESSES": str(num_processes),
             "JAX_PROCESS_ID": str(process_id),
         }
-        cmd = [sys.executable, "-u", "-m", config.script, f"--payload={encoded}"]
+        script = config.script or "scaling_tpu.models.transformer.train"
+        cmd = [sys.executable, "-u", "-m", script, f"--payload={encoded}"]
         if host in ("localhost", "127.0.0.1") and num_processes == 1:
             procs.append(subprocess.Popen(cmd, env={**os.environ, **env_exports}))
         else:
