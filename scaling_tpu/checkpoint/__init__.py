@@ -1,4 +1,5 @@
 from .checkpoint import (
+    AsyncCheckpointWriter,
     load_model_checkpoint,
     load_optimizer_checkpoint,
     save_model_checkpoint,
@@ -6,6 +7,7 @@ from .checkpoint import (
 )
 
 __all__ = [
+    "AsyncCheckpointWriter",
     "load_model_checkpoint",
     "load_optimizer_checkpoint",
     "save_model_checkpoint",

@@ -19,8 +19,9 @@ from __future__ import annotations
 
 import json
 import re
+from concurrent.futures import Future, ThreadPoolExecutor
 from pathlib import Path
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +29,68 @@ import numpy as np
 
 from ..logging import logger
 from ..nn.param import ParamMeta
+
+
+class AsyncCheckpointWriter:
+    """Runs checkpoint file writes on a background thread.
+
+    Arrays are fetched to host *before* submission (the jitted train step
+    donates its input buffers, so device arrays must not outlive the call
+    that scheduled the save) — only the np.savez disk I/O happens off the
+    train loop. ``wait()`` blocks until all pending writes are durable;
+    a new save waits for the previous one so files never interleave.
+
+    Once any write fails, every later-submitted task of the same save is
+    skipped (so e.g. the trailing "latest" pointer never lands on a
+    partially-written checkpoint); the original exception re-raises from
+    ``wait()``. Submission applies backpressure past ``max_queued`` pending
+    writes to bound host RAM at a few layers' worth of arrays.
+    """
+
+    def __init__(self, max_queued: int = 4) -> None:
+        self._pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="ckpt-writer")
+        self._pending: List[Future] = []
+        self._max_queued = max_queued
+        self._failed = False
+
+    def submit(self, fn, *args) -> None:
+        def run():
+            if self._failed:
+                return
+            try:
+                fn(*args)
+            except BaseException:
+                self._failed = True
+                raise
+
+        while len([f for f in self._pending if not f.done()]) >= self._max_queued:
+            self._pending[0].result()
+            self._pending.pop(0)
+        self._pending.append(self._pool.submit(run))
+
+    def wait(self) -> None:
+        pending, self._pending = self._pending, []
+        try:
+            for f in pending:
+                f.result()  # re-raises writer-thread exceptions
+        finally:
+            self._failed = False  # a later save may retry on a healthy disk
+
+    def close(self) -> None:
+        self.wait()
+        self._pool.shutdown(wait=True)
+
+
+def _write_npz(path: Path, arrays: Dict[str, np.ndarray]) -> None:
+    np.savez(path, **arrays)
+
+
+def _emit(writer: Optional[AsyncCheckpointWriter], path: Path,
+          arrays: Dict[str, np.ndarray]) -> None:
+    if writer is None:
+        _write_npz(path, arrays)
+    else:
+        writer.submit(_write_npz, path, arrays)
 
 
 def _meta_leaves(metas: Any) -> list[ParamMeta]:
@@ -52,8 +115,13 @@ def save_model_checkpoint(
     params: Any,
     metas: Any,
     separate_file_for_parameters: Optional[List[str]] = None,
+    writer: Optional[AsyncCheckpointWriter] = None,
 ) -> None:
-    """One npz per layer; PEFT params split into ``..._{name}.npz`` files."""
+    """One npz per layer; PEFT params split into ``..._{name}.npz`` files.
+
+    Arrays are host-gathered here; with ``writer`` the disk writes happen on
+    its background thread instead of blocking the train loop.
+    """
     path = Path(dir)
     path.mkdir(parents=True, exist_ok=True)
     for (layer_index, layer_class), group in _grouped_by_layer(params, metas).items():
@@ -72,12 +140,12 @@ def save_model_checkpoint(
                 separate.setdefault(target, {})[name] = np_arr
         fname = f"model_state_layer_{layer_index}_{layer_class}.npz"
         if main:
-            np.savez(path / fname, **main)
+            _emit(writer, path / fname, main)
         # double underscore separates the PEFT suffix from the class name so
         # the loader can recover the class unambiguously
         for sep, group_arrs in separate.items():
             sep_name = f"model_state_layer_{layer_index}_{layer_class}__{sep}.npz"
-            np.savez(path / sep_name, **group_arrs)
+            _emit(writer, path / sep_name, group_arrs)
 
 
 def _compile_patterns(patterns: Optional[List[str]]) -> list:
@@ -160,22 +228,31 @@ def load_model_checkpoint(
     return jax.tree.unflatten(treedef, new_leaves)
 
 
-def save_optimizer_checkpoint(dir: Path | str, opt_state, metas: Any) -> None:
+OPT_FIELDS = ("master", "exp_avg", "exp_avg_sq")
+
+
+def save_optimizer_checkpoint(
+    dir: Path | str, opt_state, metas: Any,
+    writer: Optional[AsyncCheckpointWriter] = None,
+) -> None:
+    """One ``optimizer_state_layer_{i}.npz`` per layer, written exactly once,
+    holding all three Adam fields as ``{field}.{param_name}`` entries."""
     path = Path(dir)
     path.mkdir(parents=True, exist_ok=True)
-    m_leaves = _meta_leaves(metas)
 
-    for field in ("master", "exp_avg", "exp_avg_sq"):
+    # group device arrays (cheap references) per layer first, then gather and
+    # write ONE layer at a time — host RAM peaks at a layer of fp32 state,
+    # not the whole model's (the writer's backpressure bounds the async case)
+    per_layer: dict[int, dict[str, jax.Array]] = {}
+    for field in OPT_FIELDS:
         tree = getattr(opt_state, field)
-        groups = _grouped_by_layer(tree, metas)
-        for (layer_index, _layer_class), group in groups.items():
-            fname = path / f"optimizer_state_layer_{layer_index}_{field}.npz"
-            existing = {}
-            if fname.exists():
-                with np.load(fname) as z:
-                    existing = {k: z[k] for k in z.files}
-            existing.update({k: np.asarray(jax.device_get(v)) for k, v in group.items()})
-            np.savez(fname, **existing)
+        for (layer_index, _cls), group in _grouped_by_layer(tree, metas).items():
+            bucket = per_layer.setdefault(layer_index, {})
+            for name, arr in group.items():
+                bucket[f"{field}.{name}"] = arr
+    for layer_index, refs in per_layer.items():
+        arrays = {k: np.asarray(jax.device_get(v)) for k, v in refs.items()}
+        _emit(writer, path / f"optimizer_state_layer_{layer_index}.npz", arrays)
 
     scalars = {
         "step": int(opt_state.step),
@@ -196,32 +273,45 @@ def load_optimizer_checkpoint(dir: Path | str, opt_state, metas: Any):
     path = Path(dir)
     m_leaves = _meta_leaves(metas)
 
+    cache: dict[Path, Any] = {}
+
+    def load_entry(field: str, layer_index: int, param_name: str) -> np.ndarray:
+        f = path / f"optimizer_state_layer_{layer_index}.npz"
+        legacy = path / f"optimizer_state_layer_{layer_index}_{field}.npz"
+        if f.exists():
+            if f not in cache:
+                cache[f] = np.load(f)
+            return cache[f][f"{field}.{param_name}"]
+        if legacy.exists():
+            # pre-r2 layout: one file per (layer, field), plain param keys
+            if legacy not in cache:
+                cache[legacy] = np.load(legacy)
+            return cache[legacy][param_name]
+        raise FileNotFoundError(f"optimizer checkpoint file missing: {f}")
+
     def load_tree(field: str, current):
         c_leaves, treedef = jax.tree.flatten(current)
         new_leaves = []
-        cache: dict[Path, Any] = {}
         for p, m in zip(c_leaves, m_leaves):
-            f = path / f"optimizer_state_layer_{m.layer_index}_{field}.npz"
-            if not f.exists():
-                raise FileNotFoundError(f"optimizer checkpoint file missing: {f}")
-            if f not in cache:
-                cache[f] = np.load(f)
-            arr = cache[f][m.parameter_name]
+            arr = load_entry(field, m.layer_index, m.parameter_name)
             new_leaves.append(
                 jax.device_put(jnp.asarray(arr, dtype=p.dtype), p.sharding)
                 if hasattr(p, "sharding")
                 else jnp.asarray(arr, dtype=p.dtype)
             )
-        for z in cache.values():
-            z.close()
         return jax.tree.unflatten(treedef, new_leaves)
 
     scalars = json.loads((path / "optimizer_state.json").read_text())
+    master = load_tree("master", opt_state.master)
+    exp_avg = load_tree("exp_avg", opt_state.exp_avg)
+    exp_avg_sq = load_tree("exp_avg_sq", opt_state.exp_avg_sq)
+    for z in cache.values():
+        z.close()
     return OptimizerState(
         step=jnp.asarray(scalars["step"], jnp.int32),
-        master=load_tree("master", opt_state.master),
-        exp_avg=load_tree("exp_avg", opt_state.exp_avg),
-        exp_avg_sq=load_tree("exp_avg_sq", opt_state.exp_avg_sq),
+        master=master,
+        exp_avg=exp_avg,
+        exp_avg_sq=exp_avg_sq,
         loss_scaler=LossScalerState(
             current_scale=jnp.asarray(scalars["loss_scaler"]["current_scale"], jnp.float32),
             current_hysteresis=jnp.asarray(scalars["loss_scaler"]["current_hysteresis"], jnp.float32),

@@ -400,22 +400,56 @@ class ParallelSelfAttention(BaseLayer):
     def merge_lora_weights(self, params: dict) -> dict:
         """Fold LoRA deltas into base weights; returns updated params tree.
 
-        (reference: attention.py:766-797)
+        The reference mutates base weights and deletes the lora modules
+        (attention.py:766-797). Functionally the same thing here: the delta
+        is folded into the host weight and the lora_b factor is zeroed, so
+        the still-present LoRA path contributes exactly nothing afterwards.
+        A trained LoRA bias is folded into the host projection's bias (the
+        reference silently drops it with the deleted module); merging raises
+        if the host has no bias to absorb it rather than changing the model
+        function silently.
         """
         if not self.lora_config:
             return params
         params = dict(params)
         lc = self.lora_config
+
+        def fold_bias(host: dict, lora_bias, what: str) -> dict:
+            if lora_bias is None or not jnp.asarray(lora_bias).size:
+                return host
+            if "bias" not in host:
+                raise ValueError(
+                    f"cannot merge LoRA bias on {what}: the host projection "
+                    "has no bias parameter to absorb it (set lora bias=False "
+                    "or keep the LoRA unmerged)"
+                )
+            host["bias"] = host["bias"] + lora_bias.astype(host["bias"].dtype)
+            return host
+
         for mt in lc.parallel_modules:
             name = f"{mt.value}_{lc.name}"
             if name not in self.lora_modules:
                 continue
             delta = self.lora_modules[name].get_delta_weights(params[name])
+            lora_bias = params[name].get("bias")
+            disabled = {
+                **params[name],
+                "lora_b": jnp.zeros_like(params[name]["lora_b"]),
+            }
+            if "bias" in disabled:
+                disabled["bias"] = jnp.zeros_like(disabled["bias"])
+            params[name] = disabled
             if mt == LoRAModuleType.DENSE:
                 host = dict(params["dense"])
                 host["weight"] = host["weight"] + delta.astype(host["weight"].dtype)
-                params["dense"] = host
+                params["dense"] = fold_bias(host, lora_bias, "dense")
             elif self.qkv_in_one:
+                if lora_bias is not None:
+                    raise NotImplementedError(
+                        "LoRA bias merge is unsupported for the fused "
+                        "query_key_value layout; set attention_qkv_in_one "
+                        "false or lora bias=False"
+                    )
                 host = dict(params["query_key_value"])
                 w = host["weight"].reshape(
                     self.hidden_size, self.num_attention_heads, 3 * self.head_dim
@@ -430,5 +464,5 @@ class ParallelSelfAttention(BaseLayer):
             else:
                 host = dict(params[mt.value])
                 host["weight"] = host["weight"] + delta.astype(host["weight"].dtype)
-                params[mt.value] = host
+                params[mt.value] = fold_bias(host, lora_bias, mt.value)
         return params

@@ -37,6 +37,7 @@ from ..nn.base_layer import (
 )
 from ..nn.param import ParamMeta, named_parameters, tree_with_layer
 from ..topology import ActivationCheckpointingType, Topology
+from ..topology.topology import MODEL_AXIS, PIPE_AXIS
 from .pipeline import PipelinedBody
 
 if TYPE_CHECKING:  # break the optimizer <-> parallel import cycle
@@ -60,6 +61,26 @@ class EvaluationStepOutput(NamedTuple):
     loss: Any
     metrics: Dict[str, Any]
     step_duration: Optional[float] = None
+
+
+def _lift_edge_meta_over_pipe(meta: ParamMeta) -> ParamMeta:
+    """Shard edge-layer model-parallel dims over (pipe, model) when pp > 1.
+
+    Layers outside the pipelined body (embedding, lm head) would otherwise
+    be replicated on every pipe stage — at a 7B/128k-vocab scale that wastes
+    several GB of params + fp32 master/moments per stage. The reference
+    instead places these on the first/last stage (partitioned_module.py);
+    spatially, splitting their vocab dim across the pipe axis is the
+    equivalent memory footprint, and GSPMD inserts the pipe-axis collectives.
+    """
+    if not getattr(meta, "is_model_parallel", False):
+        return meta
+    dim = meta.model_parallel_dimension or 0
+    spec = list(meta.partition_spec)
+    if dim >= len(spec) or spec[dim] != MODEL_AXIS:
+        return meta
+    spec[dim] = (PIPE_AXIS, MODEL_AXIS)
+    return ParamMeta(**{**meta.__dict__, "partition_spec": tuple(spec)})
 
 
 def _get_path(tree: dict, path: str):
@@ -168,8 +189,14 @@ class ParallelModule:
 
     def param_metas(self) -> dict:
         metas = {}
+        pp = self.topology.pipe_parallel_size if self.topology else 1
         for i, layer in enumerate(self.layers):
             m = layer.param_metas()
+            if pp > 1 and not isinstance(layer, PipelinedBody):
+                m = jax.tree.map(
+                    _lift_edge_meta_over_pipe, m,
+                    is_leaf=lambda x: isinstance(x, ParamMeta),
+                )
             m = tree_with_layer(m, self._logical_start[i], self._layer_class_name(i))
             metas[self.layer_name(i)] = m
         for info in self.tied.values():
@@ -272,6 +299,27 @@ class ParallelModule:
 
     def parameter_count(self, params: dict) -> int:
         return sum(int(p.size) for p in jax.tree.leaves(params))
+
+    def merge_lora_weights(self, params: dict) -> dict:
+        """Fold LoRA deltas into base weights on every layer that has them.
+
+        Backs ``trainer.merge_lora_after_loading_checkpoint`` (reference:
+        attention.py:766-797 via trainer config). Stage-stacked pipeline
+        bodies are merged per layer via nested vmap over the (pp,
+        layers_per_stage) leading dims.
+        """
+        params = dict(params)
+        for i, layer in enumerate(self.layers):
+            name = self.layer_name(i)
+            if isinstance(layer, PipelinedBody):
+                template = layer.template
+                if hasattr(template, "merge_lora_weights"):
+                    params[name] = jax.vmap(jax.vmap(template.merge_lora_weights))(
+                        params[name]
+                    )
+            elif hasattr(layer, "merge_lora_weights"):
+                params[name] = layer.merge_lora_weights(params[name])
+        return params
 
     # ---------------------------------------------------------- forward
     def _layer_params(self, params: dict, i: int) -> dict:

@@ -21,6 +21,7 @@ import numpy as np
 from pydantic import Field
 
 from ..checkpoint import (
+    AsyncCheckpointWriter,
     load_model_checkpoint,
     load_optimizer_checkpoint,
     save_model_checkpoint,
@@ -87,6 +88,11 @@ class TrainerConfig(BaseConfig):
     dataloader_num_workers: int = Field(0, description="kept for config parity")
     dataloader_pin_memory: bool = Field(True, description="kept for config parity")
     dataloader_prefetch_factor: Optional[int] = Field(None, description="kept for config parity")
+    save_checkpoint_async: bool = Field(
+        False,
+        description="write checkpoint files on a background thread; the train "
+        "loop only blocks for the device-to-host gather",
+    )
 
 
 class BaseTrainer:
@@ -118,6 +124,7 @@ class BaseTrainer:
 
         self.params: Any = None
         self.opt_state: Optional[OptimizerState] = None
+        self._ckpt_writer: Optional[AsyncCheckpointWriter] = None
         self._train_step = None
         self._eval_step = None
         self.dataloader: Optional[DataLoader] = None
@@ -250,6 +257,7 @@ class BaseTrainer:
             if getattr(self, "_preempted", False):
                 if self.config.save_dir is not None:
                     self.save_checkpoint()
+                    self.finalize_checkpoints()
                     logger.info("preemption: checkpoint saved, exiting cleanly")
                 return
             if (
@@ -281,8 +289,13 @@ class BaseTrainer:
             if log_metrics_fn is not None:
                 metrics = log_metrics_fn(self, output, metrics)
             logger.log_metrics(metrics, self.context.iterations)
+        self.finalize_checkpoints()
 
     # ----------------------------------------------------------- checkpoint
+    def finalize_checkpoints(self) -> None:
+        """Block until pending async checkpoint writes are durable."""
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.wait()
     def _step_dir(self, base: Path, iterations: int) -> Path:
         return base / f"global_step{iterations}"
 
@@ -290,6 +303,13 @@ class BaseTrainer:
         base = Path(dir or self.config.save_dir)
         step_dir = self._step_dir(base, self.context.iterations)
         step_dir.mkdir(parents=True, exist_ok=True)
+        writer = None
+        if self.config.save_checkpoint_async:
+            if self._ckpt_writer is None:
+                self._ckpt_writer = AsyncCheckpointWriter()
+            else:
+                self._ckpt_writer.wait()  # never interleave two saves
+            writer = self._ckpt_writer
         # checkpoint-view trees: stage-stacked pipeline bodies un-stack into
         # per-layer files so checkpoints are pipe-layout independent
         metas = self.module.ckpt_metas()
@@ -298,13 +318,14 @@ class BaseTrainer:
             separate_file_for_parameters=getattr(
                 self.module, "separate_file_for_parameters", None
             ),
+            writer=writer,
         )
         viewed_opt = self.opt_state._replace(
             master=self.module.ckpt_view(self.opt_state.master),
             exp_avg=self.module.ckpt_view(self.opt_state.exp_avg),
             exp_avg_sq=self.module.ckpt_view(self.opt_state.exp_avg_sq),
         )
-        save_optimizer_checkpoint(step_dir, viewed_opt, metas)
+        save_optimizer_checkpoint(step_dir, viewed_opt, metas, writer=writer)
         self.context.save_checkpoint(step_dir)
         # full config travels with the weights so inference can rebuild the
         # architecture (reference: context.py:113-125 config.yml copy)
@@ -315,7 +336,13 @@ class BaseTrainer:
             (step_dir / "config.yml").write_text(
                 _yaml.safe_dump(cfg.model_dump(mode="json"), sort_keys=False)
             )
-        (base / "latest").write_text(f"global_step{self.context.iterations}")
+        latest = f"global_step{self.context.iterations}"
+        if writer is None:
+            (base / "latest").write_text(latest)
+        else:
+            # the single writer thread is FIFO: "latest" lands only after
+            # every npz of this save is durable
+            writer.submit((base / "latest").write_text, latest)
         logger.info(f"saved checkpoint {step_dir}")
         if self.config.delete_past_optimizer_states:
             for old in sorted(base.glob("global_step*")):
@@ -344,8 +371,17 @@ class BaseTrainer:
             ignore_keys=self.config.ignore_keys_in_checkpoint,
         )
         self.params = self.module.ckpt_unview(params_view, self.params)
+        merged_lora = False
+        if self.config.merge_lora_after_loading_checkpoint:
+            self.params = self.module.merge_lora_weights(self.params)
+            merged_lora = True
+            logger.info("merged LoRA deltas into base weights after load")
         optimizer_states_loaded = False
-        if self.config.load_optimizer_states:
+        # after a merge the checkpoint's fp32 masters are stale (they hold the
+        # unmerged weights and nonzero lora_b — the first step would resurrect
+        # the folded delta); re-derive instead, like the reference's
+        # refresh_optimizer_after_model_change (trainer.py:87-92)
+        if self.config.load_optimizer_states and not merged_lora:
             try:
                 viewed_current = self.opt_state._replace(
                     master=self.module.ckpt_view(self.opt_state.master),

@@ -149,3 +149,32 @@ def test_checkpoint_layout(tmp_path, devices):
     assert (step_dir / "context.json").is_file()
     assert (step_dir / "optimizer_state.json").is_file()
     assert list(step_dir.glob("optimizer_state_layer_*.npz"))
+
+
+def test_async_checkpoint_resume_matches_sync(tmp_path, devices):
+    """save_checkpoint_async produces byte-equivalent checkpoints: resume
+    from an async save reproduces the sync-save training trajectory."""
+    cfg_sync = make_config(tmp_path / "sync", train_iterations=6, save_interval=3)
+    cfg_async = make_config(tmp_path / "async", train_iterations=6, save_interval=3)
+    d = cfg_async.model_dump(mode="json")
+    d["trainer"]["save_checkpoint_async"] = True
+    cfg_async = type(cfg_async).from_dict(d)
+
+    l_sync = run_steps(build_trainer(cfg_sync), 6)
+    t_async = build_trainer(cfg_async)
+    l_async = run_steps(t_async, 6)
+    np.testing.assert_allclose(np.asarray(l_sync), np.asarray(l_async), rtol=1e-6)
+    # run_training waited for the writer: all files of the last save exist
+    step_dir = tmp_path / "async" / "ckpt" / "global_step6"
+    assert (tmp_path / "async" / "ckpt" / "latest").read_text() == "global_step6"
+    assert list(step_dir.glob("model_state_layer_*.npz"))
+    assert list(step_dir.glob("optimizer_state_layer_*.npz"))
+
+    # resume each and confirm identical continued losses
+    r_sync = build_trainer(make_config(
+        tmp_path / "rs", train_iterations=9, load_dir=tmp_path / "sync" / "ckpt"))
+    r_async = build_trainer(make_config(
+        tmp_path / "ra", train_iterations=9, load_dir=tmp_path / "async" / "ckpt"))
+    np.testing.assert_allclose(
+        np.asarray(run_steps(r_sync, 3)), np.asarray(run_steps(r_async, 3)), rtol=1e-6
+    )

@@ -110,3 +110,46 @@ def test_finetunable_parameters_regex(tmp_path, pretrain):
     trainer = build_capturing_trainer(cfg, load=True)
     keys = trainable_keys(trainer)
     assert keys and all("input_layernorm" in k for k in keys), keys
+
+
+def test_merge_lora_after_loading_checkpoint(tmp_path, pretrain):
+    """merge_lora_after_loading_checkpoint folds deltas into base weights and
+    disables the live LoRA path, preserving the model function
+    (reference: trainer.py:87-92, attention.py:766-797)."""
+    cfg = finetune_config(
+        tmp_path, pretrain,
+        {"lora_config": {"name": "lo", "rank": 2, "alpha": 4}},
+        missing=[r".*_lo\."],
+    )
+    trainer = build_capturing_trainer(cfg, load=True)
+    train_capture(trainer, 3)  # give the LoRA params nonzero values
+    trainer.save_checkpoint()
+
+    load_cfg_dict = cfg.model_dump(mode="json")
+    load_cfg_dict["trainer"]["load_dir"] = load_cfg_dict["trainer"]["save_dir"]
+    load_cfg_dict["trainer"]["allowed_missing_keys_in_checkpoint"] = []
+    plain = type(cfg).from_dict(load_cfg_dict)
+    load_cfg_dict["trainer"]["merge_lora_after_loading_checkpoint"] = True
+    merged = type(cfg).from_dict(load_cfg_dict)
+
+    t_plain = build_capturing_trainer(plain, load=True)
+    t_merged = build_capturing_trainer(merged, load=True)
+
+    p_plain = {k: np.asarray(p) for k, p, _ in t_plain.module.named_parameters(t_plain.params)}
+    p_merged = {k: np.asarray(p) for k, p, _ in t_merged.module.named_parameters(t_merged.params)}
+
+    # base attention weights must have absorbed the (nonzero) deltas
+    changed = [k for k in p_plain
+               if "_lo." not in k and not np.array_equal(p_plain[k], p_merged[k])]
+    assert changed, "merge changed no base weights"
+    assert all("attention" in k for k in changed), changed
+    # lora_b must be zeroed so the live path is inert
+    for k in p_merged:
+        if "_lo." in k and k.endswith("lora_b"):
+            assert not p_merged[k].any(), f"{k} not zeroed after merge"
+    # the model function is preserved: identical eval loss on the same batch
+    batch = next(iter(t_plain.dataloader))
+    model_in_plain = t_plain.batch_to_model_input(batch)
+    loss_plain = float(t_plain._eval_step(t_plain.params, model_in_plain)[0])
+    loss_merged = float(t_merged._eval_step(t_merged.params, model_in_plain)[0])
+    assert abs(loss_plain - loss_merged) < 2e-2, (loss_plain, loss_merged)
