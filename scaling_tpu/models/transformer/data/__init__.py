@@ -6,6 +6,7 @@ from .finetuning import (
     FinetuningTextDataset,
 )
 from .text_dataset import (
+    LegacyBlendedDataset,
     TextBlendedDataset,
     TextDataset,
     TextDatasetBatch,
@@ -18,6 +19,7 @@ __all__ = [
     "FinetuningItem",
     "FinetuningTextBlendedDataset",
     "FinetuningTextDataset",
+    "LegacyBlendedDataset",
     "TextBlendedDataset",
     "TextDataset",
     "TextDatasetBatch",

@@ -33,17 +33,44 @@ from .text_dataset import TextDatasetBatch
 from ....nn.seq_packing import get_position_ids_from_segments, get_segment_ids
 
 
-class FinetuningItem:
-    __slots__ = ("token_ids", "target_token_ids", "loss_weights")
+IMAGE_ENCODER_TOKEN_COUNT = 144  # 384/32 patches squared (image_encoder.py)
+IMAGE_SIZE = 384
+# CLIP preprocessing constants (reference: finetuning_chat_dataset.py:24
+# clip_transform); kept so data pipelines transfer unchanged
+_IMAGE_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
+_IMAGE_STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
 
-    def __init__(self, token_ids, target_token_ids, loss_weights):
+
+def load_image(path: Path) -> np.ndarray:
+    """Image file -> normalized (H, W, 3) float32, CLIP-style preprocessing."""
+    from PIL import Image
+
+    img = Image.open(str(path)).convert("RGB")
+    img = img.resize((IMAGE_SIZE, IMAGE_SIZE), Image.BICUBIC)
+    arr = np.asarray(img, dtype=np.float32) / 255.0
+    return (arr - _IMAGE_MEAN) / _IMAGE_STD
+
+
+class FinetuningItem:
+    __slots__ = ("token_ids", "target_token_ids", "loss_weights", "images",
+                 "image_locations")
+
+    def __init__(self, token_ids, target_token_ids, loss_weights,
+                 images=None, image_locations=None):
         self.token_ids = token_ids
         self.target_token_ids = target_token_ids
         self.loss_weights = loss_weights
+        self.images = images  # list of (H, W, 3) arrays or None
+        self.image_locations = image_locations  # list of start positions
 
 
 class _FinetuningBase(BaseDataset):
     """Shared item assembly + collate for both finetuning datasets."""
+
+    #: fixed image-slot count for every batch this dataset produces; padding
+    #: to a dataset-level constant (not the per-batch max) keeps the jitted
+    #: train step's input signature stable across batches — no recompiles
+    max_images: int = 0
 
     def __init__(self, sequence_length: int, eod_token_id: int,
                  seed: int = 42, shuffle: bool = True):
@@ -59,20 +86,39 @@ class _FinetuningBase(BaseDataset):
         self.shuffle = shuffle
 
     def _assemble(
-        self, input_ids: List[int], target_ids: List[int], loss_mask: List[int]
+        self, input_ids: List[int], target_ids: List[int], loss_mask: List[int],
+        truncate: str = "front", images=None, image_locations=None,
     ) -> FinetuningItem:
+        """``truncate='front'`` keeps the tail (the trained completion lives
+        there — text finetuning); ``'back'`` keeps the head like the
+        reference chat dataset (finetuning_chat_dataset.py:208-216), which
+        keeps recorded image splice locations valid."""
         L = self.sequence_length
         if len(input_ids) > L:
-            # keep the tail: the trained completion lives there
-            input_ids = input_ids[-L:]
-            target_ids = target_ids[-L:]
-            loss_mask = loss_mask[-L:]
+            if truncate == "front":
+                input_ids = input_ids[-L:]
+                target_ids = target_ids[-L:]
+                loss_mask = loss_mask[-L:]
+            else:
+                input_ids = input_ids[:L]
+                target_ids = target_ids[:L]
+                loss_mask = loss_mask[:L]
+        if image_locations is not None:
+            # drop any image whose 144-token span no longer fits: truncation
+            # can cut it, and a trailing image loses its last placeholder to
+            # the target shift (a partial splice would overwrite real tokens)
+            keep = [
+                i for i, st in enumerate(image_locations)
+                if st + IMAGE_ENCODER_TOKEN_COUNT <= len(input_ids)
+            ]
+            images = [images[i] for i in keep]
+            image_locations = [image_locations[i] for i in keep]
         pad = L - len(input_ids)
         eod = self.eod_token_id
         token_ids = np.asarray(input_ids + [eod] * pad, dtype=np.int64)
         target = np.asarray(target_ids + [eod] * pad, dtype=np.int64)
         weights = np.asarray(loss_mask + [0] * pad, dtype=np.float32)
-        return FinetuningItem(token_ids, target, weights)
+        return FinetuningItem(token_ids, target, weights, images, image_locations)
 
     def collate(self, batch: List[FinetuningItem]) -> TextDatasetBatch:
         tokens = np.stack([b.token_ids for b in batch])
@@ -83,13 +129,30 @@ class _FinetuningBase(BaseDataset):
         position_ids = np.broadcast_to(
             np.arange(tokens.shape[1], dtype=np.int32), tokens.shape
         ).copy()
-        return TextDatasetBatch(
+        out = TextDatasetBatch(
             token_ids=tokens.astype(np.int32),
             target_token_ids=targets.astype(np.int32),
             position_ids=position_ids,
             segment_ids=segment_ids,
             loss_weights=weights,
         )
+        n_img = self.max_images
+        if n_img > 0:
+            b_sz = len(batch)
+            imgs = np.zeros((b_sz, n_img, IMAGE_SIZE, IMAGE_SIZE, 3), np.float32)
+            locs = np.zeros((b_sz, n_img), np.int32)
+            mask = np.zeros((b_sz, n_img), bool)
+            for i, item in enumerate(batch):
+                for j, (img, st) in enumerate(
+                    zip(item.images or [], item.image_locations or [])
+                ):
+                    imgs[i, j] = img
+                    locs[i, j] = st
+                    mask[i, j] = True
+            out.input_images = imgs
+            out.input_image_locations = locs
+            out.input_image_mask = mask
+        return out
 
 
 class FinetuningTextDataset(_FinetuningBase):
@@ -185,9 +248,11 @@ class FinetuningChatDataset(_FinetuningBase):
         vocab_file: Path | str,
         seed: int = 42,
         shuffle: bool = True,
+        softprompt_n_tokens: int = 0,
     ):
         self.data_prefix = Path(data_prefix)
         self.vocab_file = Path(vocab_file)
+        self.softprompt_n_tokens = softprompt_n_tokens
         self.tokenizer, self.tokenizer_no_prefix_space = load_tokenizers(self.vocab_file)
         path = self.data_prefix
         if path.suffix != ".jsonl" and not path.exists():
@@ -200,19 +265,34 @@ class FinetuningChatDataset(_FinetuningBase):
             elements = json.loads(line)
             tokens: List[int] = []
             mask: List[int] = []
-            first = True
+            image_paths: List[Path] = []
+            image_locations: List[int] = []
+            first_text = True
+            has_text_eos = False
             for el in elements:
-                if el["type"] != "text":
+                if el["type"] == "text":
+                    tok = self.tokenizer if first_text else self.tokenizer_no_prefix_space
+                    ids = tok.encode(el["content"])
+                    tokens.extend(ids)
+                    mask.extend([int(bool(el.get("has_loss", False)))] * len(ids))
+                    first_text = False
+                    has_text_eos = has_text_eos or (eos is not None and eos in ids)
+                elif el["type"] == "image":
+                    # 144 placeholder tokens the embedding layer overwrites
+                    # with the encoded image (reference:
+                    # finetuning_chat_dataset.py:120-134)
+                    image_paths.append(self.data_path_parent / el["content"])
+                    image_locations.append(len(tokens))
+                    tokens.extend([eos or 0] * IMAGE_ENCODER_TOKEN_COUNT)
+                    mask.extend([0] * IMAGE_ENCODER_TOKEN_COUNT)
+                else:
                     raise NotImplementedError(
-                        f"chat content type {el['type']!r} needs the image encoder"
+                        f"chat content type {el['type']!r} is not supported"
                     )
-                tok = self.tokenizer if first else self.tokenizer_no_prefix_space
-                ids = tok.encode(el["content"])
-                tokens.extend(ids)
-                mask.extend([int(bool(el.get("has_loss", False)))] * len(ids))
-                first = False
-            # the chat format carries its own EOS (reference warns, we do too)
-            if eos is not None and eos not in tokens:
+            # the chat format carries its own EOS (reference warns, we do
+            # too); image placeholders reuse the eos id, so only text
+            # elements count
+            if eos is not None and not has_text_eos:
                 import warnings
 
                 warnings.warn(
@@ -224,9 +304,18 @@ class FinetuningChatDataset(_FinetuningBase):
                     "input": tokens[:-1],
                     "target": tokens[1:],
                     "mask": mask[1:],
+                    "image_paths": image_paths,
+                    "image_locations": image_locations,
                 }
             )
+        self.max_images = max(
+            (len(s["image_paths"]) for s in self._samples), default=0
+        )
         super().__init__(sequence_length, eos or 0, seed=seed, shuffle=shuffle)
+
+    @property
+    def data_path_parent(self) -> Path:
+        return self.data_prefix.parent
 
     def ident(self) -> str:
         h = hashlib.md5(
@@ -239,7 +328,26 @@ class FinetuningChatDataset(_FinetuningBase):
 
     def __getitem__(self, index: int) -> FinetuningItem:
         s = self._samples[index]
-        return self._assemble(list(s["input"]), list(s["target"]), list(s["mask"]))
+        inputs = list(s["input"])
+        targets = list(s["target"])
+        mask = list(s["mask"])
+        locations = list(s["image_locations"])
+        n_sp = self.softprompt_n_tokens
+        if n_sp > 0:
+            # placeholder ids the softprompt layer overwrites in-embedding;
+            # prepended after the target shift like the reference
+            # (finetuning_chat_dataset.py:191-206)
+            inputs = [0] * n_sp + inputs
+            targets = [0] * n_sp + targets
+            mask = [0] * n_sp + mask
+            locations = [st + n_sp for st in locations]
+        images = [load_image(p) for p in s["image_paths"]] or None
+        return self._assemble(
+            inputs, targets, mask,
+            truncate="back",  # keep the head so image locations stay valid
+            images=images,
+            image_locations=locations if images else None,
+        )
 
 
 class FinetuningTextBlendedDataset(BaseBlendedDataset):

@@ -43,21 +43,32 @@ class TextDatasetBatch(BaseDatasetBatch):
         position_ids: np.ndarray,
         segment_ids: np.ndarray,
         loss_weights: np.ndarray,
+        input_images: "np.ndarray | None" = None,  # (b, n_img, H, W, 3)
+        input_image_locations: "np.ndarray | None" = None,  # (b, n_img) starts
+        input_image_mask: "np.ndarray | None" = None,  # (b, n_img) validity
     ):
         self.token_ids = token_ids
         self.target_token_ids = target_token_ids
         self.position_ids = position_ids
         self.segment_ids = segment_ids
         self.loss_weights = loss_weights
+        self.input_images = input_images
+        self.input_image_locations = input_image_locations
+        self.input_image_mask = input_image_mask
 
     def as_model_input(self) -> dict:
-        return {
+        out = {
             "token_ids": self.token_ids,
             "target_token_ids": self.target_token_ids,
             "position_ids": self.position_ids,
             "segment_ids": self.segment_ids,
             "loss_weights": self.loss_weights,
         }
+        if self.input_images is not None:
+            out["input_images"] = self.input_images
+            out["input_image_locations"] = self.input_image_locations
+            out["input_image_mask"] = self.input_image_mask
+        return out
 
     def only_inputs(self) -> "TextDatasetBatch":
         return self
@@ -222,6 +233,18 @@ class TextDataset(BaseDataset[TextDatasetItem, TextDatasetBatch]):
             segment_ids=segment_ids.astype(np.int32),
             loss_weights=loss_weights,
         )
+
+
+class LegacyBlendedDataset(BaseBlendedDataset):
+    """Blend of legacy (Megatron .bin/.idx) TextDatasets
+    (reference: legacy_blended_dataset.py:22-282).
+
+    The reference class re-implements weighting + a Megatron-format index
+    cache; here both already live in BaseBlendedDataset (same
+    furthest-off-target interleave, same weights_by_num_docs /
+    weights_examples_proportional formulas, file-cached index), so this is
+    the named entry point used when ``data.legacy_dataset`` is set.
+    """
 
 
 class TextBlendedDataset(BaseBlendedDataset):

@@ -46,7 +46,7 @@ class EmbeddingInput(BaseLayer):
                 width=architecture.image_encoder_width,
                 layers=architecture.image_encoder_layers,
                 heads=architecture.image_encoder_heads,
-                dropout_p=architecture.dropout_embedding,
+                dropout_p=architecture.dropout_image_encoder,
                 dtype=architecture.dtype,
             )
 
@@ -90,12 +90,18 @@ class EmbeddingInput(BaseLayer):
                 params["image_encoder"], imgs.reshape((b_ * n_img,) + imgs.shape[2:]), ctx
             )
             enc = enc.reshape(b_, n_img, enc.shape[-2], enc.shape[-1])
+            # (b, n_img) validity mask: collate pads items to the batch's max
+            # image count; padded slots must not overwrite real embeddings
+            img_mask = batch.get("input_image_mask")
             for j in range(n_img):
-                embeddings = jax.vmap(
+                spliced = jax.vmap(
                     lambda e, blk, st: jax.lax.dynamic_update_slice(
                         e, blk.astype(e.dtype), (st, 0)
                     )
                 )(embeddings, enc[:, j], locs[:, j].astype(jnp.int32))
+                if img_mask is not None:
+                    spliced = jnp.where(img_mask[:, j, None, None], spliced, embeddings)
+                embeddings = spliced
 
         if self.softprompt_config is not None:
             # overwrite the first n_tokens positions with the learned prompt

@@ -24,7 +24,7 @@ from .data.finetuning import (
     FinetuningTextBlendedDataset,
     FinetuningTextDataset,
 )
-from .data.text_dataset import TextBlendedDataset, TextDataset
+from .data.text_dataset import LegacyBlendedDataset, TextBlendedDataset, TextDataset
 from .model import init_model, init_optimizer, loss_function
 from .utils.get_tflops import (
     HardwareType,
@@ -89,12 +89,16 @@ def _read_dataset(config: TransformerConfig, prefixes: Optional[List[Any]]):
         if arch.vocab_file is None:
             raise ValueError("finetuning datasets need transformer_architecture.vocab_file")
         if data.finetuning_chat_dataset:
+            softprompt_chat = arch.softprompt_config
             datasets: List[Any] = [
                 FinetuningChatDataset(
                     data_prefix=p,
                     sequence_length=arch.sequence_length,
                     vocab_file=arch.vocab_file,
                     seed=config.trainer.seed,
+                    softprompt_n_tokens=(
+                        softprompt_chat.n_tokens if softprompt_chat else 0
+                    ),
                 )
                 for p in prefixes
             ]
@@ -127,7 +131,7 @@ def _read_dataset(config: TransformerConfig, prefixes: Optional[List[Any]]):
             )
             for p in prefixes
         ]
-        blended_cls = TextBlendedDataset
+        blended_cls = LegacyBlendedDataset if data.legacy_dataset else TextBlendedDataset
     if len(datasets) == 1:
         return datasets[0]
     blended_config = data.blended_dataset or BlendedDatasetConfig()

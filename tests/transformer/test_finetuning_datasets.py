@@ -139,3 +139,172 @@ def test_finetuning_end_to_end(vocab_file, text_jsonl, tmp_path):
     )
     trainer = main(config)
     assert trainer.context.iterations == 3
+
+
+def _write_png(path, rng):
+    from PIL import Image
+
+    arr = rng.integers(0, 255, size=(20, 30, 3), dtype=np.uint8)
+    Image.fromarray(arr).save(path)
+
+
+def test_chat_image_entries(vocab_file, tmp_path):
+    """Image elements become 144 loss-free placeholder tokens with recorded
+    splice locations (reference: finetuning_chat_dataset.py:120-134)."""
+    from scaling_tpu.models.transformer.data.finetuning import (
+        IMAGE_ENCODER_TOKEN_COUNT,
+        IMAGE_SIZE,
+    )
+
+    rng = np.random.default_rng(0)
+    _write_png(tmp_path / "img.png", rng)
+    rows = [
+        [{"type": "text", "content": "question foo"},
+         {"type": "image", "content": "img.png"},
+         {"type": "text", "content": "answer <|endoftext|>", "has_loss": True}],
+        [{"type": "text", "content": "hello"},
+         {"type": "text", "content": "world <|endoftext|>", "has_loss": True}],
+    ]
+    path = tmp_path / "chat.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in rows))
+    L = IMAGE_ENCODER_TOKEN_COUNT + 8
+    ds = FinetuningChatDataset(path, sequence_length=L, vocab_file=vocab_file)
+
+    item = ds[0]
+    assert item.images and len(item.images) == 1
+    assert item.images[0].shape == (IMAGE_SIZE, IMAGE_SIZE, 3)
+    assert item.image_locations == [2]  # after the 2 "question foo" tokens
+    # placeholder span carries no loss (weights are target-aligned: the last
+    # placeholder position predicts the first has_loss token, so it is 1)
+    assert item.loss_weights[1 : 1 + IMAGE_ENCODER_TOKEN_COUNT].sum() == 0
+
+    batch = ds.collate([ds[0], ds[1]])
+    assert batch.input_images.shape == (2, 1, IMAGE_SIZE, IMAGE_SIZE, 3)
+    assert batch.input_image_mask.tolist() == [[True], [False]]
+    model_in = batch.as_model_input()
+    assert "input_images" in model_in
+
+
+def test_chat_truncates_back_keeping_head(vocab_file, tmp_path):
+    rows = [[{"type": "text", "content": "hello " * 20, "has_loss": True}]]
+    path = tmp_path / "chat.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in rows))
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ds = FinetuningChatDataset(path, sequence_length=8, vocab_file=vocab_file)
+    item = ds[0]
+    hello_id = ds.tokenizer.encode("hello")[0]
+    # head survives: all 8 positions are the leading "hello" tokens
+    assert item.token_ids.tolist() == [hello_id] * 8
+
+
+def test_chat_softprompt_prefix(vocab_file, tmp_path):
+    rows = [[{"type": "text", "content": "hello <|endoftext|>", "has_loss": True}]]
+    path = tmp_path / "chat.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in rows))
+    ds = FinetuningChatDataset(
+        path, sequence_length=10, vocab_file=vocab_file, softprompt_n_tokens=4
+    )
+    item = ds[0]
+    assert item.token_ids[:4].tolist() == [0] * 4
+    assert item.loss_weights[:4].sum() == 0  # softprompt positions carry no loss
+
+
+def test_chat_image_end_to_end_training(vocab_file, tmp_path):
+    """Chat data with images trains through the multimodal model: the image
+    encoder gets gradients and the masked splice leaves padded slots alone."""
+    from scaling_tpu.models.transformer import TransformerConfig
+    from .test_training import build_capturing_trainer, train_capture
+
+    rng = np.random.default_rng(1)
+    _write_png(tmp_path / "a.png", rng)
+    rows = []
+    for i in range(4):
+        rows.append(
+            [{"type": "text", "content": "question foo"},
+             {"type": "image", "content": "a.png"},
+             {"type": "text", "content": "answer baz <|endoftext|>", "has_loss": True}]
+        )
+        rows.append(
+            [{"type": "text", "content": "hello"},
+             {"type": "text", "content": "world <|endoftext|>", "has_loss": True}]
+        )
+    (tmp_path / "chat.jsonl").write_text("\n".join(json.dumps(r) for r in rows))
+
+    config = TransformerConfig.from_dict(
+        {
+            "topology": {
+                "model_parallel_size": 1, "pipe_parallel_size": 1,
+                "data_parallel_size": 1, "micro_batch_size": 2,
+                "gradient_accumulation_steps": 1,
+            },
+            "transformer_architecture": {
+                "vocab_size": 16, "hidden_size": 32, "num_layers": 1,
+                "num_attention_heads": 2, "sequence_length": 160,
+                "vocab_file": str(vocab_file),
+                "image_encoder": True, "image_encoder_width": 32,
+                "image_encoder_layers": 1, "image_encoder_heads": 2,
+            },
+            "optimizer": {"gradient_clipping": 1.0},
+            "learning_rate_scheduler": {"learning_rate": 0.01,
+                                        "learning_rate_warmup_steps": 1,
+                                        "learning_rate_decay_iters": 10},
+            "trainer": {"train_iterations": 2, "seed": 7,
+                        "save_dir": str(tmp_path / "ckpt"), "save_interval": 100},
+            "data": {"finetuning_chat_dataset": True,
+                     "data_prefixes": [str(tmp_path / "chat.jsonl")]},
+            "logger": {"log_dir": None},
+        }
+    )
+    trainer = build_capturing_trainer(config)
+    losses = train_capture(trainer, 2)
+    assert np.isfinite(losses).all()
+
+
+def test_legacy_blended_dataset(tmp_path):
+    """LegacyBlendedDataset blends Megatron-format datasets with the
+    furthest-off-target interleave (reference: legacy_blended_dataset.py)."""
+    from scaling_tpu.data.blended_dataset import BlendedDatasetConfig
+    from scaling_tpu.data.legacy_indexed_dataset import LegacyMMapIndexWriter
+    from scaling_tpu.models.transformer.data import (
+        LegacyBlendedDataset,
+        TextDataset,
+    )
+
+    rng = np.random.default_rng(3)
+    prefixes = []
+    for name, n_docs in (("a", 12), ("b", 4)):
+        prefix = tmp_path / name
+        with LegacyMMapIndexWriter(prefix, dtype=np.uint16) as w:
+            for _ in range(n_docs):
+                w.add(np.append(rng.integers(1, 50, size=24), 0).astype(np.uint16))
+        prefixes.append(prefix)
+
+    datasets = [
+        TextDataset(p, sequence_length=16, seed=5, legacy_dataset=True)
+        for p in prefixes
+    ]
+    blended = LegacyBlendedDataset(
+        seed=5,
+        config=BlendedDatasetConfig(
+            weight_by_num_documents=True, weighted_sampler_alpha=0.5,
+            cache_directory=str(tmp_path / "cache"),
+        ),
+        datasets=datasets,
+    )
+    assert len(blended) > 0
+    items = [blended[i] for i in range(len(blended))]
+    # TextDataset items carry seq_len + 1 tokens (inputs and shifted targets)
+    assert all(i.token_ids.shape == (17,) for i in items)
+    # deterministic: same seed + cache round-trip gives the same mixture
+    blended2 = LegacyBlendedDataset(
+        seed=5,
+        config=BlendedDatasetConfig(
+            weight_by_num_documents=True, weighted_sampler_alpha=0.5,
+            cache_directory=str(tmp_path / "cache"),
+        ),
+        datasets=datasets,
+    )
+    np.testing.assert_array_equal(blended.dataset_indices, blended2.dataset_indices)
