@@ -12,16 +12,21 @@ formulation is *spatial* pipelining:
   pipe-sharded dim to an ICI collective-permute) and applies every stage in
   parallel via ``vmap``;
 - ``n_micro + pp - 1`` ticks drain the pipeline; ``jax.grad`` through the
-  scan gives the backward schedule, with ``jax.checkpoint`` on the stage
-  body bounding activation memory (GPipe + remat — the jit-native equivalent
-  of the reference's 1F1B memory profile).
+  scan gives the backward schedule. ``jax.checkpoint`` on the stage body
+  plus sqrt(T)-chunked remat over the tick scan bounds boundary-activation
+  memory to O(sqrt(n_micro) * pp) — measured sublinear in
+  tests/transformer/test_training_pipeline.py (the reference's 1F1B holds
+  its pp in-flight micro-batches; an unchunked scan would hold all
+  n_micro).
 
 The 1F1B instruction DSL and its simulator survive as the pure-Python
 planning/visualisation tool in ``pipeline_schedule.py``.
 
 Heterogeneous edges (embedding, final norm, lm head) run outside the
-pipelined region, replicated over the pipe axis: their FLOPs are negligible
-next to the body, and replication avoids idle bubbles on edge stages.
+pipelined region: their FLOPs are negligible next to the body. Their big
+vocab-dim parameters are sharded over (pipe, model) rather than replicated
+per stage (parallel_module.py:_lift_edge_meta_over_pipe) — the memory
+equivalent of the reference placing them on the first/last stage only.
 """
 
 from __future__ import annotations
@@ -258,7 +263,30 @@ class PipelinedBody:
             lambda xs: jnp.zeros((pp,) + xs.shape[1:], dtype=xs.dtype), x_microbatches
         )
         zero_state = constrain_state(zero_state)
-        _, outs = jax.lax.scan(tick, zero_state, jnp.arange(n_micro + pp - 1))
+        n_ticks = n_micro + pp - 1
+        if remat and n_ticks >= 4:
+            # sqrt(T)-chunked remat over the tick scan: a plain scan saves
+            # every tick's carry for backward — O(n_micro * pp) boundary
+            # activations, where the reference's 1F1B holds only its pp
+            # in-flight micro-batches (pipeline_schedule/train.py:109-117).
+            # Checkpointing chunks of ~sqrt(T) ticks stores only chunk-edge
+            # carries + one chunk's internal carries during its backward:
+            # O(sqrt(n_micro) * pp) memory for one extra body forward.
+            chunk = int(np.ceil(np.sqrt(n_ticks)))
+            n_chunks = int(np.ceil(n_ticks / chunk))
+            padded = n_chunks * chunk  # excess ticks produce discarded outputs
+            tick_ids = jnp.arange(padded).reshape(n_chunks, chunk)
+
+            @jax.checkpoint
+            def chunk_body(state, ts):
+                return jax.lax.scan(tick, state, ts)
+
+            _, outs = jax.lax.scan(chunk_body, zero_state, tick_ids)
+            outs = jax.tree.map(
+                lambda o: o.reshape((padded,) + o.shape[2:])[pp - 1 : n_ticks], outs
+            )
+            return outs
+        _, outs = jax.lax.scan(tick, zero_state, jnp.arange(n_ticks))
         return jax.tree.map(lambda o: o[pp - 1 :], outs)
 
 
