@@ -299,14 +299,38 @@ class ParallelSelfAttention(BaseLayer):
                 positions_q=None, positions_k=None,
             )
 
-        k = repeat_kv(k, self.num_repeat_kv)
-        v = repeat_kv(v, self.num_repeat_kv)
-
         dropout_fn = None
         if self.dropout_attention_probs > 0.0 and not ctx.deterministic:
             dropout_fn = lambda p: ctx.dropout(p, self.dropout_attention_probs)  # noqa: E731
 
         n_local = self.num_local_attention_heads
+
+        # the flash (splash) kernel consumes UNREPEATED kv heads — the KV
+        # bandwidth/memory win of GQA; every other path repeats below
+        use_flash_here = (
+            self.use_flash
+            and kv_cache is None
+            and attention_scores_manipulation is None
+            and dropout_fn is None
+            and n_local == 0
+            and self.causal
+            and ctx.context_parallel_size <= 1
+        )
+        if use_flash_here:
+            from ..ops.flash_attention import (
+                flash_attention_fused,
+                flash_attention_supported,
+            )
+
+            use_flash_here = flash_attention_supported(s, self.head_dim)
+        if use_flash_here:
+            out = flash_attention_fused(
+                q, k, v, segment_ids, causal=True, sm_scale=self.scaling_factor
+            )
+            return self._project_out(params, out, ctx, b, s, new_kv)
+
+        k = repeat_kv(k, self.num_repeat_kv)
+        v = repeat_kv(v, self.num_repeat_kv)
         if ctx.context_parallel_size > 1 and kv_cache is None:
             # ring attention: sequence sharded over the context mesh axis,
             # K/V blocks rotate over ICI (ops/ring_attention.py)
@@ -322,44 +346,7 @@ class ParallelSelfAttention(BaseLayer):
                 q, k, v, segment_ids, ctx.mesh,
                 causal=self.causal, sm_scale=self.scaling_factor,
             )
-            out = out.reshape(b, s, self.hidden_size)
-            y = self.dense(params["dense"], out, ctx)
-            if self.lora_config:
-                name = f"{LoRAModuleType.DENSE.value}_{self.lora_config.name}"
-                if name in self.lora_modules:
-                    y = y + self.lora_modules[name](params[name], out, ctx)
-            if new_kv is not None:
-                return y, new_kv
-            return y
-
-        use_flash_here = (
-            self.use_flash
-            and kv_cache is None
-            and attention_scores_manipulation is None
-            and dropout_fn is None
-            and n_local == 0
-            and self.causal
-        )
-        if use_flash_here:
-            from ..ops.flash_attention import (
-                flash_attention_fused,
-                flash_attention_supported,
-            )
-
-            use_flash_here = flash_attention_supported(s, self.head_dim)
-        if use_flash_here:
-            out = flash_attention_fused(
-                q, k, v, segment_ids, causal=True, sm_scale=self.scaling_factor
-            )
-            out = out.reshape(b, s, self.hidden_size)
-            y = self.dense(params["dense"], out, ctx)
-            if self.lora_config:
-                name = f"{LoRAModuleType.DENSE.value}_{self.lora_config.name}"
-                if name in self.lora_modules:
-                    y = y + self.lora_modules[name](params[name], out, ctx)
-            if new_kv is not None:
-                return y, new_kv
-            return y
+            return self._project_out(params, out, ctx, b, s, new_kv)
 
         if n_local > 0 and kv_cache is None:
             # mixed local/global heads: first (n - n_local) heads global,
@@ -386,6 +373,10 @@ class ParallelSelfAttention(BaseLayer):
                 dropout_fn, attention_scores_manipulation,
             )
 
+        return self._project_out(params, out, ctx, b, s, new_kv)
+
+    def _project_out(self, params, out, ctx, b, s, new_kv):
+        """Shared epilogue: heads -> hidden, dense projection + LoRA delta."""
         out = out.reshape(b, s, self.hidden_size)
         y = self.dense(params["dense"], out, ctx)
         if self.lora_config:

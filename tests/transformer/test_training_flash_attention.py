@@ -1,0 +1,47 @@
+"""End-to-end training with the flash (splash) attention kernel matches the
+XLA kernel's losses (reference: tests/transformer/test_training_flash_attention.py
+flash-vs-torch loss parity grid)."""
+
+import numpy as np
+import pytest
+
+from scaling_tpu.data.memory_map import MemoryMapDatasetBuilder
+from scaling_tpu.ops.flash_attention import force_flash_interpret
+
+from .test_training import build_capturing_trainer, make_config, train_capture
+
+
+@pytest.fixture(scope="module")
+def data_prefix(tmp_path_factory):
+    prefix = tmp_path_factory.mktemp("flashdata") / "data"
+    rng = np.random.default_rng(31)
+    with MemoryMapDatasetBuilder(prefix, dtype=np.uint16) as builder:
+        for _ in range(48):
+            doc = rng.integers(1, 96, size=rng.integers(16, 120))
+            builder.add(np.append(doc, 0).astype(np.uint16))
+    return prefix
+
+
+def _config(tmp_path, data_prefix, kernel):
+    # flash needs seq % 128 == 0 and head_dim >= 64
+    return make_config(
+        tmp_path, data_prefix, train_iterations=4, save_interval=100,
+        hidden_size=128, num_attention_heads=2, attention_num_kv_heads=1,
+        sequence_length=128, attention_qkv_in_one=False,
+        masked_softmax={"kernel": kernel},
+    )
+
+
+def test_flash_training_matches_xla(tmp_path, data_prefix, devices):
+    losses = {}
+    for kernel in ("torch", "flash_attention"):
+        cfg = _config(tmp_path / kernel, data_prefix, kernel)
+        with force_flash_interpret():
+            trainer = build_capturing_trainer(cfg)
+            losses[kernel] = train_capture(trainer, 4)
+    np.testing.assert_allclose(
+        np.asarray(losses["torch"], np.float32),
+        np.asarray(losses["flash_attention"], np.float32),
+        rtol=2e-3, atol=2e-3,
+    )
+    assert losses["flash_attention"][0] > losses["flash_attention"][-1]
