@@ -50,28 +50,47 @@ def encode_payload(payload: Any) -> str:
 
 
 def runner_main(config: RunnerConfig, payload: Any) -> int:
-    """Launch ``config.script`` on every host in the pool. On a single host
-    this just execs the script in-process-count 1; multi-host uses ssh."""
+    """Launch ``config.script`` across the resource pool.
+
+    All-localhost pools expand slots into local worker processes (each
+    claiming its own device slot via LOCAL_SLOT/local_device_ids); remote
+    hosts get one ssh-launched process each, owning all local devices."""
     pool = get_resource_pool(config)
+    all_local = all(h in ("localhost", "127.0.0.1") for h in pool)
+    if all_local:
+        # expand slots into local worker processes — the reference's
+        # pdsh-on-localhost mode (tests/core/test_runner exercises a real
+        # multi-process rendezvous this way)
+        workers = [
+            (host, slot)
+            for host, slots in pool.items()
+            for slot in range(max(slots, 1))
+        ]
+    else:
+        # one process per host; jax owns all of that host's devices
+        workers = [(host, 0) for host in pool]
     hosts = list(pool)
     master_addr = config.master_addr or hosts[0]
-    num_processes = len(hosts)
+    num_processes = len(workers)
     encoded = encode_payload(payload)
 
+    local_workers = {h: sum(1 for hh, _ in workers if hh == h) for h in pool}
     procs: List[subprocess.Popen] = []
-    for process_id, host in enumerate(hosts):
+    for process_id, (host, slot) in enumerate(workers):
         env_exports = {
             "MASTER_ADDR": master_addr,
             "MASTER_PORT": str(config.master_port),
-            "WORLD_SIZE": str(sum(pool.values())),
+            # total device slots, NOT process count (LaunchConfig contract)
+            "WORLD_SIZE": str(sum(max(s, 1) for s in pool.values())),
             "RANK": str(process_id),
-            "LOCAL_SLOT": "0",
+            "LOCAL_SLOT": str(slot),
+            "LOCAL_WORLD_SIZE": str(local_workers[host]),
             "JAX_NUM_PROCESSES": str(num_processes),
             "JAX_PROCESS_ID": str(process_id),
         }
         script = config.script or "scaling_tpu.models.transformer.train"
         cmd = [sys.executable, "-u", "-m", script, f"--payload={encoded}"]
-        if host in ("localhost", "127.0.0.1") and num_processes == 1:
+        if host in ("localhost", "127.0.0.1"):
             procs.append(subprocess.Popen(cmd, env={**os.environ, **env_exports}))
         else:
             exports = " ".join(f"{k}={v}" for k, v in env_exports.items())
@@ -104,15 +123,26 @@ def runner_main(config: RunnerConfig, payload: Any) -> int:
 
 def initialize_distributed(launch_config: Optional[LaunchConfig] = None) -> None:
     """Per-host bootstrap: joins the jax.distributed rendezvous when a
-    multi-process launch is detected; no-op single host."""
+    multi-process launch is detected; no-op single process.
+
+    When several workers share one host (slot expansion), each claims only
+    its own slot's device via ``local_device_ids`` — without this every
+    process would try to own all local chips and libtpu would abort."""
     num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
     if num_processes <= 1:
         return
     import jax
 
     lc = launch_config or LaunchConfig.from_launcher_args()
+    kwargs = {}
+    platforms = (jax.config.jax_platforms or "") + os.environ.get("JAX_PLATFORMS", "")
+    if int(os.environ.get("LOCAL_WORLD_SIZE", "1")) > 1 and "cpu" not in platforms:
+        # accelerator hosts: each co-located worker claims only its slot's
+        # chip; virtual CPU devices are per-process and never collide
+        kwargs["local_device_ids"] = [lc.local_slot]
     jax.distributed.initialize(
         coordinator_address=f"{lc.master_addr}:{lc.master_port}",
         num_processes=num_processes,
         process_id=int(os.environ.get("JAX_PROCESS_ID", str(lc.global_rank))),
+        **kwargs,
     )

@@ -1,0 +1,6 @@
+"""Worker that fails immediately — exercises the launcher's babysitting."""
+
+import sys
+
+if __name__ == "__main__":
+    sys.exit(3)

@@ -1,0 +1,33 @@
+"""Worker launched by test_runner: joins the real jax.distributed
+rendezvous on CPU and records what it saw (reference:
+tests/core/test_runner/runner_script.py writes one json per process)."""
+
+import json
+import os
+from pathlib import Path
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # never touch a real TPU here
+
+    from scaling_tpu.runner import LaunchConfig
+    from scaling_tpu.runner.runner import initialize_distributed
+
+    lc = LaunchConfig.from_launcher_args()
+    initialize_distributed(lc)
+
+    out = {
+        "rank": lc.global_rank,
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "global_devices": len(jax.devices()),
+        "payload": lc.payload,
+    }
+    cache_dir = Path(lc.payload["cache_dir"])
+    (cache_dir / f"rank_{lc.global_rank}.json").write_text(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

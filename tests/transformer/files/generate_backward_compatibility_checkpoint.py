@@ -49,11 +49,11 @@ def main() -> None:
             builder.add(np.append(doc, 0).astype(np.uint16))
 
     gen = make_config(
-        OUT, data_prefix, train_iterations=3, save_interval=3,
+        OUT, data_prefix, train_iterations=3, save_interval=100,
     )
 
     trainer = build_capturing_trainer(gen)
-    pre_losses = train_capture(trainer, 3)
+    pre_losses = train_capture(trainer, 3)  # save_interval 100: no auto-save
     step_dir = trainer.save_checkpoint()
     # de-absolutize the paths baked into the checkpoint's config.yml so the
     # committed fixture is machine-independent (regeneration diffs cleanly)
@@ -74,13 +74,11 @@ def main() -> None:
     rtrainer = build_capturing_trainer(resume, load=True)
     resumed_losses = train_capture(rtrainer, 2)
 
+    # only resumed_losses are asserted (a fresh-train determinism pin would
+    # break on benign jax-version numeric drift); pretrain goes to stdout
     (OUT / "ground_truth.json").write_text(
         json.dumps(
-            {
-                "pretrain_losses": [float(x) for x in pre_losses],
-                "resumed_losses": [float(x) for x in resumed_losses],
-            },
-            indent=2,
+            {"resumed_losses": [float(x) for x in resumed_losses]}, indent=2
         )
     )
     print("pretrain:", pre_losses)

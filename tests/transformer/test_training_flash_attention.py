@@ -25,7 +25,7 @@ def data_prefix(tmp_path_factory):
 def _config(tmp_path, data_prefix, kernel):
     # flash needs seq % 128 == 0 and head_dim >= 64
     return make_config(
-        tmp_path, data_prefix, train_iterations=4, save_interval=100,
+        tmp_path, data_prefix, train_iterations=6, save_interval=100,
         hidden_size=128, num_attention_heads=2, attention_num_kv_heads=1,
         sequence_length=128, attention_qkv_in_one=False,
         masked_softmax={"kernel": kernel},
@@ -38,10 +38,13 @@ def test_flash_training_matches_xla(tmp_path, data_prefix, devices):
         cfg = _config(tmp_path / kernel, data_prefix, kernel)
         with force_flash_interpret():
             trainer = build_capturing_trainer(cfg)
-            losses[kernel] = train_capture(trainer, 4)
+            losses[kernel] = train_capture(trainer, 6)
     np.testing.assert_allclose(
         np.asarray(losses["torch"], np.float32),
         np.asarray(losses["flash_attention"], np.float32),
         rtol=2e-3, atol=2e-3,
     )
-    assert np.isfinite(losses["flash_attention"]).all()
+    fl = np.asarray(losses["flash_attention"], np.float32)
+    assert np.isfinite(fl).all()
+    # training makes progress (de-flaked: early steps can tick up briefly)
+    assert fl[-2:].mean() < fl[0]
