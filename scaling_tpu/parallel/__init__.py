@@ -9,6 +9,7 @@ from .pipeline_schedule import (
     PipelineScheduleInference,
     PipelineScheduleTrain,
     SimulationEngine,
+    visualize,
 )
 from .sharding import (
     constrain,
@@ -30,6 +31,7 @@ __all__ = [
     "PipelineScheduleInference",
     "PipelineScheduleTrain",
     "SimulationEngine",
+    "visualize",
     "constrain",
     "shard_activation_replicated_h",
     "shard_activation_sp",

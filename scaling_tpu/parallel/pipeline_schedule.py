@@ -324,3 +324,74 @@ def illustrate(
     idle = ", ".join(f"{i:.0%}" for i in result["idle_fraction"])
     lines.append(f"total {result['total_time']:.2f}s  idle per rank: {idle}")
     return "\n".join(lines)
+
+
+def visualize(
+    pipe_parallel_size: int,
+    gradient_accumulation_steps: int,
+    output_path,
+    schedule_cls=PipelineScheduleTrain,
+    durations: Optional[Dict[str, float]] = None,
+) -> None:
+    """Render the simulated schedule as a PNG Gantt timeline — one lane per
+    pipe rank, forward/backward/comm blocks colored and labeled with their
+    micro-batch id (reference: pipeline_schedule/base.py:276-690 renders the
+    same view with matplotlib)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    from matplotlib.patches import Patch
+
+    sim = SimulationEngine(
+        pipe_parallel_size=pipe_parallel_size,
+        gradient_accumulation_steps=gradient_accumulation_steps,
+        durations=durations or {},
+    )
+    result = sim.simulate(schedule_cls)
+
+    colors = {
+        "forward_pass": "#4878cf",
+        "backward_pass": "#d65f5f",
+        "optimizer_step": "#6acc65",
+        "loss": "#956cb4",
+        "send_activation": "#c4ad66",
+        "recv_activation": "#c4ad66",
+        "send_grad": "#77bedb",
+        "recv_grad": "#77bedb",
+        "load_micro_batch": "#bbbbbb",
+        "store_micro_batch": "#bbbbbb",
+        "reduce_tied_grads": "#8c613c",
+    }
+    fig, ax = plt.subplots(
+        figsize=(12, 0.8 * pipe_parallel_size + 1.5), constrained_layout=True
+    )
+    for ev in result["timeline"]:
+        color = colors.get(ev["name"], "#dddddd")
+        ax.barh(
+            ev["rank"], ev["end"] - ev["start"], left=ev["start"], height=0.7,
+            color=color, edgecolor="white", linewidth=0.3,
+        )
+        if ev["name"] in ("forward_pass", "backward_pass") and ev["micro_batch"] is not None:
+            ax.text(
+                (ev["start"] + ev["end"]) / 2, ev["rank"], str(ev["micro_batch"]),
+                ha="center", va="center", fontsize=7, color="white",
+            )
+    ax.set_yticks(range(pipe_parallel_size))
+    ax.set_yticklabels([f"rank {r}" for r in range(pipe_parallel_size)])
+    ax.invert_yaxis()
+    ax.set_xlabel("time (s, simulated)")
+    idle = ", ".join(f"{i:.0%}" for i in result["idle_fraction"])
+    ax.set_title(
+        f"{schedule_cls.__name__}  pp={pipe_parallel_size} "
+        f"gas={gradient_accumulation_steps}  total {result['total_time']:.2f}s  "
+        f"idle: {idle}"
+    )
+    shown = {n: c for n, c in colors.items()
+             if any(ev["name"] == n for ev in result["timeline"])}
+    ax.legend(
+        handles=[Patch(color=c, label=n) for n, c in shown.items()],
+        loc="upper right", fontsize=7, ncol=2,
+    )
+    fig.savefig(output_path, dpi=120)
+    plt.close(fig)

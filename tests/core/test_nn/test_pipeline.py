@@ -220,3 +220,15 @@ def test_illustrate_renders():
     text = illustrate(4, 8, width=60)
     assert "rank 0" in text and "rank 3" in text and "idle per rank" in text
     assert "F" in text and "B" in text
+
+
+def test_visualize_renders_png(tmp_path):
+    """PNG Gantt parity with the reference's schedule visualizer
+    (reference: pipeline_schedule/base.py:276-690)."""
+    from scaling_tpu.parallel import visualize
+
+    out = tmp_path / "schedule.png"
+    visualize(4, 8, out)
+    data = out.read_bytes()
+    assert data[:8] == b"\x89PNG\r\n\x1a\n"
+    assert len(data) > 5000
