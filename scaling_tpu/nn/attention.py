@@ -329,11 +329,12 @@ class ParallelSelfAttention(BaseLayer):
             )
             return self._project_out(params, out, ctx, b, s, new_kv)
 
-        k = repeat_kv(k, self.num_repeat_kv)
-        v = repeat_kv(v, self.num_repeat_kv)
         if ctx.context_parallel_size > 1 and kv_cache is None:
             # ring attention: sequence sharded over the context mesh axis,
-            # K/V blocks rotate over ICI (ops/ring_attention.py)
+            # K/V blocks rotate over ICI (ops/ring_attention.py). The ring is
+            # GQA-native — rotating unrepeated KV cuts ICI traffic by the
+            # group factor — but kv heads must still shard over the model
+            # axis; repeat only as far as divisibility requires.
             assert attention_scores_manipulation is None, (
                 "attention_scores_manipulation is unsupported under context "
                 "parallelism"
@@ -341,12 +342,35 @@ class ParallelSelfAttention(BaseLayer):
             assert n_local == 0, "local-window heads are unsupported under CP"
             assert dropout_fn is None, "attention-prob dropout unsupported under CP"
             from ..ops.ring_attention import ring_attention
+            from ..topology.topology import MODEL_AXIS
 
+            mp = (
+                ctx.mesh.shape[MODEL_AXIS]
+                if ctx.mesh is not None and MODEL_AXIS in ctx.mesh.axis_names
+                else 1
+            )
+            kr, vr = k, v
+            n_kv = k.shape[2]
+            if n_kv % mp != 0:
+                # kv heads must shard over the model axis: repeat only as far
+                # as divisibility requires (full repeat would forfeit the
+                # whole GQA ICI saving). repeat_kv's consecutive copies stay
+                # aligned with the ring's grouped-head reshape.
+                import math
+
+                rep = mp // math.gcd(n_kv, mp)
+                if self.num_repeat_kv % rep != 0:
+                    rep = self.num_repeat_kv  # fallback: full repeat
+                kr = repeat_kv(k, rep)
+                vr = repeat_kv(v, rep)
             out = ring_attention(
-                q, k, v, segment_ids, ctx.mesh,
+                q, kr, vr, segment_ids, ctx.mesh,
                 causal=self.causal, sm_scale=self.scaling_factor,
             )
             return self._project_out(params, out, ctx, b, s, new_kv)
+
+        k = repeat_kv(k, self.num_repeat_kv)
+        v = repeat_kv(v, self.num_repeat_kv)
 
         if n_local > 0 and kv_cache is None:
             # mixed local/global heads: first (n - n_local) heads global,

@@ -37,7 +37,7 @@ _NEG = -1e9
 
 def _ring_attention_local(
     q: jax.Array,  # (b, s_loc, n_loc, d) — this device's shards
-    k: jax.Array,
+    k: jax.Array,  # (b, s_loc, n_kv_loc, d) — UNREPEATED kv heads (GQA)
     v: jax.Array,
     seg: jax.Array,  # (b, s_loc) int32 packed-doc ids
     *,
@@ -48,11 +48,14 @@ def _ring_attention_local(
     ring = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, s_loc, n, d = q.shape
+    n_kv = k.shape[2]
+    g = n // n_kv  # query heads per kv head; rotating unrepeated K/V keeps
+    # the ring's ICI traffic at 1/g of the repeated layout
 
     # absolute sequence indices of this device's queries
     q_pos = my_idx * s_loc + jnp.arange(s_loc)  # (s_loc,)
 
-    qf = q.astype(jnp.float32) * sm_scale
+    qf = q.astype(jnp.float32).reshape(b, s_loc, n_kv, g, d) * sm_scale
 
     def block_scores_mask(k_owner, seg_k):
         k_pos = k_owner * s_loc + jnp.arange(s_loc)
@@ -63,18 +66,19 @@ def _ring_attention_local(
 
     def step(carry, _):
         m, l, acc, k_blk, v_blk, seg_blk, owner = carry
-        s = jnp.einsum("bqnd,bknd->bnqk", qf, k_blk.astype(jnp.float32))
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qf, k_blk.astype(jnp.float32))
         allowed = block_scores_mask(owner, seg_blk)  # (b, sq, sk)
-        s = jnp.where(allowed[:, None, :, :], s, _NEG)
-        m_new = jnp.maximum(m, s.max(axis=-1))  # (b, n, sq)
+        masked = allowed[:, None, None, :, :]
+        s = jnp.where(masked, s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1))  # (b, h, g, sq)
         # explicit zeroing: for a fully-masked block s == m_new == _NEG and
         # exp(0) would be 1 — the mask, not the exp, must kill those terms
-        p = jnp.exp(s - m_new[..., None]) * allowed[:, None, :, :]
+        p = jnp.exp(s - m_new[..., None]) * masked
         correction = jnp.exp(m - m_new)
         l_new = l * correction + p.sum(axis=-1)
         acc_new = (
-            acc * correction.transpose(0, 2, 1)[..., None]
-            + jnp.einsum("bnqk,bknd->bqnd", p, v_blk.astype(jnp.float32))
+            acc * jnp.moveaxis(correction, 3, 1)[..., None]
+            + jnp.einsum("bhgqk,bkhd->bqhgd", p, v_blk.astype(jnp.float32))
         )
         # rotate the K/V block to the next ring neighbour
         perm = [(i, (i + 1) % ring) for i in range(ring)]
@@ -84,15 +88,15 @@ def _ring_attention_local(
         owner = jax.lax.ppermute(owner, axis_name, perm)
         return (m_new, l_new, acc_new, k_blk, v_blk, seg_blk, owner), None
 
-    m0 = jnp.full((b, n, s_loc), _NEG, jnp.float32)
-    l0 = jnp.zeros((b, n, s_loc), jnp.float32)
-    acc0 = jnp.zeros((b, s_loc, n, d), jnp.float32)
+    m0 = jnp.full((b, n_kv, g, s_loc), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, n_kv, g, s_loc), jnp.float32)
+    acc0 = jnp.zeros((b, s_loc, n_kv, g, d), jnp.float32)
     carry = (m0, l0, acc0, k, v, seg, my_idx)
     (m, l, acc, *_), _ = jax.lax.scan(
         jax.checkpoint(step), carry, None, length=ring
     )
-    out = acc / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    out = acc / jnp.maximum(jnp.moveaxis(l, 3, 1), 1e-20)[..., None]
+    return out.reshape(b, s_loc, n, d).astype(q.dtype)
 
 
 def ring_attention(

@@ -23,7 +23,38 @@ try:
 except Exception:
     pass
 
+# persistent XLA compilation cache: the suite is compile-dominated on a
+# small host, and repeat runs (CI, local loops) hit the cache instead
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("SCALING_TPU_TEST_CACHE", "/tmp/scaling_tpu_test_jaxcache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+try:
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+except Exception:
+    pass
+
 import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="also run tests marked slow (full parity grids)",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    """Default runs finish fast; the slow tier holds redundant grid entries
+    and extra-heavy parity runs (every capability keeps at least one fast
+    representative). Enable with --runslow."""
+    if config.getoption("--runslow"):
+        return
+    skip = pytest.mark.skip(reason="slow tier: pass --runslow to include")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
 
 
 @pytest.fixture(scope="session")

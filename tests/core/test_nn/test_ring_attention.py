@@ -81,3 +81,81 @@ def test_ring_gradients_match(cp_topology):
         np.testing.assert_allclose(
             np.asarray(gr), np.asarray(gf), atol=5e-5, rtol=5e-5, err_msg=name
         )
+
+
+def test_ring_gqa_unrepeated_kv(cp_topology):
+    """The ring rotates UNREPEATED kv heads (1/group ICI traffic) and matches
+    the repeat-kv single-device reference."""
+    from scaling_tpu.nn.attention import repeat_kv
+
+    n, n_kv = 4, 2
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (B, S, n, D), jnp.float32) * 0.5
+    k = jax.random.normal(ks[1], (B, S, n_kv, D), jnp.float32) * 0.5
+    v = jax.random.normal(ks[2], (B, S, n_kv, D), jnp.float32) * 0.5
+    seg = jnp.asarray(
+        np.concatenate([np.zeros((B, 20)), np.ones((B, 12))], axis=1), jnp.int32
+    )
+    ref = xla_reference(q, repeat_kv(k, 2), repeat_kv(v, 2), seg, causal=True)
+    out = jax.jit(
+        lambda q, k, v, s: ring_attention(
+            q, k, v, s, cp_topology.mesh, causal=True, sm_scale=1.0 / np.sqrt(D)
+        )
+    )(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def loss_ring(q, k, v):
+        o = ring_attention(q, k, v, seg, cp_topology.mesh, causal=True,
+                           sm_scale=1.0 / np.sqrt(D))
+        return (o * o).sum()
+
+    def loss_ref(q, k, v):
+        o = xla_reference(q, repeat_kv(k, 2), repeat_kv(v, 2), seg, causal=True)
+        return (o * o).sum()
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for gr, gf, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gr), np.asarray(gf), atol=5e-4, rtol=5e-4, err_msg=name
+        )
+
+
+@pytest.fixture(scope="module")
+def cp_mp_topology(devices):
+    return Topology(
+        TopologyConfig.from_dict(
+            {
+                "model_parallel_size": 2,
+                "pipe_parallel_size": 1,
+                "data_parallel_size": 2,
+                "context_parallel_size": 2,
+                "micro_batch_size": 1,
+                "gradient_accumulation_steps": 1,
+            }
+        )
+    )
+
+
+@pytest.mark.parametrize("n_kv", [2, 4], ids=["gqa_kv2", "mha"])
+def test_ring_gqa_under_model_parallel(cp_mp_topology, n_kv):
+    """mp=2 x cp=2: kv heads shard over the model axis AND rotate the ring —
+    the head-group/shard alignment regime the single-axis tests miss."""
+    from scaling_tpu.nn.attention import repeat_kv
+
+    n = 4
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (B, S, n, D), jnp.float32) * 0.5
+    k = jax.random.normal(ks[1], (B, S, n_kv, D), jnp.float32) * 0.5
+    v = jax.random.normal(ks[2], (B, S, n_kv, D), jnp.float32) * 0.5
+    seg = jnp.asarray(
+        np.concatenate([np.zeros((B, 20)), np.ones((B, 12))], axis=1), jnp.int32
+    )
+    rep = n // n_kv
+    ref = xla_reference(q, repeat_kv(k, rep), repeat_kv(v, rep), seg, causal=True)
+    out = jax.jit(
+        lambda q, k, v, s: ring_attention(
+            q, k, v, s, cp_mp_topology.mesh, causal=True, sm_scale=1.0 / np.sqrt(D)
+        )
+    )(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)

@@ -93,7 +93,7 @@ def run_steps(trainer, n):
 
 @pytest.mark.parametrize("dp,gas,zero,loss_scaler", [
     (1, 1, False, False),
-    (2, 2, False, False),
+    pytest.param(2, 2, False, False, marks=pytest.mark.slow),
     (2, 1, True, False),
     (1, 1, False, True),
 ])
@@ -112,6 +112,7 @@ def test_checkpoint_resume_loss_exactness(tmp_path, devices, dp, gas, zero, loss
     np.testing.assert_array_equal(np.asarray(losses[6:]), np.asarray(resumed_losses))
 
 
+@pytest.mark.slow
 def test_training_descends_across_dp_layouts(tmp_path, devices):
     """Both dp=1 and dp=2 layouts train successfully (data order differs
     between layouts by design — DP striding — so curves aren't comparable
@@ -125,6 +126,7 @@ def test_training_descends_across_dp_layouts(tmp_path, devices):
     assert l2[0] > l2[-1]
 
 
+@pytest.mark.slow
 def test_zero_matches_nonzero_losses(tmp_path, devices):
     cfg_a = make_config(tmp_path / "a", dp=2, zero=False, train_iterations=5)
     cfg_b = make_config(tmp_path / "b", dp=2, zero=True, train_iterations=5)
@@ -151,6 +153,7 @@ def test_checkpoint_layout(tmp_path, devices):
     assert list(step_dir.glob("optimizer_state_layer_*.npz"))
 
 
+@pytest.mark.slow
 def test_async_checkpoint_resume_matches_sync(tmp_path, devices):
     """save_checkpoint_async produces byte-equivalent checkpoints: resume
     from an async save reproduces the sync-save training trajectory."""

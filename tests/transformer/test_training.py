@@ -77,11 +77,12 @@ def test_main_entry_runs(tmp_path, data_prefix):
     "topo,arch",
     [
         ((1, 1, 1), {}),
-        ((2, 1, 1), {}),
-        ((1, 2, 2), {}),
-        ((2, 2, 1), {"weight_tying": True}),
-        ((1, 1, 1), {"mlp_type": "swiglu", "mlp_factor": 2.0, "norm_type": "rms",
-                     "weight_tying": True}),
+        pytest.param((2, 1, 1), {}, marks=pytest.mark.slow),
+        pytest.param((1, 2, 2), {}, marks=pytest.mark.slow),
+        pytest.param((2, 2, 1), {"weight_tying": True}, marks=pytest.mark.slow),
+        pytest.param((1, 1, 1), {"mlp_type": "swiglu", "mlp_factor": 2.0,
+                                 "norm_type": "rms", "weight_tying": True},
+                     marks=pytest.mark.slow),
     ],
     ids=["1x1", "mp2", "dp2_gas2", "mp2dp2_tied", "swiglu_tied"],
 )

@@ -57,6 +57,7 @@ def test_pp2_loss_close_to_pp1(tmp_path, data_prefix):
     )
 
 
+@pytest.mark.slow
 def test_pp2_resume_loss_exact(tmp_path, data_prefix):
     """pp=2 train 10 save at 6, resume at pp=2: steps 7-10 match exactly."""
     cfg = make_pp_config(tmp_path, data_prefix, pp=2, gas=4)
@@ -76,7 +77,12 @@ def test_pp2_resume_loss_exact(tmp_path, data_prefix):
     )
 
 
-@pytest.mark.parametrize("save_pp,load_pp", [(2, 1), (1, 2), (2, 4)])
+@pytest.mark.parametrize(
+    "save_pp,load_pp",
+    [pytest.param(2, 1, marks=pytest.mark.slow),
+     pytest.param(1, 2, marks=pytest.mark.slow),
+     pytest.param(2, 4, marks=pytest.mark.slow)],
+)
 def test_checkpoint_interchanges_across_pipe_layouts(
     tmp_path, data_prefix, save_pp, load_pp
 ):
