@@ -42,13 +42,28 @@ def sample_argmax(logits: jax.Array, key: Optional[jax.Array] = None) -> jax.Arr
 
 
 def make_sampler(
-    temperature: float = 1.0, top_k: Optional[int] = None
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
 ) -> Callable[[jax.Array, jax.Array], jax.Array]:
+    """Temperature / top-k / top-p (nucleus) sampling, composable like the
+    reference's transform chain (reference: inference/sample.py:17-45)."""
+
     def sample(logits: jax.Array, key: jax.Array) -> jax.Array:
         scaled = logits.astype(jnp.float32) / max(temperature, 1e-6)
         if top_k is not None:
             kth = jnp.sort(scaled, axis=-1)[..., -top_k][..., None]
             scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+        if top_p is not None:
+            # keep the smallest prefix of descending-prob tokens whose
+            # cumulative mass reaches top_p (always keeping the best token)
+            sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep_sorted = cum - probs < top_p
+            kept = jnp.sum(keep_sorted, axis=-1, keepdims=True)
+            cutoff = jnp.take_along_axis(sorted_logits, kept - 1, axis=-1)
+            scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
         return jax.random.categorical(key, scaled, axis=-1)
 
     return sample
@@ -228,9 +243,15 @@ class TransformerInferenceModule:
         sample_fn: Optional[Callable] = None,
         use_cache: bool = True,
         eos_token_id: Optional[int] = None,
+        stop_tokens: Optional[List[int]] = None,
         seed: int = 0,
     ) -> CompletionOutput:
-        """Autoregressive decode (reference: inference_model.py:195-263)."""
+        """Autoregressive decode (reference: inference_model.py:195-263).
+
+        Stops at ``eos_token_id`` or any of ``stop_tokens`` (reference's
+        ``stop_tokens`` sequence); per-step logits for the emitted tokens
+        come back in ``CompletionOutput.logits`` like the reference's
+        ``completion_logits``."""
         if isinstance(input_ids, str):
             assert self.tokenizer is not None, "text prompt needs a tokenizer"
             input_ids = self.tokenizer.encode(input_ids)
@@ -241,35 +262,49 @@ class TransformerInferenceModule:
         assert b == 1, "generate supports batch size 1 (reference: attention.py:491)"
         if eos_token_id is None and self.tokenizer is not None:
             eos_token_id = self.tokenizer.eos_token_id
+        stop = set(stop_tokens or [])
+        if eos_token_id is not None:
+            stop.add(int(eos_token_id))
         sample = sample_fn or sample_argmax
         key = jax.random.PRNGKey(seed)
+        out_logits: List[jax.Array] = []
 
         if use_cache:
             max_len = prompt_len + max_tokens
             logits, caches = self._prefill(prompt, max_len)
             next_tok = sample(logits[:, -1], key)
             out_tokens = [int(next_tok[0])]
+            out_logits.append(logits[:, -1])
 
-            if self._decode_fn is None or self._decode_len != max_len:
+            # the jitted decode closure bakes in the sampler: invalidate on
+            # either a new length or a different sample_fn, or a later call
+            # with the default sampler would silently reuse a stale one
+            if (
+                self._decode_fn is None
+                or self._decode_len != max_len
+                or getattr(self, "_decode_sampler", None) is not sample
+            ):
                 def decode(params, caches, tok, offset, k):
                     pos = jnp.broadcast_to(offset[None, None], (1, 1))
                     batch = self._make_batch(tok[:, None], pos)
                     logits, new_caches = self._run_layers(params, batch, caches, offset)
                     nxt = sample(logits[:, -1], k)
-                    return nxt, new_caches
+                    return nxt, logits[:, -1], new_caches
 
                 self._decode_fn = jax.jit(decode)
                 self._decode_len = max_len
+                self._decode_sampler = sample
 
             tok = next_tok
             for t in range(1, max_tokens):
-                if eos_token_id is not None and out_tokens[-1] == eos_token_id:
+                if out_tokens[-1] in stop:
                     break
                 key, sub = jax.random.split(key)
-                tok, caches = self._decode_fn(
+                tok, step_logits, caches = self._decode_fn(
                     self.params, caches, tok, jnp.asarray(prompt_len + t - 1, jnp.int32), sub
                 )
                 out_tokens.append(int(tok[0]))
+                out_logits.append(step_logits)
         else:
             # refeed the whole (fixed-size) buffer each step: one compile
             max_len = prompt_len + max_tokens
@@ -286,10 +321,15 @@ class TransformerInferenceModule:
                 key, sub = jax.random.split(key)
                 nxt = sample(logits[:, cur - 1], sub)
                 out_tokens.append(int(nxt[0]))
-                if eos_token_id is not None and out_tokens[-1] == eos_token_id:
+                out_logits.append(logits[:, cur - 1])
+                if out_tokens[-1] in stop:
                     break
                 buf = jax.lax.dynamic_update_slice(buf, nxt[:, None].astype(jnp.int32), (0, cur))
                 cur += 1
 
         text = self.tokenizer.decode(out_tokens) if self.tokenizer else None
-        return CompletionOutput(completion_ids=out_tokens, completion=text)
+        return CompletionOutput(
+            completion_ids=out_tokens,
+            completion=text,
+            logits=jnp.concatenate(out_logits, axis=0) if out_logits else None,
+        )

@@ -32,15 +32,46 @@ class Tokenizer:
 
         return cls(HFTokenizer.from_file(str(vocab_file)))
 
+    @classmethod
+    def from_str(cls, json_str: str) -> "Tokenizer":
+        """Build from a serialized tokenizer json (reference: tokenizer.py:28)."""
+        from tokenizers import Tokenizer as HFTokenizer
+
+        return cls(HFTokenizer.from_str(json_str))
+
+    @classmethod
+    def default(cls) -> "Tokenizer":
+        """A functional byte-level fallback tokenizer (256 byte tokens +
+        ``<|endoftext|>``). The reference ships a llama2 tokenizer json for
+        this (tokenizer.py:33-38); building one programmatically avoids
+        bundling a model asset while keeping ``default()`` usable."""
+        from tokenizers import Tokenizer as HFTokenizer
+        from tokenizers.decoders import ByteLevel as ByteLevelDecoder
+        from tokenizers.models import BPE
+        from tokenizers.pre_tokenizers import ByteLevel
+
+        alphabet = ByteLevel.alphabet()
+        vocab = {ch: i for i, ch in enumerate(sorted(alphabet))}
+        vocab["<|endoftext|>"] = len(vocab)
+        tok = HFTokenizer(BPE(vocab, merges=[]))
+        tok.pre_tokenizer = ByteLevel(add_prefix_space=False)
+        tok.decoder = ByteLevelDecoder()
+        return cls(tok)
+
+    def __len__(self) -> int:
+        return self.tokenizer.get_vocab_size()
+
     @property
     def vocab_size(self) -> int:
         return self.tokenizer.get_vocab_size()
 
-    def encode(self, text: str) -> List[int]:
-        return self.tokenizer.encode(text, add_special_tokens=False).ids
+    def encode(self, text: str, add_special_tokens: bool = False) -> List[int]:
+        return self.tokenizer.encode(text, add_special_tokens=add_special_tokens).ids
 
-    def decode(self, token_ids: List[int]) -> str:
-        return self.tokenizer.decode(list(token_ids), skip_special_tokens=False)
+    def decode(self, token_ids: List[int], skip_special_tokens: bool = False) -> str:
+        return self.tokenizer.decode(
+            list(token_ids), skip_special_tokens=skip_special_tokens
+        )
 
     def token_to_id(self, token: str) -> Optional[int]:
         return self.tokenizer.token_to_id(token)
@@ -53,17 +84,27 @@ def load_tokenizers(vocab_file: Path | str) -> Tuple[Tokenizer, Tokenizer]:
 
     data = json.loads(Path(vocab_file).read_text())
     changed = False
+
+    def strip_prefix(entry: dict) -> bool:
+        if entry.get("type") != "Metaspace":
+            return False
+        touched = False
+        # modern tokenizers serialize prepend_scheme; legacy files carry
+        # add_prefix_space — the two must stay consistent or from_str rejects
+        if entry.get("prepend_scheme", "always") != "never":
+            entry["prepend_scheme"] = "never"
+            touched = True
+        if entry.get("add_prefix_space", True):
+            entry["add_prefix_space"] = False
+            touched = True
+        return touched
+
     decoder = data.get("decoder") or {}
     for entry in decoder.get("decoders", []) if decoder else []:
-        if entry.get("type") == "Metaspace" and entry.get("add_prefix_space", True):
-            entry["add_prefix_space"] = False
-            changed = True
+        changed |= strip_prefix(entry)
     pre = data.get("pre_tokenizer") or {}
-    candidates = [pre] + list(pre.get("pretokenizers", []) or [])
-    for entry in candidates:
-        if entry.get("type") == "Metaspace" and entry.get("add_prefix_space", True):
-            entry["add_prefix_space"] = False
-            changed = True
+    for entry in [pre] + list(pre.get("pretokenizers", []) or []):
+        changed |= strip_prefix(entry)
 
     if changed:
         from tokenizers import Tokenizer as HFTokenizer

@@ -159,3 +159,52 @@ def test_ring_gqa_under_model_parallel(cp_mp_topology, n_kv):
         )
     )(q, k, v, seg)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_gqa_partial_repeat_under_mp4(devices):
+    """n_kv=2, mp=4: kv heads repeat only to 4 (mp // gcd), not to the full
+    8 query heads — the partial-repeat alignment path in attention.py's CP
+    branch, exercised end-to-end through ParallelSelfAttention."""
+    from scaling_tpu.nn.attention import ParallelSelfAttention, repeat_kv
+    from scaling_tpu.nn.base_layer import ForwardContext
+    from scaling_tpu.nn.masked_softmax import MaskedSoftmaxConfig
+
+    topo = Topology(
+        TopologyConfig.from_dict(
+            {
+                "model_parallel_size": 4,
+                "pipe_parallel_size": 1,
+                "data_parallel_size": 1,
+                "context_parallel_size": 2,
+                "micro_batch_size": 1,
+                "gradient_accumulation_steps": 1,
+            }
+        )
+    )
+    n, n_kv, d, hidden = 8, 2, 8, 64
+    attn = ParallelSelfAttention(
+        hidden_size=hidden,
+        num_attention_heads=n,
+        masked_softmax_config=MaskedSoftmaxConfig(),
+        causal=True,
+        qkv_in_one=False,
+        num_kv_heads=n_kv,
+        bias=False,
+        relative_position_embedding_type="none",
+    )
+    params = attn.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, S, hidden), jnp.float32) * 0.2
+    seg = jnp.zeros((2, S), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(S)[None], (2, S))
+
+    ctx_cp = ForwardContext(
+        mesh=topo.mesh, context_parallel_size=2, deterministic=True
+    )
+    ctx_single = ForwardContext(deterministic=True)
+    out_cp = jax.jit(
+        lambda p, x: attn(p, x, ctx_cp, segment_ids=seg, position_ids=pos)
+    )(params, x)
+    out_ref = attn(params, x, ctx_single, segment_ids=seg, position_ids=pos)
+    np.testing.assert_allclose(
+        np.asarray(out_cp), np.asarray(out_ref), atol=3e-5, rtol=3e-5
+    )

@@ -68,3 +68,40 @@ def test_hidden_states_recorder(checkpoint_dir):
     assert len(some) == 2
     h = list(rec.values())[0]
     assert h.shape[:2] == (1, 3)
+
+
+def test_top_p_sampler_masks_tail():
+    """Nucleus sampling keeps the smallest head of the distribution whose
+    mass reaches top_p (reference: inference/sample.py:30-45)."""
+    import jax
+    import jax.numpy as jnp
+
+    from scaling_tpu.models.transformer.inference import make_sampler
+
+    # probs ~ [0.6, 0.3, 0.08, 0.02]: top_p=0.8 keeps exactly two tokens
+    logits = jnp.log(jnp.asarray([[0.6, 0.3, 0.08, 0.02]]))
+    sampler = make_sampler(temperature=1.0, top_p=0.8)
+    seen = {
+        int(sampler(logits, jax.random.PRNGKey(i))[0]) for i in range(64)
+    }
+    assert seen <= {0, 1}, seen
+    assert seen == {0, 1}  # both head tokens are reachable
+
+    # top_p=0.5 keeps only the argmax
+    sampler = make_sampler(temperature=1.0, top_p=0.5)
+    seen = {int(sampler(logits, jax.random.PRNGKey(i))[0]) for i in range(32)}
+    assert seen == {0}
+
+
+def test_generate_stop_tokens_and_logits(checkpoint_dir):
+    """stop_tokens halt decoding like the reference's sequence form, and
+    per-step logits ride along (reference: CompletionOutput.completion_logits)."""
+    mod = TransformerInferenceModule.from_checkpoint(checkpoint_dir)
+    out = mod.generate([3, 5, 7], max_tokens=8, use_cache=True)
+    assert out.logits is not None
+    assert out.logits.shape == (len(out.completion_ids), mod.architecture.vocab_size)
+    # force an immediate stop on whatever token greedy decoding picks first
+    first = out.completion_ids[0]
+    out2 = mod.generate([3, 5, 7], max_tokens=8, stop_tokens=[first], use_cache=True)
+    assert out2.completion_ids[0] == first
+    assert len(out2.completion_ids) == 1

@@ -1,0 +1,55 @@
+"""Tokenizer wrapper: eos detection, pair loading, default fallback
+(reference: src/scaling/transformer/tokenizer/tokenizer.py)."""
+
+import json
+
+import pytest
+
+from scaling_tpu.models.transformer.tokenizer import Tokenizer, load_tokenizers
+
+
+def test_default_tokenizer_round_trips():
+    tok = Tokenizer.default()
+    ids = tok.encode("hello TPU world")
+    assert tok.decode(ids) == "hello TPU world"
+    assert tok.eos_token_id is not None
+    assert len(tok) == tok.vocab_size == 257
+
+
+def test_from_str_matches_from_file(tmp_path):
+    tok = Tokenizer.default()
+    serialized = tok.tokenizer.to_str()
+    again = Tokenizer.from_str(serialized)
+    assert again.encode("abc") == tok.encode("abc")
+    assert again.eos_token_id == tok.eos_token_id
+
+
+def test_eos_detection_variants(tmp_path):
+    from tokenizers import Tokenizer as HFTokenizer
+    from tokenizers.models import WordLevel
+
+    vocab = {"</s>": 0, "<unk>": 1, "x": 2}
+    tok = HFTokenizer(WordLevel(vocab, unk_token="<unk>"))
+    path = tmp_path / "v.json"
+    tok.save(str(path))
+    wrapped = Tokenizer.from_file(path)
+    assert wrapped.eos_token == "</s>"
+    assert wrapped.eos_token_id == 0
+
+
+def test_pair_loader_strips_prefix_space(tmp_path):
+    """Metaspace tokenizers get the no-prefix-space variant for chat
+    concatenation (reference: tokenizer.py:64-103)."""
+    from tokenizers import Tokenizer as HFTokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Metaspace
+
+    vocab = {"▁hi": 0, "hi": 1, "<unk>": 2, "<|endoftext|>": 3}
+    tok = HFTokenizer(WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = Metaspace()
+    path = tmp_path / "m.json"
+    tok.save(str(path))
+
+    normal, no_prefix = load_tokenizers(path)
+    assert normal.encode("hi") == [0]  # leading metaspace applied
+    assert no_prefix.encode("hi") == [1]  # mid-sentence continuation form

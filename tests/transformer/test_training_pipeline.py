@@ -79,8 +79,7 @@ def test_pp2_resume_loss_exact(tmp_path, data_prefix):
 
 @pytest.mark.parametrize(
     "save_pp,load_pp",
-    [pytest.param(2, 1, marks=pytest.mark.slow),
-     pytest.param(1, 2, marks=pytest.mark.slow),
+    [(2, 1), pytest.param(1, 2, marks=pytest.mark.slow),
      pytest.param(2, 4, marks=pytest.mark.slow)],
 )
 def test_checkpoint_interchanges_across_pipe_layouts(
