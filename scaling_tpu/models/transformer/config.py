@@ -45,6 +45,10 @@ class Precision(Enum):
 class MLPType(Enum):
     DEFAULT = "default"
     SWIGLU = "swiglu"
+    # beyond the reference: routed mixture-of-experts FFN with expert
+    # parallelism over the data mesh axis (nn/moe.py; SURVEY §2.4 lists EP
+    # as absent upstream)
+    MOE = "moe"
 
 
 class RelativePositionEmbeddingType(Enum):
@@ -136,6 +140,17 @@ class TransformerArchitectureConfig(BaseConfig):
     mlp_type: MLPType = Field(MLPType.DEFAULT, description="")
     mlp_factor: float = Field(4.0, description="mlp intermediate = factor * hidden", gt=0)
     mlp_bias: bool = Field(True, description="add bias terms to the mlp projections")
+    moe_num_experts: int = Field(
+        8, description="expert count for mlp_type 'moe'", gt=0
+    )
+    moe_top_k: int = Field(2, description="experts routed per token", gt=0)
+    moe_capacity_factor: float = Field(
+        1.25, description="per-expert token buffer slack over the uniform share",
+        gt=0,
+    )
+    moe_aux_loss_coef: float = Field(
+        0.01, description="Switch-style load-balance loss coefficient", ge=0
+    )
     activation_function: ActivationFunction = Field(ActivationFunction.GELU, description="")
     precision: Precision = Field(Precision.FLOAT32, description="compute/param dtype")
     layernorm: LayerNormConfig = Field(LayerNormConfig(), description="")
@@ -179,6 +194,17 @@ class TransformerArchitectureConfig(BaseConfig):
     def _validate(self):
         if self.num_local_attention_heads > 0 and self.local_attention_window_size is None:
             raise ValueError("local attention heads require local_attention_window_size")
+        if self.mlp_type == MLPType.MOE:
+            if self.moe_top_k > self.moe_num_experts:
+                raise ValueError(
+                    f"moe_top_k ({self.moe_top_k}) cannot exceed "
+                    f"moe_num_experts ({self.moe_num_experts})"
+                )
+            if self.mlp_bias:
+                raise ValueError(
+                    "mlp_type 'moe' does not support mlp_bias; set it false "
+                    "(experts are GLU FFNs without bias)"
+                )
         return self
 
     @property

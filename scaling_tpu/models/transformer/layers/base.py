@@ -33,8 +33,9 @@ def make_layer_io(
     loss_weights: Optional[jax.Array] = None,
     embeddings: Optional[jax.Array] = None,
     attention_scores_manipulation: Optional[jax.Array] = None,
+    aux_loss: Optional[jax.Array] = None,
 ) -> Dict[str, Any]:
-    return {
+    io = {
         "activations": activations,
         "position_ids": position_ids,
         "segment_ids": segment_ids,
@@ -42,3 +43,8 @@ def make_layer_io(
         "embeddings": embeddings,
         "attention_scores_manipulation": attention_scores_manipulation,
     }
+    if aux_loss is not None:
+        # MoE router load-balance term, accumulated layer by layer; present
+        # only for MoE models so dense pytrees keep their shape
+        io["aux_loss"] = aux_loss
+    return io

@@ -122,10 +122,18 @@ class EmbeddingInput(BaseLayer):
         segment_ids = batch.get("segment_ids")
         if segment_ids is None:
             segment_ids = jnp.zeros((b, s), dtype=jnp.int32)
+        from ..config import MLPType
+
+        aux_loss = (
+            jnp.zeros((), jnp.float32)
+            if self.architecture.mlp_type == MLPType.MOE
+            else None
+        )
         return make_layer_io(
             activations=embeddings,
             position_ids=position_ids,
             segment_ids=segment_ids,
             loss_weights=batch.get("loss_weights"),
             attention_scores_manipulation=batch.get("attention_scores_manipulation"),
+            aux_loss=aux_loss,
         )

@@ -111,8 +111,23 @@ class TransformerLayer(BaseLayer):
         self.post_attention_layernorm = get_norm(
             arch.norm_type, arch.hidden_size, arch.layernorm, dtype, bitfit
         )
-        if arch.mlp_type == MLPType.SWIGLU:
-            self.mlp: BaseLayer = ParallelSwiGLUMLP(
+        self.is_moe = arch.mlp_type == MLPType.MOE
+        if self.is_moe:
+            from ....nn.moe import ParallelMoEMLP
+
+            self.mlp: BaseLayer = ParallelMoEMLP(
+                io_features=arch.hidden_size,
+                intermediate_feature_factor=arch.mlp_factor,
+                num_experts=arch.moe_num_experts,
+                top_k=arch.moe_top_k,
+                capacity_factor=arch.moe_capacity_factor,
+                aux_loss_coef=arch.moe_aux_loss_coef,
+                glu=True,
+                activation=arch.activation_function,
+                dtype=dtype,
+            )
+        elif arch.mlp_type == MLPType.SWIGLU:
+            self.mlp = ParallelSwiGLUMLP(
                 io_features=arch.hidden_size,
                 intermediate_feature_factor=arch.mlp_factor,
                 bias=arch.mlp_bias,
@@ -212,7 +227,11 @@ class TransformerLayer(BaseLayer):
         h = h + attn.astype(h.dtype)
 
         normed = self.post_attention_layernorm(params["post_attention_layernorm"], h, ctx)
-        mlp_out = self.mlp(params["mlp"], normed, ctx)
+        aux_loss = None
+        if self.is_moe:
+            mlp_out, aux_loss = self.mlp(params["mlp"], normed, ctx)
+        else:
+            mlp_out = self.mlp(params["mlp"], normed, ctx)
         mlp_out = ctx.dropout(mlp_out, arch.dropout_after_mlp)
         if self.adapter_mlp is not None:
             mlp_out = mlp_out + self.adapter_mlp(
@@ -222,6 +241,9 @@ class TransformerLayer(BaseLayer):
 
         out = dict(x)
         out["activations"] = h
+        if aux_loss is not None:
+            # router load-balance loss rides the IO dict to the loss function
+            out["aux_loss"] = x.get("aux_loss", 0.0) + aux_loss
         if new_kv is not None:
             return out, new_kv
         return out

@@ -101,7 +101,14 @@ def loss_function(output: Dict[str, Any], batch: Dict[str, Any]):
     loss = (token_loss * loss_weights).sum() / denom
     correct = (logits.argmax(-1) == targets).astype(jnp.float32)
     accuracy = (correct * loss_weights).sum() / denom
-    return loss, {"accuracy": accuracy}
+    metrics = {"accuracy": accuracy}
+    aux = output.get("aux_loss")
+    if aux is not None:
+        # MoE load-balance term (already coefficient-scaled by the layers)
+        aux = jnp.asarray(aux, jnp.float32).mean()
+        loss = loss + aux
+        metrics["moe_aux_loss"] = aux
+    return loss, metrics
 
 
 def metrics_aggregation_fn(metrics_list: List[Dict[str, Any]]) -> Dict[str, Any]:

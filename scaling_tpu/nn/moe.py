@@ -1,0 +1,175 @@
+"""Mixture-of-Experts MLP with expert parallelism (beyond the reference).
+
+The reference has no MoE (SURVEY §2.4: "EP absent; TPU build may treat as
+out of scope or future mesh axis"); this is the future mesh axis built the
+TPU way — the GShard/Switch dense-dispatch formulation:
+
+- the router scores every token against ``num_experts`` experts; top-k
+  gating with a Switch-style load-balance auxiliary loss;
+- a static ``capacity_factor`` bounds tokens per expert, so every shape is
+  static and the whole block is three einsums on the MXU (dispatch,
+  expert FFN, combine) — no sorting, no ragged tensors, no host control
+  flow;
+- the expert dimension is sharded over the ``data`` mesh axis (canonical
+  expert-parallel: EP reuses the DP devices) and the expert FFN's hidden
+  dim over ``model``; GSPMD derives the token all-to-alls from these
+  shardings, the same way the rest of the stack gets its collectives.
+
+Dropped tokens (over capacity) fall through on the residual path, exactly
+as in Switch Transformers (Fedus et al. 2021).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .activation_function import ActivationFunction, get_activation_function
+from .base_layer import BaseLayer, ForwardContext
+from .param import ParamMeta
+from ..topology.topology import DATA_AXIS, MODEL_AXIS
+
+
+class ParallelMoEMLP(BaseLayer):
+    """Top-k routed expert MLPs (SwiGLU or plain) behind one dense dispatch."""
+
+    def __init__(
+        self,
+        io_features: int,
+        intermediate_feature_factor: float,
+        num_experts: int,
+        top_k: int = 2,
+        capacity_factor: float = 1.25,
+        aux_loss_coef: float = 0.01,
+        glu: bool = True,
+        activation: ActivationFunction = ActivationFunction.SILU,
+        dtype=None,
+    ):
+        dtype = dtype or jnp.float32
+        intermediate = int(io_features * intermediate_feature_factor)
+        assert float(intermediate) == io_features * intermediate_feature_factor
+        assert 1 <= top_k <= num_experts
+        self.io_features = io_features
+        self.intermediate = intermediate
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.aux_loss_coef = aux_loss_coef
+        self.glu = glu
+        self.activation_fn = get_activation_function(activation)
+        self.dtype = dtype
+
+    # ------------------------------------------------------------------ init
+    def init(self, key: jax.Array) -> dict:
+        import math
+
+        ks = jax.random.split(key, 4)
+        E, h, f = self.num_experts, self.io_features, self.intermediate
+
+        def expert_init(k, shape, dtype):
+            # xavier over the PER-EXPERT matmul fans (the leading expert dim
+            # is a batch dim, not a fan — feeding it to a 2-D initializer
+            # over-scales every expert)
+            _, fan_in, fan_out = shape
+            std = math.sqrt(2.0 / (fan_in + fan_out))
+            return (jax.random.normal(k, shape) * std).astype(dtype)
+
+        params = {
+            # router in fp32, near-zero init: routing starts ~uniform and the
+            # decisions should not quantize (Switch Transformer practice)
+            "router": {
+                "weight": (jax.random.normal(ks[0], (h, E)) * 0.02).astype(
+                    jnp.float32
+                )
+            },
+            "w_in": expert_init(ks[1], (E, h, f), self.dtype),
+            "w_out": expert_init(ks[2], (E, f, h), self.dtype),
+        }
+        if self.glu:
+            params["w_gate"] = expert_init(ks[3], (E, h, f), self.dtype)
+        return params
+
+    def param_metas(self) -> dict:
+        def expert_meta(name, spec):
+            return ParamMeta(
+                parameter_name=name,
+                partition_spec=spec,
+                is_model_parallel=True,
+                model_parallel_dimension=spec.index(MODEL_AXIS),
+            )
+
+        metas = {
+            "router": {
+                "weight": ParamMeta(
+                    parameter_name="router.weight",
+                    partition_spec=(None, None),
+                    is_model_parallel_duplicate=True,
+                )
+            },
+            # experts over data (EP), ffn hidden over model (TP inside expert)
+            "w_in": expert_meta("w_in", (DATA_AXIS, None, MODEL_AXIS)),
+            "w_out": expert_meta("w_out", (DATA_AXIS, MODEL_AXIS, None)),
+        }
+        if self.glu:
+            metas["w_gate"] = expert_meta("w_gate", (DATA_AXIS, None, MODEL_AXIS))
+        return metas
+
+    def __call__(
+        self, params: dict, x: jax.Array, ctx: ForwardContext
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Returns (output (b,s,h), aux_loss scalar — already coefficient-
+        scaled, ready to add to the training loss)."""
+        b, s, h = x.shape
+        E, k = self.num_experts, self.top_k
+        C = max(1, int(self.capacity_factor * k * s / E))
+
+        router_w = params["router"]["weight"]
+        logits = jnp.einsum("bsh,he->bse", x.astype(jnp.float32), router_w)
+        probs = jax.nn.softmax(logits, axis=-1)  # (b, s, E)
+
+        # Switch load-balance loss: E * sum_e mean_prob_e * assigned_frac_e,
+        # with assignment fractions from the top-1 choice
+        top1 = jnp.argmax(probs, axis=-1)
+        assigned = jax.nn.one_hot(top1, E, dtype=jnp.float32)  # (b, s, E)
+        aux = E * jnp.sum(probs.mean(axis=(0, 1)) * assigned.mean(axis=(0, 1)))
+        aux = (aux * self.aux_loss_coef).astype(jnp.float32)
+
+        # top-k choices per token, each with its gate weight
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)  # (b, s, k)
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(axis=-1, keepdims=True), 1e-9
+        )
+
+        # position of each (token, choice) in its expert's capacity buffer:
+        # running count of prior tokens routed to the same expert. Choices
+        # are flattened (s, k) -> priority order matches GShard's
+        # token-major, choice-minor scan.
+        choice_exp = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)  # (b,s,k,E)
+        flat = choice_exp.reshape(b, s * k, E)
+        position = jnp.cumsum(flat, axis=1) - flat  # prior count, (b, s*k, E)
+        pos_in_exp = jnp.einsum("bte,bte->bt", position, flat).reshape(b, s, k)
+        pos_in_exp = pos_in_exp.astype(jnp.int32)  # exact small counts
+        keep = (pos_in_exp < C).astype(jnp.float32)  # dropped past capacity
+
+        # dispatch/combine (b, s, E, C)
+        pos_oh = jax.nn.one_hot(pos_in_exp, C, dtype=jnp.float32)  # (b,s,k,C)
+        combine = jnp.einsum(
+            "bsk,bsk,bske,bskc->bsec", gate_vals, keep, choice_exp, pos_oh
+        )
+        dispatch = jnp.einsum("bsk,bske,bskc->bsec", keep, choice_exp, pos_oh)
+
+        xin = jnp.einsum("bsec,bsh->ebch", dispatch.astype(x.dtype), x)
+        w_in = params["w_in"].astype(x.dtype)
+        up = jnp.einsum("ebch,ehf->ebcf", xin, w_in)
+        if self.glu:
+            gate = jnp.einsum(
+                "ebch,ehf->ebcf", xin, params["w_gate"].astype(x.dtype)
+            )
+            act = self.activation_fn(gate) * up
+        else:
+            act = self.activation_fn(up)
+        out = jnp.einsum("ebcf,efh->ebch", act, params["w_out"].astype(x.dtype))
+        y = jnp.einsum("bsec,ebch->bsh", combine.astype(x.dtype), out)
+        return y, aux

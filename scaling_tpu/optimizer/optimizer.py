@@ -172,7 +172,15 @@ class Optimizer:
         spec = list(meta.partition_spec)
         while len(spec) < len(shape):
             spec.append(None)
-        if self.config.zero:
+        used_axes = {
+            a
+            for entry in spec
+            if entry is not None
+            for a in (entry if isinstance(entry, tuple) else (entry,))
+        }
+        if self.config.zero and DATA_AXIS not in used_axes:
+            # expert-parallel params already consume the data axis; a mesh
+            # axis can appear at most once in a sharding spec
             dp = self.topology.data_parallel_size
             for d in range(len(shape)):
                 if spec[d] is None and shape[d] % max(dp, 1) == 0 and dp > 1:
