@@ -277,8 +277,6 @@ class DataConfig(BaseConfig):
     load_data_item_mmap_index_to_memory: bool = Field(False, description="")
     only_full_sequences: bool = Field(False, description="")
     allow_incomplete_sequences_every_n: int = Field(0, description="", ge=0)
-    embedding_dataset: bool = Field(False, description="")
-    embedding_dataset_memory_map: bool = Field(False, description="")
 
 
 from ...data.blended_dataset import BlendedDatasetConfig  # noqa: E402
@@ -287,6 +285,26 @@ DataConfig.model_rebuild()
 
 
 from ...profiler import ProfilerConfig  # noqa: E402
+
+
+# config keys that existed in earlier releases and were removed; configs
+# baked into old checkpoints still carry them, and extra="forbid" would
+# otherwise refuse to load those checkpoints
+REMOVED_CONFIG_KEYS = (
+    ("transformer_architecture", "umup"),
+    ("data", "embedding_dataset"),
+    ("data", "embedding_dataset_memory_map"),
+)
+
+
+def strip_removed_config_keys(d: dict) -> dict:
+    """Drop known-removed keys from a checkpoint-embedded config dict."""
+    d = {k: (dict(v) if isinstance(v, dict) else v) for k, v in d.items()}
+    for section, key in REMOVED_CONFIG_KEYS:
+        sub = d.get(section)
+        if isinstance(sub, dict):
+            sub.pop(key, None)
+    return d
 
 
 class TransformerConfig(BaseConfig):

@@ -105,8 +105,11 @@ class TransformerInferenceModule:
         config_file = ckpt / "config.yml"
         if not config_file.is_file():
             raise FileNotFoundError(f"no config.yml in {ckpt}")
+        from .config import strip_removed_config_keys
+
         config = TransformerConfig.from_dict(
-            yaml.safe_load(config_file.read_text()), overwrite_values=overwrite_config
+            strip_removed_config_keys(yaml.safe_load(config_file.read_text())),
+            overwrite_values=overwrite_config,
         )
         specs = get_transformer_layer_specs(config.transformer_architecture)
         module = ParallelModule(

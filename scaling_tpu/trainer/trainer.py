@@ -87,7 +87,16 @@ class TrainerConfig(BaseConfig):
     eval_interval: Optional[int] = Field(None, description="evaluate every n train steps")
     dataloader_num_workers: int = Field(0, description="kept for config parity")
     dataloader_pin_memory: bool = Field(True, description="kept for config parity")
-    dataloader_prefetch_factor: Optional[int] = Field(None, description="kept for config parity")
+    dataloader_prefetch_factor: Optional[int] = Field(
+        None,
+        description="prefetch up to this many micro-batch stacks on a "
+        "background thread, overlapping host-side batch assembly with the "
+        "device step; None/0 loads synchronously. Resume exactness is "
+        "unaffected: the stream is a pure function of (seed, "
+        "consumed_samples) and prefetched-but-unconsumed batches are "
+        "rebuilt on restart",
+        ge=0,
+    )
     save_checkpoint_async: bool = Field(
         False,
         description="write checkpoint files on a background thread; the train "
@@ -125,6 +134,9 @@ class BaseTrainer:
         self.params: Any = None
         self.opt_state: Optional[OptimizerState] = None
         self._ckpt_writer: Optional[AsyncCheckpointWriter] = None
+        self._prefetch_queue: Any = None
+        self._prefetch_thread: Any = None
+        self._prefetch_stop: Any = None
         self._train_step = None
         self._eval_step = None
         self.dataloader: Optional[DataLoader] = None
@@ -155,6 +167,63 @@ class BaseTrainer:
         self._build_dataloaders()
         self._train_step = self.module.build_train_step(self.optimizer, self.loss_function)
         self._eval_step = self.module.build_eval_step(self.loss_function)
+        if (self.config.dataloader_prefetch_factor or 0) > 0 and self.dataloader is not None:
+            self._start_prefetch(self.config.dataloader_prefetch_factor)
+
+    def _start_prefetch(self, depth: int) -> None:
+        """Fill a bounded queue of ready micro-batch stacks off-thread.
+
+        The worker runs for the trainer's lifetime (daemon thread): stopping
+        mid-stream would desynchronize the dataloader's internal cursor from
+        ``consumed_samples`` by discarding already-assembled batches. Every
+        already-queued batch is consumed in order by later steps, so
+        back-to-back run_training calls see the exact synchronous stream.
+        """
+        import queue
+        import threading
+
+        q = queue.Queue(maxsize=depth)
+        stop = threading.Event()
+        self._prefetch_queue = q
+        self._prefetch_stop = stop
+
+        def worker():
+            # closure locals: stop_prefetch may null the attributes while a
+            # slow assemble is still in flight
+            while not stop.is_set():
+                try:
+                    item = self._assemble_micro_batches()
+                except BaseException as e:  # surfaced on the consumer side
+                    item = e
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+                if isinstance(item, BaseException):
+                    if stop.is_set():
+                        logger.warning(
+                            f"batch prefetch error during shutdown: {item!r}"
+                        )
+                    return
+
+        self._prefetch_thread = threading.Thread(
+            target=worker, name="batch-prefetch", daemon=True
+        )
+        self._prefetch_thread.start()
+
+    def stop_prefetch(self) -> None:
+        """Explicit shutdown (tests / trainer teardown); discards any
+        batches still in the queue, so only call when this trainer object
+        will not train further."""
+        if self._prefetch_stop is not None:
+            self._prefetch_stop.set()
+        if self._prefetch_thread is not None:
+            self._prefetch_thread.join(timeout=5)
+        self._prefetch_queue = None
+        self._prefetch_thread = None
+        self._prefetch_stop = None
 
     def _build_dataloaders(self) -> None:
         if self.dataset is not None:
@@ -173,7 +242,7 @@ class BaseTrainer:
             )
 
     # ----------------------------------------------------------- train step
-    def _next_micro_batches(self):
+    def _assemble_micro_batches(self):
         """Stack grad-accum micro batches along a new leading axis."""
         gas = self.topology.gradient_accumulation_steps
         batches = [
@@ -181,6 +250,15 @@ class BaseTrainer:
         ]
         stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *batches)
         return self.module.shard_batch(stacked)
+
+    def _next_micro_batches(self):
+        if self._prefetch_queue is not None:
+            item = self._prefetch_queue.get()
+            if isinstance(item, BaseException):
+                self.stop_prefetch()
+                raise item
+            return item
+        return self._assemble_micro_batches()
 
     def train_step(self) -> TrainStepOutput:
         step_idx = self.context.iterations
@@ -293,7 +371,11 @@ class BaseTrainer:
 
     # ----------------------------------------------------------- checkpoint
     def finalize_checkpoints(self) -> None:
-        """Block until pending async checkpoint writes are durable."""
+        """Block until pending async checkpoint writes are durable.
+
+        Deliberately leaves the prefetch thread running: the trainer may
+        train again (queued batches continue the exact stream); the daemon
+        thread dies with the process."""
         if self._ckpt_writer is not None:
             self._ckpt_writer.wait()
     def _step_dir(self, base: Path, iterations: int) -> Path:

@@ -49,3 +49,28 @@ def test_golden_checkpoint_files_present():
     assert "config.yml" in names
     assert sum(n.startswith("model_state_layer_") for n in names) == 5
     assert sum(n.startswith("optimizer_state_layer_") for n in names) == 5
+
+
+def test_old_checkpoint_config_with_removed_keys_loads(tmp_path):
+    """Checkpoints written by earlier releases carry config keys that no
+    longer exist (umup, embedding_dataset); from_checkpoint must strip them
+    instead of refusing the checkpoint (extra='forbid')."""
+    import shutil
+
+    import yaml
+
+    from scaling_tpu.models.transformer.inference import TransformerInferenceModule
+
+    src = FILES / "ckpt"
+    dst = tmp_path / "ckpt"
+    shutil.copytree(src, dst)
+    step = dst / "global_step3"
+    cfg = yaml.safe_load((step / "config.yml").read_text())
+    cfg["transformer_architecture"]["umup"] = {"enable": False}
+    cfg["data"]["embedding_dataset"] = False
+    cfg["data"]["embedding_dataset_memory_map"] = False
+    (step / "config.yml").write_text(yaml.safe_dump(cfg))
+
+    module = TransformerInferenceModule.from_checkpoint(dst)
+    logits = module.logits([3, 7, 11])
+    assert logits.shape[1] == 3
