@@ -306,13 +306,13 @@ class ParallelSelfAttention(BaseLayer):
         n_local = self.num_local_attention_heads
 
         # the flash (splash) kernel consumes UNREPEATED kv heads — the KV
-        # bandwidth/memory win of GQA; every other path repeats below
+        # bandwidth/memory win of GQA — and covers mixed local/global heads
+        # via per-head masks; every other path repeats below
         use_flash_here = (
             self.use_flash
             and kv_cache is None
             and attention_scores_manipulation is None
             and dropout_fn is None
-            and n_local == 0
             and self.causal
             and ctx.context_parallel_size <= 1
         )
@@ -325,7 +325,9 @@ class ParallelSelfAttention(BaseLayer):
             use_flash_here = flash_attention_supported(s, self.head_dim)
         if use_flash_here:
             out = flash_attention_fused(
-                q, k, v, segment_ids, causal=True, sm_scale=self.scaling_factor
+                q, k, v, segment_ids, causal=True, sm_scale=self.scaling_factor,
+                num_local_heads=n_local,
+                local_window=self.local_attention_window_size,
             )
             return self._project_out(params, out, ctx, b, s, new_kv)
 

@@ -104,7 +104,8 @@ def _snap_block(block: int, seq_len: int) -> int:
 
 @functools.lru_cache(maxsize=32)
 def _make_kernel(num_q_heads: int, seq_len: int, block_q: int, block_kv: int,
-                 interpret: bool):
+                 interpret: bool, num_local_heads: int = 0,
+                 local_window: Optional[int] = None):
     from jax.experimental.pallas.ops.tpu.splash_attention import (
         splash_attention_kernel as sk,
         splash_attention_mask as sm,
@@ -112,9 +113,19 @@ def _make_kernel(num_q_heads: int, seq_len: int, block_q: int, block_kv: int,
 
     bq = _snap_block(block_q, seq_len)
     bkv = _snap_block(block_kv, seq_len)
-    mask = sm.MultiHeadMask(
-        [sm.CausalMask((seq_len, seq_len)) for _ in range(num_q_heads)]
-    )
+    # mixed-head masks: leading heads are fully causal, the trailing
+    # num_local_heads attend within a backward window (the reference's
+    # local-attention heads ride its flash sliding window,
+    # attention.py:204-259); masks are per Q head, so GQA grouping is
+    # unaffected
+    shape = (seq_len, seq_len)
+    head_masks = [
+        sm.CausalMask(shape) for _ in range(num_q_heads - num_local_heads)
+    ] + [
+        sm.LocalMask(shape, window_size=(local_window, 0), offset=0)
+        for _ in range(num_local_heads)
+    ]
+    mask = sm.MultiHeadMask(head_masks)
     sizes = sk.BlockSizes(
         block_q=bq, block_kv=bkv, block_kv_compute=bkv,
         block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bkv,
@@ -133,8 +144,13 @@ def flash_attention_fused(
     segment_ids: Optional[jax.Array] = None,  # (b, s) int32 packed-doc ids
     causal: bool = True,
     sm_scale: float = 1.0,
+    num_local_heads: int = 0,
+    local_window: Optional[int] = None,
 ) -> jax.Array:
-    """Block-wise causal attention, O(s) memory; returns (b, s, n, d)."""
+    """Block-wise causal attention, O(s) memory; returns (b, s, n, d).
+
+    The trailing ``num_local_heads`` query heads attend only within
+    ``local_window`` tokens back (mixed local/global heads)."""
     assert causal, "the flash path is causal-only; XLA handles the rest"
     from jax.experimental.pallas.ops.tpu.splash_attention import (
         splash_attention_kernel as sk,
@@ -146,7 +162,10 @@ def flash_attention_fused(
     # construct (and cache) the kernel outside the enclosing jit trace —
     # its mask-info constants must be concrete, not tracers
     with jax.ensure_compile_time_eval():
-        kernel = _make_kernel(n, s, block_q, block_kv, _FORCE_INTERPRET)
+        kernel = _make_kernel(
+            n, s, block_q, block_kv, _FORCE_INTERPRET,
+            num_local_heads, local_window,
+        )
 
     qt = jnp.swapaxes(q, 1, 2) * sm_scale  # (b, n, s, d) pre-scaled
     kt = jnp.swapaxes(k, 1, 2)  # (b, n_kv, s, d)

@@ -124,3 +124,36 @@ def test_flash_matches_xla_grads(gqa, interpret_pallas):
         np.testing.assert_allclose(
             np.asarray(gf), np.asarray(gr), atol=5e-4, rtol=5e-4, err_msg=name
         )
+
+
+def test_flash_mixed_local_global_heads(interpret_pallas):
+    """Trailing local-window heads get a LocalMask inside the kernel and
+    match the XLA mixed-head reference (reference: flash sliding window,
+    attention.py:204-259)."""
+    n, n_local, window = 4, 2, 16
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (B, S, n, D), jnp.float32) * 0.3
+    k = jax.random.normal(ks[1], (B, S, n, D), jnp.float32) * 0.3
+    v = jax.random.normal(ks[2], (B, S, n, D), jnp.float32) * 0.3
+    segment_ids = jnp.zeros((B, S), jnp.int32)
+
+    softmax = MaskedSoftmax(MaskedSoftmaxConfig(softmax_in_fp32=True))
+    global_mask = segment_ids_to_mask(segment_ids, None, causal=True)
+    local_mask = segment_ids_to_mask(
+        segment_ids, None, causal=True, local_window=window
+    )
+    out_g = multi_head_attention(
+        q[:, :, : n - n_local], k[:, :, : n - n_local], v[:, :, : n - n_local],
+        global_mask, 1.0 / np.sqrt(D), softmax, None, None,
+    )
+    out_l = multi_head_attention(
+        q[:, :, n - n_local :], k[:, :, n - n_local :], v[:, :, n - n_local :],
+        local_mask, 1.0 / np.sqrt(D), softmax, None, None,
+    )
+    ref = jnp.concatenate([out_g, out_l], axis=2)
+
+    out = flash_attention_fused(
+        q, k, v, segment_ids, causal=True, sm_scale=1.0 / np.sqrt(D),
+        num_local_heads=n_local, local_window=window,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
