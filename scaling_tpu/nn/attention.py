@@ -328,6 +328,7 @@ class ParallelSelfAttention(BaseLayer):
                 q, k, v, segment_ids, causal=True, sm_scale=self.scaling_factor,
                 num_local_heads=n_local,
                 local_window=self.local_attention_window_size,
+                mesh=ctx.mesh,
             )
             return self._project_out(params, out, ctx, b, s, new_kv)
 

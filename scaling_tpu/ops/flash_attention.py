@@ -137,6 +137,25 @@ def _make_kernel(num_q_heads: int, seq_len: int, block_q: int, block_kv: int,
     )
 
 
+def _tp_shardable(mesh, b: int, n: int, n_kv: int, num_local_heads: int) -> bool:
+    """True when the kernel can be shard_map-partitioned over (data, model):
+    uniform causal masks (no local heads), heads and batch divisible, and no
+    pipe axis in play (inside the spatial pipeline the operands are already
+    stage-local and shard_map's replication assumption would be wrong)."""
+    from ..topology.topology import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
+
+    if num_local_heads > 0:
+        return False
+    names = mesh.axis_names
+    if MODEL_AXIS not in names or mesh.shape[MODEL_AXIS] <= 1:
+        return False
+    if PIPE_AXIS in names and mesh.shape[PIPE_AXIS] > 1:
+        return False
+    mp = mesh.shape[MODEL_AXIS]
+    dp = mesh.shape[DATA_AXIS] if DATA_AXIS in names else 1
+    return n % mp == 0 and n_kv % mp == 0 and b % max(dp, 1) == 0
+
+
 def flash_attention_fused(
     q: jax.Array,  # (b, s, n, d)
     k: jax.Array,  # (b, s, n_kv, d)  — UNREPEATED kv heads (GQA-native)
@@ -146,6 +165,7 @@ def flash_attention_fused(
     sm_scale: float = 1.0,
     num_local_heads: int = 0,
     local_window: Optional[int] = None,
+    mesh=None,
 ) -> jax.Array:
     """Block-wise causal attention, O(s) memory; returns (b, s, n, d).
 
@@ -170,14 +190,50 @@ def flash_attention_fused(
     qt = jnp.swapaxes(q, 1, 2) * sm_scale  # (b, n, s, d) pre-scaled
     kt = jnp.swapaxes(k, 1, 2)  # (b, n_kv, s, d)
     vt = jnp.swapaxes(v, 1, 2)
+    seg_i32 = (
+        segment_ids.astype(jnp.int32)
+        if segment_ids is not None
+        else jnp.zeros((b, s), jnp.int32)
+    )
 
-    if segment_ids is not None:
-        seg_i32 = segment_ids.astype(jnp.int32)
+    def run_local(qq, kk, vv, seg):
+        def one(qi, ki, vi, si):
+            return kernel(qi, ki, vi, segment_ids=sk.SegmentIds(q=si, kv=si))
 
-        def run(qq, kk, vv, seg):
-            return kernel(qq, kk, vv, segment_ids=sk.SegmentIds(q=seg, kv=seg))
+        return jax.vmap(one)(qq, kk, vv, seg)
 
-        out = jax.vmap(run)(qt, kt, vt, seg_i32)
+    if mesh is not None and _tp_shardable(mesh, b, n, k.shape[2], num_local_heads):
+        # partition the kernel itself: pallas custom calls are opaque to
+        # GSPMD, which would otherwise gather heads to every device. With
+        # uniform causal masks each model shard runs an identical kernel on
+        # its contiguous slice of q (and kv) heads; batch splits over data.
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ..topology.topology import DATA_AXIS, MODEL_AXIS
+
+        mp = mesh.shape[MODEL_AXIS]
+        with jax.ensure_compile_time_eval():
+            shard_kernel = _make_kernel(
+                n // mp, s, block_q, block_kv, _FORCE_INTERPRET, 0, None
+            )
+
+        def run_shard(qq, kk, vv, seg):
+            def one(qi, ki, vi, si):
+                return shard_kernel(
+                    qi, ki, vi, segment_ids=sk.SegmentIds(q=si, kv=si)
+                )
+
+            return jax.vmap(one)(qq, kk, vv, seg)
+
+        qkv_spec = P(DATA_AXIS, MODEL_AXIS, None, None)
+        out = shard_map(
+            run_shard,
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec, P(DATA_AXIS, None)),
+            out_specs=qkv_spec,
+            check_rep=False,
+        )(qt, kt, vt, seg_i32)
     else:
-        out = jax.vmap(lambda qq, kk, vv: kernel(qq, kk, vv))(qt, kt, vt)
+        out = run_local(qt, kt, vt, seg_i32)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # (b, s, n, d)

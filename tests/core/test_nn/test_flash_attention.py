@@ -157,3 +157,39 @@ def test_flash_mixed_local_global_heads(interpret_pallas):
         num_local_heads=n_local, local_window=window,
     )
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_shard_map_tp_parity(interpret_pallas, devices):
+    """Under mp>1 the kernel partitions via shard_map (contiguous head
+    slices per model shard, batch over data) and matches the unsharded
+    kernel — GSPMD alone would replicate the opaque pallas call."""
+    from scaling_tpu.topology import Topology, TopologyConfig
+
+    topo = Topology(
+        TopologyConfig.from_dict(
+            {
+                "model_parallel_size": 2,
+                "pipe_parallel_size": 1,
+                "data_parallel_size": 4,
+                "micro_batch_size": 1,
+                "gradient_accumulation_steps": 1,
+            }
+        )
+    )
+    n, n_kv = 4, 2
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = jax.random.normal(ks[0], (4, S, n, D), jnp.float32) * 0.3
+    k = jax.random.normal(ks[1], (4, S, n_kv, D), jnp.float32) * 0.3
+    v = jax.random.normal(ks[2], (4, S, n_kv, D), jnp.float32) * 0.3
+    seg = jnp.concatenate(
+        [jnp.zeros((4, S // 2), jnp.int32), jnp.ones((4, S - S // 2), jnp.int32)],
+        axis=1,
+    )
+    scale = 1.0 / np.sqrt(D)
+    ref = flash_attention_fused(q, k, v, seg, causal=True, sm_scale=scale)
+    out = jax.jit(
+        lambda q, k, v, s: flash_attention_fused(
+            q, k, v, s, causal=True, sm_scale=scale, mesh=topo.mesh
+        )
+    )(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
