@@ -52,11 +52,14 @@ def measure_achievable_tflops() -> float:
         x, _ = jax.lax.scan(body, a, None, length=32)
         return x.sum()
 
-    float(chain(a, b))  # compile
+    jax.block_until_ready(chain(a, b))  # compile
     best = float("inf")
     for i in range(3):  # best-of-3: the chip may be time-shared
         t0 = time.perf_counter()
-        float(chain(a + float(i), b))  # scalar fetch forces completion
+        # the scan serializes its 32 matmuls, so block_until_ready bounds
+        # the full computation; a scalar fetch would add a host roundtrip
+        # (hundreds of ms over a slow tunnel) and understate the peak
+        jax.block_until_ready(chain(a + float(i), b))
         best = min(best, time.perf_counter() - t0)
     return 32 * 2 * 4096**3 / best / 1e12
 
