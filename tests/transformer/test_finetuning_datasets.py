@@ -308,3 +308,40 @@ def test_legacy_blended_dataset(tmp_path):
         datasets=datasets,
     )
     np.testing.assert_array_equal(blended.dataset_indices, blended2.dataset_indices)
+
+
+REFERENCE = __import__("pathlib").Path("/root/reference")
+
+
+@pytest.mark.skipif(not REFERENCE.is_dir(), reason="reference checkout absent")
+def test_reference_finetuning_fixtures_load_unchanged():
+    """The reference's shipped finetuning fixtures (jsonl, chat jsonl,
+    memory map) and its llama2 tokenizer drive our datasets unchanged."""
+    files = REFERENCE / "tests/transformer/files"
+    vocab = files / "llama2-tokenizer.json"
+
+    ds = FinetuningTextDataset(
+        files / "dataset/finetuning.jsonl", sequence_length=32, vocab_file=vocab
+    )
+    assert len(ds) > 0
+    item = ds[0]
+    assert item.token_ids.shape == (32,)
+    assert item.loss_weights.sum() > 0  # completion carries loss
+    # prompt span carries none: first tokens are loss-free
+    assert item.loss_weights[0] == 0
+
+    chat = FinetuningChatDataset(
+        files / "dataset/finetuning_chat.jsonl", sequence_length=96,
+        vocab_file=vocab,
+    )
+    assert len(chat) > 0
+    citem = chat[0]
+    w = citem.loss_weights
+    assert 0 < w.sum() < w.size  # role masking: some spans train, some don't
+
+    mm = FinetuningTextDataset(
+        files / "dataset/finetuning_memory_map/dataset", sequence_length=32,
+        vocab_file=vocab, memory_map_dataset=True,
+    )
+    assert len(mm) > 0
+    assert mm[0].token_ids.shape == (32,)
