@@ -38,3 +38,28 @@ def test_text_dataset_over_legacy(tmp_path):
     item = ds[0]
     stream = np.concatenate(docs)
     np.testing.assert_array_equal(item.token_ids, stream[:17])
+
+
+def test_reference_legacy_fixture_loads_unchanged():
+    """The reference's shipped Megatron-format enron fixture reads as-is
+    (reference: tests/transformer/files/dataset/legacy/)."""
+    import pathlib
+
+    import pytest
+
+    fixture = pathlib.Path(
+        "/root/reference/tests/transformer/files/dataset/legacy/enron_text_document_100"
+    )
+    if not fixture.with_suffix(".bin").is_file():
+        pytest.skip("reference checkout absent")
+    from scaling_tpu.data.legacy_indexed_dataset import LegacyIndexedDataset
+
+    ds = LegacyIndexedDataset(fixture)
+    assert len(ds) == 100
+    assert all(len(ds[i]) > 0 for i in (0, 50, 99))
+
+    from scaling_tpu.models.transformer.data import TextDataset
+
+    text = TextDataset(fixture, sequence_length=64, seed=3, legacy_dataset=True)
+    item = text[0]
+    assert item.token_ids.shape == (65,)
