@@ -1,0 +1,144 @@
+"""Import reference (PyTorch) checkpoints into this framework's format.
+
+The migration path for users of the reference repo: its partitioned
+checkpoints (``model_state_layer_{i}_{Class}.pt``, reference:
+partitioned_module.py:197-257) and its legacy whole-model state dicts
+(reference: tests/transformer/test_backwards_compatibility.py:20-43)
+convert into the npz layout written by ``save_model_checkpoint``. Layer
+class names match one-to-one; within a layer the differences are
+
+- torch ``nn.Linear`` stores ``(out, in)`` — our linears store
+  ``(in, out)``, so 2-D projection weights transpose;
+- the reference's attention attribute is ``self_attention``, ours is
+  ``attention`` (the fused query_key_value head-major [q|k|v] layout is
+  identical on both sides);
+- rotary ``inv_freq`` buffers are derived values here and are dropped;
+- a tied LM head duplicates the embedding table in reference checkpoints —
+  structural tying holds a single copy, so the duplicate is dropped.
+
+Verified against the reference's own shipped golden artifacts
+(state_dict.pt + ground_truth.pt logits) in
+tests/transformer/test_reference_weight_import.py.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Any, Dict
+
+import numpy as np
+
+_LINEAR_HOSTS = ("attention.", "mlp.", "linear", "embedding_head")
+
+
+def _map_param(name: str, arr: np.ndarray):
+    """reference per-layer param name -> (our name, our array) or None."""
+    if name.endswith(".inv_freq"):
+        return None
+    name = name.replace("self_attention.", "attention.")
+    # legacy MLP naming (reference: test_backwards_compatibility.py:36-37)
+    name = name.replace("dense_h_to_4h", "dense_in")
+    name = name.replace("dense_4h_to_h", "dense_out")
+    if (
+        arr.ndim == 2
+        and name.endswith(".weight")
+        and any(h in name for h in _LINEAR_HOSTS)
+        and not name.startswith("embedding.")
+    ):
+        arr = np.ascontiguousarray(arr.T)
+    return name, arr
+
+
+def convert_reference_layer(state_dict: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """One reference layer's state dict -> our param-name->array mapping."""
+    out: Dict[str, np.ndarray] = {}
+    for name, value in state_dict.items():
+        value = np.asarray(
+            value.detach().cpu().numpy() if hasattr(value, "detach") else value
+        )
+        mapped = _map_param(name, value)
+        if mapped is not None:
+            out[mapped[0]] = mapped[1]
+    return out
+
+
+def convert_reference_checkpoint(src_dir: Path | str, dst_dir: Path | str) -> int:
+    """Convert a reference partitioned checkpoint directory to our npz
+    layout; returns the number of layer files written. Tied LM head layers
+    (TransformerLMHeadTied) are skipped — tying is structural here."""
+    import torch
+
+    src, dst = Path(src_dir), Path(dst_dir)
+    dst.mkdir(parents=True, exist_ok=True)
+    written = 0
+    for f in sorted(src.glob("model_state_layer_*.pt")):
+        m = re.match(r"model_state_layer_(\d+)_(.+)\.pt", f.name)
+        if m is None:
+            continue
+        layer_index, layer_class = int(m.group(1)), m.group(2)
+        if layer_class == "TransformerLMHeadTied":
+            written += 1  # nothing to write: the owner layer has the table
+            continue
+        sd = torch.load(f, map_location="cpu", weights_only=False)
+        arrays = convert_reference_layer(sd)
+        np.savez(dst / f"model_state_layer_{layer_index}_{layer_class}.npz", **arrays)
+        written += 1
+    return written
+
+
+# legacy whole-model state dicts (pre-partitioned codebase) --------------------
+
+_LEGACY_LAYER_CLASSES = ("EmbeddingInput", "TransformerLayer", "LayerNormWrapper")
+
+
+def convert_legacy_state_dict(
+    state_dict: Dict[str, Any], num_layers: int
+) -> Dict[str, Dict[str, np.ndarray]]:
+    """Legacy ``transformer.*`` state dict -> {layer_file_stem: arrays}.
+
+    Mirrors the reference's own legacy translation
+    (test_backwards_compatibility.py:20-43): word embeddings -> layer 0,
+    ``transformer.layerN`` -> layer N+1, final norm -> layer num_layers+1;
+    the tied head copy the reference appends is implicit here.
+    """
+    layers: Dict[int, Dict[str, Any]] = {}
+
+    def put(idx: int, name: str, value):
+        layers.setdefault(idx, {})[name] = value
+
+    for k, v in state_dict.items():
+        if k.endswith(".inv_freq"):
+            continue
+        if k == "transformer.embeddings.word_embeddings.weight":
+            put(0, "embedding.weight", v)
+            continue
+        m = re.match(r"transformer\.layer(\d+)\.(.+)", k)
+        if m:
+            put(1 + int(m.group(1)), m.group(2), v)
+            continue
+        m = re.match(r"transformer\.norm\.(.+)", k)
+        if m:
+            put(1 + num_layers, f"norm.{m.group(1)}", v)
+            continue
+        raise ValueError(f"unrecognized legacy parameter {k!r}")
+
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    for idx, sd in layers.items():
+        if idx == 0:
+            cls = "EmbeddingInput"
+        elif idx == 1 + num_layers:
+            cls = "LayerNormWrapper"
+        else:
+            cls = "TransformerLayer"
+        out[f"model_state_layer_{idx}_{cls}"] = convert_reference_layer(sd)
+    return out
+
+
+def write_converted_layers(
+    layers: Dict[str, Dict[str, np.ndarray]], dst_dir: Path | str
+) -> None:
+    dst = Path(dst_dir)
+    dst.mkdir(parents=True, exist_ok=True)
+    for stem, arrays in layers.items():
+        np.savez(dst / f"{stem}.npz", **arrays)
