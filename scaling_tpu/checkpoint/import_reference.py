@@ -40,6 +40,15 @@ def _map_param(name: str, arr: np.ndarray):
     # legacy MLP naming (reference: test_backwards_compatibility.py:36-37)
     name = name.replace("dense_h_to_4h", "dense_in")
     name = name.replace("dense_4h_to_h", "dense_out")
+    # adapters: the reference hosts ParallelMLPs named attn_adapter_{n} /
+    # mlp_adapter_{n} (layer.py:147-181); ours are bottleneck Adapters named
+    # adapter_attention_{n} / adapter_mlp_{n} with down/up factors
+    m = re.match(r"(attn|mlp)_adapter_([^.]+)\.dense_(in|out)\.weight$", name)
+    if m:
+        host = "attention" if m.group(1) == "attn" else "mlp"
+        direction = "down" if m.group(3) == "in" else "up"
+        name = f"adapter_{host}_{m.group(2)}.{direction}"
+        return name, np.ascontiguousarray(arr.T)
     if (
         arr.ndim == 2
         and name.endswith(".weight")
@@ -50,23 +59,54 @@ def _map_param(name: str, arr: np.ndarray):
     return name, arr
 
 
+def _to_numpy(value: Any) -> np.ndarray:
+    if hasattr(value, "detach"):
+        value = value.detach().cpu()
+        if str(value.dtype) == "torch.bfloat16":
+            # numpy has no bf16: round-trip through fp32 (exact superset)
+            value = value.float()
+        value = value.numpy()
+    return np.asarray(value)
+
+
 def convert_reference_layer(state_dict: Dict[str, Any]) -> Dict[str, np.ndarray]:
     """One reference layer's state dict -> our param-name->array mapping."""
     out: Dict[str, np.ndarray] = {}
     for name, value in state_dict.items():
-        value = np.asarray(
-            value.detach().cpu().numpy() if hasattr(value, "detach") else value
-        )
-        mapped = _map_param(name, value)
+        mapped = _map_param(name, _to_numpy(value))
         if mapped is not None:
             out[mapped[0]] = mapped[1]
     return out
 
 
+# reference layer classes, longest-match first so PEFT suffixes split off
+# correctly (reference writes "{Class}_{peft_name}.pt" with a SINGLE
+# underscore, partitioned_module.py; our loader expects "{Class}__{name}")
+_LAYER_CLASSES = (
+    "TransformerLMHeadTied",
+    "TransformerEmbeddingHead",
+    "TransformerLMHead",
+    "TransformerLayer",
+    "LayerNormWrapper",
+    "EmbeddingInput",
+)
+
+
+def _split_class_suffix(stem: str):
+    """'TransformerLayer_lora' -> ('TransformerLayer', 'lora')."""
+    for cls in _LAYER_CLASSES:
+        if stem == cls:
+            return cls, None
+        if stem.startswith(cls + "_"):
+            return cls, stem[len(cls) + 1 :]
+    return stem, None
+
+
 def convert_reference_checkpoint(src_dir: Path | str, dst_dir: Path | str) -> int:
     """Convert a reference partitioned checkpoint directory to our npz
-    layout; returns the number of layer files written. Tied LM head layers
-    (TransformerLMHeadTied) are skipped — tying is structural here."""
+    layout; returns the number of npz files written. Base tied-LM-head
+    files are skipped (tying is structural here — the embedding layer owns
+    the single copy); their PEFT-suffix side files still convert."""
     import torch
 
     src, dst = Path(src_dir), Path(dst_dir)
@@ -76,13 +116,16 @@ def convert_reference_checkpoint(src_dir: Path | str, dst_dir: Path | str) -> in
         m = re.match(r"model_state_layer_(\d+)_(.+)\.pt", f.name)
         if m is None:
             continue
-        layer_index, layer_class = int(m.group(1)), m.group(2)
-        if layer_class == "TransformerLMHeadTied":
-            written += 1  # nothing to write: the owner layer has the table
-            continue
+        layer_index = int(m.group(1))
+        layer_class, peft_suffix = _split_class_suffix(m.group(2))
+        if layer_class == "TransformerLMHeadTied" and peft_suffix is None:
+            continue  # nothing to write: the owner layer has the table
         sd = torch.load(f, map_location="cpu", weights_only=False)
         arrays = convert_reference_layer(sd)
-        np.savez(dst / f"model_state_layer_{layer_index}_{layer_class}.npz", **arrays)
+        stem = f"model_state_layer_{layer_index}_{layer_class}"
+        if peft_suffix is not None:
+            stem += f"__{peft_suffix}"
+        np.savez(dst / f"{stem}.npz", **arrays)
         written += 1
     return written
 

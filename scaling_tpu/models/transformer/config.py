@@ -69,11 +69,17 @@ class AdapterConfig(BaseConfig):
     (reference: config.py:80-97, layers/layer.py:140-187)."""
 
     name: str = Field("adapter", description="adapter parameter name suffix")
-    attention_downsampling_factor: Optional[int] = Field(
-        None, description="hidden // factor bottleneck after the attention block"
+    attention_downsampling_factor: Optional[float] = Field(
+        None,
+        description="adapter width = hidden * factor after the attention "
+        "block (multiplicative like the reference, config.py:105 — e.g. "
+        "0.25 for a 4x bottleneck)",
+        gt=0,
     )
-    mlp_downsampling_factor: Optional[int] = Field(
-        None, description="hidden // factor bottleneck after the mlp block"
+    mlp_downsampling_factor: Optional[float] = Field(
+        None,
+        description="adapter width = hidden * factor after the mlp block",
+        gt=0,
     )
     init_std: float = Field(1.0e-3, description="std of the adapter init")
 

@@ -41,9 +41,11 @@ class Adapter(BaseLayer):
     (reference: layers/layer.py:140-187). Replicated params (adapters are
     small; sharding them would waste ICI)."""
 
-    def __init__(self, hidden_size: int, downsampling_factor: int, init_std: float, dtype):
+    def __init__(self, hidden_size: int, downsampling_factor: float, init_std: float, dtype):
         self.hidden_size = hidden_size
-        self.bottleneck = hidden_size // downsampling_factor
+        # multiplicative, matching the reference's ParallelMLP factor
+        # (layer.py:152): 0.25 -> a 4x bottleneck
+        self.bottleneck = max(1, int(hidden_size * downsampling_factor))
         self.init_std = init_std
         self.dtype = dtype
 

@@ -90,8 +90,8 @@ def test_bitfit_finetune(tmp_path, pretrain):
 def test_adapter_finetune(tmp_path, pretrain):
     cfg = finetune_config(
         tmp_path, pretrain,
-        {"adapter_config": {"name": "ad", "attention_downsampling_factor": 4,
-                            "mlp_downsampling_factor": 4, "init_std": 0.01}},
+        {"adapter_config": {"name": "ad", "attention_downsampling_factor": 0.25,
+                            "mlp_downsampling_factor": 0.25, "init_std": 0.01}},
         missing=[r".*_ad\."],
     )
     trainer = build_capturing_trainer(cfg, load=True)

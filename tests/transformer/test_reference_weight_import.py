@@ -103,7 +103,7 @@ def test_partitioned_checkpoint_converter_round_trips(tmp_path):
     torch.save(emb, src / "model_state_layer_3_TransformerLMHeadTied.pt")
 
     dst = tmp_path / "ours"
-    assert convert_reference_checkpoint(src, dst) == 4
+    assert convert_reference_checkpoint(src, dst) == 3  # tied head skipped
     files = sorted(p.name for p in dst.glob("*.npz"))
     assert files == [
         "model_state_layer_0_EmbeddingInput.npz",
@@ -114,3 +114,51 @@ def test_partitioned_checkpoint_converter_round_trips(tmp_path):
         # torch (out, in) became ours (in, out)
         assert z["attention.query_key_value.weight"].shape == (16, 48)
         assert "attention.rotary_emb.inv_freq" not in z.files
+
+
+def test_converter_handles_bf16_and_peft_suffix_files(tmp_path):
+    import torch
+
+    from scaling_tpu.checkpoint.import_reference import convert_reference_checkpoint
+
+    src = tmp_path / "ref"
+    src.mkdir()
+    torch.save(
+        {"embedding.weight": torch.zeros(8, 4, dtype=torch.bfloat16)},
+        src / "model_state_layer_0_EmbeddingInput.pt",
+    )
+    # PEFT side file: reference single-underscore suffix naming
+    torch.save(
+        {"attention.dense_lora.lora_a": torch.zeros(4, 2, dtype=torch.bfloat16)},
+        src / "model_state_layer_1_TransformerLayer_lora.pt",
+    )
+    torch.save(
+        {"bias_b.weight": torch.zeros(4)},
+        src / "model_state_layer_3_TransformerLMHeadTied_b.pt",
+    )
+    dst = tmp_path / "out"
+    assert convert_reference_checkpoint(src, dst) == 3
+    names = sorted(p.name for p in dst.glob("*.npz"))
+    assert names == [
+        "model_state_layer_0_EmbeddingInput.npz",
+        "model_state_layer_1_TransformerLayer__lora.npz",
+        "model_state_layer_3_TransformerLMHeadTied__b.npz",
+    ]
+    with np.load(dst / "model_state_layer_0_EmbeddingInput.npz") as z:
+        assert z["embedding.weight"].dtype == np.float32
+
+
+def test_converter_translates_adapter_names(tmp_path):
+    import torch
+
+    from scaling_tpu.checkpoint.import_reference import convert_reference_layer
+
+    sd = {
+        "attn_adapter_ad.dense_in.weight": torch.zeros(4, 16),
+        "attn_adapter_ad.dense_out.weight": torch.zeros(16, 4),
+        "mlp_adapter_ad.dense_in.weight": torch.zeros(4, 16),
+    }
+    out = convert_reference_layer(sd)
+    assert out["adapter_attention_ad.down"].shape == (16, 4)
+    assert out["adapter_attention_ad.up"].shape == (4, 16)
+    assert out["adapter_mlp_ad.down"].shape == (16, 4)
