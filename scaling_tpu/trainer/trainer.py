@@ -418,6 +418,15 @@ class BaseTrainer:
             (step_dir / "config.yml").write_text(
                 _yaml.safe_dump(cfg.model_dump(mode="json"), sort_keys=False)
             )
+            # tokenizer travels with the weights so inference needs nothing
+            # else (reference: inference_model.py:70 expects vocab.json)
+            vocab = getattr(
+                getattr(cfg, "transformer_architecture", None), "vocab_file", None
+            )
+            if vocab and Path(vocab).is_file():
+                import shutil
+
+                shutil.copyfile(vocab, step_dir / "vocab.json")
         latest = f"global_step{self.context.iterations}"
         if writer is None:
             (base / "latest").write_text(latest)

@@ -105,3 +105,52 @@ def test_generate_stop_tokens_and_logits(checkpoint_dir):
     out2 = mod.generate([3, 5, 7], max_tokens=8, stop_tokens=[first], use_cache=True)
     assert out2.completion_ids[0] == first
     assert len(out2.completion_ids) == 1
+
+
+def test_checkpoint_carries_tokenizer(tmp_path):
+    """Checkpoints embed vocab.json when a vocab_file is configured, and
+    from_checkpoint auto-loads it (reference: inference_model.py:70)."""
+    from tokenizers import Tokenizer as HFTokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    from scaling_tpu.models.transformer import TransformerConfig
+    from .test_training import build_capturing_trainer, train_capture
+
+    vocab = {"<|endoftext|>": 0, "<unk>": 1, "a": 2, "b": 3}
+    tok = HFTokenizer(WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = Whitespace()
+    vocab_path = tmp_path / "vocab.json"
+    tok.save(str(vocab_path))
+
+    rows = [{"prompt": "a", "completion": "b"}] * 4
+    data = tmp_path / "ft.jsonl"
+    data.write_text("\n".join(__import__("json").dumps(r) for r in rows))
+
+    config = TransformerConfig.from_dict(
+        {
+            "topology": {
+                "model_parallel_size": 1, "pipe_parallel_size": 1,
+                "data_parallel_size": 1, "micro_batch_size": 2,
+                "gradient_accumulation_steps": 1,
+            },
+            "transformer_architecture": {
+                "vocab_size": 8, "hidden_size": 16, "num_layers": 1,
+                "num_attention_heads": 2, "sequence_length": 8,
+                "vocab_file": str(vocab_path),
+            },
+            "trainer": {"train_iterations": 1, "seed": 1,
+                        "save_dir": str(tmp_path / "ckpt"), "save_interval": 1},
+            "data": {"data_prefixes": [str(data)], "finetuning_dataset": True},
+            "logger": {"log_dir": None},
+        }
+    )
+    trainer = build_capturing_trainer(config)
+    train_capture(trainer, 1)
+    step = tmp_path / "ckpt" / "global_step1"
+    assert (step / "vocab.json").is_file()
+
+    module = TransformerInferenceModule.from_checkpoint(tmp_path / "ckpt")
+    assert module.tokenizer is not None
+    out = module.generate("a", max_tokens=2)
+    assert out.completion is not None
