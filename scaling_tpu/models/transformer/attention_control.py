@@ -1,0 +1,54 @@
+"""AtMan-style attention control for inference.
+
+(reference: src/scaling/transformer/data/inference_settings.py:1-54 +
+attention.py:105-190) — per-token suppression/amplification factors become
+an additive manipulation on pre-softmax attention scores, flowing through
+the batch dict every layer already consumes
+(``attention_scores_manipulation``). Log-additive application matches the
+reference's default ``control_log_additive=True`` path; the multiplicative
+variant operates on a different scale per layer-score distribution and is
+intentionally not offered.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from pydantic import Field
+
+from ...config import BaseConfig
+
+
+class Control(BaseConfig):
+    """Scale attention toward one key position by ``factor``
+    (reference: inference_settings.py:8-12)."""
+
+    token_index: int = Field(description="key/token position to control", ge=0)
+    factor: float = Field(description="attention factor; <1 suppresses", gt=0)
+
+
+def build_attention_scores_manipulation(
+    controls: List[Control],
+    seq_len: int,
+    batch_size: int = 1,
+    dtype=jnp.float32,
+) -> Optional[jnp.ndarray]:
+    """-> (batch, 1, s_q, s_k) additive score offsets, or None if empty.
+
+    Every query's score against a controlled key position shifts by
+    ``log(factor)``; after softmax that multiplies the attention weight by
+    ~``factor`` (exactly, up to renormalisation) — the reference's
+    log-additive semantics.
+    """
+    if not controls:
+        return None
+    offsets = np.zeros((batch_size, 1, seq_len, seq_len), np.float32)
+    for c in controls:
+        if c.token_index >= seq_len:
+            raise ValueError(
+                f"control token_index {c.token_index} >= sequence length {seq_len}"
+            )
+        offsets[:, :, :, c.token_index] += float(np.log(c.factor))
+    return jnp.asarray(offsets, dtype)

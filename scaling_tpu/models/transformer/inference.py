@@ -159,18 +159,32 @@ class TransformerInferenceModule:
             "attention_scores_manipulation": None,
         }
 
-    def logits(self, token_ids) -> jax.Array:
-        """Full-sequence logits (b, s, vocab)."""
+    def logits(self, token_ids, controls=None) -> jax.Array:
+        """Full-sequence logits (b, s, vocab).
+
+        ``controls``: AtMan-style per-token attention controls
+        (attention_control.Control) applied as log-additive score offsets in
+        every layer (reference: inference_settings.py + attention.py:158)."""
         token_ids = jnp.asarray(token_ids)
         if token_ids.ndim == 1:
             token_ids = token_ids[None]
         b, s = token_ids.shape
         pos = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
-        if self._logits_fn is None:
-            self._logits_fn = jax.jit(
-                lambda p, t, po: self._run_layers(p, self._make_batch(t, po), None, None)[0]
+        manipulation = None
+        if controls:
+            from .attention_control import build_attention_scores_manipulation
+
+            manipulation = build_attention_scores_manipulation(
+                controls, seq_len=s, batch_size=b
             )
-        return self._logits_fn(self.params, token_ids, pos)
+        if self._logits_fn is None:
+            def run(p, t, po, manip):
+                batch = self._make_batch(t, po)
+                batch["attention_scores_manipulation"] = manip
+                return self._run_layers(p, batch, None, None)[0]
+
+            self._logits_fn = jax.jit(run)
+        return self._logits_fn(self.params, token_ids, pos, manipulation)
 
     def hidden_states(
         self,

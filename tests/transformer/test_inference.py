@@ -154,3 +154,28 @@ def test_checkpoint_carries_tokenizer(tmp_path):
     assert module.tokenizer is not None
     out = module.generate("a", max_tokens=2)
     assert out.completion is not None
+
+
+def test_attention_control_suppression(checkpoint_dir):
+    """AtMan-style controls shift attention scores log-additively: factor 1
+    is a no-op; a tiny factor on a prompt token changes downstream logits
+    (reference: inference_settings.py + attention.py:158)."""
+    from scaling_tpu.models.transformer.attention_control import Control
+
+    module = TransformerInferenceModule.from_checkpoint(checkpoint_dir)
+    prompt = [5, 9, 2, 14, 7, 3]
+    base = np.asarray(module.logits(prompt), np.float32)
+    noop = np.asarray(
+        module.logits(prompt, controls=[Control(token_index=1, factor=1.0)]),
+        np.float32,
+    )
+    np.testing.assert_allclose(noop, base, atol=1e-5)
+
+    suppressed = np.asarray(
+        module.logits(prompt, controls=[Control(token_index=1, factor=1e-6)]),
+        np.float32,
+    )
+    # positions after the suppressed token see different attention
+    assert np.abs(suppressed[0, 2:] - base[0, 2:]).max() > 1e-4
+    # position 0 attends only to itself (causal): unaffected
+    np.testing.assert_allclose(suppressed[0, 0], base[0, 0], atol=1e-5)
