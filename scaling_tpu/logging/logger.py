@@ -13,7 +13,7 @@ import sys
 from pathlib import Path
 from typing import Any, List, Optional
 
-from pydantic import Field
+from pydantic import Field, model_validator
 
 from ..config import BaseConfig
 
@@ -51,6 +51,18 @@ class LoggerConfig(BaseConfig):
     wandb_project: str = Field("scaling_tpu", description="")
     wandb_group: str = Field("default", description="")
     wandb_api_key: Optional[str] = Field(None, description="")
+
+    @model_validator(mode="after")
+    def _check_wandb_key(self):
+        """(reference: logger_config.py wandb/api-key validation)"""
+        import os
+
+        if self.use_wandb and not (self.wandb_api_key or os.environ.get("WANDB_API_KEY")):
+            raise ValueError(
+                "If 'use_wandb' is set to True a wandb api key needs to be "
+                "provided (wandb_api_key or the WANDB_API_KEY env variable)."
+            )
+        return self
 
 
 def _rank_enabled(ranks: Optional[List[int]], rank: int) -> bool:

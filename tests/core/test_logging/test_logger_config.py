@@ -1,0 +1,22 @@
+"""LoggerConfig validation (reference: tests/core/test_logging)."""
+
+import pytest
+from pydantic import ValidationError
+
+from scaling_tpu.logging import LoggerConfig
+
+
+def test_wandb_requires_api_key(monkeypatch):
+    monkeypatch.delenv("WANDB_API_KEY", raising=False)
+    with pytest.raises(ValidationError, match="wandb api key"):
+        LoggerConfig(use_wandb=True)
+    with pytest.raises(ValidationError, match="wandb api key"):
+        LoggerConfig(use_wandb=True, wandb_api_key="")
+
+
+def test_wandb_key_from_env_or_config(monkeypatch):
+    monkeypatch.delenv("WANDB_API_KEY", raising=False)
+    LoggerConfig(use_wandb=False)  # no key needed when off
+    LoggerConfig(use_wandb=True, wandb_api_key="some_key")
+    monkeypatch.setenv("WANDB_API_KEY", "some_key")
+    LoggerConfig(use_wandb=True)
