@@ -34,12 +34,43 @@ from scaling_tpu.topology import Topology
 MFU_TARGET = 0.45  # BASELINE.json: ">=45% MFU on a 7B on v5p-128"
 
 
+def fetch_scalar(x, timeout_s: float = 120.0):
+    """Best-effort device->host fetch of a scalar with a watchdog.
+
+    Over the tunnel a d2h transfer can hang outright when the link degrades
+    (observed live: ``float()`` on an ``x+1`` result never returned while
+    block_until_ready kept working). The bench must degrade, not hang — so
+    fetches run in a daemon thread and time out to None.
+    """
+    import threading
+
+    box: dict = {}
+
+    def run():
+        try:
+            box["v"] = float(x)
+        except Exception as e:  # surface device errors, not just timeouts
+            box["e"] = e
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    th.join(timeout_s)
+    if "e" in box:
+        raise box["e"]
+    return box.get("v")
+
+
 def measure_achievable_tflops() -> float:
     """Sustained large-matmul bf16 throughput on THIS device.
 
     Virtualized/shared chips (e.g. tunneled dev slices) can deliver a small
     fraction of the nominal peak; reporting MFU against the measured ceiling
     separates framework efficiency from hardware provisioning.
+
+    block_until_ready bounds each sample; the median-of-5 rejects the
+    occasional early return the tunnel produces under load (a bogus
+    22 PFLOP/s best-of-N reading made it into one artifact), and the
+    nominal hardware peak clamps the physical ceiling.
     """
     a = jax.random.normal(jax.random.PRNGKey(0), (4096, 4096), jnp.bfloat16)
     b = jax.random.normal(jax.random.PRNGKey(1), (4096, 4096), jnp.bfloat16)
@@ -49,19 +80,20 @@ def measure_achievable_tflops() -> float:
         def body(x, _):
             return x @ b, None
 
+        # the scan serializes its 32 matmuls, so the block below bounds the
+        # full computation; a scalar fetch can hang on a degraded tunnel
         x, _ = jax.lax.scan(body, a, None, length=32)
         return x.sum()
 
     jax.block_until_ready(chain(a, b))  # compile
-    best = float("inf")
-    for i in range(3):  # best-of-3: the chip may be time-shared
+    times = []
+    for i in range(5):
         t0 = time.perf_counter()
-        # the scan serializes its 32 matmuls, so block_until_ready bounds
-        # the full computation; a scalar fetch would add a host roundtrip
-        # (hundreds of ms over a slow tunnel) and understate the peak
         jax.block_until_ready(chain(a + float(i), b))
-        best = min(best, time.perf_counter() - t0)
-    return 32 * 2 * 4096**3 / best / 1e12
+        times.append(time.perf_counter() - t0)
+    t_med = max(sorted(times)[len(times) // 2], 1e-9)
+    measured = 32 * 2 * 4096**3 / t_med / 1e12
+    return min(measured, detect_hardware().max_tflops)
 
 
 def detect_hardware() -> HardwareType:
@@ -162,6 +194,14 @@ def main() -> None:
         )
         params, opt_state, loss, _, _ = step(params, opt_state, batch, key)
         jax.block_until_ready(loss)
+        try:
+            val = fetch_scalar(loss)  # best-effort: None when d2h is down
+        except Exception:
+            val = None  # a broken transfer is infra, not a kernel failure
+        if val is not None and not np.isfinite(val):
+            # non-finite loss under the current kernel IS a kernel failure:
+            # let the flash->XLA fallback catch and record it
+            raise RuntimeError(f"non-finite warmup loss {val}")
         return arch, key, params, opt_state, step, batch
 
     try:
@@ -175,13 +215,20 @@ def main() -> None:
         arch, key, params, opt_state, step, batch = setup_and_warm()
 
     iters = 10 if on_tpu else 3
-    t0 = time.perf_counter()
-    for i in range(iters):
-        params, opt_state, loss, _, _ = step(
-            params, opt_state, batch, jax.random.fold_in(key, i)
-        )
-    jax.block_until_ready(loss)
-    dt = (time.perf_counter() - t0) / iters
+    # median-of-3 windows: the chip is time-shared (a window can absorb a
+    # co-tenant burst) and the tunnel can return a block early under load
+    # (min would keep exactly the bogus sample); each window is bounded by
+    # block_until_ready on the final loss, which chains on all prior steps
+    windows = []
+    for _ in range(3 if on_tpu else 1):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            params, opt_state, loss, _, _ = step(
+                params, opt_state, batch, jax.random.fold_in(key, i)
+            )
+        jax.block_until_ready(loss)
+        windows.append((time.perf_counter() - t0) / iters)
+    dt = sorted(windows)[len(windows) // 2]
 
     tokens_per_sec = mbs * seq_len / dt
     param_count = get_model_parameter_count(
@@ -196,6 +243,11 @@ def main() -> None:
     mfu_achievable = (
         round(mfu * hardware.max_tflops / achievable, 4) if achievable else None
     )
+    if mfu > 1.0:
+        # physically impossible: the tunnel returned a block early and the
+        # timing is garbage — better no number than a fantasy one
+        print(f"# timing implausible (mfu={mfu:.2f} > 1); rerun", file=sys.stderr)
+        sys.exit(1)
     print(
         json.dumps(
             {
