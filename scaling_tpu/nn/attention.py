@@ -52,6 +52,35 @@ def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
     return x.reshape(b, s, n_kv * n_rep, h)
 
 
+def flash_path_active(
+    *,
+    kernel_is_flash: bool,
+    causal: bool,
+    dropout_attention_probs: float,
+    deterministic: bool,
+    context_parallel_size: int,
+    seq_len: int,
+    head_dim: int,
+    has_kv_cache: bool = False,
+    has_scores_manipulation: bool = False,
+) -> bool:
+    """Single source of truth for the flash-vs-XLA kernel gate.
+
+    ``ParallelSelfAttention.__call__`` decides through this, and bench.py
+    reports through it, so the artifact's ``kernel`` label cannot drift
+    from the path that actually ran (mirrors the reference's kernel switch,
+    masked_softmax_config.py:8-37)."""
+    if not kernel_is_flash or has_kv_cache or has_scores_manipulation:
+        return False
+    if not causal or context_parallel_size > 1:
+        return False
+    if dropout_attention_probs > 0.0 and not deterministic:
+        return False
+    from ..ops.flash_attention import flash_attention_supported
+
+    return flash_attention_supported(seq_len, head_dim)
+
+
 def multi_head_attention(
     query: jax.Array,  # (b, s_q, n, h)
     key: jax.Array,  # (b, s_k, n, h)
@@ -308,22 +337,19 @@ class ParallelSelfAttention(BaseLayer):
         # the flash (splash) kernel consumes UNREPEATED kv heads — the KV
         # bandwidth/memory win of GQA — and covers mixed local/global heads
         # via per-head masks; every other path repeats below
-        use_flash_here = (
-            self.use_flash
-            and kv_cache is None
-            and attention_scores_manipulation is None
-            and dropout_fn is None
-            and self.causal
-            and ctx.context_parallel_size <= 1
+        use_flash_here = flash_path_active(
+            kernel_is_flash=self.use_flash,
+            causal=self.causal,
+            dropout_attention_probs=self.dropout_attention_probs,
+            deterministic=ctx.deterministic,
+            context_parallel_size=ctx.context_parallel_size,
+            seq_len=s,
+            head_dim=self.head_dim,
+            has_kv_cache=kv_cache is not None,
+            has_scores_manipulation=attention_scores_manipulation is not None,
         )
         if use_flash_here:
-            from ..ops.flash_attention import (
-                flash_attention_fused,
-                flash_attention_supported,
-            )
-
-            use_flash_here = flash_attention_supported(s, self.head_dim)
-        if use_flash_here:
+            from ..ops.flash_attention import flash_attention_fused
             out = flash_attention_fused(
                 q, k, v, segment_ids, causal=True, sm_scale=self.scaling_factor,
                 num_local_heads=n_local,

@@ -27,7 +27,7 @@ def pretrain(tmp_path_factory):
 
 
 def finetune_config(tmp_path, pretrain, peft_arch, finetunable=None, missing=None,
-                    unexpected=None):
+                    unexpected=None, topology=None):
     save_dir, prefix = pretrain
     cfg = make_config(
         tmp_path, prefix, train_iterations=3, save_interval=100,
@@ -42,6 +42,9 @@ def finetune_config(tmp_path, pretrain, peft_arch, finetunable=None, missing=Non
     d["trainer"]["allowed_unexpected_keys_in_checkpoint"] = unexpected or []
     d["trainer"]["load_optimizer_states"] = False
     d["trainer"]["load_context"] = False
+    if topology:
+        d["topology"].update(topology)
+        d["topology"]["world_size"] = None  # re-derive from the parallel sizes
     return type(cfg).from_dict(d)
 
 
@@ -49,12 +52,8 @@ def trainable_keys(trainer):
     return {k for g in trainer.optimizer.parameter_groups for k in g.keys}
 
 
-def test_lora_finetune(tmp_path, pretrain):
-    cfg = finetune_config(
-        tmp_path, pretrain,
-        {"lora_config": {"name": "lo", "rank": 2, "alpha": 4}},
-        missing=[r".*_lo\."],
-    )
+def run_lora_finetune_and_check(cfg):
+    """Train 3 steps; only LoRA params may move, base weights stay frozen."""
     trainer = build_capturing_trainer(cfg, load=True)
     keys = trainable_keys(trainer)
     assert keys and all("_lo." in k for k in keys), keys
@@ -62,12 +61,27 @@ def test_lora_finetune(tmp_path, pretrain):
     losses = train_capture(trainer, 3)
     assert np.isfinite(losses).all()
     after = {k: np.asarray(p) for k, p, _ in trainer.module.named_parameters(trainer.params)}
-    for k in before:
-        if "_lo." in k and "lora_a" in k.lower() or ("_lo." in k and "a" in k.split(".")[-1]):
-            continue
-    # frozen base weights must be bit-identical; LoRA A params must move
     moved = {k for k in before if not np.array_equal(before[k], after[k])}
     assert moved and all("_lo." in k for k in moved), moved
+
+
+LORA_ARCH = {"lora_config": {"name": "lo", "rank": 2, "alpha": 4}}
+
+
+def test_lora_finetune(tmp_path, pretrain):
+    cfg = finetune_config(tmp_path, pretrain, LORA_ARCH, missing=[r".*_lo\."])
+    run_lora_finetune_and_check(cfg)
+
+
+def test_lora_finetune_tensor_parallel(tmp_path, pretrain):
+    """BASELINE #5's combination at test scale: LoRA finetune under TP=2,
+    loading the mp=1 pretrain checkpoint into the mp=2 layout (reference
+    grids: tests/transformer/test_finetuning.py)."""
+    cfg = finetune_config(
+        tmp_path, pretrain, LORA_ARCH, missing=[r".*_lo\."],
+        topology={"model_parallel_size": 2},
+    )
+    run_lora_finetune_and_check(cfg)
 
 
 def test_bitfit_finetune(tmp_path, pretrain):
