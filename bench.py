@@ -155,6 +155,9 @@ def build(seq_len: int, micro_batch_size: int, hidden: int, layers: int):
                 # XLA attention ~10x at seq 2048 in the fwd+bwd micro-bench;
                 # BENCH_KERNEL=torch selects the XLA path for comparison
                 "masked_softmax": {"kernel": os.environ.get("BENCH_KERNEL", "flash_attention")},
+                # BENCH_NORM=fused selects the Pallas fused RMSNorm for A/B
+                # against the XLA-fused default
+                "layernorm": {"optimization_type": os.environ.get("BENCH_NORM", "torch")},
                 "weight_tying": False,
                 "attention_qkv_in_one": False,
                 "dropout_embedding": 0.0,

@@ -3,10 +3,10 @@
 Parity with the reference's norm stack
 (reference: src/scaling/core/nn/norm/layernorm.py:14-87, rms_norm.py:21-63,
 get_norm.py): LayerNorm with optional bitfit bias, RMSNorm, a factory keyed
-by ``NormType``. The reference's fused flash-attn RMSNorm kernel maps to a
-Pallas fused path later; XLA already fuses these elementwise chains into
-neighbouring matmuls, so the ``torch`` optimization type is simply the XLA
-path here.
+by ``NormType``. The reference's ``fused`` optimization type (flash-attn's
+CUDA fused rms_norm) maps to the Pallas kernel in ``ops/rms_norm.py``;
+``torch`` is the plain XLA path, which XLA fuses into neighbouring ops on
+its own.
 
 Sequence-parallel contract: norms sit *between* TP regions, so under SP
 their input/output stay sequence-sharded; the surrounding linears change
@@ -122,6 +122,20 @@ class RMSNorm(BaseLayer):
         return {"weight": _norm_meta("weight")}
 
     def __call__(self, params: dict, x: jax.Array, ctx: ForwardContext) -> jax.Array:
+        if self.config.optimization_type == LayerNormOptimizationType.FUSED:
+            from ..ops.rms_norm import rms_norm_fused, rms_norm_fused_supported
+
+            # pallas calls are opaque to GSPMD (see ops/flash_attention.py's
+            # shard_map handling): on a multi-device mesh the kernel would
+            # force an all-gather of the (possibly sequence-sharded)
+            # activation, so the fused path is single-device-mesh only and
+            # TP/SP layouts keep the XLA path until the kernel grows its own
+            # shard_map integration
+            single_device = ctx.mesh is None or ctx.mesh.size <= 1
+            if single_device and rms_norm_fused_supported(self.dimensions):
+                return rms_norm_fused(
+                    x, params["weight"], self.config.layernorm_epsilon
+                )
         dtype = x.dtype
         x32 = x.astype(jnp.float32)
         var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)

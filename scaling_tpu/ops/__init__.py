@@ -1,3 +1,9 @@
 from .flash_attention import flash_attention_fused, flash_attention_supported
+from .rms_norm import rms_norm_fused, rms_norm_fused_supported
 
-__all__ = ["flash_attention_fused", "flash_attention_supported"]
+__all__ = [
+    "flash_attention_fused",
+    "flash_attention_supported",
+    "rms_norm_fused",
+    "rms_norm_fused_supported",
+]
