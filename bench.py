@@ -204,6 +204,19 @@ def main() -> None:
         # keep the CPU smoke path fast; numbers only meaningful on TPU
         seq_len, mbs, hidden, layers = 512, 2, 512, 4
 
+    if os.environ.get("BENCH_NORM") == "fused":
+        from scaling_tpu.ops.rms_norm import rms_norm_fused_supported
+
+        if not rms_norm_fused_supported(hidden):
+            # without this, the 'fused' A/B arm silently measures the same
+            # XLA path as the baseline and reads as "no benefit"
+            print(
+                "# BENCH_NORM=fused requested but unsupported here "
+                f"(hidden={hidden}, backend={jax.default_backend()}): "
+                "this run measures the XLA norm path",
+                file=sys.stderr,
+            )
+
     def setup_and_warm():
         config, topology, module, optimizer = build(seq_len, mbs, hidden, layers)
         arch = config.transformer_architecture

@@ -268,15 +268,21 @@ class TransformerInferenceModule:
         Stops at ``eos_token_id`` or any of ``stop_tokens`` (reference's
         ``stop_tokens`` sequence); per-step logits for the emitted tokens
         come back in ``CompletionOutput.logits`` like the reference's
-        ``completion_logits``."""
+        ``completion_logits``.
+
+        Accepts a batch of same-length prompts as a (b, s) array (or a list
+        of b token lists) and decodes all rows in one pass, each row
+        stopping independently — the reference's cache is bs=1 only
+        (attention.py:491). Batched input returns a list of
+        ``CompletionOutput``; 1-D input keeps the single-output form."""
         if isinstance(input_ids, str):
             assert self.tokenizer is not None, "text prompt needs a tokenizer"
             input_ids = self.tokenizer.encode(input_ids)
         prompt = jnp.asarray(input_ids, jnp.int32)
-        if prompt.ndim == 1:
+        single = prompt.ndim == 1
+        if single:
             prompt = prompt[None]
         b, prompt_len = prompt.shape
-        assert b == 1, "generate supports batch size 1 (reference: attention.py:491)"
         if eos_token_id is None and self.tokenizer is not None:
             eos_token_id = self.tokenizer.eos_token_id
         stop = set(stop_tokens or [])
@@ -284,14 +290,25 @@ class TransformerInferenceModule:
             stop.add(int(eos_token_id))
         sample = sample_fn or sample_argmax
         key = jax.random.PRNGKey(seed)
-        out_logits: List[jax.Array] = []
+        row_tokens: List[List[int]] = [[] for _ in range(b)]
+        row_logits: List[List[jax.Array]] = [[] for _ in range(b)]
+        finished = [False] * b
+
+        def collect(tok, step_logits):
+            """Append this step's token/logits to unfinished rows."""
+            tok_host = np.asarray(tok)  # one transfer per step, not per row
+            for i in range(b):
+                if finished[i]:
+                    continue
+                row_tokens[i].append(int(tok_host[i]))
+                row_logits[i].append(step_logits[i])
+                finished[i] = row_tokens[i][-1] in stop
 
         if use_cache:
             max_len = prompt_len + max_tokens
             logits, caches = self._prefill(prompt, max_len)
             next_tok = sample(logits[:, -1], key)
-            out_tokens = [int(next_tok[0])]
-            out_logits.append(logits[:, -1])
+            collect(next_tok, logits[:, -1])
 
             # the jitted decode closure bakes in the sampler: invalidate on
             # either a new length or a different sample_fn, or a later call
@@ -302,7 +319,8 @@ class TransformerInferenceModule:
                 or getattr(self, "_decode_sampler", None) is not sample
             ):
                 def decode(params, caches, tok, offset, k):
-                    pos = jnp.broadcast_to(offset[None, None], (1, 1))
+                    bb = tok.shape[0]
+                    pos = jnp.broadcast_to(offset[None, None], (bb, 1))
                     batch = self._make_batch(tok[:, None], pos)
                     logits, new_caches = self._run_layers(params, batch, caches, offset)
                     nxt = sample(logits[:, -1], k)
@@ -314,39 +332,45 @@ class TransformerInferenceModule:
 
             tok = next_tok
             for t in range(1, max_tokens):
-                if out_tokens[-1] in stop:
+                if all(finished):
                     break
                 key, sub = jax.random.split(key)
+                # finished rows keep stepping (their output is discarded);
+                # rows advance in lockstep so one shared cache_offset works
                 tok, step_logits, caches = self._decode_fn(
                     self.params, caches, tok, jnp.asarray(prompt_len + t - 1, jnp.int32), sub
                 )
-                out_tokens.append(int(tok[0]))
-                out_logits.append(step_logits)
+                collect(tok, step_logits)
         else:
             # refeed the whole (fixed-size) buffer each step: one compile
             max_len = prompt_len + max_tokens
-            buf = jnp.zeros((1, max_len), jnp.int32)
+            buf = jnp.zeros((b, max_len), jnp.int32)
             buf = jax.lax.dynamic_update_slice_in_dim(buf, prompt, 0, axis=1)
             fwd = jax.jit(
                 lambda p, t, po: self._run_layers(p, self._make_batch(t, po), None, None)[0]
             )
-            pos = jnp.broadcast_to(jnp.arange(max_len)[None], (1, max_len))
-            out_tokens = []
+            pos = jnp.broadcast_to(jnp.arange(max_len)[None], (b, max_len))
             cur = prompt_len
             for _ in range(max_tokens):
+                if all(finished):
+                    break
                 logits = fwd(self.params, buf, pos)
                 key, sub = jax.random.split(key)
                 nxt = sample(logits[:, cur - 1], sub)
-                out_tokens.append(int(nxt[0]))
-                out_logits.append(logits[:, cur - 1])
-                if out_tokens[-1] in stop:
-                    break
-                buf = jax.lax.dynamic_update_slice(buf, nxt[:, None].astype(jnp.int32), (0, cur))
+                collect(nxt, logits[:, cur - 1])
+                buf = jax.lax.dynamic_update_slice(
+                    buf, nxt[:, None].astype(jnp.int32), (0, cur)
+                )
                 cur += 1
 
-        text = self.tokenizer.decode(out_tokens) if self.tokenizer else None
-        return CompletionOutput(
-            completion_ids=out_tokens,
-            completion=text,
-            logits=jnp.concatenate(out_logits, axis=0) if out_logits else None,
-        )
+        outs = [
+            CompletionOutput(
+                completion_ids=row_tokens[i],
+                completion=(
+                    self.tokenizer.decode(row_tokens[i]) if self.tokenizer else None
+                ),
+                logits=jnp.stack(row_logits[i], axis=0) if row_logits[i] else None,
+            )
+            for i in range(b)
+        ]
+        return outs[0] if single else outs

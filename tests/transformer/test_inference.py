@@ -179,3 +179,25 @@ def test_attention_control_suppression(checkpoint_dir):
     assert np.abs(suppressed[0, 2:] - base[0, 2:]).max() > 1e-4
     # position 0 attends only to itself (causal): unaffected
     np.testing.assert_allclose(suppressed[0, 0], base[0, 0], atol=1e-5)
+
+
+def test_generate_batched_matches_single(checkpoint_dir):
+    """Batched greedy decode (beyond the reference's bs=1 cache,
+    attention.py:491): each row of a (b, s) prompt batch must emit exactly
+    the tokens that row produces when generated alone, with independent
+    per-row stopping."""
+    module = TransformerInferenceModule.from_checkpoint(checkpoint_dir)
+    prompts = [[5, 9, 2, 14, 7], [3, 3, 8, 1, 12], [20, 4, 6, 9, 2]]
+    batched = module.generate(prompts, max_tokens=6, use_cache=True)
+    assert isinstance(batched, list) and len(batched) == 3
+    for row, prompt in zip(batched, prompts):
+        alone = module.generate(prompt, max_tokens=6, use_cache=True)
+        assert row.completion_ids == alone.completion_ids
+        np.testing.assert_allclose(
+            np.asarray(row.logits), np.asarray(alone.logits), atol=1e-4
+        )
+    # uncached path decodes batches too, and must agree
+    batched_nc = module.generate(prompts, max_tokens=6, use_cache=False)
+    assert [o.completion_ids for o in batched_nc] == [
+        o.completion_ids for o in batched
+    ]
