@@ -359,43 +359,62 @@ class ParallelSelfAttention(BaseLayer):
             return self._project_out(params, out, ctx, b, s, new_kv)
 
         if ctx.context_parallel_size > 1 and kv_cache is None:
-            # ring attention: sequence sharded over the context mesh axis,
-            # K/V blocks rotate over ICI (ops/ring_attention.py). The ring is
-            # GQA-native — rotating unrepeated KV cuts ICI traffic by the
-            # group factor — but kv heads must still shard over the model
-            # axis; repeat only as far as divisibility requires.
+            # context parallelism: sequence sharded over the context mesh
+            # axis. Two variants (topology.context_parallel_variant): 'ring'
+            # rotates K/V blocks over ICI (ops/ring_attention.py); 'ulysses'
+            # all-to-alls heads for sequence (ops/ulysses_attention.py).
+            # Both are GQA-native — unrepeated KV cuts ICI traffic by the
+            # group factor — but kv heads must still divide over the model
+            # axis (and, for ulysses, over the context axis too); repeat
+            # only as far as divisibility requires.
             assert attention_scores_manipulation is None, (
                 "attention_scores_manipulation is unsupported under context "
                 "parallelism"
             )
             assert n_local == 0, "local-window heads are unsupported under CP"
             assert dropout_fn is None, "attention-prob dropout unsupported under CP"
-            from ..ops.ring_attention import ring_attention
             from ..topology.topology import MODEL_AXIS
 
+            assert ctx.context_parallel_variant in ("ring", "ulysses"), (
+                f"unknown context_parallel_variant "
+                f"{ctx.context_parallel_variant!r} (expected 'ring' or "
+                "'ulysses') — refusing to silently pick a collective pattern"
+            )
+            ulysses = ctx.context_parallel_variant == "ulysses"
             mp = (
                 ctx.mesh.shape[MODEL_AXIS]
                 if ctx.mesh is not None and MODEL_AXIS in ctx.mesh.axis_names
                 else 1
             )
+            # kv heads must split cleanly over the model axis — and for
+            # ulysses also over the context axis after the model split
+            div = mp * (ctx.context_parallel_size if ulysses else 1)
             kr, vr = k, v
             n_kv = k.shape[2]
-            if n_kv % mp != 0:
-                # kv heads must shard over the model axis: repeat only as far
-                # as divisibility requires (full repeat would forfeit the
-                # whole GQA ICI saving). repeat_kv's consecutive copies stay
-                # aligned with the ring's grouped-head reshape.
+            if n_kv % div != 0:
+                # repeat_kv's consecutive copies stay aligned with the
+                # grouped-head reshape both variants use
                 import math
 
-                rep = mp // math.gcd(n_kv, mp)
+                rep = div // math.gcd(n_kv, div)
                 if self.num_repeat_kv % rep != 0:
                     rep = self.num_repeat_kv  # fallback: full repeat
                 kr = repeat_kv(k, rep)
                 vr = repeat_kv(v, rep)
-            out = ring_attention(
-                q, kr, vr, segment_ids, ctx.mesh,
-                causal=self.causal, sm_scale=self.scaling_factor,
-            )
+            if ulysses:
+                from ..ops.ulysses_attention import ulysses_attention
+
+                out = ulysses_attention(
+                    q, kr, vr, segment_ids, ctx.mesh,
+                    causal=self.causal, sm_scale=self.scaling_factor,
+                )
+            else:
+                from ..ops.ring_attention import ring_attention
+
+                out = ring_attention(
+                    q, kr, vr, segment_ids, ctx.mesh,
+                    causal=self.causal, sm_scale=self.scaling_factor,
+                )
             return self._project_out(params, out, ctx, b, s, new_kv)
 
         k = repeat_kv(k, self.num_repeat_kv)

@@ -32,6 +32,9 @@ class ForwardContext:
     sequence_parallel: bool = False
     model_parallel_size: int = 1
     context_parallel_size: int = 1
+    # "ring" (K/V rotation) or "ulysses" (head all-to-all); see
+    # topology.config.ContextParallelVariant
+    context_parallel_variant: str = "ring"
     # mesh is needed for explicit collectives; None on single device
     mesh: Optional[Any] = None
 

@@ -1,9 +1,13 @@
 from .flash_attention import flash_attention_fused, flash_attention_supported
+from .ring_attention import ring_attention
 from .rms_norm import rms_norm_fused, rms_norm_fused_supported
+from .ulysses_attention import ulysses_attention
 
 __all__ = [
     "flash_attention_fused",
     "flash_attention_supported",
+    "ring_attention",
     "rms_norm_fused",
     "rms_norm_fused_supported",
+    "ulysses_attention",
 ]

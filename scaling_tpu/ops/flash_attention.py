@@ -207,7 +207,7 @@ def flash_attention_fused(
         # GSPMD, which would otherwise gather heads to every device. With
         # uniform causal masks each model shard runs an identical kernel on
         # its contiguous slice of q (and kv) heads; batch splits over data.
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
         from ..topology.topology import DATA_AXIS, MODEL_AXIS
@@ -232,7 +232,7 @@ def flash_attention_fused(
             mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec, P(DATA_AXIS, None)),
             out_specs=qkv_spec,
-            check_rep=False,
+            check_vma=False,
         )(qt, kt, vt, seg_i32)
     else:
         out = run_local(qt, kt, vt, seg_i32)

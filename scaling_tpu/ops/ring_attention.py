@@ -110,7 +110,7 @@ def ring_attention(
 ) -> jax.Array:
     """shard_map entry: shards q/k/v over (data, context, model) and runs the
     ring. Requires seq divisible by the context axis size."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     if segment_ids is None:
         segment_ids = jnp.zeros(q.shape[:2], jnp.int32)
@@ -128,6 +128,6 @@ def ring_attention(
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec),
         out_specs=qkv_spec,
-        check_rep=False,
+        check_vma=False,
     )
     return fn(q, k, v, segment_ids)

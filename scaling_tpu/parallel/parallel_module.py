@@ -361,6 +361,9 @@ class ParallelModule:
             sequence_parallel=bool(topo and topo.sequence_parallel),
             model_parallel_size=topo.model_parallel_size if topo else 1,
             context_parallel_size=topo.context_parallel_size if topo else 1,
+            context_parallel_variant=(
+                topo.context_parallel_variant if topo else "ring"
+            ),
             mesh=topo.mesh if topo else None,
         )
 

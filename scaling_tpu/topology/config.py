@@ -27,6 +27,11 @@ class ActivationCheckpointingType(Enum):
     DISABLED = "disabled"
 
 
+class ContextParallelVariant(Enum):
+    RING = "ring"
+    ULYSSES = "ulysses"
+
+
 class TopologyConfig(BaseConfig):
     global_rank: Optional[int] = Field(None, description="", ge=0)
 
@@ -42,12 +47,20 @@ class TopologyConfig(BaseConfig):
 
     context_parallel_size: int = Field(
         1,
-        description="ring-attention context parallelism: activations shard "
-        "along the sequence dim over a 'context' mesh axis; K/V blocks rotate "
-        "over ICI with collective-permute. A capability beyond the reference "
-        "(which caps context at per-device memory, SURVEY §5). Requires "
-        "pipe_parallel_size == 1.",
+        description="context parallelism: activations shard along the "
+        "sequence dim over a 'context' mesh axis. A capability beyond the "
+        "reference (which caps context at per-device memory, SURVEY §5). "
+        "Requires pipe_parallel_size == 1.",
         gt=0,
+    )
+
+    context_parallel_variant: ContextParallelVariant = Field(
+        ContextParallelVariant.RING,
+        description="how attention crosses the context axis: 'ring' rotates "
+        "unrepeated K/V blocks over ICI collective-permute (O(s/cp) memory, "
+        "best for very long sequences); 'ulysses' all-to-alls heads for "
+        "sequence so each device attends its n/cp heads over the full "
+        "sequence (two collectives per layer, needs heads divisible by cp)",
     )
 
     global_batch_size: int = Field(

@@ -74,6 +74,10 @@ class Topology:
         return self.config.context_parallel_size
 
     @property
+    def context_parallel_variant(self) -> str:
+        return self.config.context_parallel_variant.value
+
+    @property
     def micro_batch_size(self) -> int:
         return self.config.micro_batch_size
 

@@ -22,16 +22,20 @@ def data_prefix(tmp_path_factory):
     return prefix
 
 
-def cp_config(tmp_path, data_prefix, cp, load_dir=None):
+def cp_config(tmp_path, data_prefix, cp, load_dir=None, variant="ring"):
     cfg = make_config(tmp_path, data_prefix, train_iterations=5, save_interval=100,
                       load_dir=load_dir)
     d = cfg.model_dump(mode="json")
     d["topology"]["context_parallel_size"] = cp
+    d["topology"]["context_parallel_variant"] = variant
     d["topology"]["world_size"] = cp
     return type(cfg).from_dict(d)
 
 
-def test_cp2_loss_matches_cp1(tmp_path, data_prefix):
+@pytest.mark.parametrize("variant", ["ring", "ulysses"])
+def test_cp2_loss_matches_cp1(tmp_path, data_prefix, variant):
+    """Either context-parallel variant must reproduce the cp=1 losses from
+    identical weights — the variant changes attention internals only."""
     seed_cfg = make_config(tmp_path / "seed", data_prefix, train_iterations=1,
                            save_interval=100)
     t0 = build_capturing_trainer(seed_cfg)
@@ -40,7 +44,7 @@ def test_cp2_loss_matches_cp1(tmp_path, data_prefix):
     losses = {}
     for cp in (1, 2):
         cfg = cp_config(tmp_path / f"cp{cp}", data_prefix, cp,
-                        load_dir=Path(seed_cfg.trainer.save_dir))
+                        load_dir=Path(seed_cfg.trainer.save_dir), variant=variant)
         t = build_capturing_trainer(cfg, load=True)
         losses[cp] = train_capture(t, 5)
     np.testing.assert_allclose(
