@@ -110,7 +110,7 @@ def test_ulysses_gqa_unrepeated_kv(cp_topology):
 
 
 def test_ulysses_rejects_indivisible_heads(cp_topology):
-    """3 heads over a 4-wide context axis cannot all-to-all: loud error, not
+    """2 heads over a 4-wide context axis cannot all-to-all: loud error, not
     silent corruption."""
     q, k, v = make_qkv(4, n=2, n_kv=2)
     seg = jnp.zeros((B, S), jnp.int32)

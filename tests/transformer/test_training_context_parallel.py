@@ -32,22 +32,31 @@ def cp_config(tmp_path, data_prefix, cp, load_dir=None, variant="ring"):
     return type(cfg).from_dict(d)
 
 
-@pytest.mark.parametrize("variant", ["ring", "ulysses"])
-def test_cp2_loss_matches_cp1(tmp_path, data_prefix, variant):
-    """Either context-parallel variant must reproduce the cp=1 losses from
-    identical weights — the variant changes attention internals only."""
-    seed_cfg = make_config(tmp_path / "seed", data_prefix, train_iterations=1,
+@pytest.fixture(scope="module")
+def cp1_baseline(tmp_path_factory, data_prefix):
+    """Variant-independent half of the parity test, computed once: a seed
+    checkpoint plus the cp=1 losses trained from it (cp=1 never reaches
+    the variant branch)."""
+    tmp = tmp_path_factory.mktemp("cp_base")
+    seed_cfg = make_config(tmp / "seed", data_prefix, train_iterations=1,
                            save_interval=100)
     t0 = build_capturing_trainer(seed_cfg)
     t0.save_checkpoint()
+    seed_dir = Path(seed_cfg.trainer.save_dir)
+    cfg = cp_config(tmp / "cp1", data_prefix, 1, load_dir=seed_dir)
+    losses = train_capture(build_capturing_trainer(cfg, load=True), 5)
+    return seed_dir, losses
 
-    losses = {}
-    for cp in (1, 2):
-        cfg = cp_config(tmp_path / f"cp{cp}", data_prefix, cp,
-                        load_dir=Path(seed_cfg.trainer.save_dir), variant=variant)
-        t = build_capturing_trainer(cfg, load=True)
-        losses[cp] = train_capture(t, 5)
+
+@pytest.mark.parametrize("variant", ["ring", "ulysses"])
+def test_cp2_loss_matches_cp1(tmp_path, data_prefix, cp1_baseline, variant):
+    """Either context-parallel variant must reproduce the cp=1 losses from
+    identical weights — the variant changes attention internals only."""
+    seed_dir, cp1_losses = cp1_baseline
+    cfg = cp_config(tmp_path / "cp2", data_prefix, 2, load_dir=seed_dir,
+                    variant=variant)
+    cp2_losses = train_capture(build_capturing_trainer(cfg, load=True), 5)
     np.testing.assert_allclose(
-        np.asarray(losses[1], np.float32), np.asarray(losses[2], np.float32),
+        np.asarray(cp1_losses, np.float32), np.asarray(cp2_losses, np.float32),
         rtol=2e-4, atol=2e-4,
     )
