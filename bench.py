@@ -194,12 +194,23 @@ def synth_batch(rng: np.random.Generator, batch: int, seq_len: int, vocab: int, 
     }
 
 
+def checked_devices():
+    """First device contact, watchdogged: a hung bench records nothing, so
+    an unreachable backend aborts with an explicit message instead."""
+    from scaling_tpu.devices import probe_devices
+
+    devs, err = probe_devices(timeout_s=60.0)
+    if devs is None:
+        sys.exit(f"# bench: device backend unreachable ({err}); aborting")
+    return devs
+
+
 def main() -> None:
     seq_len, mbs = 2048, 4
     # ~0.5B: params bf16 + fp32 master/moments + fp32 grads ~ 9G, inside the
     # 16G HBM of the smallest current chip (v5e)
     hidden, layers = 2048, 8
-    on_tpu = jax.devices()[0].platform == "tpu"
+    on_tpu = checked_devices()[0].platform == "tpu"
     if not on_tpu:
         # keep the CPU smoke path fast; numbers only meaningful on TPU
         seq_len, mbs, hidden, layers = 512, 2, 512, 4

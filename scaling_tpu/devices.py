@@ -1,0 +1,39 @@
+"""Watchdogged first contact with the JAX device backend.
+
+Backend init can block indefinitely when a tunneled accelerator's link is
+down (observed live: ``jax.devices()`` never returned while the process
+stayed healthy). Anything that must not hang — the bench, the driver's
+multichip dryrun — probes through here instead of calling ``jax.devices()``
+directly.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Tuple
+
+
+def probe_devices(
+    timeout_s: float = 30.0,
+) -> Tuple[Optional[List[Any]], Optional[BaseException | str]]:
+    """Return ``(devices, None)`` on success, ``(None, reason)`` on failure.
+
+    ``reason`` is the raised exception if ``jax.devices()`` failed, or a
+    timeout description if it never answered. Runs in a daemon thread so a
+    hung backend cannot hang the caller."""
+    import jax
+
+    box: dict = {}
+
+    def run():
+        try:
+            box["devs"] = jax.devices()
+        except Exception as e:
+            box["err"] = e
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    th.join(timeout_s)
+    if "devs" in box:
+        return box["devs"], None
+    return None, box.get("err", f"no response in {timeout_s:.0f}s")
