@@ -1,0 +1,61 @@
+"""Scratch: isolate flash vs XLA attention fwd+bwd at the bench shape."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+from scaling_tpu.ops.flash_attention import flash_attention_fused
+
+B, S, N, NKV, D = 4, 2048, 16, 4, 128
+scale = D ** -0.5
+
+
+def timeit(fn, *args, iters=10):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best * 1e3
+
+
+key = jax.random.PRNGKey(0)
+q = jax.random.normal(key, (B, S, N, D), jnp.bfloat16)
+k = jax.random.normal(key, (B, S, NKV, D), jnp.bfloat16)
+v = jax.random.normal(key, (B, S, NKV, D), jnp.bfloat16)
+seg = jnp.zeros((B, S), jnp.int32)
+
+
+def flash(q, k, v):
+    return flash_attention_fused(q, k, v, segment_ids=seg, sm_scale=scale)
+
+
+def xla_attn(q, k, v):
+    # repeat kv to full heads, causal masked softmax
+    rep = N // NKV
+    kk = jnp.repeat(k, rep, axis=2)
+    vv = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bsnd,btnd->bnst", q, kk) * scale
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    logits = jnp.where(mask[None, None], logits.astype(jnp.float32), -1e9)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnst,btnd->bsnd", p, vv)
+
+
+def loss_of(fn):
+    def f(q, k, v):
+        return fn(q, k, v).astype(jnp.float32).sum()
+    return jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+
+
+fwd_flash = jax.jit(flash)
+fwd_xla = jax.jit(xla_attn)
+print(f"flash fwd : {timeit(fwd_flash, q, k, v):8.2f} ms")
+print(f"xla   fwd : {timeit(fwd_xla, q, k, v):8.2f} ms")
+print(f"flash f+b : {timeit(loss_of(flash), q, k, v):8.2f} ms")
+print(f"xla   f+b : {timeit(loss_of(xla_attn), q, k, v):8.2f} ms")

@@ -95,9 +95,17 @@ class TransformerInferenceModule:
         checkpoint_dir: Path | str,
         vocab_file: Optional[Path | str] = None,
         overwrite_config: Optional[dict] = None,
+        topology: Optional[dict] = None,
     ) -> "TransformerInferenceModule":
         """Reads ``config.yml`` + per-layer npz files from a checkpoint dir
-        (reference: inference_model.py:55-87)."""
+        (reference: inference_model.py:55-87).
+
+        ``topology`` enables mesh-sharded inference for models too big for
+        one chip: e.g. ``{"model_parallel_size": 4}`` tensor-parallelizes
+        every layer over 4 devices (the reference instead hops layer slices
+        across GPUs sequentially, inference_module.py:77-109 — TP keeps all
+        devices busy every layer). Checkpoints are layout-independent, so
+        any saved model loads at any ``model_parallel_size``."""
         ckpt = Path(checkpoint_dir)
         latest = ckpt / "latest"
         if latest.is_file():
@@ -111,15 +119,49 @@ class TransformerInferenceModule:
             strip_removed_config_keys(yaml.safe_load(config_file.read_text())),
             overwrite_values=overwrite_config,
         )
-        specs = get_transformer_layer_specs(config.transformer_architecture)
+        topo = None
+        if topology is not None:
+            from ...topology import Topology, TopologyConfig
+
+            tdict = dict(topology)
+            if tdict.get("pipe_parallel_size", 1) != 1:
+                # explicit raise (not assert): stripped asserts would let a
+                # pp>1 stack silently decode without its KV caches
+                raise ValueError(
+                    "inference shards with model parallelism only; use "
+                    "model_parallel_size, not pipe stages"
+                )
+            tdict.setdefault("pipe_parallel_size", 1)
+            tdict.setdefault("data_parallel_size", 1)
+            tdict.setdefault("micro_batch_size", 1)
+            tdict.setdefault("gradient_accumulation_steps", 1)
+            topo = Topology(TopologyConfig.from_dict(tdict))
+        specs = get_transformer_layer_specs(config.transformer_architecture, topo)
         module = ParallelModule(
-            specs, topology=None, compute_dtype=config.transformer_architecture.dtype
+            specs, topology=topo, compute_dtype=config.transformer_architecture.dtype
         )
-        params = module.init_params(jax.random.PRNGKey(0))
-        params = module.ckpt_unview(
-            load_model_checkpoint(ckpt, module.ckpt_view(params), module.ckpt_metas()),
-            params,
-        )
+        if topo is None:
+            params = module.init_params(jax.random.PRNGKey(0))
+            params = module.ckpt_unview(
+                load_model_checkpoint(
+                    ckpt, module.ckpt_view(params), module.ckpt_metas()
+                ),
+                params,
+            )
+        else:
+            # init + load on host CPU first: doing it on the accelerator
+            # would materialize the full model on device 0 and OOM exactly
+            # the too-big-for-one-chip models sharded inference is for;
+            # shard_params then device_puts each leaf pre-sharded
+            with jax.default_device(jax.local_devices(backend="cpu")[0]):
+                params = module.init_params(jax.random.PRNGKey(0))
+                params = module.ckpt_unview(
+                    load_model_checkpoint(
+                        ckpt, module.ckpt_view(params), module.ckpt_metas()
+                    ),
+                    params,
+                )
+            params = module.shard_params(params)
         tokenizer = None
         vocab = Path(vocab_file) if vocab_file else ckpt / "vocab.json"
         if vocab.is_file():
