@@ -1,35 +1,18 @@
-"""Scratch: schema-free xplane parser -> top ops by self time per line.
+"""Schema-free xplane trace parser -> top ops by self time per trace line.
 
-Field numbers (verified empirically via protoc --decode_raw):
+No external tooling: the installed tensorboard profile plugin's generated
+protos are incompatible with the installed protobuf, so this walks the
+wire format directly. Field numbers (verified empirically via
+``protoc --decode_raw``):
   XSpace.planes=1; XPlane: name=2, lines=3, event_metadata=4 (map k=1 v=2);
   XEventMetadata: id=1, name=2; XLine: id=1, name=2, timestamp=3, events=4;
   XEvent: metadata_id=1, offset_ps=2, duration_ps=3.
 
-Usage: python .scratch/analyze_trace2.py <trace_dir> [line-filter]
+Usage: python benchmarks/analyze_trace.py <trace_dir> [line-filter]
 """
 import glob
 import sys
 from collections import defaultdict
-
-
-def walk(buf):
-    """Yield (field_number, wire_type, value) for one message buffer."""
-    i, n = 0, len(buf)
-    while i < n:
-        tag, i = read_varint(buf, i)
-        fn, wt = tag >> 3, tag & 7
-        if wt == 0:
-            v, i = read_varint(buf, i)
-        elif wt == 1:
-            v, i = buf[i:i + 8], i + 8
-        elif wt == 2:
-            ln, i = read_varint(buf, i)
-            v, i = buf[i:i + ln], i + ln
-        elif wt == 5:
-            v, i = buf[i:i + 4], i + 4
-        else:
-            raise ValueError(f"wire type {wt}")
-        yield fn, wt, v
 
 
 def read_varint(buf, i):
@@ -41,6 +24,26 @@ def read_varint(buf, i):
         if not b & 0x80:
             return val, i
         shift += 7
+
+
+def walk(buf):
+    """Yield (field_number, wire_type, value) for one message buffer."""
+    i, n = 0, len(buf)
+    while i < n:
+        tag, i = read_varint(buf, i)
+        fn, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = read_varint(buf, i)
+        elif wt == 1:
+            v, i = buf[i : i + 8], i + 8
+        elif wt == 2:
+            ln, i = read_varint(buf, i)
+            v, i = buf[i : i + ln], i + ln
+        elif wt == 5:
+            v, i = buf[i : i + 4], i + 4
+        else:
+            raise ValueError(f"wire type {wt}")
+        yield fn, wt, v
 
 
 def fields(buf, fn_want):
@@ -66,12 +69,12 @@ def main():
             pname = b"".join(fields(plane, 2)).decode(errors="replace")
             ev_names = {}
             for entry in fields(plane, 4):
-                k = first_varint(entry, 1)
+                key = first_varint(entry, 1)
                 for meta in fields(entry, 2):
                     nm = b"".join(
                         v for fn, wt, v in walk(meta) if fn == 2 and wt == 2
                     ).decode(errors="replace")
-                    ev_names[k] = nm
+                    ev_names[key] = nm
             for line in fields(plane, 3):
                 lname = b"".join(
                     v for fn, wt, v in walk(line) if fn == 2 and wt == 2
@@ -91,8 +94,10 @@ def main():
                 print(f"== {pname} :: {lname}: {tot/1e9:.2f} ms total")
                 for name, d in sorted(totals.items(), key=lambda kv: -kv[1])[:25]:
                     print(
-                        f"   {d/1e9:9.3f} ms {100*d/tot:5.1f}% x{counts[name]:<5} {name[:100]}"
+                        f"   {d/1e9:9.3f} ms {100*d/tot:5.1f}% "
+                        f"x{counts[name]:<5} {name[:100]}"
                     )
 
 
-main()
+if __name__ == "__main__":
+    main()

@@ -1,23 +1,22 @@
-"""Scratch: one serial on-chip session for when the tunnel is healthy.
+"""One serial on-chip measurement session (run when the chip is healthy).
 
-Runs, in order, each timed with block_until_ready (median-of-3):
+Runs, in order, each timed with block_until_ready (median-of-3 via
+attn_bench.timeit):
   1. attention micro-bench: flash vs XLA fwd+bwd at the bench shape
-  2. flash block-size sweep (512/512, 1024/1024, 2048/1024, 1024/2048)
+  2. flash block-size sweep
   3. full train step A/B: flash vs torch kernel (shared params)
   4. norm A/B: BENCH_NORM fused vs torch with the flash kernel
-  5. bench.py equivalent number + trace capture for analyze_trace2.py
+  5. trace capture for benchmarks/analyze_trace.py
 
 Usage: cd /root/repo && python benchmarks/chip_session.py 2>&1 | tee /tmp/chip_session.log
 """
 import os
 import sys
-import time
 
 sys.path.insert(0, "/root/repo")
 os.chdir("/root/repo")
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from scaling_tpu.devices import probe_devices
@@ -28,68 +27,29 @@ if devs is None:
 print(f"devices: {[d.device_kind for d in devs]}", flush=True)
 
 import bench  # noqa: E402
-
-
-def timeit(fn, *args, iters=5):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(*args)
-        jax.block_until_ready(out)
-        times.append((time.perf_counter() - t0) / iters)
-    return sorted(times)[1] * 1e3  # median, ms
-
+from benchmarks import attn_bench  # noqa: E402
 
 # ---------------------------------------------------------- 1. micro bench
-from scaling_tpu.ops.flash_attention import flash_attention_fused  # noqa: E402
-
-B, S, N, NKV, D = 4, 2048, 16, 4, 128
-scale = D**-0.5
-key = jax.random.PRNGKey(0)
-q = jax.random.normal(key, (B, S, N, D), jnp.bfloat16)
-k = jax.random.normal(key, (B, S, NKV, D), jnp.bfloat16)
-v = jax.random.normal(key, (B, S, NKV, D), jnp.bfloat16)
-seg = jnp.zeros((B, S), jnp.int32)
-
-
-def flash(q, k, v):
-    return flash_attention_fused(q, k, v, segment_ids=seg, sm_scale=scale)
-
-
-def xla_attn(q, k, v):
-    rep = N // NKV
-    kk = jnp.repeat(k, rep, axis=2)
-    vv = jnp.repeat(v, rep, axis=2)
-    logits = jnp.einsum("bsnd,btnd->bnst", q, kk) * scale
-    mask = jnp.tril(jnp.ones((S, S), bool))
-    logits = jnp.where(mask[None, None], logits.astype(jnp.float32), -1e9)
-    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bnst,btnd->bsnd", p, vv)
-
-
-def fb(fn):
-    return jax.jit(jax.grad(lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
-
-
-print(f"1. attn flash f+b: {timeit(fb(flash), q, k, v):8.2f} ms", flush=True)
-print(f"1. attn xla   f+b: {timeit(fb(xla_attn), q, k, v):8.2f} ms", flush=True)
+q, k, v, seg = attn_bench.make_qkv()
+fb_flash = attn_bench.fwd_bwd(attn_bench.flash)
+fb_xla = attn_bench.fwd_bwd(attn_bench.xla_attn)
+print(f"1. attn flash f+b: {attn_bench.timeit(fb_flash, q, k, v, seg):8.2f} ms", flush=True)
+print(f"1. attn xla   f+b: {attn_bench.timeit(fb_xla, q, k, v, seg):8.2f} ms", flush=True)
 
 # ------------------------------------------------------ 2. block-size sweep
 for bq, bkv in ((512, 512), (1024, 1024), (2048, 1024), (1024, 2048)):
     os.environ["SCALING_TPU_FLASH_BLOCK_Q"] = str(bq)
     os.environ["SCALING_TPU_FLASH_BLOCK_KV"] = str(bkv)
     try:
-        t = timeit(fb(flash), q, k, v)
+        t = attn_bench.timeit(attn_bench.fwd_bwd(attn_bench.flash), q, k, v, seg)
         print(f"2. flash blocks q={bq} kv={bkv}: {t:8.2f} ms", flush=True)
     except Exception as e:
         print(f"2. flash blocks q={bq} kv={bkv}: FAIL {type(e).__name__}", flush=True)
 os.environ.pop("SCALING_TPU_FLASH_BLOCK_Q", None)
 os.environ.pop("SCALING_TPU_FLASH_BLOCK_KV", None)
 
-# ------------------------------------------------- 3. full-step kernel A/B
+
+# ------------------------------------------------- 3./4. full-step A/B
 def build_step(kernel, norm="torch"):
     os.environ["BENCH_KERNEL"] = kernel
     os.environ["BENCH_NORM"] = norm
@@ -98,19 +58,22 @@ def build_step(kernel, norm="torch"):
     return config, module, optimizer, step
 
 
+key = jax.random.PRNGKey(0)
 cfg, module, optimizer, step_f = build_step("flash_attention")
 arch = cfg.transformer_architecture
 params = module.shard_params(module.init_params(key))
 opt_state = optimizer.init_state(params)
 rng = np.random.default_rng(0)
-batch = module.shard_batch(bench.synth_batch(rng, 4, 2048, arch.vocab_size, 1), stacked=True)
+batch = module.shard_batch(
+    bench.synth_batch(rng, 4, 2048, arch.vocab_size, 1), stacked=True
+)
 _, _, _, step_x = build_step("torch")
 _, _, _, step_fn = build_step("flash_attention", norm="fused")
 
 
 def run_step(stp):
     def f(params, opt_state):
-        p2, o2, loss, _, _ = stp(params, opt_state, batch, key)
+        _, _, loss, _, _ = stp(params, opt_state, batch, key)
         return loss
 
     return f
@@ -118,7 +81,7 @@ def run_step(stp):
 
 for name, stp in (("flash", step_f), ("xla", step_x), ("flash+fusednorm", step_fn)):
     try:
-        t = timeit(run_step(stp), params, opt_state, iters=3)
+        t = attn_bench.timeit(run_step(stp), params, opt_state, iters=3)
         print(f"3/4. step {name}: {t:8.1f} ms", flush=True)
     except Exception as e:
         print(f"3/4. step {name}: FAIL {type(e).__name__}: {e}", flush=True)
@@ -132,5 +95,8 @@ for i in range(2):
     loss = run_step(step_f)(params, opt_state)
 jax.block_until_ready(loss)
 jax.profiler.stop_trace()
-print(f"5. trace written to {outdir}; analyze with "
-      f"python benchmarks/analyze_trace.py {outdir}", flush=True)
+print(
+    f"5. trace written to {outdir}; analyze with "
+    f"python benchmarks/analyze_trace.py {outdir}",
+    flush=True,
+)

@@ -1,0 +1,121 @@
+"""Export this framework's checkpoints to the reference's torch format.
+
+The other half of the migration path (see ``import_reference.py``): users
+who trained here can hand a checkpoint back to the reference repo's
+tooling (``model_state_layer_{i}_{Class}.pt`` files, reference:
+partitioned_module.py:197-257). Exactly the importer's mapping, inverted:
+
+- our ``(in, out)`` 2-D projection weights transpose back to torch
+  ``nn.Linear``'s ``(out, in)``;
+- ``attention.`` renames to the reference's ``self_attention.``;
+- bottleneck Adapter ``down``/``up`` factors become the reference's
+  ``{attn,mlp}_adapter_{n}.dense_{in,out}.weight`` ParallelMLP naming
+  (reference: layer.py:147-181);
+- PEFT side files ``{Class}__{name}.npz`` become the reference's
+  single-underscore ``{Class}_{name}.pt``;
+- structurally-tied LM heads regain the reference's duplicated embedding
+  table in ``TransformerLMHeadTied.pt`` (its state dict holds the shared
+  ``embedding.weight``, reference: lm_head_tied.py:27-40).
+
+Round-trip (export -> import) is bit-exact:
+tests/transformer/test_reference_weight_import.py.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Any, Dict
+
+import numpy as np
+import yaml
+
+from .import_reference import _LINEAR_HOSTS
+
+
+def _unmap_param(name: str, arr: np.ndarray):
+    """our per-layer param name -> (reference name, reference array)."""
+    m = re.match(r"adapter_(attention|mlp)_([^.]+)\.(down|up)$", name)
+    if m:
+        host = "attn" if m.group(1) == "attention" else "mlp"
+        direction = "in" if m.group(3) == "down" else "out"
+        ref = f"{host}_adapter_{m.group(2)}.dense_{direction}.weight"
+        return ref, np.ascontiguousarray(arr.T)
+    if (
+        arr.ndim == 2
+        and name.endswith(".weight")
+        and any(h in name for h in _LINEAR_HOSTS)
+        and not name.startswith("embedding.")
+    ):
+        arr = np.ascontiguousarray(arr.T)
+    return name.replace("attention.", "self_attention."), arr
+
+
+def export_layer(arrays: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """One of our layers' arrays -> a reference-format torch state dict."""
+    import torch
+
+    out: Dict[str, Any] = {}
+    for name, arr in arrays.items():
+        ref_name, ref_arr = _unmap_param(name, np.asarray(arr))
+        out[ref_name] = torch.from_numpy(np.ascontiguousarray(ref_arr))
+    return out
+
+
+def export_reference_checkpoint(src_dir: Path | str, dst_dir: Path | str) -> int:
+    """Our npz checkpoint directory -> reference .pt files; returns the
+    number of files written. ``src_dir`` may be the save root (with a
+    ``latest`` pointer) or a ``global_step{N}`` directory."""
+    import torch
+
+    src = Path(src_dir)
+    latest = src / "latest"
+    if latest.is_file():
+        src = src / latest.read_text().strip()
+    dst = Path(dst_dir)
+    dst.mkdir(parents=True, exist_ok=True)
+
+    written = 0
+    embedding_table = None
+    norm_index = None
+    for f in sorted(src.glob("model_state_layer_*.npz")):
+        m = re.match(r"model_state_layer_(\d+)_(.+)\.npz", f.name)
+        if m is None:
+            continue
+        layer_index = int(m.group(1))
+        stem = m.group(2)
+        if "__" in stem:  # PEFT side file: our double underscore -> single
+            cls, suffix = stem.split("__", 1)
+            ref_stem = f"model_state_layer_{layer_index}_{cls}_{suffix}"
+        else:
+            ref_stem = f"model_state_layer_{layer_index}_{stem}"
+            if stem == "LayerNormWrapper":
+                norm_index = layer_index
+        arrays = dict(np.load(f))
+        if layer_index == 0 and "embedding.weight" in arrays:
+            embedding_table = np.asarray(arrays["embedding.weight"])
+        torch.save(export_layer(arrays), dst / f"{ref_stem}.pt")
+        written += 1
+
+    # tied models hold one structural copy of the table; the reference's
+    # checkpoint format expects the duplicate in the tied head's file. The
+    # head's slot is the final norm's index + 1 (get_transformer_layer_specs
+    # order: embedding, layers, LayerNormWrapper, head[, embedding head]) —
+    # NOT max-index + 1, which an embedding-head or PEFT side file after
+    # the head's slot would push past the hole the head must fill.
+    config_file = src / "config.yml"
+    if config_file.is_file():
+        cfg = yaml.safe_load(config_file.read_text()) or {}
+        arch = cfg.get("transformer_architecture", {})
+        if arch.get("weight_tying") and embedding_table is not None:
+            if norm_index is None:
+                raise ValueError(
+                    "weight-tied checkpoint without a LayerNormWrapper "
+                    "layer file: cannot place the tied head's slot"
+                )
+            torch.save(
+                {"embedding.weight": torch.from_numpy(embedding_table)},
+                dst / f"model_state_layer_{norm_index + 1}_TransformerLMHeadTied.pt",
+            )
+            written += 1
+    return written

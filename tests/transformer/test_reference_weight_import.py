@@ -162,3 +162,83 @@ def test_converter_translates_adapter_names(tmp_path):
     assert out["adapter_attention_ad.down"].shape == (16, 4)
     assert out["adapter_attention_ad.up"].shape == (4, 16)
     assert out["adapter_mlp_ad.down"].shape == (16, 4)
+
+
+def test_export_then_import_is_bit_exact(tmp_path):
+    """The exporter is the importer's exact inverse: our npz -> reference
+    .pt -> our npz reproduces every array bit-for-bit (the reference side
+    of the round trip is its own checkpoint format, so a reference user
+    can leave AND return without loss)."""
+    import torch
+
+    from scaling_tpu.checkpoint.export_reference import export_reference_checkpoint
+    from scaling_tpu.checkpoint.import_reference import convert_reference_checkpoint
+
+    rng = np.random.default_rng(0)
+    src = tmp_path / "ours"
+    src.mkdir()
+    emb = {"embedding.weight": rng.normal(size=(96, 16)).astype(np.float32)}
+    layer = {
+        "attention.query_key_value.weight": rng.normal(size=(16, 48)).astype(np.float32),
+        "attention.dense.weight": rng.normal(size=(16, 16)).astype(np.float32),
+        "attention.dense.bias": rng.normal(size=(16,)).astype(np.float32),
+        "mlp.dense_in.weight": rng.normal(size=(16, 64)).astype(np.float32),
+        "mlp.dense_out.weight": rng.normal(size=(64, 16)).astype(np.float32),
+        "input_layernorm.weight": rng.normal(size=(16,)).astype(np.float32),
+        "adapter_attention_a.down": rng.normal(size=(16, 4)).astype(np.float32),
+        "adapter_attention_a.up": rng.normal(size=(4, 16)).astype(np.float32),
+    }
+    norm = {"norm.weight": rng.normal(size=(16,)).astype(np.float32)}
+    np.savez(src / "model_state_layer_0_EmbeddingInput.npz", **emb)
+    np.savez(src / "model_state_layer_1_TransformerLayer.npz", **layer)
+    np.savez(src / "model_state_layer_1_TransformerLayer__lora.npz",
+             **{"attention.dense.bias_lora": rng.normal(size=(16,)).astype(np.float32)})
+    np.savez(src / "model_state_layer_2_LayerNormWrapper.npz", **norm)
+
+    ref = tmp_path / "ref"
+    assert export_reference_checkpoint(src, ref) == 4
+    # the exported files use the reference's naming conventions
+    names = sorted(p.name for p in ref.glob("*.pt"))
+    assert names == [
+        "model_state_layer_0_EmbeddingInput.pt",
+        "model_state_layer_1_TransformerLayer.pt",
+        "model_state_layer_1_TransformerLayer_lora.pt",
+        "model_state_layer_2_LayerNormWrapper.pt",
+    ]
+    sd = torch.load(ref / "model_state_layer_1_TransformerLayer.pt", weights_only=False)
+    assert sd["self_attention.query_key_value.weight"].shape == (48, 16)  # torch (out, in)
+    assert sd["attn_adapter_a.dense_in.weight"].shape == (4, 16)
+
+    back = tmp_path / "back"
+    assert convert_reference_checkpoint(ref, back) == 4
+    for f in src.glob("*.npz"):
+        with np.load(f) as orig, np.load(back / f.name) as rt:
+            assert sorted(orig.files) == sorted(rt.files), f.name
+            for k in orig.files:
+                np.testing.assert_array_equal(orig[k], rt[k], err_msg=f"{f.name}:{k}")
+
+
+def test_export_restores_tied_head_duplicate(tmp_path):
+    """Tied models hold one structural table copy; the exported reference
+    checkpoint regains the duplicate TransformerLMHeadTied file."""
+    import torch
+    import yaml
+
+    from scaling_tpu.checkpoint.export_reference import export_reference_checkpoint
+
+    src = tmp_path / "ours"
+    src.mkdir()
+    table = np.arange(96 * 16, dtype=np.float32).reshape(96, 16)
+    np.savez(src / "model_state_layer_0_EmbeddingInput.npz",
+             **{"embedding.weight": table})
+    np.savez(src / "model_state_layer_1_LayerNormWrapper.npz",
+             **{"norm.weight": np.ones(16, np.float32)})
+    (src / "config.yml").write_text(
+        yaml.safe_dump({"transformer_architecture": {"weight_tying": True}})
+    )
+    ref = tmp_path / "ref"
+    assert export_reference_checkpoint(src, ref) == 3
+    tied = torch.load(
+        ref / "model_state_layer_2_TransformerLMHeadTied.pt", weights_only=False
+    )
+    np.testing.assert_array_equal(tied["embedding.weight"].numpy(), table)
