@@ -104,6 +104,52 @@ class EmbeddingHeadConfig(BaseConfig):
     )
 
 
+class MupConfig(BaseConfig):
+    """Maximal-update parametrization (Tensor Programs V, Yang & Hu 2021):
+    tune hyperparameters on a small base width, transfer them to any width.
+
+    The reference shipped a ``umup`` flag that implemented nothing; this is
+    the real thing, wired through four rules (Adam variant):
+
+    - hidden-matrix AND readout learning rates scale by
+      base_hidden_size / hidden_size (applied as ``lr_scale`` on the
+      optimizer param groups; embedding, norms, biases and softprompts
+      stay unscaled);
+    - attention logits scale 1/d beyond the base width
+      (sqrt(base_head_dim)/head_dim — equal to 1/sqrt(head_dim) at base);
+    - LM-head logits multiply by the width-independent tunable output_mult
+      (the width correction is the readout LR scale — the multiplier and
+      LR formulations of the muP output rule are alternatives, not
+      composable);
+    - the LM head zero-initializes (readout_zero_init), removing the
+      width-dependent readout noise at init.
+
+    Hidden weights keep xavier init (variance already ~1/width). Verified
+    by the coordinate-check test: logit updates stay width-independent
+    where standard parametrization grows with width
+    (tests/transformer/test_mup.py)."""
+
+    base_hidden_size: int = Field(
+        description="hidden size of the tuned base model; scaling rules "
+        "activate as hidden_size grows past it",
+        gt=0,
+    )
+    base_num_attention_heads: Optional[int] = Field(
+        None,
+        description="head count of the tuned base model; defaults to this "
+        "model's head count (width grown by head_dim). Set it when width "
+        "is grown by ADDING heads instead — the attention rule needs the "
+        "base model's true head_dim, not hidden/width-mult",
+        gt=0,
+    )
+    output_mult: float = Field(
+        1.0, description="tunable multiplier on the LM-head logits", gt=0
+    )
+    readout_zero_init: bool = Field(
+        True, description="zero-initialize the LM head projection"
+    )
+
+
 class TransformerArchitectureConfig(BaseConfig):
     """Model shape + feature switches
     (reference: src/scaling/transformer/context/config.py:126-330)."""
@@ -172,6 +218,12 @@ class TransformerArchitectureConfig(BaseConfig):
     dropout_after_attention: float = Field(0.0, description="", ge=0.0, le=1.0)
     dropout_after_mlp: float = Field(0.0, description="", ge=0.0, le=1.0)
 
+    mup: Optional[MupConfig] = Field(
+        None,
+        description="maximal-update parametrization for width-transferable "
+        "hyperparameters (see MupConfig)",
+    )
+
     # fine tuning / PEFT
     bitfit_bias_config: Optional[BitfitConfig] = Field(None, description="")
     adapter_config: Optional[AdapterConfig] = Field(None, description="")
@@ -211,7 +263,20 @@ class TransformerArchitectureConfig(BaseConfig):
                     "mlp_type 'moe' does not support mlp_bias; set it false "
                     "(experts are GLU FFNs without bias)"
                 )
+        if self.mup is not None and self.weight_tying:
+            raise ValueError(
+                "mup does not compose with weight_tying: the tied table "
+                "would need embedding-scale init and readout-scale LR at "
+                "once; untie the head to use mup"
+            )
         return self
+
+    @property
+    def mup_width_mult(self) -> float:
+        """Width multiplier m = hidden / base_hidden (1.0 when mup is off)."""
+        if self.mup is None:
+            return 1.0
+        return self.hidden_size / self.mup.base_hidden_size
 
     @property
     def dtype(self):

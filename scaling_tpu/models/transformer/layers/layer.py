@@ -89,9 +89,23 @@ class TransformerLayer(BaseLayer):
                 base=arch.rotary_embedding_base,
                 max_seq_length=arch.sequence_length,
             )
+        mup_attention_scale = None
+        if arch.mup is not None:
+            # muP rule: attention logits scale 1/d beyond the base width —
+            # sqrt(base_head_dim)/head_dim equals 1/sqrt(head_dim) at the
+            # base model and decays like 1/head_dim past it. base_head_dim
+            # comes from the base model's own head count: width grown by
+            # adding heads keeps head_dim (and this scale) constant
+            head_dim = arch.hidden_size // arch.num_attention_heads
+            base_heads = (
+                arch.mup.base_num_attention_heads or arch.num_attention_heads
+            )
+            base_head_dim = arch.mup.base_hidden_size / base_heads
+            mup_attention_scale = (base_head_dim**0.5) / head_dim
         self.attention = ParallelSelfAttention(
             hidden_size=arch.hidden_size,
             num_attention_heads=arch.num_attention_heads,
+            scaling_factor=mup_attention_scale,
             masked_softmax_config=arch.masked_softmax,
             causal=arch.causal,
             num_local_attention_heads=arch.num_local_attention_heads,

@@ -54,16 +54,28 @@ class LayerNormWrapper(BaseLayer):
 
 class TransformerLMHead(BaseLayer):
     """Untied head: column-parallel projection to the vocabulary
-    (reference: lm_head.py:16-66)."""
+    (reference: lm_head.py:16-66). Under muP the readout zero-initializes
+    and logits carry the tunable output_mult; the width correction is the
+    readout's 1/m learning-rate scale, NOT a logit multiplier — applying
+    both (the two equivalent muP output formulations) over-suppresses
+    updates by an extra 1/m, which the coordinate check catches."""
 
     def __init__(self, architecture: TransformerArchitectureConfig):
         arch = architecture
+        mup = arch.mup
+        init_method = xavier_normal_init
+        self.logit_mult = None
+        if mup is not None:
+            self.logit_mult = mup.output_mult
+            if mup.readout_zero_init:
+                init_method = lambda key, shape, dtype: jnp.zeros(shape, dtype)  # noqa: E731
         self.linear = ColumnParallelLinear(
             arch.hidden_size,
             arch.vocab_size,
             bias=False,
             dtype=arch.dtype,
             parallel_output=False,
+            init_method=init_method,
         )
 
     def init(self, key: jax.Array) -> dict:
@@ -74,7 +86,10 @@ class TransformerLMHead(BaseLayer):
 
     def __call__(self, params: dict, x: dict, ctx: ForwardContext) -> dict:
         out = dict(x)
-        out["activations"] = self.linear(params["linear"], x["activations"], ctx)
+        logits = self.linear(params["linear"], x["activations"], ctx)
+        if self.logit_mult is not None:
+            logits = logits * jnp.asarray(self.logit_mult, logits.dtype)
+        out["activations"] = logits
         return out
 
 

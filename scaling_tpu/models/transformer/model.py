@@ -168,30 +168,72 @@ def get_parameter_groups(
             embedding_keys.add(meta.key)
         elif meta.no_weight_decay or any(
             s in meta.parameter_name.lower() for s in NO_WEIGHT_DECAY_SUBSTRINGS
-        ) or meta.lr_group == "embedding":
+        ) or meta.lr_group == "embedding" or "softprompt" in meta.parameter_name:
             no_decay_keys.add(meta.key)
         else:
             decay_keys.add(meta.key)
 
+    # muP (Adam rule): LR scales by 1/width-mult for matrices whose FAN-IN
+    # grows with hidden_size — qkv/dense/mlp/expert weights, the readout,
+    # adapter down-projections, lora_a, the first embedding-head
+    # projection. Everything width-independent keeps the base LR: vectors,
+    # the input-like embedding table and softprompts, adapter up, lora_b,
+    # later embedding-head projections, the whole image encoder — their
+    # update scale never grew with width, so shrinking it has no muP
+    # justification.
+    mup_mult = config.transformer_architecture.mup_width_mult
+
+    def fan_in_scales_with_width(meta: ParamMeta) -> bool:
+        if len(meta.partition_spec) < 2:
+            return False  # vectors (norms, biases)
+        name = meta.parameter_name
+        if meta.lr_group == "embedding" or "softprompt" in name:
+            return False  # input-like: fan_in is vocab / prompt slots
+        if "image_encoder" in name:
+            return False
+        if name.endswith(".up") or "lora_b" in name:
+            return False
+        m = re.search(r"proj_(\d+)_", name)
+        if m:
+            return int(m.group(1)) == 0
+        return True
+
+    if mup_mult == 1.0:
+        group_spec = (
+            (decay_keys, training.weight_decay, "weight_decay_params", 1.0),
+            (no_decay_keys, 0.0, "no_weight_decay_params", 1.0),
+        )
+    else:
+        by_key = {meta.key: meta for meta in metas}
+
+        def split(keys: set) -> tuple[set, set]:
+            scaled = {k for k in keys if fan_in_scales_with_width(by_key[k])}
+            return scaled, keys - scaled
+
+        decay_scaled, decay_fixed = split(decay_keys)
+        no_decay_scaled, no_decay_fixed = split(no_decay_keys)
+        group_spec = (
+            (decay_scaled, training.weight_decay, "weight_decay_params",
+             1.0 / mup_mult),
+            (decay_fixed, training.weight_decay,
+             "weight_decay_params_fixed_width", 1.0),
+            (no_decay_scaled, 0.0, "no_weight_decay_params_width_scaled",
+             1.0 / mup_mult),
+            (no_decay_fixed, 0.0, "no_weight_decay_params", 1.0),
+        )
+
     groups = []
-    if decay_keys:
-        groups.append(
-            OptimizerParamGroup(
-                keys=decay_keys,
-                weight_decay=training.weight_decay,
-                learning_rate_scheduler=config.learning_rate_scheduler,
-                name="weight_decay_params",
+    for keys, wd, name, lr_scale in group_spec:
+        if keys:
+            groups.append(
+                OptimizerParamGroup(
+                    keys=keys,
+                    weight_decay=wd,
+                    learning_rate_scheduler=config.learning_rate_scheduler,
+                    name=name,
+                    lr_scale=lr_scale,
+                )
             )
-        )
-    if no_decay_keys:
-        groups.append(
-            OptimizerParamGroup(
-                keys=no_decay_keys,
-                weight_decay=0.0,
-                learning_rate_scheduler=config.learning_rate_scheduler,
-                name="no_weight_decay_params",
-            )
-        )
     if embedding_keys:
         groups.append(
             OptimizerParamGroup(

@@ -93,12 +93,16 @@ class OptimizerParamGroup:
         weight_decay: float = 0.0,
         learning_rate_scheduler: Optional[LearningRateSchedulerConfig] = None,
         name: str = "param_group",
+        lr_scale: float = 1.0,
     ):
         self.keys = set(keys)
         self.weight_decay = weight_decay
         self.lr_config = learning_rate_scheduler or LearningRateSchedulerConfig()
         self.scheduler = LearningRateScheduler(self.lr_config)
         self.name = name
+        # constant multiplier on the scheduled LR; muP width scaling rides
+        # here (models/transformer/model.py get_parameter_groups)
+        self.lr_scale = lr_scale
 
 
 class OptimizerState(NamedTuple):
@@ -279,7 +283,10 @@ class Optimizer:
 
         # ---- per-group learning rates at step+1 (reference steps then logs)
         step_index = state.step + 1
-        group_lrs = [g.scheduler.get_lr(step_index) for g in self.parameter_groups]
+        group_lrs = [
+            g.scheduler.get_lr(step_index) * g.lr_scale
+            for g in self.parameter_groups
+        ]
 
         beta1, beta2 = c.beta1, c.beta2
         t = step_index.astype(jnp.float32)
@@ -297,7 +304,12 @@ class Optimizer:
                 new_s.append(avg_sq)
                 continue
             lr = group_lrs[gi].astype(jnp.float32)
-            wd = self.parameter_groups[gi].weight_decay
+            # decoupled decay uses lr*wd, so an lr_scale (muP width rule)
+            # would silently rescale regularization too; dividing wd by the
+            # scale keeps lr*wd — the decay actually applied — exactly as
+            # tuned at the base width ("independent weight decay")
+            grp = self.parameter_groups[gi]
+            wd = grp.weight_decay / grp.lr_scale
             m2 = master * (1.0 - lr * wd) if wd else master
             a2 = beta1 * avg + (1.0 - beta1) * g
             s2 = beta2 * avg_sq + (1.0 - beta2) * jnp.square(g)

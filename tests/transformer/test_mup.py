@@ -1,0 +1,183 @@
+"""muP (maximal-update parametrization) coordinate checks.
+
+The property that makes muP real (and the reference's dead ``umup`` knob
+was not): the *update* to the network function after an optimizer step is
+width-independent, so learning rates tuned at the base width transfer to
+any width. Verified here by the standard coordinate check — logit change
+after steps at width 4x the base must stay the same order under muP while
+standard parametrization grows with width."""
+
+import numpy as np
+import pytest
+
+from scaling_tpu.data.memory_map import MemoryMapDatasetBuilder
+
+from .test_training import build_capturing_trainer, make_config, train_capture
+
+BASE, WIDE = 32, 128
+
+
+@pytest.fixture(scope="module")
+def data_prefix(tmp_path_factory):
+    prefix = tmp_path_factory.mktemp("mup_data") / "data"
+    rng = np.random.default_rng(17)
+    with MemoryMapDatasetBuilder(prefix, dtype=np.uint16) as builder:
+        for _ in range(48):
+            doc = rng.integers(1, 96, size=rng.integers(8, 48))
+            builder.add(np.append(doc, 0).astype(np.uint16))
+    return prefix
+
+
+def _config(tmp_path, data_prefix, hidden, mup: bool):
+    arch = {
+        "hidden_size": hidden,
+        "weight_tying": False,
+        "norm_type": "rms",
+    }
+    if mup:
+        arch["mup"] = {"base_hidden_size": BASE}
+    return make_config(
+        tmp_path, data_prefix, train_iterations=3, save_interval=100, **arch
+    )
+
+
+def _logit_update_rms(tmp_path, data_prefix, hidden, mup):
+    """RMS of (logits after 3 steps - logits at init) on a fixed batch."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = _config(tmp_path, data_prefix, hidden, mup)
+    trainer = build_capturing_trainer(cfg)
+    probe = {
+        "token_ids": jnp.asarray(np.arange(24)[None] % 60 + 1, jnp.int32),
+        "position_ids": jnp.asarray(np.arange(24)[None], jnp.int32),
+        "segment_ids": jnp.zeros((1, 24), jnp.int32),
+    }
+
+    def probe_logits():
+        fwd = trainer.module.build_forward(deterministic=True)
+        return np.asarray(fwd(trainer.params, probe)["activations"], np.float32)
+
+    before = probe_logits()
+    losses = train_capture(trainer, 3)
+    assert np.isfinite(losses).all()
+    after = probe_logits()
+    return float(np.sqrt(np.mean((after - before) ** 2)))
+
+
+def test_mup_logit_updates_width_independent(tmp_path, data_prefix):
+    """Same LR at base and 4x width: muP keeps the logit update the same
+    order; standard parametrization's update grows with width. The muP
+    width ratio must stay within a constant band AND beat the standard
+    ratio (the discriminating comparison)."""
+    upd = {}
+    for mup in (True, False):
+        for hidden in (BASE, WIDE):
+            key = ("mup" if mup else "sp", hidden)
+            upd[key] = _logit_update_rms(
+                tmp_path / f"{key[0]}{hidden}", data_prefix, hidden, mup
+            )
+    mup_ratio = upd[("mup", WIDE)] / upd[("mup", BASE)]
+    sp_ratio = upd[("sp", WIDE)] / upd[("sp", BASE)]
+    # muP: width-independent updates (band allows constant-factor noise)
+    assert 0.2 < mup_ratio < 3.0, (upd, mup_ratio)
+    # and the check must actually discriminate
+    assert mup_ratio < sp_ratio, (upd, mup_ratio, sp_ratio)
+
+
+def test_mup_rules_wired(tmp_path, data_prefix):
+    """The three mechanical rules: scaled attention logits, zero-init
+    readout with the output multiplier, and 1/m matrix LR scale."""
+    import math
+
+    from scaling_tpu.models.transformer.model import (
+        get_parameter_groups,
+        init_model,
+    )
+
+    cfg = _config(tmp_path, data_prefix, WIDE, mup=True)
+    arch = cfg.transformer_architecture
+    m = arch.mup_width_mult
+    assert m == WIDE / BASE
+
+    module = init_model(cfg, topology=None)
+    # attention scale: sqrt(base_head_dim)/head_dim
+    layer = module.layers[1]
+    head_dim = arch.hidden_size // arch.num_attention_heads
+    assert math.isclose(
+        layer.attention.scaling_factor, math.sqrt(head_dim / m) / head_dim
+    )
+    # readout zero-init + logits multiplier
+    import jax
+
+    params = module.init_params(jax.random.PRNGKey(0))
+    head_params = module._layer_params(params, len(module.layers) - 1)
+    assert float(np.abs(np.asarray(head_params["linear"]["weight"])).max()) == 0.0
+    assert module.layers[-1].logit_mult == 1.0  # output_mult, width-free
+    # matrix group LR scaled, vector/embedding groups not
+    groups = {g.name: g for g in get_parameter_groups(cfg, module)}
+    assert groups["weight_decay_params"].lr_scale == 1.0 / m
+    assert groups["no_weight_decay_params"].lr_scale == 1.0
+
+
+def test_mup_base_head_count_keeps_scale_when_adding_heads(tmp_path, data_prefix):
+    """Width grown by adding heads keeps head_dim — and must keep the base
+    model's attention scale 1/sqrt(head_dim) exactly."""
+    import math
+
+    from scaling_tpu.models.transformer.model import init_model
+
+    cfg = make_config(
+        tmp_path, data_prefix,
+        hidden_size=WIDE, num_attention_heads=16, weight_tying=False,
+        mup={"base_hidden_size": BASE, "base_num_attention_heads": 4},
+    )
+    module = init_model(cfg, topology=None)
+    head_dim = WIDE // 16
+    assert head_dim == BASE // 4  # same head_dim at base and wide
+    assert math.isclose(
+        module.layers[1].attention.scaling_factor, 1.0 / math.sqrt(head_dim)
+    )
+
+
+def test_mup_fixed_width_matrices_keep_base_lr(tmp_path, data_prefix):
+    """Adapter up-projections and lora_b have width-independent fan-in:
+    under muP they keep the base LR while down/lora_a scale 1/m."""
+    from scaling_tpu.models.transformer.model import (
+        get_parameter_groups,
+        init_model,
+    )
+
+    cfg = make_config(
+        tmp_path, data_prefix,
+        hidden_size=WIDE, weight_tying=False,
+        mup={"base_hidden_size": BASE},
+        adapter_config={"name": "ad", "attention_downsampling_factor": 0.25},
+        lora_config={"name": "lo", "rank": 2, "alpha": 4},
+    )
+    module = init_model(cfg, topology=None)
+    groups = {g.name: g for g in get_parameter_groups(cfg, module)}
+    scaled = groups["weight_decay_params"]
+    fixed = groups["weight_decay_params_fixed_width"]
+    assert scaled.lr_scale == BASE / WIDE and fixed.lr_scale == 1.0
+    # decay (lr*wd) stays width-invariant despite the lr scale
+    assert scaled.weight_decay == fixed.weight_decay
+    assert any(".down" in k for k in scaled.keys)
+    assert any(".up" in k for k in fixed.keys)
+    # lora matrices are no-decay (reference parity); lora_a's fan-in scales
+    # with width, lora_b's is the fixed rank
+    nd_scaled = groups["no_weight_decay_params_width_scaled"]
+    nd_fixed = groups["no_weight_decay_params"]
+    assert nd_scaled.lr_scale == BASE / WIDE and nd_fixed.lr_scale == 1.0
+    assert any("lora_a" in k for k in nd_scaled.keys)
+    assert any("lora_b" in k for k in nd_fixed.keys)
+    assert all("lora" not in k for k in scaled.keys | fixed.keys)
+
+
+def test_mup_rejects_weight_tying(tmp_path, data_prefix):
+    with pytest.raises(Exception, match="weight_tying"):
+        make_config(
+            tmp_path, data_prefix,
+            hidden_size=WIDE, weight_tying=True,
+            mup={"base_hidden_size": BASE},
+        )
