@@ -168,7 +168,7 @@ def get_parameter_groups(
             embedding_keys.add(meta.key)
         elif meta.no_weight_decay or any(
             s in meta.parameter_name.lower() for s in NO_WEIGHT_DECAY_SUBSTRINGS
-        ) or meta.lr_group == "embedding" or "softprompt" in meta.parameter_name:
+        ) or meta.lr_group == "embedding":
             no_decay_keys.add(meta.key)
         else:
             decay_keys.add(meta.key)
@@ -177,10 +177,11 @@ def get_parameter_groups(
     # grows with hidden_size — qkv/dense/mlp/expert weights, the readout,
     # adapter down-projections, lora_a, the first embedding-head
     # projection. Everything width-independent keeps the base LR: vectors,
-    # the input-like embedding table and softprompts, adapter up, lora_b,
-    # later embedding-head projections, the whole image encoder — their
-    # update scale never grew with width, so shrinking it has no muP
-    # justification.
+    # the input-like embedding table and softprompts (in whichever decay
+    # set they already lived — muP must not change decay membership),
+    # adapter up, lora_b, later embedding-head projections, the whole
+    # image encoder — their update scale never grew with width, so
+    # shrinking it has no muP justification.
     mup_mult = config.transformer_architecture.mup_width_mult
 
     def fan_in_scales_with_width(meta: ParamMeta) -> bool:

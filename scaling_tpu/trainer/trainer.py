@@ -12,13 +12,14 @@ from __future__ import annotations
 
 import shutil
 import time
+from enum import Enum
 from pathlib import Path
 from typing import Any, Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from pydantic import Field
+from pydantic import Field, model_validator
 
 from ..checkpoint import (
     AsyncCheckpointWriter,
@@ -37,6 +38,11 @@ from ..parallel.parallel_module import (
     ParallelModule,
     TrainStepOutput,
 )
+
+
+class CheckpointBackend(Enum):
+    NPZ = "npz"
+    ORBAX = "orbax"
 
 
 class TrainerConfig(BaseConfig):
@@ -102,6 +108,28 @@ class TrainerConfig(BaseConfig):
         description="write checkpoint files on a background thread; the train "
         "loop only blocks for the device-to-host gather",
     )
+    checkpoint_backend: CheckpointBackend = Field(
+        CheckpointBackend.NPZ,
+        description="'npz': layout-independent per-layer files, host-gathered "
+        "(the golden format; supports non-strict PEFT loading). 'orbax': "
+        "tensorstore-backed sharded save/restore — every host writes only "
+        "its own shards and restore re-shards to the current mesh, the "
+        "multi-host-scale path (requires exact key match; checkpoints keep "
+        "the same per-layer canonical tree, so pp/mp relayouts still load)",
+    )
+
+    @model_validator(mode="after")
+    def _validate_backend(self):
+        if (
+            self.checkpoint_backend == CheckpointBackend.ORBAX
+            and self.save_checkpoint_async
+        ):
+            raise ValueError(
+                "save_checkpoint_async is not supported with the orbax "
+                "backend yet: its tensorstore write is synchronous, which "
+                "would silently break the async contract — disable one"
+            )
+        return self
 
 
 class BaseTrainer:
@@ -395,19 +423,22 @@ class BaseTrainer:
         # checkpoint-view trees: stage-stacked pipeline bodies un-stack into
         # per-layer files so checkpoints are pipe-layout independent
         metas = self.module.ckpt_metas()
-        save_model_checkpoint(
-            step_dir, self.module.ckpt_view(self.params), metas,
-            separate_file_for_parameters=getattr(
-                self.module, "separate_file_for_parameters", None
-            ),
-            writer=writer,
-        )
         viewed_opt = self.opt_state._replace(
             master=self.module.ckpt_view(self.opt_state.master),
             exp_avg=self.module.ckpt_view(self.opt_state.exp_avg),
             exp_avg_sq=self.module.ckpt_view(self.opt_state.exp_avg_sq),
         )
-        save_optimizer_checkpoint(step_dir, viewed_opt, metas, writer=writer)
+        if self.config.checkpoint_backend == CheckpointBackend.ORBAX:
+            self._save_orbax(step_dir, viewed_opt)
+        else:
+            save_model_checkpoint(
+                step_dir, self.module.ckpt_view(self.params), metas,
+                separate_file_for_parameters=getattr(
+                    self.module, "separate_file_for_parameters", None
+                ),
+                writer=writer,
+            )
+            save_optimizer_checkpoint(step_dir, viewed_opt, metas, writer=writer)
         self.context.save_checkpoint(step_dir)
         # full config travels with the weights so inference can rebuild the
         # architecture (reference: context.py:113-125 config.yml copy)
@@ -437,10 +468,93 @@ class BaseTrainer:
         logger.info(f"saved checkpoint {step_dir}")
         if self.config.delete_past_optimizer_states:
             for old in sorted(base.glob("global_step*")):
-                if old != step_dir:
-                    for f in old.glob("optimizer_state_*"):
-                        f.unlink()
+                if old == step_dir:
+                    continue
+                for f in old.glob("optimizer_state_*"):
+                    f.unlink()
+                old_orbax_opt = old / "orbax" / "optimizer"
+                if old_orbax_opt.is_dir():
+                    shutil.rmtree(old_orbax_opt)
         return step_dir
+
+    def _save_orbax(self, step_dir: Path, viewed_opt: OptimizerState) -> None:
+        """Tensorstore-backed sharded save: every host writes only its own
+        shards — no host gather, unlike the npz path (save trees are the
+        same per-layer canonical views, so pp/mp relayouts still restore)."""
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            # force=True: re-saving an existing step (crash before 'latest'
+            # landed, then re-reaching the step) overwrites like npz does
+            ckptr.save(
+                (step_dir / "orbax" / "model").absolute(),
+                self.module.ckpt_view(self.params),
+                force=True,
+            )
+            ckptr.save(
+                (step_dir / "orbax" / "optimizer").absolute(),
+                {
+                    "step": viewed_opt.step,
+                    "master": viewed_opt.master,
+                    "exp_avg": viewed_opt.exp_avg,
+                    "exp_avg_sq": viewed_opt.exp_avg_sq,
+                    "loss_scaler": viewed_opt.loss_scaler._asdict(),
+                },
+                force=True,
+            )
+
+    @staticmethod
+    def _orbax_abstract(tree):
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+            ),
+            tree,
+        )
+
+    def _restore_orbax_params(self, step_dir: Path):
+        """Restore the param view tree, re-sharded to the CURRENT mesh
+        layout (orbax reads each shard from tensorstore)."""
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore(
+                (step_dir / "orbax" / "model").absolute(),
+                self._orbax_abstract(self.module.ckpt_view(self.params)),
+            )
+
+    def _restore_orbax_opt(self, step_dir: Path) -> OptimizerState:
+        """Restore the optimizer view trees (call only when the caller wants
+        optimizer states — missing/mismatched trees raise and the caller
+        re-derives fresh state, like the npz path)."""
+        import orbax.checkpoint as ocp
+
+        opt_dir = step_dir / "orbax" / "optimizer"
+        if not opt_dir.is_dir():
+            raise FileNotFoundError(str(opt_dir))
+        opt_target = {
+            "step": self.opt_state.step,
+            "master": self.module.ckpt_view(self.opt_state.master),
+            "exp_avg": self.module.ckpt_view(self.opt_state.exp_avg),
+            "exp_avg_sq": self.module.ckpt_view(self.opt_state.exp_avg_sq),
+            "loss_scaler": self.opt_state.loss_scaler._asdict(),
+        }
+        with ocp.StandardCheckpointer() as ckptr:
+            restored = ckptr.restore(
+                opt_dir.absolute(), self._orbax_abstract(opt_target)
+            )
+        # scalars come back COMMITTED to whatever single device orbax used;
+        # jit refuses to relocate committed arrays across the mesh, so hand
+        # them back as host values (uncommitted — jit places them freely)
+        return self.opt_state._replace(
+            step=np.asarray(restored["step"]),
+            master=restored["master"],
+            exp_avg=restored["exp_avg"],
+            exp_avg_sq=restored["exp_avg_sq"],
+            loss_scaler=type(self.opt_state.loss_scaler)(
+                **jax.tree.map(np.asarray, restored["loss_scaler"])
+            ),
+        )
 
     def load_checkpoint(self, dir: Optional[Path | str] = None) -> bool:
         base = Path(dir or self.config.load_dir)
@@ -452,15 +566,19 @@ class BaseTrainer:
         else:
             logger.warning(f"no checkpoint found at {base}")
             return False
+        orbax_backend = (step_dir / "orbax").is_dir()
         metas = self.module.ckpt_metas()
-        params_view = load_model_checkpoint(
-            step_dir,
-            self.module.ckpt_view(self.params),
-            metas,
-            allowed_missing_keys=self.config.allowed_missing_keys_in_checkpoint,
-            allowed_unexpected_keys=self.config.allowed_unexpected_keys_in_checkpoint,
-            ignore_keys=self.config.ignore_keys_in_checkpoint,
-        )
+        if orbax_backend:
+            params_view = self._restore_orbax_params(step_dir)
+        else:
+            params_view = load_model_checkpoint(
+                step_dir,
+                self.module.ckpt_view(self.params),
+                metas,
+                allowed_missing_keys=self.config.allowed_missing_keys_in_checkpoint,
+                allowed_unexpected_keys=self.config.allowed_unexpected_keys_in_checkpoint,
+                ignore_keys=self.config.ignore_keys_in_checkpoint,
+            )
         self.params = self.module.ckpt_unview(params_view, self.params)
         merged_lora = False
         if self.config.merge_lora_after_loading_checkpoint:
@@ -474,12 +592,15 @@ class BaseTrainer:
         # refresh_optimizer_after_model_change (trainer.py:87-92)
         if self.config.load_optimizer_states and not merged_lora:
             try:
-                viewed_current = self.opt_state._replace(
-                    master=self.module.ckpt_view(self.opt_state.master),
-                    exp_avg=self.module.ckpt_view(self.opt_state.exp_avg),
-                    exp_avg_sq=self.module.ckpt_view(self.opt_state.exp_avg_sq),
-                )
-                loaded = load_optimizer_checkpoint(step_dir, viewed_current, metas)
+                if orbax_backend:
+                    loaded = self._restore_orbax_opt(step_dir)
+                else:
+                    viewed_current = self.opt_state._replace(
+                        master=self.module.ckpt_view(self.opt_state.master),
+                        exp_avg=self.module.ckpt_view(self.opt_state.exp_avg),
+                        exp_avg_sq=self.module.ckpt_view(self.opt_state.exp_avg_sq),
+                    )
+                    loaded = load_optimizer_checkpoint(step_dir, viewed_current, metas)
                 self.opt_state = loaded._replace(
                     master=self.module.ckpt_unview(loaded.master, self.opt_state.master),
                     exp_avg=self.module.ckpt_unview(loaded.exp_avg, self.opt_state.exp_avg),
