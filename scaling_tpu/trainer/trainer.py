@@ -422,7 +422,6 @@ class BaseTrainer:
             writer = self._ckpt_writer
         # checkpoint-view trees: stage-stacked pipeline bodies un-stack into
         # per-layer files so checkpoints are pipe-layout independent
-        metas = self.module.ckpt_metas()
         viewed_opt = self.opt_state._replace(
             master=self.module.ckpt_view(self.opt_state.master),
             exp_avg=self.module.ckpt_view(self.opt_state.exp_avg),
@@ -431,6 +430,14 @@ class BaseTrainer:
         if self.config.checkpoint_backend == CheckpointBackend.ORBAX:
             self._save_orbax(step_dir, viewed_opt)
         else:
+            stale_orbax = step_dir / "orbax"
+            if stale_orbax.is_dir():
+                # a crashed orbax run re-reached this step under the npz
+                # backend: load detects the backend by directory presence,
+                # so the stale orbax tree would silently shadow this save
+                logger.warning(f"removing stale orbax checkpoint {stale_orbax}")
+                shutil.rmtree(stale_orbax)
+            metas = self.module.ckpt_metas()
             save_model_checkpoint(
                 step_dir, self.module.ckpt_view(self.params), metas,
                 separate_file_for_parameters=getattr(
@@ -455,8 +462,6 @@ class BaseTrainer:
                 getattr(cfg, "transformer_architecture", None), "vocab_file", None
             )
             if vocab and Path(vocab).is_file():
-                import shutil
-
                 shutil.copyfile(vocab, step_dir / "vocab.json")
         latest = f"global_step{self.context.iterations}"
         if writer is None:
@@ -611,6 +616,16 @@ class BaseTrainer:
                 optimizer_states_loaded = True
             except FileNotFoundError:
                 logger.warning(f"optimizer states absent in {step_dir}")
+            except Exception as e:
+                if not orbax_backend:
+                    raise
+                # an orbax tree mismatch (architecture/PEFT change) is the
+                # same situation as absent npz files: fall back to fresh
+                # state rather than aborting the load
+                logger.warning(
+                    f"orbax optimizer restore failed ({type(e).__name__}: {e}); "
+                    "re-deriving fresh optimizer state"
+                )
         if not optimizer_states_loaded:
             # fp32 masters were copied from the random init; re-derive them
             # from the loaded params or the first step would revert the model
