@@ -1,0 +1,92 @@
+"""LoRA chat-finetune example, end to end on one host:
+
+    python -m examples.finetune_example.run
+
+1. writes the byte-level fallback tokenizer + synthetic data on first run
+   (a pretrain token stream and a chat jsonl whose assistant turns carry
+   ``has_loss: true`` — the role-masking format of the finetuning chat
+   dataset, reference: finetuning_chat_dataset.py);
+2. trains the tiny base model if its checkpoint is absent
+   (``config_pretrain.yml``);
+3. runs the LoRA finetune over it (``config_finetune.yml``): only the
+   LoRA matrices train, the base stays frozen.
+
+After it finishes, generate with the tuned adapter:
+
+    python -c "
+    from scaling_tpu.models.transformer import TransformerInferenceModule
+    m = TransformerInferenceModule.from_checkpoint(
+        '.checkpoints/finetune_example/lora')
+    print(m.generate('Q: what color is the sky?\\nA:', max_tokens=16).completion)
+    "
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+from scaling_tpu.logging import logger
+from scaling_tpu.models.transformer import TransformerConfig
+from scaling_tpu.models.transformer.tokenizer import Tokenizer
+from scaling_tpu.models.transformer.train import main
+
+HERE = Path(__file__).parent
+DATA = Path(".data/finetune_example")
+
+QA = [
+    ("what color is the sky?", "blue"),
+    ("what color is grass?", "green"),
+    ("how many legs has a cat?", "four"),
+    ("what is 2 plus 2?", "four"),
+    ("what is the opposite of hot?", "cold"),
+    ("what do bees make?", "honey"),
+]
+
+
+def ensure_data() -> None:
+    DATA.mkdir(parents=True, exist_ok=True)
+    vocab = DATA / "vocab.json"
+    if not vocab.is_file():
+        vocab.write_text(Tokenizer.default().tokenizer.to_str())
+        logger.info(f"wrote fallback tokenizer to {vocab}")
+
+    pretrain = DATA / "pretrain"
+    if not pretrain.with_suffix(".bin").exists():
+        from scaling_tpu.models.transformer.data.prepare import prepare
+
+        rng = np.random.default_rng(0)
+        words = ["the", "sky", "is", "blue", "grass", "green", "cats", "have",
+                 "four", "legs", "bees", "make", "honey", "hot", "cold"]
+        docs = DATA / "pretrain_docs.txt"
+        docs.write_text("\n".join(
+            " ".join(rng.choice(words, size=int(rng.integers(4, 12))))
+            for _ in range(256)
+        ))
+        stats = prepare([docs], vocab, pretrain)  # the dataset-prep CLI path
+        logger.info(f"wrote synthetic pretrain stream to {pretrain}: {stats}")
+
+    chat = DATA / "chat.jsonl"
+    if not chat.is_file():
+        lines = []
+        for q, a in QA * 8:
+            lines.append(json.dumps([
+                {"type": "text", "content": f"Q: {q}\nA:", "has_loss": False},
+                {"type": "text", "content": f" {a}<|endoftext|>", "has_loss": True},
+            ]))
+        chat.write_text("\n".join(lines))
+        logger.info(f"wrote chat finetuning data to {chat}")
+
+
+if __name__ == "__main__":
+    ensure_data()
+    base_ckpt = Path(".checkpoints/finetune_example/base")
+    if not (base_ckpt / "latest").is_file():
+        logger.info("phase 1: training the base model")
+        main(TransformerConfig.from_yaml(HERE / "config_pretrain.yml"))
+    else:
+        logger.info(f"phase 1 skipped: base checkpoint at {base_ckpt}")
+    logger.info("phase 2: LoRA chat finetune")
+    main(TransformerConfig.from_yaml(HERE / "config_finetune.yml"))
+    sys.exit(0)

@@ -34,6 +34,13 @@ def iter_documents(path: Path, field: str) -> Iterator[str]:
             if not line.strip():
                 continue
             record = json.loads(line)
+            if not isinstance(record, dict):
+                raise ValueError(
+                    f"{path}:{line_no} is a JSON {type(record).__name__}, "
+                    "not an object — this tool consumes pretraining jsonl "
+                    "({'text': ...} per line); chat finetuning jsonl (a "
+                    "list per line) is read directly by the chat dataset"
+                )
             if field not in record:
                 raise KeyError(
                     f"{path}:{line_no} has no {field!r} field "

@@ -56,6 +56,10 @@ class Tokenizer:
         tok = HFTokenizer(BPE(vocab, merges=[]))
         tok.pre_tokenizer = ByteLevel(add_prefix_space=False)
         tok.decoder = ByteLevelDecoder()
+        # registered as special so the literal text "<|endoftext|>" encodes
+        # to the single eos id instead of byte tokens (id unchanged: it is
+        # already in the vocab)
+        tok.add_special_tokens(["<|endoftext|>"])
         return cls(tok)
 
     def __len__(self) -> int:

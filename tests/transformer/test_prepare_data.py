@@ -4,12 +4,17 @@ import json
 from pathlib import Path
 
 import numpy as np
+import pytest
 
 from scaling_tpu.data.memory_map import MemoryMapDataset
 from scaling_tpu.models.transformer.data.prepare import prepare
 from scaling_tpu.models.transformer.tokenizer import Tokenizer
 
 REFERENCE_VOCAB = Path("/root/reference/tests/transformer/files/llama2-tokenizer.json")
+
+pytestmark = pytest.mark.skipif(
+    not REFERENCE_VOCAB.is_file(), reason="reference checkout absent"
+)
 
 
 def test_prepare_jsonl_roundtrip(tmp_path):

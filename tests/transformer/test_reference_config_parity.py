@@ -126,3 +126,15 @@ def test_reference_example_config_trains_end_to_end(tmp_path, devices):
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]  # 128k-vocab from-scratch: fast early drop
     assert (tmp_path / "ckpt" / "global_step8").is_dir()
+
+
+def test_example_configs_parse():
+    """Every shipped example config must load through TransformerConfig
+    (guards the examples against config-surface drift)."""
+    from pathlib import Path
+
+    for yml in sorted(Path("examples").glob("*example/config*.yml")):
+        if "mlp" in str(yml):
+            continue  # mlp example uses its own config class
+        cfg = TransformerConfig.from_yaml(yml)
+        assert cfg.transformer_architecture.hidden_size > 0, yml
