@@ -1,0 +1,127 @@
+"""Standalone evaluation: checkpoint + tokenized dataset -> loss/perplexity.
+
+The trainer evaluates mid-run (eval_interval); this CLI scores any saved
+checkpoint against any memory-map dataset after the fact:
+
+    python -m scaling_tpu.models.transformer.evaluate \
+        --checkpoint .checkpoints/run --data data/val [--batch-size 8]
+
+Deterministic (no shuffle, sequential packing), so two runs on the same
+checkpoint and data produce the same number. Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from pathlib import Path
+from typing import Optional
+
+import jax
+import numpy as np
+
+from .data.text_dataset import TextDataset
+from .inference import TransformerInferenceModule
+
+
+def evaluate(
+    checkpoint_dir: Path | str,
+    data_prefix: Path | str,
+    batch_size: int = 8,
+    max_batches: Optional[int] = None,
+    legacy_dataset: bool = False,
+) -> dict:
+    if batch_size < 1:
+        raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+    module = TransformerInferenceModule.from_checkpoint(checkpoint_dir)
+    arch = module.architecture
+    dataset = TextDataset(
+        data_prefix,
+        sequence_length=arch.sequence_length,
+        shuffle=False,
+        legacy_dataset=legacy_dataset,
+    )
+    if len(dataset) == 0:
+        # a perfect-looking zero score for nothing evaluated misleads any
+        # consumer of the JSON — refuse instead
+        raise ValueError(
+            f"{data_prefix} packs into 0 sequences of length "
+            f"{arch.sequence_length} (wrong prefix or dataset too small)"
+        )
+
+    fwd = None
+    total_loss = total_weight = total_correct = 0.0
+    n_batches = math.ceil(len(dataset) / batch_size)
+    if max_batches is not None:
+        n_batches = min(n_batches, max_batches)
+    for b in range(n_batches):
+        items = [
+            dataset[i]
+            for i in range(b * batch_size, min((b + 1) * batch_size, len(dataset)))
+        ]
+        batch = dataset.collate(items).as_model_input()
+        if len(items) < batch_size:
+            # pad the trailing batch to the jitted shape; padding rows carry
+            # zero loss weight so they never contribute
+            pad = batch_size - len(items)
+            batch = {
+                k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)], axis=0)
+                if hasattr(v, "ndim") and v.ndim > 0
+                else v
+                for k, v in batch.items()
+            }
+            batch["loss_weights"][-pad:] = 0.0
+        if fwd is None:
+
+            def run(params, batch):
+                from .model import per_token_loss
+
+                ctx = module.module._make_ctx(deterministic=True, dropout_key=None)
+                out = module.module.forward(params, batch, ctx)
+                # weighted SUMS (not the training loss_function's means):
+                # batches of unequal live-token counts aggregate exactly
+                token_loss, correct = per_token_loss(
+                    out["activations"], batch["target_token_ids"]
+                )
+                weights = batch["loss_weights"].astype("float32")
+                return (
+                    (token_loss * weights).sum(),
+                    (correct * weights).sum(),
+                    weights.sum(),
+                )
+
+            fwd = jax.jit(run)
+        loss_sum, correct_sum, weight_sum = fwd(module.params, batch)
+        total_loss += float(loss_sum)
+        total_correct += float(correct_sum)
+        total_weight += float(weight_sum)
+
+    mean_loss = total_loss / max(total_weight, 1.0)
+    return {
+        "loss": round(mean_loss, 6),
+        "perplexity": round(math.exp(min(mean_loss, 80.0)), 4),
+        "accuracy": round(total_correct / max(total_weight, 1.0), 6),
+        "tokens": int(total_weight),
+        "batches": n_batches,
+    }
+
+
+def main(argv: Optional[list] = None) -> None:
+    ap = argparse.ArgumentParser(description="score a checkpoint on a dataset")
+    ap.add_argument("--checkpoint", required=True, type=Path)
+    ap.add_argument("--data", required=True, type=Path,
+                    help="memory-map dataset prefix")
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--max-batches", type=int, default=None)
+    ap.add_argument("--legacy-dataset", action="store_true",
+                    help="Megatron .bin/.idx format")
+    args = ap.parse_args(argv)
+    stats = evaluate(args.checkpoint, args.data, args.batch_size,
+                     args.max_batches, args.legacy_dataset)
+    print(json.dumps({"checkpoint": str(args.checkpoint), **stats}))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

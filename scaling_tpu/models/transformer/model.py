@@ -85,21 +85,30 @@ def get_transformer_layer_specs(
     return specs
 
 
+def per_token_loss(logits, targets):
+    """(token cross-entropy, correct-prediction flags) in fp32 — the one
+    definition both the training loss and the standalone evaluator reduce
+    (they differ only in mean-vs-sum aggregation)."""
+    logits = logits.astype(jnp.float32)
+    targets = targets.astype(jnp.int32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    token_loss = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    correct = (logits.argmax(-1) == targets).astype(jnp.float32)
+    return token_loss, correct
+
+
 def loss_function(output: Dict[str, Any], batch: Dict[str, Any]):
     """Cross entropy with per-token loss weights + accuracy
     (reference: model.py:43-76)."""
-    logits = output["activations"].astype(jnp.float32)
-    targets = batch["target_token_ids"].astype(jnp.int32)
+    targets = batch["target_token_ids"]
     loss_weights = batch.get("loss_weights")
     if loss_weights is None:
         loss_weights = jnp.ones(targets.shape, dtype=jnp.float32)
     loss_weights = loss_weights.astype(jnp.float32)
 
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    token_loss = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    token_loss, correct = per_token_loss(output["activations"], targets)
     denom = jnp.maximum(loss_weights.sum(), 1.0)
     loss = (token_loss * loss_weights).sum() / denom
-    correct = (logits.argmax(-1) == targets).astype(jnp.float32)
     accuracy = (correct * loss_weights).sum() / denom
     metrics = {"accuracy": accuracy}
     aux = output.get("aux_loss")
