@@ -35,10 +35,11 @@ def make_qkv(seed=0):
     return tuple(jax.random.normal(k, (B, S, N, D), jnp.float32) * 0.5 for k in ks)
 
 
-def xla_reference(q, k, v, segment_ids, causal=True):
+def xla_reference(q, k, v, segment_ids, causal=True, head_dim=None):
     mask = segment_ids_to_mask(segment_ids, None, causal=causal)
     softmax = MaskedSoftmax(MaskedSoftmaxConfig(softmax_in_fp32=True))
-    return multi_head_attention(q, k, v, mask, 1.0 / np.sqrt(D), softmax, None, None)
+    scale = 1.0 / np.sqrt(head_dim if head_dim is not None else D)
+    return multi_head_attention(q, k, v, mask, scale, softmax, None, None)
 
 
 @pytest.mark.parametrize("packed", [False, True], ids=["single-doc", "packed"])
@@ -208,3 +209,32 @@ def test_ring_gqa_partial_repeat_under_mp4(devices):
     np.testing.assert_allclose(
         np.asarray(out_cp), np.asarray(out_ref), atol=3e-5, rtol=3e-5
     )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("variant", ["ring", "ulysses"])
+def test_long_sequence_parity(cp_topology, variant):
+    """Longer-context check (slow tier): seq 1024 over a 4-wide context
+    axis, packed documents crossing every shard boundary, both variants
+    matching the single-device reference."""
+    from scaling_tpu.ops.ulysses_attention import ulysses_attention
+
+    b, s, n, d = 2, 1024, 4, 16
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (b, s, n, d), jnp.float32) * 0.5
+    k = jax.random.normal(ks[1], (b, s, n, d), jnp.float32) * 0.5
+    v = jax.random.normal(ks[2], (b, s, n, d), jnp.float32) * 0.5
+    # every shard boundary (256/512/768) falls MID-document so each ring
+    # handoff exercises the online-softmax merge
+    lengths = [257, 254, 301, 212]
+    seg = jnp.asarray(
+        np.concatenate([np.full((b, ln), i) for i, ln in enumerate(lengths)], axis=1),
+        jnp.int32,
+    )
+    ref = xla_reference(q, k, v, seg, causal=True, head_dim=d)
+    fn = ring_attention if variant == "ring" else ulysses_attention
+    out = jax.jit(
+        lambda q, k, v, sg: fn(q, k, v, sg, cp_topology.mesh, causal=True,
+                               sm_scale=1.0 / np.sqrt(d))
+    )(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5, rtol=3e-5)
