@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from typing import TYPE_CHECKING
 
@@ -574,6 +575,13 @@ class ParallelModule:
 
         ``stacked=True`` for train batches with a leading grad-accum axis
         (gas, dp*mbs, ...); False for single micro batches (dp*mbs, ...).
+
+        Multi-host: every process passes the same full global batch (the
+        loader stream is a pure function of seed + consumed samples, so
+        identical on all hosts) and each host materializes only the slices
+        its own devices hold — the JAX equivalent of the reference's
+        broadcast_data + DP-strided loader split (broadcast_data.py:103,
+        dataloader.py:69-80).
         """
         if self.topology is None:
             return batch
@@ -582,11 +590,20 @@ class ParallelModule:
         # batch dims shard over data; the sequence dim (first after batch)
         # shards over the context axis for ring attention (no-op at cp=1)
         lead = (None, "data", "context") if stacked else ("data", "context")
+        multiprocess = jax.process_count() > 1
 
         def put(x):
             if not hasattr(x, "ndim") or x.ndim < len(lead) - 1:
                 return x
             spec = lead[: x.ndim] + (None,) * (x.ndim - len(lead))
-            return jax.device_put(x, NamedSharding(self.topology.mesh, P(*spec)))
+            sharding = NamedSharding(self.topology.mesh, P(*spec))
+            if multiprocess:
+                # device_put cannot target non-addressable devices; the
+                # callback is invoked only for this host's shard indices
+                x_np = np.asarray(x)
+                return jax.make_array_from_callback(
+                    x_np.shape, sharding, lambda idx: x_np[idx]
+                )
+            return jax.device_put(x, sharding)
 
         return jax.tree.map(put, batch)

@@ -1,10 +1,95 @@
 """Worker launched by test_runner: joins the real jax.distributed
 rendezvous on CPU and records what it saw (reference:
-tests/core/test_runner/runner_script.py writes one json per process)."""
+tests/core/test_runner/runner_script.py writes one json per process).
+
+``payload["case"] == "train"`` additionally runs REAL distributed
+training: every process holds 2 virtual CPU devices, the mesh spans all
+processes, and the jitted train step executes with cross-process
+collectives — the closest single-machine emulation of a multi-host pod.
+"""
 
 import json
 import os
 from pathlib import Path
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
+).strip()
+
+
+def run_distributed_train() -> dict:
+    """Two global train steps over the multi-process mesh; returns losses
+    (every process must see identical, finite values)."""
+    import jax
+    import numpy as np
+
+    from scaling_tpu.models.transformer import TransformerConfig
+    from scaling_tpu.models.transformer.model import (
+        init_model,
+        init_optimizer,
+        loss_function,
+    )
+    from scaling_tpu.topology import Topology
+
+    dp = len(jax.devices())  # all processes' devices
+    config = TransformerConfig.from_dict(
+        {
+            "topology": {
+                "model_parallel_size": 1,
+                "pipe_parallel_size": 1,
+                "data_parallel_size": dp,
+                "micro_batch_size": 2,
+                "gradient_accumulation_steps": 1,
+            },
+            "transformer_architecture": {
+                "vocab_size": 64,
+                "hidden_size": 32,
+                "num_layers": 1,
+                "num_attention_heads": 2,
+                "sequence_length": 16,
+                "precision": "float32",
+            },
+            "optimizer": {"gradient_clipping": 1.0, "loss_scaler": {"enable": False}},
+            "learning_rate_scheduler": {
+                "learning_rate": 1e-2,
+                "learning_rate_warmup_steps": 1,
+                "learning_rate_decay_iters": 10,
+            },
+            "trainer": {"train_iterations": 2, "seed": 0},
+            "data": {},
+            "logger": {"log_dir": None},
+        }
+    )
+    topology = Topology(config.topology)
+    module = init_model(config, topology)
+    optimizer = init_optimizer(config, module, topology)
+    params = module.shard_params(module.init_params(jax.random.PRNGKey(0)))
+    opt_state = optimizer.init_state(params)
+    step = module.build_train_step(optimizer, loss_function)
+
+    losses = []
+    for i in range(2):
+        # every process synthesizes the IDENTICAL global batch (pure
+        # function of the seed); shard_batch materializes local shards only
+        rng = np.random.default_rng(i)
+        tokens = rng.integers(1, 64, size=(1, 2 * dp, 16))
+        batch = module.shard_batch(
+            {
+                "token_ids": tokens.astype(np.int32),
+                "target_token_ids": np.roll(tokens, -1, axis=-1).astype(np.int32),
+                "position_ids": np.broadcast_to(
+                    np.arange(16, dtype=np.int32), (1, 2 * dp, 16)
+                ),
+                "segment_ids": np.zeros((1, 2 * dp, 16), np.int32),
+                "loss_weights": np.ones((1, 2 * dp, 16), np.float32),
+            },
+            stacked=True,
+        )
+        params, opt_state, loss, _, _ = step(
+            params, opt_state, batch, jax.random.PRNGKey(i)
+        )
+        losses.append(float(loss))  # replicated output: addressable everywhere
+    return {"losses": losses}
 
 
 def main() -> None:
@@ -25,6 +110,8 @@ def main() -> None:
         "global_devices": len(jax.devices()),
         "payload": lc.payload,
     }
+    if lc.payload.get("case") == "train":
+        out.update(run_distributed_train())
     cache_dir = Path(lc.payload["cache_dir"])
     (cache_dir / f"rank_{lc.global_rank}.json").write_text(json.dumps(out))
 
