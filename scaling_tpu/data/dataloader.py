@@ -12,8 +12,12 @@ Keeps the reference's sample-order contract
 Single-controller difference: one loader feeds ALL data-parallel shards —
 each ``__next__`` returns the micro batch for every dp rank stacked along the
 batch axis (shard r occupying rows [r*mbs, (r+1)*mbs)), ready to be sharded
-over the mesh's data axis. Per-rank iteration (multi-host) is available via
-``dp_rank``.
+over the mesh's data axis. This full-global-batch form is ALSO the
+multi-host training contract: every host builds the identical stacked
+batch (the stream is a pure function of seed + consumed samples) and
+``ParallelModule.shard_batch`` materializes only the host's own shards.
+``dp_rank`` gives per-rank iteration for inspection and custom pipelines;
+do NOT feed per-rank slices to ``shard_batch`` (it rejects them).
 """
 
 from __future__ import annotations

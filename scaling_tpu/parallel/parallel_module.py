@@ -591,6 +591,10 @@ class ParallelModule:
         # shards over the context axis for ring attention (no-op at cp=1)
         lead = (None, "data", "context") if stacked else ("data", "context")
         multiprocess = jax.process_count() > 1
+        batch_axis = 1 if stacked else 0
+        global_batch = (
+            self.topology.micro_batch_size * self.topology.data_parallel_size
+        )
 
         def put(x):
             if not hasattr(x, "ndim") or x.ndim < len(lead) - 1:
@@ -598,6 +602,17 @@ class ParallelModule:
             spec = lead[: x.ndim] + (None,) * (x.ndim - len(lead))
             sharding = NamedSharding(self.topology.mesh, P(*spec))
             if multiprocess:
+                # every host must pass the same FULL global batch: a
+                # per-rank slice has a locally-consistent shape too, so
+                # without this guard each host would silently train on
+                # different data under one "global" array
+                if x.ndim > batch_axis and x.shape[batch_axis] != global_batch:
+                    raise ValueError(
+                        f"multi-host shard_batch needs the full global batch "
+                        f"(dim {batch_axis} == micro_batch_size * dp = "
+                        f"{global_batch}), got shape {x.shape}; do not feed "
+                        "per-dp_rank slices here"
+                    )
                 # device_put cannot target non-addressable devices; the
                 # callback is invoked only for this host's shard indices
                 x_np = np.asarray(x)

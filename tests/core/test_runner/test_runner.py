@@ -107,7 +107,8 @@ class _CountingDataset:
 
 
 def test_dataloader_per_host_dp_rank(devices):
-    """Multi-host mode: each process builds a loader for its own dp_rank and
+    """Per-rank iteration (inspection / custom pipelines — multi-host
+    TRAINING feeds shard_batch full global batches): a loader for one dp_rank
     the union covers each sample exactly once per epoch (VERDICT r1 item 8:
     the per-host data path was unexercised)."""
     from scaling_tpu.topology import Topology, TopologyConfig
