@@ -1,13 +1,16 @@
 """scaling_tpu — a TPU-native distributed training framework.
 
 A ground-up JAX/XLA/Pallas re-design of the capabilities of Aleph Alpha's
-``scaling`` library (reference: marcobellagente93/scaling): 3D parallelism
-(data x tensor x pipeline) over a ``jax.sharding.Mesh``, Megatron-style
-sequence parallelism, ZeRO-1 optimizer-state sharding, mixed precision with
-dynamic loss scaling, activation rematerialisation, layout-independent
-checkpoints, and a transformer suite (GQA, RoPE, SwiGLU, sequence packing,
-local attention, LoRA/adapter/bitfit/softprompt fine-tuning, KV-cached
-inference).
+``scaling`` library (reference: marcobellagente93/scaling): 4-axis
+parallelism (data x tensor x pipeline x context — ring or ulysses) over
+one ``jax.sharding.Mesh``, Megatron-style sequence parallelism, ZeRO-1
+optimizer-state sharding, mixture-of-experts with expert parallelism,
+muP width-transferable hyperparameters, mixed precision with dynamic
+loss scaling, activation rematerialisation, layout-independent npz or
+orbax/tensorstore checkpoints, multi-host training over
+``jax.distributed``, and a transformer suite (GQA, RoPE, SwiGLU,
+sequence packing, local attention, LoRA/adapter/bitfit/softprompt
+fine-tuning, batched KV-cached and tensor-parallel inference).
 
 Layout:
   scaling_tpu.config     pydantic config base (yaml/json, templates)
