@@ -430,6 +430,15 @@ class BaseTrainer:
         if self.config.checkpoint_backend == CheckpointBackend.ORBAX:
             self._save_orbax(step_dir, viewed_opt)
         else:
+            # checked here, not in config validation: jax.process_count()
+            # initializes the backend as a side effect, which would break a
+            # later jax.distributed.initialize() for configs built early
+            if jax.process_count() > 1:
+                raise RuntimeError(
+                    "the npz checkpoint backend host-gathers every array "
+                    "and cannot run multi-process; set "
+                    "trainer.checkpoint_backend: orbax for multi-host runs"
+                )
             stale_orbax = step_dir / "orbax"
             if stale_orbax.is_dir():
                 # a crashed orbax run re-reached this step under the npz
@@ -486,68 +495,43 @@ class BaseTrainer:
         """Tensorstore-backed sharded save: every host writes only its own
         shards — no host gather, unlike the npz path (save trees are the
         same per-layer canonical views, so pp/mp relayouts still restore)."""
-        import orbax.checkpoint as ocp
+        from ..checkpoint.orbax_backend import save_orbax
 
-        with ocp.StandardCheckpointer() as ckptr:
-            # force=True: re-saving an existing step (crash before 'latest'
-            # landed, then re-reaching the step) overwrites like npz does
-            ckptr.save(
-                (step_dir / "orbax" / "model").absolute(),
-                self.module.ckpt_view(self.params),
-                force=True,
-            )
-            ckptr.save(
-                (step_dir / "orbax" / "optimizer").absolute(),
-                {
-                    "step": viewed_opt.step,
-                    "master": viewed_opt.master,
-                    "exp_avg": viewed_opt.exp_avg,
-                    "exp_avg_sq": viewed_opt.exp_avg_sq,
-                    "loss_scaler": viewed_opt.loss_scaler._asdict(),
-                },
-                force=True,
-            )
-
-    @staticmethod
-    def _orbax_abstract(tree):
-        return jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(
-                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
-            ),
-            tree,
+        save_orbax(
+            step_dir,
+            self.module.ckpt_view(self.params),
+            {
+                "step": viewed_opt.step,
+                "master": viewed_opt.master,
+                "exp_avg": viewed_opt.exp_avg,
+                "exp_avg_sq": viewed_opt.exp_avg_sq,
+                "loss_scaler": viewed_opt.loss_scaler._asdict(),
+            },
         )
 
     def _restore_orbax_params(self, step_dir: Path):
         """Restore the param view tree, re-sharded to the CURRENT mesh
         layout (orbax reads each shard from tensorstore)."""
-        import orbax.checkpoint as ocp
+        from ..checkpoint.orbax_backend import restore_orbax_params
 
-        with ocp.StandardCheckpointer() as ckptr:
-            return ckptr.restore(
-                (step_dir / "orbax" / "model").absolute(),
-                self._orbax_abstract(self.module.ckpt_view(self.params)),
-            )
+        return restore_orbax_params(step_dir, self.module.ckpt_view(self.params))
 
     def _restore_orbax_opt(self, step_dir: Path) -> OptimizerState:
         """Restore the optimizer view trees (call only when the caller wants
         optimizer states — missing/mismatched trees raise and the caller
         re-derives fresh state, like the npz path)."""
-        import orbax.checkpoint as ocp
+        from ..checkpoint.orbax_backend import restore_orbax_opt
 
-        opt_dir = step_dir / "orbax" / "optimizer"
-        if not opt_dir.is_dir():
-            raise FileNotFoundError(str(opt_dir))
-        opt_target = {
-            "step": self.opt_state.step,
-            "master": self.module.ckpt_view(self.opt_state.master),
-            "exp_avg": self.module.ckpt_view(self.opt_state.exp_avg),
-            "exp_avg_sq": self.module.ckpt_view(self.opt_state.exp_avg_sq),
-            "loss_scaler": self.opt_state.loss_scaler._asdict(),
-        }
-        with ocp.StandardCheckpointer() as ckptr:
-            restored = ckptr.restore(
-                opt_dir.absolute(), self._orbax_abstract(opt_target)
-            )
+        restored = restore_orbax_opt(
+            step_dir,
+            {
+                "step": self.opt_state.step,
+                "master": self.module.ckpt_view(self.opt_state.master),
+                "exp_avg": self.module.ckpt_view(self.opt_state.exp_avg),
+                "exp_avg_sq": self.module.ckpt_view(self.opt_state.exp_avg_sq),
+                "loss_scaler": self.opt_state.loss_scaler._asdict(),
+            },
+        )
         # scalars come back COMMITTED to whatever single device orbax used;
         # jit refuses to relocate committed arrays across the mesh, so hand
         # them back as host values (uncommitted — jit places them freely)

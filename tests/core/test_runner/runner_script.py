@@ -17,9 +17,10 @@ os.environ["XLA_FLAGS"] = (
 ).strip()
 
 
-def run_distributed_train() -> dict:
+def run_distributed_train(cache_dir: Path) -> dict:
     """Two global train steps over the multi-process mesh; returns losses
-    (every process must see identical, finite values)."""
+    (every process must see identical, finite values) plus a collective
+    orbax save/restore round-trip flag."""
     import jax
     import numpy as np
 
@@ -89,7 +90,40 @@ def run_distributed_train() -> dict:
             params, opt_state, batch, jax.random.PRNGKey(i)
         )
         losses.append(float(loss))  # replicated output: addressable everywhere
-    return {"losses": losses}
+
+    # distributed checkpointing through the PRODUCT backend (the same
+    # functions the trainer's checkpoint_backend=orbax uses): a collective
+    # save where every process writes only its own shards, then a sharded
+    # restore that must reproduce the trained params and optimizer masters
+    # exactly
+    from scaling_tpu.checkpoint.orbax_backend import (
+        restore_orbax_opt,
+        restore_orbax_params,
+        save_orbax,
+    )
+
+    step_dir = cache_dir / "global_step2"
+    params_view = module.ckpt_view(params)
+    opt_view = {
+        "step": opt_state.step,
+        "master": module.ckpt_view(opt_state.master),
+        "exp_avg": module.ckpt_view(opt_state.exp_avg),
+        "exp_avg_sq": module.ckpt_view(opt_state.exp_avg_sq),
+        "loss_scaler": opt_state.loss_scaler._asdict(),
+    }
+    save_orbax(step_dir, params_view, opt_view)
+    back_params = restore_orbax_params(step_dir, params_view)
+    back_opt = restore_orbax_opt(step_dir, opt_view)
+    same = [
+        bool(jax.numpy.array_equal(a, b))
+        for a, b in zip(jax.tree.leaves(params_view), jax.tree.leaves(back_params))
+    ] + [
+        bool(jax.numpy.array_equal(a, b))
+        for a, b in zip(
+            jax.tree.leaves(opt_view["master"]), jax.tree.leaves(back_opt["master"])
+        )
+    ]
+    return {"losses": losses, "orbax_roundtrip": all(same)}
 
 
 def main() -> None:
@@ -110,9 +144,9 @@ def main() -> None:
         "global_devices": len(jax.devices()),
         "payload": lc.payload,
     }
-    if lc.payload.get("case") == "train":
-        out.update(run_distributed_train())
     cache_dir = Path(lc.payload["cache_dir"])
+    if lc.payload.get("case") == "train":
+        out.update(run_distributed_train(cache_dir))
     (cache_dir / f"rank_{lc.global_rank}.json").write_text(json.dumps(out))
 
 
