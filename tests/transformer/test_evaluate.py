@@ -36,3 +36,35 @@ def test_evaluate_scores_checkpoint(tmp_path):
     # max_batches bounds the work
     bounded = evaluate(ckpt, prefix, batch_size=4, max_batches=2)
     assert bounded["batches"] == 2 and bounded["tokens"] < stats["tokens"]
+
+
+def test_evaluate_legacy_dataset(tmp_path):
+    """--legacy-dataset scores Megatron .bin/.idx data through the same
+    path (reference: legacy_dataset/indexed_dataset.py)."""
+    from scaling_tpu.data.legacy_indexed_dataset import LegacyMMapIndexWriter
+
+    rng = np.random.default_rng(13)
+    npz_prefix = tmp_path / "train"
+    with MemoryMapDatasetBuilder(npz_prefix, dtype=np.uint16) as builder:
+        for _ in range(32):
+            builder.add(np.append(rng.integers(1, 96, size=20), 0).astype(np.uint16))
+    cfg = make_config(tmp_path, npz_prefix, train_iterations=2, save_interval=2)
+    train_capture(build_capturing_trainer(cfg), 2)
+
+    # identical documents in BOTH formats: the legacy reader must produce
+    # the exact same evaluation, not merely a finite one
+    docs = [np.append(rng.integers(1, 96, size=20), 0).astype(np.uint16)
+            for _ in range(16)]
+    legacy_prefix = tmp_path / "legacy"
+    with LegacyMMapIndexWriter(legacy_prefix, dtype=np.uint16) as w:
+        for d in docs:
+            w.add(d)
+    mmap_prefix = tmp_path / "same_docs"
+    with MemoryMapDatasetBuilder(mmap_prefix, dtype=np.uint16) as builder:
+        for d in docs:
+            builder.add(d)
+    ckpt = Path(cfg.trainer.save_dir)
+    legacy_stats = evaluate(ckpt, legacy_prefix, batch_size=4, legacy_dataset=True)
+    mmap_stats = evaluate(ckpt, mmap_prefix, batch_size=4)
+    assert legacy_stats["tokens"] > 0 and np.isfinite(legacy_stats["loss"])
+    assert legacy_stats == mmap_stats
