@@ -32,11 +32,15 @@ def run_distributed_train(cache_dir: Path) -> dict:
     )
     from scaling_tpu.topology import Topology
 
-    dp = len(jax.devices())  # all processes' devices
+    n_dev = len(jax.devices())  # all processes' devices
+    # mp x dp so BOTH collective families cross process boundaries: the
+    # per-layer tensor-parallel all-gathers and the gradient psum
+    mp = 2 if n_dev % 2 == 0 else 1
+    dp = n_dev // mp
     config = TransformerConfig.from_dict(
         {
             "topology": {
-                "model_parallel_size": 1,
+                "model_parallel_size": mp,
                 "pipe_parallel_size": 1,
                 "data_parallel_size": dp,
                 "micro_batch_size": 2,
