@@ -156,6 +156,32 @@ def _matches_any(key: str, patterns: list) -> bool:
     return any(p.search(key) for p in patterns)
 
 
+def enforce_allow_lists(
+    model_keys, available_keys, allowed_missing: list, allowed_unexpected: list
+) -> None:
+    """The non-strict loading contract, shared by the npz and orbax
+    backends: model keys absent from the checkpoint must match the
+    ``allowed_missing`` compiled patterns, checkpoint keys the model lacks
+    must match ``allowed_unexpected``; anything else raises KeyError."""
+    model_set, available_set = set(model_keys), set(available_keys)
+    missing = sorted(
+        k for k in model_set - available_set if not _matches_any(k, allowed_missing)
+    )
+    unexpected = sorted(
+        k for k in available_set - model_set if not _matches_any(k, allowed_unexpected)
+    )
+    if missing:
+        raise KeyError(
+            f"checkpoint missing parameters: {missing[:8]}"
+            f"{'...' if len(missing) > 8 else ''}"
+        )
+    if unexpected:
+        raise KeyError(
+            f"checkpoint has unexpected parameters: {unexpected[:8]}"
+            f"{'...' if len(unexpected) > 8 else ''}"
+        )
+
+
 def load_model_checkpoint(
     dir: Path | str,
     params: Any,
@@ -191,16 +217,7 @@ def load_model_checkpoint(
     m_leaves = _meta_leaves(metas)
     model_keys = [m.key for m in m_leaves]
 
-    missing = [
-        k for k in model_keys if k not in available and not _matches_any(k, allowed_missing)
-    ]
-    unexpected = [
-        k for k in available if k not in set(model_keys) and not _matches_any(k, allowed_unexpected)
-    ]
-    if missing:
-        raise KeyError(f"checkpoint missing parameters: {missing[:8]}{'...' if len(missing) > 8 else ''}")
-    if unexpected:
-        raise KeyError(f"checkpoint has unexpected parameters: {unexpected[:8]}{'...' if len(unexpected) > 8 else ''}")
+    enforce_allow_lists(model_keys, available, allowed_missing, allowed_unexpected)
 
     # load per-file lazily
     cache: dict[Path, Any] = {}

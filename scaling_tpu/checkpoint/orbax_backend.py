@@ -38,25 +38,174 @@ def save_orbax(step_dir: Path, params_view: Any, opt_view: Dict[str, Any]) -> No
         )
 
 
-def restore_orbax_params(step_dir: Path, params_view_like: Any) -> Any:
-    """Restore the param view tree, re-sharded to ``params_view_like``'s
-    current layout (orbax reads each shard from tensorstore)."""
+def _committed(d: Path) -> bool:
+    """True when ``d`` is a finalized orbax checkpoint directory.
+
+    Uses orbax's own finalization predicate (atomic-rename storage commits
+    by the final dir appearing; commit-file storage by ``commit_success``),
+    plus the pytree ``_METADATA`` file as a guard against in-place
+    corruption that the rename semantics cannot see."""
+    if not d.is_dir():
+        return False
     import orbax.checkpoint as ocp
 
+    return bool(ocp.utils.is_checkpoint_finalized(d)) and (d / "_METADATA").is_file()
+
+
+def orbax_model_valid(step_dir: Path) -> bool:
+    """True when ``step_dir/orbax/model`` is a COMMITTED orbax checkpoint.
+    Callers use this to avoid letting a torn orbax save shadow valid npz
+    files in the same step directory."""
+    return _committed(step_dir / "orbax" / "model")
+
+
+def restore_orbax_params(
+    step_dir: Path,
+    params_view_like: Any,
+    metas: Any = None,
+    allowed_missing_keys: Any = None,
+    allowed_unexpected_keys: Any = None,
+    ignore_keys: Any = None,
+) -> Any:
+    """Restore the param view tree, re-sharded to ``params_view_like``'s
+    current layout (orbax reads each shard from tensorstore).
+
+    With ``metas`` (the matching ``ckpt_metas()`` view tree) the restore is
+    NON-STRICT under the same allow-list regexes as the npz loader
+    (reference: ``load_model_checkpoint``): keys of the current model absent
+    from the checkpoint must match ``allowed_missing_keys`` (kept at their
+    current/re-initialised values — the PEFT path), checkpoint-only keys
+    must match ``allowed_unexpected_keys`` (dropped), and ``ignore_keys``
+    keeps current values even when the checkpoint has them. Without
+    ``metas`` the restore is strict, as before."""
+    import orbax.checkpoint as ocp
+
+    model_dir = (step_dir / "orbax" / "model").absolute()
     with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.restore(
-            (step_dir / "orbax" / "model").absolute(),
-            orbax_abstract(params_view_like),
+        if metas is None:
+            return ckptr.restore(model_dir, orbax_abstract(params_view_like))
+
+        import jax.tree_util as jtu
+
+        from .checkpoint import (
+            _compile_patterns,
+            _matches_any,
+            _meta_leaves,
+            enforce_allow_lists,
         )
+
+        allowed_missing = _compile_patterns(allowed_missing_keys)
+        allowed_unexpected = _compile_patterns(allowed_unexpected_keys)
+        ignore = _compile_patterns(ignore_keys)
+
+        cur_flat, cur_treedef = jtu.tree_flatten_with_path(params_view_like)
+        m_leaves = _meta_leaves(metas)
+        assert len(cur_flat) == len(m_leaves), (
+            f"params/metas mismatch: {len(cur_flat)} vs {len(m_leaves)}"
+        )
+        key_by_path = {path: m.key for (path, _), m in zip(cur_flat, m_leaves)}
+        cur_by_path = {path: leaf for path, leaf in cur_flat}
+        # view top-level name ("layer_{i}") -> (index, class), so
+        # checkpoint-only keys inside a known layer can be printed in the
+        # same "layer_{i}_{Class}.{name}" format the npz loader uses —
+        # allow-list regexes written for npz checkpoints match unchanged
+        layer_info = {
+            str(getattr(path[0], "key", path[0])): (m.layer_index, m.layer_class_name)
+            for (path, _), m in zip(cur_flat, m_leaves)
+        }
+
+        saved_tree = ckptr.metadata(model_dir).item_metadata.tree
+        saved_by_path = dict(jtu.tree_flatten_with_path(saved_tree)[0])
+
+        def saved_key(path) -> str:
+            parts = [str(getattr(k, "key", k)) for k in path]
+            info = layer_info.get(parts[0])
+            if info is not None and len(parts) > 1:
+                return f"layer_{info[0]}_{info[1]}." + ".".join(parts[1:])
+            # a whole layer the current model lacks: dotted path fallback
+            return ".".join(parts)
+
+        # shared paths print as their meta key on both sides, so the diff
+        # runs in the npz loader's key space with its exact contract
+        enforce_allow_lists(
+            (key_by_path[p] for p in cur_by_path),
+            (saved_key(p) for p in saved_by_path),
+            allowed_missing,
+            allowed_unexpected,
+        )
+
+        # restore ONLY the intersection (shared, non-ignored paths), each at
+        # the current leaf's dtype + sharding (orbax casts and re-shards).
+        # partial_restore skips everything absent from the target tree, so
+        # ignored and checkpoint-only leaves cost no tensorstore reads and
+        # no unsharded host materialization — like the npz loader, which
+        # never opens them.
+        subset: dict = {}
+        n_wanted = 0
+        for path, cur in cur_flat:
+            md = saved_by_path.get(path)
+            if md is None or _matches_any(key_by_path[path], ignore):
+                continue
+            if tuple(md.shape) != tuple(cur.shape):
+                raise ValueError(
+                    f"shape mismatch for {key_by_path[path]}: checkpoint "
+                    f"{tuple(md.shape)} vs model {tuple(cur.shape)}"
+                )
+            node = subset
+            parts = [str(getattr(k, "key", k)) for k in path]
+            for k in parts[:-1]:
+                node = node.setdefault(k, {})
+            node[parts[-1]] = jax.ShapeDtypeStruct(
+                tuple(md.shape), cur.dtype, sharding=getattr(cur, "sharding", None)
+            )
+            n_wanted += 1
+        restored_by_path: dict = {}
+        if n_wanted:
+            # PyTreeRestore ignores the sharding on ShapeDtypeStruct items
+            # (it re-reads the SAVED sharding file), so relayout targets
+            # must go through explicit ArrayRestoreArgs
+            restore_args = jax.tree.map(
+                lambda sds: ocp.ArrayRestoreArgs(
+                    sharding=sds.sharding, global_shape=sds.shape, dtype=sds.dtype
+                )
+                if sds.sharding is not None
+                else ocp.RestoreArgs(),
+                subset,
+            )
+            with ocp.PyTreeCheckpointer() as pt_ckptr:
+                restored = pt_ckptr.restore(
+                    model_dir,
+                    ocp.args.PyTreeRestore(
+                        item=subset,
+                        restore_args=restore_args,
+                        partial_restore=True,
+                    ),
+                )
+            restored_by_path = dict(jtu.tree_flatten_with_path(restored)[0])
+        new_leaves = [restored_by_path.get(path, cur) for path, cur in cur_flat]
+        return jtu.tree_unflatten(cur_treedef, new_leaves)
 
 
 def restore_orbax_opt(step_dir: Path, opt_view_like: Dict[str, Any]) -> Dict[str, Any]:
-    """Restore the optimizer view dict; raises FileNotFoundError when the
-    tree is absent (callers fall back to fresh state)."""
+    """Restore the optimizer view dict.
+
+    Raises FileNotFoundError when the tree is ABSENT — callers fall back to
+    fresh state, matching the npz backend: an absent tree is
+    indistinguishable from deliberate pruning (``delete_past_optimizer_
+    states``, disk-saving rmtree), and on atomic-rename storage a crash
+    mid-save also leaves the dir absent. Raises OSError when the tree is
+    PRESENT but uncommitted (torn in place / commit-file storage without
+    its commit marker) — that is never deliberate, so the resume aborts
+    instead of silently resetting Adam moments."""
     import orbax.checkpoint as ocp
 
     opt_dir = step_dir / "orbax" / "optimizer"
     if not opt_dir.is_dir():
         raise FileNotFoundError(str(opt_dir))
+    if not _committed(opt_dir):
+        raise OSError(
+            f"{opt_dir} exists but is not a committed orbax checkpoint "
+            "(torn save?); delete it to resume with fresh optimizer state"
+        )
     with ocp.StandardCheckpointer() as ckptr:
         return ckptr.restore(opt_dir.absolute(), orbax_abstract(opt_view_like))

@@ -509,12 +509,21 @@ class BaseTrainer:
             },
         )
 
-    def _restore_orbax_params(self, step_dir: Path):
+    def _restore_orbax_params(self, step_dir: Path, metas):
         """Restore the param view tree, re-sharded to the CURRENT mesh
-        layout (orbax reads each shard from tensorstore)."""
+        layout (orbax reads each shard from tensorstore). Non-strict under
+        the same allow-list regexes as the npz loader, so PEFT/LoRA loads
+        work against orbax base checkpoints too."""
         from ..checkpoint.orbax_backend import restore_orbax_params
 
-        return restore_orbax_params(step_dir, self.module.ckpt_view(self.params))
+        return restore_orbax_params(
+            step_dir,
+            self.module.ckpt_view(self.params),
+            metas,
+            allowed_missing_keys=self.config.allowed_missing_keys_in_checkpoint,
+            allowed_unexpected_keys=self.config.allowed_unexpected_keys_in_checkpoint,
+            ignore_keys=self.config.ignore_keys_in_checkpoint,
+        )
 
     def _restore_orbax_opt(self, step_dir: Path) -> OptimizerState:
         """Restore the optimizer view trees (call only when the caller wants
@@ -555,10 +564,25 @@ class BaseTrainer:
         else:
             logger.warning(f"no checkpoint found at {base}")
             return False
-        orbax_backend = (step_dir / "orbax").is_dir()
+        from ..checkpoint.orbax_backend import orbax_model_valid
+
+        orbax_dir_present = (step_dir / "orbax").is_dir()
+        orbax_backend = orbax_dir_present and orbax_model_valid(step_dir)
+        if orbax_dir_present and not orbax_backend:
+            # a crashed orbax save must not shadow valid npz files in the
+            # same step dir (and must fail loudly when nothing else exists)
+            if not list(step_dir.glob("model_state_layer_*.npz")):
+                raise RuntimeError(
+                    f"{step_dir / 'orbax'} exists but holds no committed orbax "
+                    "checkpoint (torn save?) and no npz files are present"
+                )
+            logger.warning(
+                f"{step_dir / 'orbax'} is not a committed orbax checkpoint; "
+                "falling back to the npz files in the same step dir"
+            )
         metas = self.module.ckpt_metas()
         if orbax_backend:
-            params_view = self._restore_orbax_params(step_dir)
+            params_view = self._restore_orbax_params(step_dir, metas)
         else:
             params_view = load_model_checkpoint(
                 step_dir,
@@ -600,14 +624,16 @@ class BaseTrainer:
                 optimizer_states_loaded = True
             except FileNotFoundError:
                 logger.warning(f"optimizer states absent in {step_dir}")
-            except Exception as e:
+            except (KeyError, ValueError, TypeError) as e:
                 if not orbax_backend:
                     raise
-                # an orbax tree mismatch (architecture/PEFT change) is the
+                # an orbax TREE MISMATCH (architecture/PEFT change) is the
                 # same situation as absent npz files: fall back to fresh
-                # state rather than aborting the load
+                # state. I/O and data-corruption errors (OSError & friends)
+                # are NOT caught — a corrupt checkpoint must abort, not
+                # silently reset Adam moments.
                 logger.warning(
-                    f"orbax optimizer restore failed ({type(e).__name__}: {e}); "
+                    f"orbax optimizer tree mismatch ({type(e).__name__}: {e}); "
                     "re-deriving fresh optimizer state"
                 )
         if not optimizer_states_loaded:

@@ -21,10 +21,11 @@ def data_prefix(tmp_path_factory):
 
 
 def orbax_config(tmp_path, data_prefix, mp=1, train_iterations=10, save_interval=6,
-                 load_dir=None):
+                 load_dir=None, **arch_overrides):
     cfg = make_config(tmp_path, data_prefix, mp=mp,
                       train_iterations=train_iterations,
-                      save_interval=save_interval, load_dir=load_dir)
+                      save_interval=save_interval, load_dir=load_dir,
+                      **arch_overrides)
     d = cfg.model_dump(mode="json")
     d["trainer"]["checkpoint_backend"] = "orbax"
     return type(cfg).from_dict(d)
@@ -64,6 +65,96 @@ def test_orbax_checkpoint_loads_at_different_mp(tmp_path, data_prefix):
     t = build_capturing_trainer(cfg2, load=True)
     more = train_capture(t, 3)
     assert np.isfinite(more).all()
+
+
+def _lora_over(cfg, missing):
+    d = cfg.model_dump(mode="json")
+    d["training"] = {"finetune": True, "finetunable_parameters": []}
+    d["trainer"]["allowed_missing_keys_in_checkpoint"] = missing
+    d["trainer"]["load_optimizer_states"] = False
+    d["trainer"]["load_context"] = False
+    return type(cfg).from_dict(d)
+
+
+def test_orbax_non_strict_lora_load(tmp_path, data_prefix):
+    """A LoRA finetune loads an orbax BASE checkpoint: fresh LoRA params are
+    allowed-missing and keep their init, matching the npz loader's
+    non-strict semantics (reference: test_load_checkpoint_non_strict.py)."""
+    cfg = orbax_config(tmp_path / "base", data_prefix, train_iterations=3,
+                       save_interval=3)
+    train_capture(build_capturing_trainer(cfg), 3)
+
+    lora_arch = {"lora_config": {"name": "lo", "rank": 2, "alpha": 4}}
+    cfg2 = _lora_over(
+        orbax_config(tmp_path / "ft", data_prefix, train_iterations=2,
+                     save_interval=100, load_dir=Path(cfg.trainer.save_dir),
+                     **lora_arch),
+        missing=[r".*_lo\."],
+    )
+    t = build_capturing_trainer(cfg2, load=True)
+    losses = train_capture(t, 2)
+    assert np.isfinite(losses).all()
+
+    # without the allow-list the same load must refuse, like the npz path
+    cfg3 = _lora_over(
+        orbax_config(tmp_path / "strict", data_prefix, train_iterations=2,
+                     save_interval=100, load_dir=Path(cfg.trainer.save_dir),
+                     **lora_arch),
+        missing=[],
+    )
+    with pytest.raises(KeyError, match="missing"):
+        build_capturing_trainer(cfg3, load=True)
+
+
+def test_torn_orbax_save_falls_back_to_npz(tmp_path, data_prefix):
+    """An uncommitted orbax dir (crashed save) must not shadow valid npz
+    files in the same step dir — and must fail loudly when nothing else
+    exists."""
+    cfg = make_config(tmp_path / "npz", data_prefix, train_iterations=6,
+                      save_interval=6)
+    full = train_capture(build_capturing_trainer(cfg), 10)
+    step = Path(cfg.trainer.save_dir) / "global_step6"
+    (step / "orbax" / "model").mkdir(parents=True)  # torn: no _METADATA
+
+    cfg2 = make_config(tmp_path / "resume", data_prefix,
+                       load_dir=Path(cfg.trainer.save_dir))
+    resumed = train_capture(build_capturing_trainer(cfg2, load=True), 4)
+    np.testing.assert_array_equal(
+        np.asarray(full[6:], np.float32), np.asarray(resumed, np.float32)
+    )
+
+    # same torn dir with the npz files gone: a loud error, not a silent init
+    for f in step.glob("model_state_layer_*.npz"):
+        f.unlink()
+    cfg3 = make_config(tmp_path / "dead", data_prefix,
+                       load_dir=Path(cfg.trainer.save_dir))
+    with pytest.raises(RuntimeError, match="torn save"):
+        build_capturing_trainer(cfg3, load=True)
+
+
+def test_torn_orbax_optimizer_aborts_resume(tmp_path, data_prefix):
+    """A committed model tree with an UNCOMMITTED optimizer tree (crash
+    between the two halves of save_orbax) must abort the resume loudly —
+    silently resetting Adam moments is the one outcome the trainer's
+    narrow except must not allow."""
+    import shutil
+
+    cfg = orbax_config(tmp_path / "pre", data_prefix, train_iterations=3,
+                       save_interval=3)
+    train_capture(build_capturing_trainer(cfg), 3)
+    opt_dir = Path(cfg.trainer.save_dir) / "global_step3" / "orbax" / "optimizer"
+    (opt_dir / "_METADATA").unlink()  # simulate the torn save
+
+    cfg2 = orbax_config(tmp_path / "resume", data_prefix, train_iterations=2,
+                        save_interval=100, load_dir=Path(cfg.trainer.save_dir))
+    with pytest.raises(OSError, match="torn save"):
+        build_capturing_trainer(cfg2, load=True)
+
+    # a fully ABSENT optimizer tree still falls back to fresh state
+    shutil.rmtree(opt_dir)
+    t = build_capturing_trainer(cfg2, load=True)
+    losses = train_capture(t, 2)
+    assert np.isfinite(losses).all()
 
 
 def test_orbax_load_without_optimizer_states(tmp_path, data_prefix):
