@@ -104,11 +104,14 @@ def restore_orbax_params(
             f"params/metas mismatch: {len(cur_flat)} vs {len(m_leaves)}"
         )
         key_by_path = {path: m.key for (path, _), m in zip(cur_flat, m_leaves)}
-        cur_by_path = {path: leaf for path, leaf in cur_flat}
         # view top-level name ("layer_{i}") -> (index, class), so
-        # checkpoint-only keys inside a known layer can be printed in the
-        # same "layer_{i}_{Class}.{name}" format the npz loader uses —
-        # allow-list regexes written for npz checkpoints match unchanged
+        # checkpoint-only keys inside a layer the model HAS print in the
+        # same "layer_{i}_{Class}.{name}" format the npz loader uses and
+        # npz-written allow-list regexes match unchanged. A WHOLE layer the
+        # model lacks has no recoverable class (the orbax tree stores only
+        # "layer_{i}" keys), so those print as the dotted path
+        # ("layer_12.attn.weight") — allow-lists dropping whole layers must
+        # match that form.
         layer_info = {
             str(getattr(path[0], "key", path[0])): (m.layer_index, m.layer_class_name)
             for (path, _), m in zip(cur_flat, m_leaves)
@@ -122,13 +125,12 @@ def restore_orbax_params(
             info = layer_info.get(parts[0])
             if info is not None and len(parts) > 1:
                 return f"layer_{info[0]}_{info[1]}." + ".".join(parts[1:])
-            # a whole layer the current model lacks: dotted path fallback
             return ".".join(parts)
 
         # shared paths print as their meta key on both sides, so the diff
         # runs in the npz loader's key space with its exact contract
         enforce_allow_lists(
-            (key_by_path[p] for p in cur_by_path),
+            key_by_path.values(),
             (saved_key(p) for p in saved_by_path),
             allowed_missing,
             allowed_unexpected,
@@ -167,9 +169,7 @@ def restore_orbax_params(
             restore_args = jax.tree.map(
                 lambda sds: ocp.ArrayRestoreArgs(
                     sharding=sds.sharding, global_shape=sds.shape, dtype=sds.dtype
-                )
-                if sds.sharding is not None
-                else ocp.RestoreArgs(),
+                ),
                 subset,
             )
             with ocp.PyTreeCheckpointer() as pt_ckptr:
@@ -183,6 +183,14 @@ def restore_orbax_params(
                 )
             restored_by_path = dict(jtu.tree_flatten_with_path(restored)[0])
         new_leaves = [restored_by_path.get(path, cur) for path, cur in cur_flat]
+        # every wanted leaf must have round-tripped through the rebuilt
+        # plain-dict subset — a path-format mismatch would otherwise keep
+        # random init values silently
+        n_merged = sum(1 for path, _ in cur_flat if path in restored_by_path)
+        assert n_merged == n_wanted == len(restored_by_path), (
+            f"orbax restore path mismatch: wanted {n_wanted}, restored "
+            f"{len(restored_by_path)}, merged {n_merged}"
+        )
         return jtu.tree_unflatten(cur_treedef, new_leaves)
 
 

@@ -123,19 +123,31 @@ class RMSNorm(BaseLayer):
 
     def __call__(self, params: dict, x: jax.Array, ctx: ForwardContext) -> jax.Array:
         if self.config.optimization_type == LayerNormOptimizationType.FUSED:
-            from ..ops.rms_norm import rms_norm_fused, rms_norm_fused_supported
+            from ..ops.rms_norm import (
+                rms_norm_fused,
+                rms_norm_fused_shardable,
+                rms_norm_fused_sharded,
+                rms_norm_fused_supported,
+            )
 
             # pallas calls are opaque to GSPMD (see ops/flash_attention.py's
-            # shard_map handling): on a multi-device mesh the kernel would
-            # force an all-gather of the (possibly sequence-sharded)
-            # activation, so the fused path is single-device-mesh only and
-            # TP/SP layouts keep the XLA path until the kernel grows its own
-            # shard_map integration
-            single_device = ctx.mesh is None or ctx.mesh.size <= 1
-            if single_device and rms_norm_fused_supported(self.dimensions):
-                return rms_norm_fused(
-                    x, params["weight"], self.config.layernorm_epsilon
-                )
+            # shard_map handling), so on a multi-device mesh the kernel is
+            # partitioned explicitly: rows split over data x (context, model)
+            # — the model-axis split IS sequence parallelism. Inside a
+            # spatial pipeline (stage-local operands) or on indivisible
+            # shapes the XLA path remains.
+            if rms_norm_fused_supported(self.dimensions):
+                if ctx.mesh is None or ctx.mesh.size <= 1:
+                    return rms_norm_fused(
+                        x, params["weight"], self.config.layernorm_epsilon
+                    )
+                if rms_norm_fused_shardable(ctx.mesh, x.shape):
+                    return rms_norm_fused_sharded(
+                        x,
+                        params["weight"],
+                        self.config.layernorm_epsilon,
+                        ctx.mesh,
+                    )
         dtype = x.dtype
         x32 = x.astype(jnp.float32)
         var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)

@@ -162,3 +162,59 @@ def _rms_bwd(eps, res, g):
 
 
 rms_norm_fused.defvjp(_rms_fwd, _rms_bwd)
+
+
+def rms_norm_fused_shardable(mesh, x_shape) -> bool:
+    """True when the kernel can be shard_map-partitioned on this mesh.
+
+    The norm is row-independent, so a (b, s, h) activation partitions over
+    batch (data axis) and sequence (context and model axes — the model-axis
+    split IS sequence parallelism, matching where SP puts the norm anyway;
+    reference: the SP layout notes in nn/norm.py). Not applicable inside a
+    spatial pipeline (operands there are stage-local, same restriction as
+    ops/flash_attention.py:_tp_shardable) or when dims don't divide."""
+    from ..topology.topology import CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS, PIPE_AXIS
+
+    if len(x_shape) != 3:
+        return False
+    names = mesh.axis_names
+    if PIPE_AXIS in names and mesh.shape[PIPE_AXIS] > 1:
+        return False
+    dp = mesh.shape[DATA_AXIS] if DATA_AXIS in names else 1
+    seq_div = 1
+    for a in (CONTEXT_AXIS, MODEL_AXIS):
+        if a in names:
+            seq_div *= mesh.shape[a]
+    b, s, _ = x_shape
+    return b % max(dp, 1) == 0 and s % seq_div == 0
+
+
+def rms_norm_fused_sharded(
+    x: jax.Array, w: jax.Array, eps: float, mesh
+) -> jax.Array:
+    """shard_map'd fused RMSNorm: every device runs the Pallas kernel on its
+    local rows with the replicated gain; shard_map's transpose inserts the
+    psum that reduces the per-shard weight grads (the manual analogue of
+    GSPMD's backward collective for the XLA path)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..topology.topology import CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS
+
+    assert rms_norm_fused_shardable(mesh, x.shape)
+    names = mesh.axis_names
+    seq_axes = tuple(
+        a for a in (CONTEXT_AXIS, MODEL_AXIS) if a in names and mesh.shape[a] > 1
+    )
+    spec = P(
+        DATA_AXIS if DATA_AXIS in names and mesh.shape[DATA_AXIS] > 1 else None,
+        seq_axes if seq_axes else None,
+        None,
+    )
+    return shard_map(
+        lambda xx, ww: rms_norm_fused(xx, ww, eps),
+        mesh=mesh,
+        in_specs=(spec, P()),
+        out_specs=spec,
+        check_vma=False,
+    )(x, w)

@@ -134,6 +134,58 @@ def test_rms_norm_fused_matches_xla():
     np.testing.assert_allclose(np.asarray(gw_f), np.asarray(gw_x), atol=1e-3)
 
 
+def test_rms_norm_fused_sharded_matches_xla():
+    """shard_map'd fused RMSNorm on the full 4-axis mesh (data=2, context=2,
+    model=2): forward and BOTH grads match the XLA path — the weight grad in
+    particular proves shard_map's transpose psums the per-shard dw of the
+    replicated gain."""
+    from jax.sharding import Mesh
+
+    from scaling_tpu.ops.rms_norm import (
+        force_rms_interpret,
+        rms_norm_fused_shardable,
+        rms_norm_fused_sharded,
+    )
+    from scaling_tpu.topology.topology import MESH_AXES
+
+    devs = np.array(jax.devices()[:8]).reshape(1, 2, 2, 2)
+    mesh = Mesh(devs, MESH_AXES)
+    eps = 1e-5
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, 8, 128), jnp.float32)
+    w = 1.0 + 0.1 * jax.random.normal(jax.random.PRNGKey(4), (128,), jnp.float32)
+
+    def xla_rms(x, w):
+        var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+        return x * jax.lax.rsqrt(var + eps) * w
+
+    def loss(fn):
+        return lambda x, w: jnp.sum(jnp.sin(fn(x, w)))
+
+    assert rms_norm_fused_shardable(mesh, x.shape)
+    assert not rms_norm_fused_shardable(mesh, (4, 9, 128))  # seq % 4 != 0
+    with force_rms_interpret():
+        fused = lambda x, w: rms_norm_fused_sharded(x, w, eps, mesh)
+        y = jax.jit(fused)(x, w)
+        gx, gw = jax.jit(jax.grad(loss(fused), (0, 1)))(x, w)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(xla_rms(x, w)), atol=1e-5)
+    gx0, gw0 = jax.grad(loss(xla_rms), (0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gx0), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(gw0), atol=1e-4)
+
+
+def test_rms_norm_fused_not_shardable_under_pipe():
+    """Inside a spatial pipeline the operands are stage-local, so the
+    sharded fused path must refuse (same restriction as the flash kernel)."""
+    from jax.sharding import Mesh
+
+    from scaling_tpu.ops.rms_norm import rms_norm_fused_shardable
+    from scaling_tpu.topology.topology import MESH_AXES
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 1, 2)
+    mesh = Mesh(devs, MESH_AXES)
+    assert not rms_norm_fused_shardable(mesh, (4, 8, 128))
+
+
 def test_rms_norm_fused_bf16_and_block_snapping():
     """bf16 in/out keeps fp32 statistics, and row counts that don't divide
     the 256-row default block snap down to a divisor (288 rows -> block 32,

@@ -1,0 +1,66 @@
+"""The fused RMSNorm must be ACTIVE under tensor parallelism, not silently
+fall back to XLA (round-2 gap: the kernel was single-device-mesh only, so
+`layernorm.optimization_type: fused` turned itself off exactly at the TP
+sizes where it matters; reference knob: core/nn/norm/rms_norm.py:55)."""
+
+import numpy as np
+import pytest
+
+from scaling_tpu.data.memory_map import MemoryMapDatasetBuilder
+
+from .test_training import build_capturing_trainer, make_config, train_capture
+
+
+@pytest.fixture(scope="module")
+def data_prefix(tmp_path_factory):
+    prefix = tmp_path_factory.mktemp("fused_norm_data") / "data"
+    rng = np.random.default_rng(31)
+    with MemoryMapDatasetBuilder(prefix, dtype=np.uint16) as builder:
+        for _ in range(48):
+            doc = rng.integers(1, 96, size=rng.integers(8, 48))
+            builder.add(np.append(doc, 0).astype(np.uint16))
+    return prefix
+
+
+def fused_cfg(tmp_path, data_prefix, optimization_type):
+    # hidden 128: the kernel requires a lane-aligned (128) hidden dim
+    cfg = make_config(
+        tmp_path, data_prefix, mp=2, train_iterations=2, save_interval=100,
+        hidden_size=128, norm_type="rms",
+    )
+    d = cfg.model_dump(mode="json")
+    d["transformer_architecture"]["layernorm"] = {
+        "optimization_type": optimization_type, "layernorm_epsilon": 1e-5,
+    }
+    return type(cfg).from_dict(d)
+
+
+def test_fused_norm_active_under_tp(tmp_path, data_prefix, monkeypatch):
+    import scaling_tpu.ops.rms_norm as rms_mod
+
+    calls = {"n": 0}
+    orig = rms_mod.rms_norm_fused_sharded
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(rms_mod, "rms_norm_fused_sharded", counting)
+
+    with rms_mod.force_rms_interpret():
+        losses_fused = train_capture(
+            build_capturing_trainer(fused_cfg(tmp_path / "fused", data_prefix,
+                                             "fused")), 2,
+        )
+    assert calls["n"] > 0, "fused norm silently fell back under mp=2"
+    assert np.isfinite(losses_fused).all()
+
+    losses_xla = train_capture(
+        build_capturing_trainer(fused_cfg(tmp_path / "xla", data_prefix,
+                                          "torch")), 2,
+    )
+    # same math up to kernel-order float association
+    np.testing.assert_allclose(
+        np.asarray(losses_fused, np.float32), np.asarray(losses_xla, np.float32),
+        rtol=2e-3,
+    )
