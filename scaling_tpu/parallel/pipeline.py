@@ -272,8 +272,7 @@ class PipelinedBody:
             # Checkpointing chunks of ~sqrt(T) ticks stores only chunk-edge
             # carries + one chunk's internal carries during its backward:
             # O(sqrt(n_micro) * pp) memory for one extra body forward.
-            chunk = int(np.ceil(np.sqrt(n_ticks)))
-            n_chunks = int(np.ceil(n_ticks / chunk))
+            chunk, n_chunks = _remat_chunking(n_ticks)
             padded = n_chunks * chunk  # excess ticks produce discarded outputs
             tick_ids = jnp.arange(padded).reshape(n_chunks, chunk)
 
@@ -288,6 +287,33 @@ class PipelinedBody:
             return outs
         _, outs = jax.lax.scan(tick, zero_state, jnp.arange(n_ticks))
         return jax.tree.map(lambda o: o[pp - 1 :], outs)
+
+
+def _remat_chunking(n_ticks: int) -> tuple[int, int]:
+    """(chunk, n_chunks) for the sqrt(T)-chunked remat scan, chosen to
+    MINIMIZE padding: every padded tick runs the full stage vmap and its
+    outputs are discarded, so padding is pure wall-clock waste. Among chunk
+    sizes within ±2 of sqrt(T) whose chunk count also stays O(sqrt(T)) the
+    smallest padding wins (ties to the size nearest sqrt(T)); padding is
+    zero whenever T factors as chunk x n_chunks inside those bounds, and
+    never exceeds the naive ceil(sqrt(T)) chunking's."""
+    root = int(np.ceil(np.sqrt(n_ticks)))
+    best = None
+    for chunk in range(max(2, root - 2), root + 3):
+        n_chunks = int(np.ceil(n_ticks / chunk))
+        # both factors stay O(sqrt(T)) — chunk bounds the recompute span,
+        # n_chunks the edge carries — and a single chunk (no outer scan)
+        # would hold every inner carry during its backward
+        if n_chunks < 2 or n_chunks > root + 2:
+            continue
+        padding = n_chunks * chunk - n_ticks
+        rank = (padding, abs(chunk - root))
+        if best is None or rank < best[0]:
+            best = (rank, chunk, n_chunks)
+    if best is None:  # unreachable for n_ticks >= 4; keep the naive split
+        chunk = root
+        return chunk, int(np.ceil(n_ticks / chunk))
+    return best[1], best[2]
 
 
 def _leading(tree: Any) -> Optional[int]:

@@ -114,3 +114,18 @@ def test_mesh_axes(devices):
     topo = Topology(make_config())
     assert topo.mesh.axis_names == ("pipe", "data", "context", "model")
     assert topo.mesh.devices.shape == (2, 2, 1, 2)
+
+
+def test_context_parallel_excludes_pipeline():
+    """cp>1 with pp>1 must be a validated config error, not a silent
+    mis-sharding (the spatial pipeline's stage shift and ring attention
+    both claim the leading layout axes)."""
+    with pytest.raises(Exception, match="context_parallel_size > 1 requires"):
+        TopologyConfig(
+            model_parallel_size=1,
+            pipe_parallel_size=2,
+            data_parallel_size=1,
+            context_parallel_size=2,
+            micro_batch_size=1,
+            gradient_accumulation_steps=1,
+        )

@@ -37,7 +37,16 @@ def test_pp2_loss_close_to_pp1(tmp_path, data_prefix):
     """From identical weights (checkpoint interchange) and the same data
     order, pp=1 and pp=2 must compute the same training math —
     float-association differences only. Init RNG streams differ between the
-    per-layer and stage-stacked assemblies, hence the common checkpoint."""
+    per-layer and stage-stacked assemblies, hence the common checkpoint.
+
+    Bound derivation (measured, this exact setup): the per-step losses are
+    BIT-IDENTICAL for the first 3 steps and drift to ~1e-7 relative by
+    step 5 — per-microbatch math is the same instruction stream, only the
+    stage stacking reassociates a handful of reductions, and fp32 ulp
+    noise compounds through 5 optimizer steps. rtol 1e-5 leaves two
+    orders of magnitude of headroom over that measured drift while any
+    real schedule bug (wrong micro-batch routed, wrong layer order, a
+    garbage fill tick leaking into outputs) lands at >=1e-2 on step 1."""
     cfg0 = make_config(tmp_path / "seed", data_prefix, gas=4, train_iterations=1,
                        save_interval=100)
     t0 = build_capturing_trainer(cfg0)
@@ -53,7 +62,7 @@ def test_pp2_loss_close_to_pp1(tmp_path, data_prefix):
 
     np.testing.assert_allclose(
         np.asarray(losses[1], np.float32), np.asarray(losses[2], np.float32),
-        rtol=2e-3, atol=2e-3,
+        rtol=1e-5, atol=1e-6,
     )
 
 
@@ -152,6 +161,101 @@ def test_edge_layers_sharded_over_pipe(tmp_path, data_prefix, devices):
     assert seen >= 1, "no vocab-dim parameters found"
 
 
+def test_remat_chunking_minimizes_padding():
+    """Every padded tick runs the full stage vmap for discarded outputs, so
+    the chunking must pick the minimal-padding split near sqrt(T) — e.g.
+    T=10 must use 2x5 (zero waste), not ceil(sqrt)=4 -> 3x4 (two wasted
+    ticks, 20% of the step)."""
+    from scaling_tpu.parallel.pipeline import _remat_chunking
+
+    for T in range(4, 200):
+        chunk, n_chunks = _remat_chunking(T)
+        padding = chunk * n_chunks - T
+        assert padding >= 0 and n_chunks * chunk >= T
+        # never worse than the naive ceil(sqrt) chunking
+        naive_chunk = int(np.ceil(np.sqrt(T)))
+        naive_pad = int(np.ceil(T / naive_chunk)) * naive_chunk - T
+        assert padding <= naive_pad, (T, chunk, n_chunks, naive_pad)
+        # memory bound stays O(sqrt(T))
+        assert chunk <= np.sqrt(T) + 3 and n_chunks <= np.sqrt(T) + 3
+    assert _remat_chunking(10) == (5, 2)  # naive pads 2 ticks here
+    assert _remat_chunking(9) == (3, 3)
+
+
+def _compile_train_step(tmp_path, data_prefix, pp, gas, remat=False):
+    """Build a trainer and compile (not run) its train step."""
+    cfg = make_pp_config(tmp_path, data_prefix, pp=pp, gas=gas,
+                         train_iterations=1, save_interval=100)
+    if remat:
+        d = cfg.model_dump(mode="json")
+        d["topology"]["activation_checkpointing_type"] = "every_layer"
+        cfg = type(cfg).from_dict(d)
+    trainer = build_capturing_trainer(cfg)
+    micro_batches = trainer._next_micro_batches()
+    key = trainer.context.rng.key("dropout", 0)
+    return trainer._train_step.lower(
+        trainer.params, trainer.opt_state, micro_batches, key
+    ).compile()
+
+
+def test_pipeline_step_flops_quantify_fill_drain(tmp_path, data_prefix):
+    """The spatial pipeline's compute economics, measured via compiled HLO
+    FLOPs at fixed global batch (remat off, so no recompute multiplier
+    muddies the accounting): pp=2 spends (n_micro + pp - 1)/n_micro of the
+    pp=1 body FLOPs — the fill/drain garbage ticks. Those garbage FLOPs
+    run on the pipe-axis devices that 1F1B would leave idle in its bubble,
+    so they cost no extra wall-clock on a real pipe mesh."""
+    flops = {}
+    gas = 9
+    for pp in (1, 2):
+        compiled = _compile_train_step(tmp_path / f"flops_pp{pp}", data_prefix,
+                                       pp=pp, gas=gas)
+        analysis = compiled.cost_analysis()
+        analysis = analysis[0] if isinstance(analysis, list) else analysis
+        # cost_analysis reports the PER-PARTITION program; scale by the
+        # mesh size to compare totals
+        flops[pp] = float(analysis["flops"]) * pp
+    ratio = flops[2] / flops[1]
+    # body ratio bound: (n_micro + pp - 1) / n_micro = 10/9 at gas=9; non-
+    # body FLOPs (embedding/head/optimizer) only dilute it, collective
+    # permutes add a little back
+    assert 0.95 <= ratio <= 10 / 9 + 0.08, (flops, ratio)
+
+
+def test_pp2_remat_with_padding_loss_parity(tmp_path, data_prefix):
+    """The PADDED chunked-remat path end to end: gas=13 gives T=14 ticks,
+    which factors as 3x5 with one discarded padding tick — a garbage tick
+    leaking into outputs or gradients would break the 1e-5 loss parity
+    with pp=1 immediately (the FLOPs test runs remat-off and cannot see
+    this path)."""
+    from scaling_tpu.parallel.pipeline import _remat_chunking
+
+    gas = 13
+    chunk, n_chunks = _remat_chunking(gas + 1)
+    assert chunk * n_chunks > gas + 1, "want a padded shape for this test"
+
+    cfg0 = make_config(tmp_path / "seed", data_prefix, gas=gas,
+                       train_iterations=1, save_interval=100)
+    t0 = build_capturing_trainer(cfg0)
+    t0.save_checkpoint()
+
+    losses = {}
+    for pp, remat in ((1, False), (2, True)):
+        cfg = make_pp_config(tmp_path / f"pp{pp}", data_prefix, pp=pp, gas=gas,
+                             train_iterations=2, save_interval=100,
+                             load_dir=Path(cfg0.trainer.save_dir))
+        if remat:
+            d = cfg.model_dump(mode="json")
+            d["topology"]["activation_checkpointing_type"] = "every_layer"
+            cfg = type(cfg).from_dict(d)
+        t = build_capturing_trainer(cfg, load=True)
+        losses[pp] = train_capture(t, 2)
+    np.testing.assert_allclose(
+        np.asarray(losses[1], np.float32), np.asarray(losses[2], np.float32),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
 def test_pipeline_memory_sublinear_in_microbatch_count(tmp_path, data_prefix):
     """The 1F1B-comparable-memory claim, measured (VERDICT r1 asked for
     numbers, not assertions): with activation checkpointing on, the pp=2
@@ -161,16 +265,7 @@ def test_pipeline_memory_sublinear_in_microbatch_count(tmp_path, data_prefix):
     carry (linear, ~1.7x per doubling when measured)."""
     temp_bytes = {}
     for gas in (8, 16):
-        cfg = make_pp_config(tmp_path / f"gas{gas}", data_prefix, pp=2, gas=gas,
-                             train_iterations=1, save_interval=100)
-        d = cfg.model_dump(mode="json")
-        d["topology"]["activation_checkpointing_type"] = "every_layer"
-        cfg = type(cfg).from_dict(d)
-        trainer = build_capturing_trainer(cfg)
-        micro_batches = trainer._next_micro_batches()
-        key = trainer.context.rng.key("dropout", 0)
-        compiled = trainer._train_step.lower(
-            trainer.params, trainer.opt_state, micro_batches, key
-        ).compile()
+        compiled = _compile_train_step(tmp_path / f"gas{gas}", data_prefix,
+                                       pp=2, gas=gas, remat=True)
         temp_bytes[gas] = compiled.memory_analysis().temp_size_in_bytes
     assert temp_bytes[16] < 1.6 * temp_bytes[8], temp_bytes
