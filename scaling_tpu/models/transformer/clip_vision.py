@@ -1,0 +1,270 @@
+"""Pretrained CLIP ViT vision tower with torch-weight import.
+
+The reference splices a *pretrained* CLIP backbone into the embedding
+stream (reference: src/scaling/transformer/model/image_encoder/clip.py,
+image_encoder.py:20-27 — RN50x16, 144 tokens from a 384x384 image). Conv
+ResNet weights don't transfer to a TPU-first stack, so the pretrained
+path here is the ViT family instead: this module is a faithful CLIP
+ViT vision tower (CLS token, learned position embeddings, pre-norm
+blocks, quick_gelu) whose parameters load from any huggingface
+``CLIPVisionModel`` checkpoint via :func:`import_clip_vision_weights`,
+reproducing its ``last_hidden_state`` patch tokens bit-for-tolerance.
+A patch-32 checkpoint at 384x384 input yields exactly the reference's
+144 prefix tokens.
+
+The tower runs replicated (no TP) like the reference's CLIP trunk; the
+trainable projection into the language stream stays in
+``image_encoder.ImageEncoder``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...nn import BaseLayer, ForwardContext
+from ...nn.param import replicated_meta, tree_prefix
+from .image_encoder import patchify
+
+
+def _quick_gelu(x: jax.Array) -> jax.Array:
+    # CLIP's activation (hidden_act="quick_gelu"): x * sigmoid(1.702 x)
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def _layernorm(p: dict, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (y * p["weight"].astype(jnp.float32) + p["bias"].astype(jnp.float32)).astype(dtype)
+
+
+def _linear_init(key, d_in, d_out, dtype):
+    scale = 1.0 / np.sqrt(d_in)
+    kw, kb = jax.random.split(key)
+    return {
+        "weight": jax.random.uniform(kw, (d_in, d_out), dtype, -scale, scale),
+        "bias": jax.random.uniform(kb, (d_out,), dtype, -scale, scale),
+    }
+
+
+def _linear_metas() -> dict:
+    return {"weight": replicated_meta(2), "bias": replicated_meta(1)}
+
+
+def _norm_init(width, dtype):
+    return {"weight": jnp.ones((width,), dtype), "bias": jnp.zeros((width,), dtype)}
+
+
+class ClipVisionEncoder(BaseLayer):
+    """(b, H, W, 3) -> (b, grid*grid, width) patch-token features, equal to
+    a huggingface ``CLIPVisionModel``'s ``last_hidden_state[:, 1:]`` once
+    weights are imported (the CLS row is computed, used by every attention
+    layer, and dropped from the output — magma consumes spatial tokens)."""
+
+    def __init__(
+        self,
+        width: int = 768,
+        layers: int = 12,
+        heads: int = 12,
+        patch_size: int = 32,
+        image_size: int = 384,
+        intermediate: int | None = None,
+        dtype=jnp.float32,
+    ):
+        assert image_size % patch_size == 0
+        assert width % heads == 0
+        self.width = width
+        self.num_layers = layers
+        self.heads = heads
+        self.patch_size = patch_size
+        self.image_size = image_size
+        self.grid = image_size // patch_size
+        self.tokens = self.grid * self.grid
+        self.intermediate = intermediate or 4 * width
+        self.dtype = dtype
+
+    def init(self, key: jax.Array) -> dict:
+        w, inter, dtype = self.width, self.intermediate, self.dtype
+        ks = iter(jax.random.split(key, 3 + 6 * self.num_layers))
+        patch_dim = self.patch_size * self.patch_size * 3
+        params: dict = {
+            "class_embedding": jax.random.normal(next(ks), (w,), dtype),
+            # flattened conv kernel, (p*p*3, width), matching patchify order
+            "patch_embedding": jax.random.normal(next(ks), (patch_dim, w), dtype)
+            / np.sqrt(patch_dim),
+            "position_embedding": jax.random.normal(next(ks), (1 + self.tokens, w), dtype)
+            * 0.02,
+            "pre_norm": _norm_init(w, dtype),
+        }
+        for i in range(self.num_layers):
+            params[f"block_{i}"] = {
+                "ln1": _norm_init(w, dtype),
+                "q": _linear_init(next(ks), w, w, dtype),
+                "k": _linear_init(next(ks), w, w, dtype),
+                "v": _linear_init(next(ks), w, w, dtype),
+                "out": _linear_init(next(ks), w, w, dtype),
+                "ln2": _norm_init(w, dtype),
+                "fc1": _linear_init(next(ks), w, inter, dtype),
+                "fc2": _linear_init(next(ks), inter, w, dtype),
+            }
+        return params
+
+    def param_metas(self) -> dict:
+        norm_metas = {"weight": replicated_meta(1, no_weight_decay=True),
+                      "bias": replicated_meta(1, no_weight_decay=True)}
+        metas: dict = {
+            "class_embedding": replicated_meta(1),
+            "patch_embedding": replicated_meta(2),
+            "position_embedding": replicated_meta(2),
+            "pre_norm": norm_metas,
+        }
+        for i in range(self.num_layers):
+            metas[f"block_{i}"] = {
+                "ln1": norm_metas, "q": _linear_metas(), "k": _linear_metas(),
+                "v": _linear_metas(), "out": _linear_metas(), "ln2": norm_metas,
+                "fc1": _linear_metas(), "fc2": _linear_metas(),
+            }
+        return {k: tree_prefix(v, k) for k, v in metas.items()}
+
+    def _attn(self, p: dict, x: jax.Array) -> jax.Array:
+        b, t, w = x.shape
+        hd = w // self.heads
+
+        def proj(pp, y):
+            return (y @ pp["weight"] + pp["bias"]).reshape(b, t, self.heads, hd)
+
+        q = proj(p["q"], x) * (hd ** -0.5)
+        k = proj(p["k"], x)
+        v = proj(p["v"], x)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, t, w)
+        return o @ p["out"]["weight"] + p["out"]["bias"]
+
+    def __call__(self, params: dict, images: jax.Array, ctx: ForwardContext) -> jax.Array:
+        x = patchify(images.astype(self.dtype), self.patch_size) @ params["patch_embedding"]
+        cls = jnp.broadcast_to(
+            params["class_embedding"][None, None, :], (x.shape[0], 1, self.width)
+        ).astype(x.dtype)
+        x = jnp.concatenate([cls, x], axis=1) + params["position_embedding"][None]
+        x = _layernorm(params["pre_norm"], x)
+        for i in range(self.num_layers):
+            p = params[f"block_{i}"]
+            x = x + self._attn(p, _layernorm(p["ln1"], x))
+            h = _layernorm(p["ln2"], x)
+            h = _quick_gelu(h @ p["fc1"]["weight"] + p["fc1"]["bias"])
+            x = x + (h @ p["fc2"]["weight"] + p["fc2"]["bias"])
+        return x[:, 1:]  # drop CLS: magma consumes the spatial tokens
+
+
+def import_clip_vision_weights(
+    encoder: ClipVisionEncoder, state_dict: Dict[str, Any]
+) -> dict:
+    """Map a huggingface ``CLIPVisionModel`` state_dict onto ``encoder``'s
+    param tree (reference capability: clip.py's pretrained trunk).
+
+    Accepts keys with or without the ``vision_model.`` prefix. The conv
+    patch kernel (width, 3, p, p) flattens to the patchify order
+    (p, p, 3) x width; position embeddings whose grid differs from the
+    encoder's are bicubic-interpolated exactly as HF's
+    ``interpolate_pos_encoding`` does (torch, align_corners=False).
+    ``post_layernorm`` is not imported — it only feeds CLIP's pooled CLS
+    head, which the prefix-token stream never uses."""
+    import torch
+
+    sd = {k.removeprefix("vision_model."): v for k, v in state_dict.items()}
+
+    # the encoder must MATCH the checkpoint's geometry — silently importing
+    # the first N layers of a deeper tower would train on a truncated trunk
+    # the user believes is the full pretrained model
+    import re as _re
+
+    ckpt_layers = 1 + max(
+        (int(m.group(1)) for k in sd if (m := _re.match(r"encoder\.layers\.(\d+)\.", k))),
+        default=-1,
+    )
+    if ckpt_layers != encoder.num_layers:
+        raise ValueError(
+            f"checkpoint has {ckpt_layers} encoder layers but the encoder is "
+            f"configured for {encoder.num_layers} (set image_encoder_layers "
+            "to the checkpoint's depth)"
+        )
+    ckpt_width = sd["embeddings.class_embedding"].shape[-1]
+    if ckpt_width != encoder.width:
+        raise ValueError(
+            f"checkpoint width {ckpt_width} != encoder width {encoder.width} "
+            "(set image_encoder_width to the checkpoint's hidden_size)"
+        )
+    ckpt_inter = sd["encoder.layers.0.mlp.fc1.weight"].shape[0]
+    if ckpt_inter != encoder.intermediate:
+        raise ValueError(
+            f"checkpoint mlp width {ckpt_inter} != encoder intermediate "
+            f"{encoder.intermediate}"
+        )
+
+    def arr(key, transpose=False):
+        t = sd[key].detach().to(torch.float32)
+        if transpose:
+            t = t.T
+        return jnp.asarray(np.asarray(t.contiguous()), encoder.dtype)
+
+    p = encoder.patch_size
+    conv = sd["embeddings.patch_embedding.weight"].detach().to(torch.float32)
+    width = conv.shape[0]
+    assert conv.shape == (width, 3, p, p), (
+        f"checkpoint patch size {tuple(conv.shape)} != encoder patch {p}"
+    )
+    # (width, c, ph, pw) -> (ph, pw, c, width) -> (p*p*c, width)
+    patch_w = jnp.asarray(
+        np.asarray(conv.permute(2, 3, 1, 0).reshape(p * p * 3, width).contiguous()),
+        encoder.dtype,
+    )
+
+    pos = sd["embeddings.position_embedding.weight"].detach().to(torch.float32)
+    src_tokens = pos.shape[0] - 1
+    if src_tokens != encoder.tokens:
+        src_grid = int(round(np.sqrt(src_tokens)))
+        assert src_grid * src_grid == src_tokens, src_tokens
+        cls_pos, patch_pos = pos[:1], pos[1:]
+        patch_pos = patch_pos.reshape(1, src_grid, src_grid, width).permute(0, 3, 1, 2)
+        patch_pos = torch.nn.functional.interpolate(
+            patch_pos, size=(encoder.grid, encoder.grid),
+            mode="bicubic", align_corners=False,
+        )
+        patch_pos = patch_pos.permute(0, 2, 3, 1).reshape(encoder.tokens, width)
+        pos = torch.cat([cls_pos, patch_pos], dim=0)
+    pos_w = jnp.asarray(np.asarray(pos.contiguous()), encoder.dtype)
+
+    def norm(prefix):
+        return {"weight": arr(f"{prefix}.weight"), "bias": arr(f"{prefix}.bias")}
+
+    def linear(prefix):
+        # torch Linear stores (out, in); ours is (in, out)
+        return {"weight": arr(f"{prefix}.weight", transpose=True),
+                "bias": arr(f"{prefix}.bias")}
+
+    params: dict = {
+        "class_embedding": arr("embeddings.class_embedding"),
+        "patch_embedding": patch_w,
+        "position_embedding": pos_w,
+        "pre_norm": norm("pre_layrnorm"),  # HF's historical spelling
+    }
+    for i in range(encoder.num_layers):
+        base = f"encoder.layers.{i}"
+        params[f"block_{i}"] = {
+            "ln1": norm(f"{base}.layer_norm1"),
+            "q": linear(f"{base}.self_attn.q_proj"),
+            "k": linear(f"{base}.self_attn.k_proj"),
+            "v": linear(f"{base}.self_attn.v_proj"),
+            "out": linear(f"{base}.self_attn.out_proj"),
+            "ln2": norm(f"{base}.layer_norm2"),
+            "fc1": linear(f"{base}.mlp.fc1"),
+            "fc2": linear(f"{base}.mlp.fc2"),
+        }
+    return params

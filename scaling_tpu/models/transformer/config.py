@@ -243,6 +243,24 @@ class TransformerArchitectureConfig(BaseConfig):
     image_encoder_width: int = Field(768, description="vision tower width", gt=0)
     image_encoder_layers: int = Field(6, description="vision tower depth", gt=0)
     image_encoder_heads: int = Field(12, description="vision tower heads", gt=0)
+    image_encoder_backbone: str = Field(
+        "vit",
+        description="'vit' trains the patch backbone from scratch; 'clip' "
+        "builds a CLIP-ViT trunk that loads pretrained huggingface "
+        "CLIPVisionModel weights — the pretrained-prior role of the "
+        "reference's CLIP RN50x16 (clip.py). Set "
+        "image_encoder_clip_checkpoint to load the weights at startup, or "
+        "call ImageEncoder.load_clip_weights manually",
+        pattern="^(vit|clip)$",
+    )
+    image_encoder_clip_checkpoint: Optional[str] = Field(
+        None,
+        description="path to pretrained CLIP vision weights applied at "
+        "train startup (fresh runs only, not resumes): a torch state_dict "
+        "file (torch.load) or a local transformers CLIPVisionModel "
+        "directory; requires image_encoder_backbone='clip' with "
+        "width/layers matching the checkpoint",
+    )
     dropout_image_encoder: float = Field(
         0.0, description="dropout applied after the image encoder projection",
         ge=0.0, le=1.0,

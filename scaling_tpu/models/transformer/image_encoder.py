@@ -13,8 +13,14 @@ but replaces the convolutional backbone with a ViT-style patch encoder:
   (ParallelSelfAttention with causal=False), so TP sharding of the vision
   tower comes for free.
 
-Pretrained CLIP weights do not transfer to this backbone; the encoder
-trains jointly (or from a vision checkpoint trained with this framework).
+Two backbones:
+- ``backbone="vit"`` (default): the from-scratch stack above, trained
+  jointly with the language model;
+- ``backbone="clip"``: a faithful CLIP ViT trunk (``clip_vision.py``)
+  that loads pretrained huggingface ``CLIPVisionModel`` weights via
+  :meth:`ImageEncoder.load_clip_weights` — the pretrained-vision-prior
+  capability of the reference's CLIP RN50x16 (clip.py), re-based onto the
+  ViT family whose weights transfer to a TPU-first stack.
 """
 
 from __future__ import annotations
@@ -37,6 +43,20 @@ from ...nn import (
 IMAGE_SIZE = 384
 PATCH_SIZE = 32
 IMAGE_ENCODER_TOKEN_COUNTS = (IMAGE_SIZE // PATCH_SIZE) ** 2  # 144, as reference
+
+
+def patchify(images: jax.Array, patch_size: int) -> jax.Array:
+    """(b, H, W, 3) -> (b, tokens, p*p*3) via reshape/transpose.
+
+    The flattening order (ph, pw, c) is LAYOUT-CRITICAL: the CLIP weight
+    import (clip_vision.import_clip_vision_weights) flattens the pretrained
+    conv kernel in exactly this order — both backbones share this one
+    definition so they cannot desynchronize."""
+    b, h, w, c = images.shape
+    p = patch_size
+    x = images.reshape(b, h // p, p, w // p, p, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # (b, gh, gw, p, p, c)
+    return x.reshape(b, (h // p) * (w // p), p * p * c)
 
 
 class _VitBlock(BaseLayer):
@@ -82,58 +102,82 @@ class ImageEncoder(BaseLayer):
         heads: int = 12,
         dropout_p: float = 0.0,
         dtype=jnp.float32,
+        backbone: str = "vit",
     ):
         self.out_features = out_features
         self.width = width
         self.num_layers = layers
         self.dropout_p = dropout_p
         self.dtype = dtype
-        patch_dim = PATCH_SIZE * PATCH_SIZE * 3  # 3072, as the reference's feature dim
-        self.patch_proj = ColumnParallelLinear(
-            patch_dim, width, bias=True, dtype=dtype, parallel_output=False
-        )
-        self.blocks = [_VitBlock(width, heads, dtype) for _ in range(layers)]
-        self.out_norm = LayerNorm(width, LayerNormConfig(), dtype)
+        assert backbone in ("vit", "clip"), backbone
+        self.backbone = backbone
+        if backbone == "clip":
+            from .clip_vision import ClipVisionEncoder
+
+            self.clip = ClipVisionEncoder(
+                width=width, layers=layers, heads=heads,
+                patch_size=PATCH_SIZE, image_size=IMAGE_SIZE, dtype=dtype,
+            )
+        else:
+            patch_dim = PATCH_SIZE * PATCH_SIZE * 3  # 3072, the reference's feature dim
+            self.patch_proj = ColumnParallelLinear(
+                patch_dim, width, bias=True, dtype=dtype, parallel_output=False
+            )
+            self.blocks = [_VitBlock(width, heads, dtype) for _ in range(layers)]
+            self.out_norm = LayerNorm(width, LayerNormConfig(), dtype)
         self.proj = RowParallelLinear(width, out_features, bias=True, dtype=dtype)
         self.final_norm = LayerNorm(out_features, LayerNormConfig(), dtype)
 
     def init(self, key: jax.Array) -> dict:
         ks = jax.random.split(key, self.num_layers + 4)
         params = {
-            "patch_proj": self.patch_proj.init(ks[0]),
-            "out_norm": self.out_norm.init(ks[1]),
             "proj": self.proj.init(ks[2]),
             "final_norm": self.final_norm.init(ks[3]),
         }
+        if self.backbone == "clip":
+            params["clip"] = self.clip.init(ks[0])
+            return params
+        params["patch_proj"] = self.patch_proj.init(ks[0])
+        params["out_norm"] = self.out_norm.init(ks[1])
         for i, blk in enumerate(self.blocks):
             params[f"block_{i}"] = blk.init(ks[4 + i])
         return params
 
     def param_metas(self) -> dict:
         metas = {
-            "patch_proj": tree_prefix(self.patch_proj.param_metas(), "image_encoder.patch_proj"),
-            "out_norm": tree_prefix(self.out_norm.param_metas(), "image_encoder.out_norm"),
             "proj": tree_prefix(self.proj.param_metas(), "image_encoder.proj"),
             "final_norm": tree_prefix(self.final_norm.param_metas(), "image_encoder.final_norm"),
         }
+        if self.backbone == "clip":
+            metas["clip"] = tree_prefix(self.clip.param_metas(), "image_encoder.clip")
+            return metas
+        metas["patch_proj"] = tree_prefix(self.patch_proj.param_metas(), "image_encoder.patch_proj")
+        metas["out_norm"] = tree_prefix(self.out_norm.param_metas(), "image_encoder.out_norm")
         for i, blk in enumerate(self.blocks):
             metas[f"block_{i}"] = tree_prefix(blk.param_metas(), f"image_encoder.block_{i}")
         return metas
 
+    def load_clip_weights(self, params: dict, state_dict) -> dict:
+        """Return ``params`` with the CLIP trunk replaced by pretrained
+        huggingface ``CLIPVisionModel`` weights (the projection into the
+        language stream stays trainable-fresh)."""
+        from .clip_vision import import_clip_vision_weights
+
+        assert self.backbone == "clip", "load_clip_weights needs backbone='clip'"
+        return {**params, "clip": import_clip_vision_weights(self.clip, state_dict)}
+
     def patchify(self, images: jax.Array) -> jax.Array:
-        """(b, H, W, 3) -> (b, tokens, patch_dim) via reshape/transpose."""
-        b, h, w, c = images.shape
-        p = PATCH_SIZE
-        x = images.reshape(b, h // p, p, w // p, p, c)
-        x = x.transpose(0, 1, 3, 2, 4, 5)  # (b, gh, gw, p, p, c)
-        return x.reshape(b, (h // p) * (w // p), p * p * c)
+        return patchify(images, PATCH_SIZE)
 
     def __call__(self, params: dict, images: jax.Array, ctx: ForwardContext) -> jax.Array:
-        x = self.patchify(images.astype(self.dtype))
-        x = self.patch_proj(params["patch_proj"], x, ctx)
-        for i, blk in enumerate(self.blocks):
-            x = blk(params[f"block_{i}"], x, ctx)
-        x = self.out_norm(params["out_norm"], x, ctx)
+        if self.backbone == "clip":
+            x = self.clip(params["clip"], images, ctx)
+        else:
+            x = self.patchify(images.astype(self.dtype))
+            x = self.patch_proj(params["patch_proj"], x, ctx)
+            for i, blk in enumerate(self.blocks):
+                x = blk(params[f"block_{i}"], x, ctx)
+            x = self.out_norm(params["out_norm"], x, ctx)
         x = self.proj(params["proj"], x, ctx)
         x = ctx.dropout(x, self.dropout_p)
         return self.final_norm(params["final_norm"], x, ctx)

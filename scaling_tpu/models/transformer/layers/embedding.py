@@ -48,6 +48,7 @@ class EmbeddingInput(BaseLayer):
                 heads=architecture.image_encoder_heads,
                 dropout_p=architecture.dropout_image_encoder,
                 dtype=architecture.dtype,
+                backbone=architecture.image_encoder_backbone,
             )
 
     def init(self, key: jax.Array) -> dict:

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Any, List, Optional
 
+import jax
+
 from ...data.blended_dataset import BlendedDatasetConfig
 from ...logging import logger
 from ...runner import LaunchConfig, initialize_distributed
@@ -170,8 +172,58 @@ def main(config: TransformerConfig) -> TransformerTrainer:
     trainer.initialize(
         load_checkpoint=config.trainer.load_dir is not None
     )
+    clip_ckpt = config.transformer_architecture.image_encoder_clip_checkpoint
+    if clip_ckpt is not None:
+        _apply_pretrained_clip(trainer, module, clip_ckpt)
     trainer.run_training()
     return trainer
+
+
+def _apply_pretrained_clip(trainer, module, path) -> None:
+    """Splice pretrained CLIP vision weights into the image-encoder trunk
+    at startup (reference: clip.py constructs its trunk pretrained). Skipped
+    on RESUME — the trained trunk is in the checkpoint; applied on fresh
+    runs and finetunes-from-LM-checkpoints, overwriting whatever the trunk
+    held. Optimizer masters re-derive so the first step can't revert it."""
+    from pathlib import Path
+
+    if trainer.context.iterations > 0:
+        logger.info(f"resume at step {trainer.context.iterations}: "
+                    "skipping pretrained CLIP splice (trunk is in the checkpoint)")
+        return
+    import torch
+
+    p = Path(path)
+    if p.is_dir():
+        from transformers import CLIPVisionModel
+
+        sd = CLIPVisionModel.from_pretrained(p).state_dict()
+    else:
+        sd = torch.load(p, map_location="cpu", weights_only=True)
+        sd = sd.get("state_dict", sd)
+
+    for i, layer in enumerate(module.layers):
+        encoder = getattr(layer, "image_encoder", None)
+        if encoder is None:
+            continue
+        name = module.layer_name(i)
+        emb_params = trainer.params[name]
+        fresh = encoder.load_clip_weights(emb_params["image_encoder"], sd)
+        placed = jax.tree.map(
+            lambda new, old: jax.device_put(new.astype(old.dtype), old.sharding)
+            if hasattr(old, "sharding") else new.astype(old.dtype),
+            fresh, emb_params["image_encoder"],
+        )
+        trainer.params = {
+            **trainer.params, name: {**emb_params, "image_encoder": placed},
+        }
+        trainer.opt_state = trainer.optimizer.init_state(trainer.params)
+        logger.info(f"loaded pretrained CLIP vision weights from {path}")
+        return
+    raise ValueError(
+        "image_encoder_clip_checkpoint set but the model has no image "
+        "encoder (set image_encoder: true, image_encoder_backbone: clip)"
+    )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,168 @@
+"""Pretrained CLIP vision import: our ClipVisionEncoder must reproduce a
+huggingface CLIPVisionModel's features from imported weights (the
+pretrained-prior capability of the reference's CLIP trunk, clip.py)."""
+
+import jax
+import numpy as np
+import pytest
+import torch
+
+from scaling_tpu.models.transformer.clip_vision import (
+    ClipVisionEncoder,
+    import_clip_vision_weights,
+)
+from scaling_tpu.nn import ForwardContext
+
+CTX = ForwardContext()
+
+
+def tiny_hf_clip(image_size, patch_size=32, width=64, layers=2, heads=4,
+                 intermediate=None):
+    from transformers import CLIPVisionConfig, CLIPVisionModel
+
+    cfg = CLIPVisionConfig(
+        hidden_size=width, intermediate_size=intermediate or 2 * width,
+        num_hidden_layers=layers, num_attention_heads=heads,
+        image_size=image_size, patch_size=patch_size,
+    )
+    torch.manual_seed(7)
+    return CLIPVisionModel(cfg).eval()
+
+
+def our_encoder_for(model, image_size):
+    c = model.config
+    return ClipVisionEncoder(
+        width=c.hidden_size, layers=c.num_hidden_layers,
+        heads=c.num_attention_heads, patch_size=c.patch_size,
+        image_size=image_size, intermediate=c.intermediate_size,
+    )
+
+
+def test_clip_import_reproduces_hf_features():
+    """Imported weights reproduce last_hidden_state[:, 1:] (the spatial
+    tokens magma consumes) within float tolerance."""
+    model = tiny_hf_clip(image_size=96)
+    enc = our_encoder_for(model, image_size=96)
+    params = import_clip_vision_weights(enc, model.state_dict())
+
+    rng = np.random.default_rng(0)
+    pixels = rng.normal(size=(2, 3, 96, 96)).astype(np.float32)
+    with torch.no_grad():
+        want = model(torch.from_numpy(pixels)).last_hidden_state[:, 1:].numpy()
+    got = enc(params, np.transpose(pixels, (0, 2, 3, 1)), CTX)
+    assert got.shape == want.shape == (2, 9, 64)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-4)
+
+
+def test_clip_import_interpolates_position_embeddings():
+    """A checkpoint trained at one resolution imports at another: the
+    position grid is bicubic-interpolated exactly as HF's
+    interpolate_pos_encoding (the reference runs its CLIP at 384 regardless
+    of the pretrain resolution, image_encoder.py:20-27)."""
+    model = tiny_hf_clip(image_size=64)  # native grid 2x2
+    enc = our_encoder_for(model, image_size=96)  # target grid 3x3
+    params = import_clip_vision_weights(enc, model.state_dict())
+
+    rng = np.random.default_rng(1)
+    pixels = rng.normal(size=(1, 3, 96, 96)).astype(np.float32)
+    with torch.no_grad():
+        want = model(
+            torch.from_numpy(pixels), interpolate_pos_encoding=True
+        ).last_hidden_state[:, 1:].numpy()
+    got = enc(params, np.transpose(pixels, (0, 2, 3, 1)), CTX)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-4)
+
+
+def test_clip_import_rejects_geometry_mismatch():
+    """Silently importing a truncated or resized trunk would train on a
+    model the user believes is the full pretrained tower."""
+    enc = ClipVisionEncoder(width=64, layers=2, heads=4, patch_size=32,
+                            image_size=96, intermediate=128)
+    with pytest.raises(AssertionError, match="patch"):
+        import_clip_vision_weights(
+            enc, tiny_hf_clip(image_size=64, patch_size=16).state_dict())
+    with pytest.raises(ValueError, match="layers"):
+        import_clip_vision_weights(
+            enc, tiny_hf_clip(image_size=64, layers=4).state_dict())
+    with pytest.raises(ValueError, match="width"):
+        import_clip_vision_weights(
+            enc, tiny_hf_clip(image_size=64, width=32, heads=2).state_dict())
+    with pytest.raises(ValueError, match="mlp width"):
+        import_clip_vision_weights(
+            enc, tiny_hf_clip(image_size=64, intermediate=64).state_dict())
+
+
+def test_image_encoder_clip_backbone():
+    """backbone='clip' end to end at the reference geometry: 384x384 in,
+    144 projected prefix tokens out, params/metas trees structure-aligned
+    (the checkpoint machinery zips them), pretrained trunk loadable."""
+    from scaling_tpu.models.transformer.image_encoder import ImageEncoder
+
+    enc = ImageEncoder(out_features=32, width=64, layers=2, heads=4,
+                       backbone="clip")
+    params = enc.init(jax.random.PRNGKey(0))
+    metas = enc.param_metas()
+    assert jax.tree.structure(params) == jax.tree.structure(
+        metas, is_leaf=lambda x: not isinstance(x, dict)
+    )
+
+    model = tiny_hf_clip(image_size=384, intermediate=256)  # trunk uses 4x width
+    params = enc.load_clip_weights(params, model.state_dict())
+    rng = np.random.default_rng(2)
+    images = rng.normal(size=(1, 384, 384, 3)).astype(np.float32)
+    out = enc(params, images, CTX)
+    assert out.shape == (1, 144, 32)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_clip_checkpoint_applied_at_train_startup(tmp_path):
+    """The image_encoder_clip_checkpoint knob end to end: main() splices
+    the pretrained trunk into a fresh run (text-only data; the trunk just
+    rides along) and the trained model's trunk carries the checkpoint's
+    class embedding, not the random init."""
+    from scaling_tpu.data.memory_map import MemoryMapDatasetBuilder
+    from scaling_tpu.models.transformer.train import main
+
+    prefix = tmp_path / "data"
+    rng = np.random.default_rng(5)
+    with MemoryMapDatasetBuilder(prefix, dtype=np.uint16) as b:
+        for _ in range(32):
+            doc = rng.integers(1, 96, size=rng.integers(8, 48))
+            b.add(np.append(doc, 0).astype(np.uint16))
+
+    model = tiny_hf_clip(image_size=384, intermediate=256)
+    ckpt = tmp_path / "clip_vision.pt"
+    torch.save(model.state_dict(), ckpt)
+
+    from scaling_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig.from_dict({
+        "topology": {"model_parallel_size": 1, "pipe_parallel_size": 1,
+                     "data_parallel_size": 1, "micro_batch_size": 2,
+                     "gradient_accumulation_steps": 1},
+        "transformer_architecture": {
+            "vocab_size": 96, "hidden_size": 32, "num_layers": 1,
+            "num_attention_heads": 4, "sequence_length": 160,
+            "image_encoder": True, "image_encoder_width": 64,
+            "image_encoder_layers": 2, "image_encoder_heads": 4,
+            "image_encoder_backbone": "clip",
+            "image_encoder_clip_checkpoint": str(ckpt),
+        },
+        "optimizer": {"gradient_clipping": 1.0},
+        "learning_rate_scheduler": {"learning_rate": 0.01,
+                                    "learning_rate_warmup_steps": 2,
+                                    "learning_rate_decay_iters": 50},
+        "trainer": {"train_iterations": 1, "seed": 42,
+                    "save_dir": str(tmp_path / "ckpt"), "save_interval": 100},
+        "data": {"data_prefixes": [str(prefix)]},
+        "logger": {"log_dir": None},
+    })
+    trainer = main(cfg)
+    for key, p, _ in trainer.module.named_parameters(trainer.params):
+        if key.endswith("image_encoder.clip.class_embedding"):
+            want = model.state_dict()["vision_model.embeddings.class_embedding"]
+            np.testing.assert_allclose(
+                np.asarray(p, np.float32), want.numpy(), atol=1e-5)
+            break
+    else:
+        raise AssertionError("clip trunk parameter not found")
