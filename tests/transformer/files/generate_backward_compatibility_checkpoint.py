@@ -5,6 +5,12 @@ changes (and say so in the commit message):
 
     python tests/transformer/files/generate_backward_compatibility_checkpoint.py
 
+Generation is idempotent PER ARTIFACT: an existing fixture (data.bin,
+ckpt/, orbax_ckpt/) is left untouched, so refreshing one backend's pin
+never perturbs the others. To regenerate a pin, DELETE its fixture dir
+first (e.g. ``rm -r .../orbax_ckpt``) and rerun; the script refuses if a
+fixture exists without its recorded losses.
+
 Mirrors the reference's backward-compatibility anchor
 (reference: tests/transformer/test_backwards_compatibility.py +
 files/backward_compatibility_checkpoint/): a tiny deterministic model is
@@ -42,47 +48,98 @@ def main() -> None:
 
     OUT.mkdir(parents=True, exist_ok=True)
     data_prefix = OUT / "data"
-    rng = np.random.default_rng(1234)
-    with MemoryMapDatasetBuilder(data_prefix, dtype=np.uint16) as builder:
-        for _ in range(48):
-            doc = rng.integers(1, 96, size=rng.integers(8, 64))
-            builder.add(np.append(doc, 0).astype(np.uint16))
+    # idempotent per artifact: an existing data/ckpt fixture is kept as-is
+    # so regenerating ONE backend's pin never perturbs the others
+    if not (OUT / "data.bin").exists():
+        rng = np.random.default_rng(1234)
+        with MemoryMapDatasetBuilder(data_prefix, dtype=np.uint16) as builder:
+            for _ in range(48):
+                doc = rng.integers(1, 96, size=rng.integers(8, 64))
+                builder.add(np.append(doc, 0).astype(np.uint16))
+
+    truth_file = OUT / "ground_truth.json"
+    truth = json.loads(truth_file.read_text()) if truth_file.exists() else {}
 
     gen = make_config(
         OUT, data_prefix, train_iterations=3, save_interval=100,
     )
 
-    trainer = build_capturing_trainer(gen)
-    pre_losses = train_capture(trainer, 3)  # save_interval 100: no auto-save
-    step_dir = trainer.save_checkpoint()
-    # de-absolutize the paths baked into the checkpoint's config.yml so the
-    # committed fixture is machine-independent (regeneration diffs cleanly)
-    cfg_file = step_dir / "config.yml"
-    cfg_file.write_text(cfg_file.read_text().replace(str(OUT), "."))
+    pre_losses = resumed_losses = None
+    if not (OUT / "ckpt").exists():
+        trainer = build_capturing_trainer(gen)
+        pre_losses = train_capture(trainer, 3)  # save_interval 100: no auto-save
+        step_dir = trainer.save_checkpoint()
+        # de-absolutize the paths baked into the checkpoint's config.yml so
+        # the committed fixture is machine-independent
+        cfg_file = step_dir / "config.yml"
+        cfg_file.write_text(cfg_file.read_text().replace(str(OUT), "."))
 
-    resume = type(gen).from_dict(
-        {
-            **gen.model_dump(mode="json"),
-            "trainer": {
-                **gen.model_dump(mode="json")["trainer"],
-                "load_dir": str(OUT / "ckpt"),
-                "train_iterations": 5,
-                "assert_checkpoint_loaded": True,
-            },
-        }
-    )
-    rtrainer = build_capturing_trainer(resume, load=True)
-    resumed_losses = train_capture(rtrainer, 2)
+        resume = type(gen).from_dict(
+            {
+                **gen.model_dump(mode="json"),
+                "trainer": {
+                    **gen.model_dump(mode="json")["trainer"],
+                    "load_dir": str(OUT / "ckpt"),
+                    "train_iterations": 5,
+                    "assert_checkpoint_loaded": True,
+                },
+            }
+        )
+        rtrainer = build_capturing_trainer(resume, load=True)
+        resumed_losses = train_capture(rtrainer, 2)
+        truth["resumed_losses"] = [float(x) for x in resumed_losses]
+
+    # the same pin for the ORBAX on-disk format: every backend gets its own
+    # golden artifact (the reference's per-format discipline,
+    # tests/transformer/test_backwards_compatibility.py)
+    def with_backend_and_dir(cfg, save_dir, load_dir=None, iters=3):
+        d = cfg.model_dump(mode="json")
+        d["trainer"].update({
+            "checkpoint_backend": "orbax",
+            "save_dir": str(save_dir),
+            "load_dir": str(load_dir) if load_dir else None,
+            "train_iterations": iters,
+            "assert_checkpoint_loaded": load_dir is not None,
+        })
+        return type(cfg).from_dict(d)
+
+    orbax_pre = orbax_resumed = None
+    if not (OUT / "orbax_ckpt").exists():
+        orbax_gen = with_backend_and_dir(gen, OUT / "orbax_ckpt")
+        otrainer = build_capturing_trainer(orbax_gen)
+        orbax_pre = train_capture(otrainer, 3)
+        orbax_step = otrainer.save_checkpoint()
+        cfg_file = orbax_step / "config.yml"
+        cfg_file.write_text(cfg_file.read_text().replace(str(OUT), "."))
+
+        orbax_resume = with_backend_and_dir(
+            gen, OUT / "orbax_ckpt", load_dir=OUT / "orbax_ckpt", iters=5
+        )
+        ortrainer = build_capturing_trainer(orbax_resume, load=True)
+        orbax_resumed = train_capture(ortrainer, 2)
+        truth["orbax_resumed_losses"] = [float(x) for x in orbax_resumed]
+
+    # a fixture without its truth key means someone deleted ground_truth
+    # but not the checkpoint — refuse rather than write an empty pin
+    for fixture, key in ((OUT / "ckpt", "resumed_losses"),
+                         (OUT / "orbax_ckpt", "orbax_resumed_losses")):
+        if fixture.exists() and key not in truth:
+            raise SystemExit(
+                f"{fixture} exists but ground_truth.json lacks '{key}': "
+                f"delete {fixture} and rerun to regenerate the pin"
+            )
 
     # only resumed_losses are asserted (a fresh-train determinism pin would
     # break on benign jax-version numeric drift); pretrain goes to stdout
-    (OUT / "ground_truth.json").write_text(
-        json.dumps(
-            {"resumed_losses": [float(x) for x in resumed_losses]}, indent=2
-        )
-    )
+    truth_file.write_text(json.dumps(truth, indent=2))
+    regenerated = [x for x in (resumed_losses, orbax_resumed) if x is not None]
+    if not regenerated:
+        print("NOTHING regenerated — every fixture already exists; delete "
+              "the one you mean to refresh and rerun")
     print("pretrain:", pre_losses)
     print("resumed:", resumed_losses)
+    print("orbax pretrain:", orbax_pre)
+    print("orbax resumed:", orbax_resumed)
     print("wrote", OUT)
 
 

@@ -19,25 +19,47 @@ from .test_training import build_capturing_trainer, make_config, train_capture
 FILES = Path(__file__).parent / "files" / "backward_compatibility_checkpoint"
 
 
-def test_golden_checkpoint_resumes_exactly(devices):
+import pytest
+
+
+@pytest.mark.parametrize(
+    "ckpt_dir,backend,truth_key",
+    [("ckpt", "npz", "resumed_losses"),
+     ("orbax_ckpt", "orbax", "orbax_resumed_losses")],
+)
+def test_golden_checkpoint_resumes_exactly(devices, ckpt_dir, backend, truth_key):
+    """Every on-disk format gets its own pin (reference discipline: one
+    golden artifact per format): the committed fixture must keep loading
+    and reproducing its recorded post-resume losses."""
     truth = json.loads((FILES / "ground_truth.json").read_text())
     config = make_config(
         FILES, FILES / "data", train_iterations=5, save_interval=100,
-        load_dir=FILES / "ckpt",
+        load_dir=FILES / ckpt_dir,
     )
     d = config.model_dump(mode="json")
     d["trainer"]["save_dir"] = None
+    d["trainer"]["checkpoint_backend"] = backend
     d["trainer"]["assert_checkpoint_loaded"] = True
     config = type(config).from_dict(d)
     trainer = build_capturing_trainer(config, load=True)
     losses = train_capture(trainer, 2)
     np.testing.assert_allclose(
         np.asarray(losses, np.float32),
-        np.asarray(truth["resumed_losses"], np.float32),
+        np.asarray(truth[truth_key], np.float32),
         rtol=1e-4,
-        err_msg="the committed checkpoint no longer reproduces its recorded "
-        "post-resume losses — the on-disk format or training math changed",
+        err_msg=f"the committed {backend} checkpoint no longer reproduces "
+        "its recorded post-resume losses — the on-disk format or training "
+        "math changed",
     )
+
+
+def test_orbax_golden_checkpoint_files_present():
+    step = FILES / "orbax_ckpt" / "global_step3"
+    assert (step / "orbax" / "model" / "_METADATA").is_file()
+    assert (step / "orbax" / "model" / "_CHECKPOINT_METADATA").is_file()
+    assert (step / "orbax" / "optimizer" / "_METADATA").is_file()
+    assert (step / "context.json").is_file()
+    assert (step / "config.yml").is_file()
 
 
 def test_golden_checkpoint_files_present():
