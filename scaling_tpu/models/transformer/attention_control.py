@@ -2,12 +2,13 @@
 
 (reference: src/scaling/transformer/data/inference_settings.py:1-54 +
 attention.py:105-190) — per-token suppression/amplification factors become
-an additive manipulation on pre-softmax attention scores, flowing through
-the batch dict every layer already consumes
-(``attention_scores_manipulation``). Log-additive application matches the
-reference's default ``control_log_additive=True`` path; the multiplicative
-variant operates on a different scale per layer-score distribution and is
-intentionally not offered.
+a manipulation on pre-softmax attention scores, flowing through the batch
+dict every layer already consumes (``attention_scores_manipulation``).
+Both reference variants are supported: log-additive (the default
+``control_log_additive=True`` — offsets of ``log(factor)`` added to
+scores) and multiplicative (``control_log_additive=False`` — scores are
+shifted so the minimum unmasked value is 0, then scaled by the factors;
+reference attention.py:166-170).
 """
 
 from __future__ import annotations
@@ -26,7 +27,11 @@ class Control(BaseConfig):
     (reference: inference_settings.py:8-12)."""
 
     token_index: int = Field(description="key/token position to control", ge=0)
-    factor: float = Field(description="attention factor; <1 suppresses", gt=0)
+    factor: float = Field(description="attention factor; <1 suppresses. 0 "
+                          "removes the token entirely under log-additive "
+                          "application; under multiplicative it pins the "
+                          "column at the row's minimum score (weight "
+                          "exp(0)/Z, not 0 — reference semantics)", ge=0)
 
 
 def build_attention_scores_manipulation(
@@ -34,21 +39,33 @@ def build_attention_scores_manipulation(
     seq_len: int,
     batch_size: int = 1,
     dtype=jnp.float32,
+    log_additive: bool = True,
 ) -> Optional[jnp.ndarray]:
-    """-> (batch, 1, s_q, s_k) additive score offsets, or None if empty.
+    """-> (batch, 1, s_q, s_k) score manipulation, or None if empty.
 
-    Every query's score against a controlled key position shifts by
-    ``log(factor)``; after softmax that multiplies the attention weight by
-    ~``factor`` (exactly, up to renormalisation) — the reference's
-    log-additive semantics.
+    ``log_additive=True`` (reference default): every query's score against
+    a controlled key position shifts by ``log(factor)`` (-10000 for factor
+    0, reference embedding.py:273-276); after softmax that multiplies the
+    attention weight by ~``factor``. ``log_additive=False``: an identity-1
+    matrix with ``factor`` in controlled columns, MULTIPLIED into
+    min-shifted scores by the attention layer (reference
+    attention.py:166-170 + embedding.py:188-189).
     """
     if not controls:
         return None
-    offsets = np.zeros((batch_size, 1, seq_len, seq_len), np.float32)
+    fill = 0.0 if log_additive else 1.0
+    out = np.full((batch_size, 1, seq_len, seq_len), fill, np.float32)
     for c in controls:
         if c.token_index >= seq_len:
             raise ValueError(
                 f"control token_index {c.token_index} >= sequence length {seq_len}"
             )
-        offsets[:, :, :, c.token_index] += float(np.log(c.factor))
-    return jnp.asarray(offsets, dtype)
+        # ASSIGNMENT, not accumulation, for both variants — duplicate
+        # controls are last-wins like the reference (embedding.py:273-278)
+        if log_additive:
+            out[:, :, :, c.token_index] = (
+                -10000.0 if c.factor == 0.0 else float(np.log(c.factor))
+            )
+        else:
+            out[:, :, :, c.token_index] = c.factor
+    return jnp.asarray(out, dtype)

@@ -201,12 +201,15 @@ class TransformerInferenceModule:
             "attention_scores_manipulation": None,
         }
 
-    def logits(self, token_ids, controls=None) -> jax.Array:
+    def logits(self, token_ids, controls=None, control_log_additive=True) -> jax.Array:
         """Full-sequence logits (b, s, vocab).
 
         ``controls``: AtMan-style per-token attention controls
-        (attention_control.Control) applied as log-additive score offsets in
-        every layer (reference: inference_settings.py + attention.py:158)."""
+        (attention_control.Control) applied in every layer; with
+        ``control_log_additive=True`` (reference default) as log(factor)
+        score offsets, with ``False`` as multiplicative factors on
+        min-shifted scores (reference: inference_settings.py:24-30 +
+        attention.py:158-170)."""
         token_ids = jnp.asarray(token_ids)
         if token_ids.ndim == 1:
             token_ids = token_ids[None]
@@ -217,16 +220,21 @@ class TransformerInferenceModule:
             from .attention_control import build_attention_scores_manipulation
 
             manipulation = build_attention_scores_manipulation(
-                controls, seq_len=s, batch_size=b
+                controls, seq_len=s, batch_size=b,
+                log_additive=control_log_additive,
             )
         if self._logits_fn is None:
-            def run(p, t, po, manip):
+            def run(p, t, po, manip, log_additive):
                 batch = self._make_batch(t, po)
                 batch["attention_scores_manipulation"] = manip
+                batch["attention_scores_manipulation_log_additive"] = log_additive
                 return self._run_layers(p, batch, None, None)[0]
 
-            self._logits_fn = jax.jit(run)
-        return self._logits_fn(self.params, token_ids, pos, manipulation)
+            # the flag is STATIC: each value compiles its own graph
+            self._logits_fn = jax.jit(run, static_argnums=(4,))
+        return self._logits_fn(
+            self.params, token_ids, pos, manipulation, bool(control_log_additive)
+        )
 
     def hidden_states(
         self,

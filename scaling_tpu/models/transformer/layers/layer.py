@@ -230,6 +230,11 @@ class TransformerLayer(BaseLayer):
             kv_cache=kv_cache,
             cache_offset=cache_offset,
             attention_scores_manipulation=x.get("attention_scores_manipulation"),
+            # a STATIC python bool (threaded by inference.logits at trace
+            # time); never a traced leaf
+            attention_scores_manipulation_log_additive=x.get(
+                "attention_scores_manipulation_log_additive", True
+            ),
             return_kv=return_kv,
         )
         new_kv = None

@@ -90,11 +90,20 @@ def multi_head_attention(
     softmax: MaskedSoftmax,
     dropout_fn: Optional[Callable[[jax.Array], jax.Array]] = None,
     attention_scores_manipulation: Optional[jax.Array] = None,
+    scores_manipulation_log_additive: bool = True,
 ) -> jax.Array:
     """Unfused attention: QK^T -> masked softmax -> PV. Returns (b, s_q, n, h)."""
     scores = jnp.einsum("bqnh,bknh->bnqk", query, key) * scaling_factor
     if attention_scores_manipulation is not None:
-        scores = scores + attention_scores_manipulation.astype(scores.dtype)
+        m = attention_scores_manipulation.astype(scores.dtype)
+        if scores_manipulation_log_additive:
+            scores = scores + m
+        else:
+            # multiplicative variant (reference attention.py:166-170):
+            # shift so the minimum UNMASKED score is 0, then scale — the
+            # factors act on a non-negative score range
+            filled = jnp.where(mask, jnp.asarray(10000.0, scores.dtype), scores)
+            scores = (scores - jnp.min(filled, axis=-1, keepdims=True)) * m
     probs = softmax(scores, mask)
     if dropout_fn is not None:
         probs = dropout_fn(probs)
@@ -288,6 +297,7 @@ class ParallelSelfAttention(BaseLayer):
         kv_cache: Optional[Tuple[jax.Array, jax.Array]] = None,
         cache_offset: Optional[jax.Array] = None,
         attention_scores_manipulation: Optional[jax.Array] = None,
+        attention_scores_manipulation_log_additive: bool = True,
         return_kv: bool = False,
     ):
         b, s, _ = x.shape
@@ -432,17 +442,20 @@ class ParallelSelfAttention(BaseLayer):
                 q[:, :, :n_global], k[:, :, :n_global], v[:, :, :n_global],
                 mask, self.scaling_factor, self.masked_softmax, dropout_fn,
                 attention_scores_manipulation,
+                attention_scores_manipulation_log_additive,
             ) if n_global > 0 else None
             out_l = multi_head_attention(
                 q[:, :, n_global:], k[:, :, n_global:], v[:, :, n_global:],
                 local_mask, self.scaling_factor, self.masked_softmax, dropout_fn,
                 attention_scores_manipulation,
+                attention_scores_manipulation_log_additive,
             )
             out = out_l if out_g is None else jnp.concatenate([out_g, out_l], axis=2)
         else:
             out = multi_head_attention(
                 q, k, v, mask, self.scaling_factor, self.masked_softmax,
                 dropout_fn, attention_scores_manipulation,
+                attention_scores_manipulation_log_additive,
             )
 
         return self._project_out(params, out, ctx, b, s, new_kv)

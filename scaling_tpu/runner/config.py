@@ -24,6 +24,25 @@ class RunnerType(Enum):
     PDSH_DOCKER = "pdsh_docker"
 
 
+class DockerConfig(BaseConfig):
+    """Containerized launch (reference: runner.py:54-82 docker mode).
+
+    On TPU VMs the container needs ``--privileged`` (libtpu drives
+    /dev/accel* and vfio) and host networking for the jax.distributed
+    rendezvous; both are always set, like the reference's GPU mode."""
+
+    docker_container: Optional[str] = Field(
+        None, description="image to run the worker in"
+    )
+    docker_sudo: bool = Field(False, description="prefix docker with sudo")
+    docker_mounts: Optional[List[List[str]]] = Field(
+        None, description="[host_dir, container_dir] bind mounts (code, data)"
+    )
+    docker_args: List[str] = Field(
+        [], description="extra args appended to docker run"
+    )
+
+
 class RunnerConfig(BaseConfig):
     runner_type: RunnerType = Field(RunnerType.PDSH, description="launch mechanism")
     hostsfile: Optional[Path] = Field(
@@ -40,7 +59,9 @@ class RunnerConfig(BaseConfig):
     default_gpu_count: int = Field(
         8, description="devices per host when the hostsfile gives no slot counts"
     )
-    docker_config: Optional[dict] = Field(None, description="kept for config parity")
+    docker_config: Optional[DockerConfig] = Field(
+        None, description="container settings for runner_type=pdsh_docker"
+    )
     use_determined: bool = Field(False, description="kept for config parity")
 
 

@@ -14,11 +14,12 @@ from __future__ import annotations
 import base64
 import json
 import os
+import shlex
 import subprocess
 import sys
 from typing import Any, Dict, List, Optional
 
-from .config import LaunchConfig, RunnerConfig
+from .config import LaunchConfig, RunnerConfig, RunnerType
 
 
 def get_resource_pool(config: RunnerConfig) -> Dict[str, int]:
@@ -47,6 +48,40 @@ def get_resource_pool(config: RunnerConfig) -> Dict[str, int]:
 
 def encode_payload(payload: Any) -> str:
     return base64.urlsafe_b64encode(json.dumps(payload).encode()).decode()
+
+
+def build_worker_command(
+    config: RunnerConfig, env_exports: Dict[str, str], encoded_payload: str
+) -> List[str]:
+    """The argv one worker runs (before any ssh wrapping) — factored out so
+    the docker assembly is testable without a daemon (reference command
+    assembly: runner.py:41-115).
+
+    Docker mode mirrors the reference's: env rides in ``--env`` flags
+    (PYTHON* keys skipped — the container has its own interpreter paths),
+    bind mounts carry code/data, ``--privileged --network=host --ipc=host``
+    give the container the TPU devices and the rendezvous network."""
+    script = config.script or "scaling_tpu.models.transformer.train"
+    if config.runner_type == RunnerType.PDSH_DOCKER:
+        dc = config.docker_config
+        if dc is None or not dc.docker_container:
+            raise ValueError(
+                "runner_type=pdsh_docker needs docker_config.docker_container"
+            )
+        cmd = ["sudo"] if dc.docker_sudo else []
+        cmd += ["docker", "run", "--rm", "--privileged",
+                "--network=host", "--ipc=host"]
+        for key, val in env_exports.items():
+            if key.lower().startswith("python"):
+                continue
+            cmd += ["--env", f"{key}={val}"]
+        for host_dir, container_dir in dc.docker_mounts or []:
+            cmd += ["-v", f"{host_dir}:{container_dir}"]
+        cmd += list(dc.docker_args)
+        cmd += [dc.docker_container, "python", "-u", "-m", script,
+                f"--payload={encoded_payload}"]
+        return cmd
+    return [sys.executable, "-u", "-m", script, f"--payload={encoded_payload}"]
 
 
 def runner_main(config: RunnerConfig, payload: Any) -> int:
@@ -88,13 +123,20 @@ def runner_main(config: RunnerConfig, payload: Any) -> int:
             "JAX_NUM_PROCESSES": str(num_processes),
             "JAX_PROCESS_ID": str(process_id),
         }
-        script = config.script or "scaling_tpu.models.transformer.train"
-        cmd = [sys.executable, "-u", "-m", script, f"--payload={encoded}"]
+        cmd = build_worker_command(config, env_exports, encoded)
+        docker = config.runner_type == RunnerType.PDSH_DOCKER
+        quoted = " ".join(shlex.quote(a) for a in cmd)
         if host in ("localhost", "127.0.0.1"):
             procs.append(subprocess.Popen(cmd, env={**os.environ, **env_exports}))
+        elif docker:
+            # env already rides inside the docker argv; no cd — the
+            # container's workdir/mounts define the code location
+            procs.append(subprocess.Popen(["ssh", host, quoted]))
         else:
-            exports = " ".join(f"{k}={v}" for k, v in env_exports.items())
-            ssh_cmd = ["ssh", host, f"cd {os.getcwd()} && {exports} {' '.join(cmd)}"]
+            exports = " ".join(
+                f"{k}={shlex.quote(v)}" for k, v in env_exports.items()
+            )
+            ssh_cmd = ["ssh", host, f"cd {shlex.quote(os.getcwd())} && {exports} {quoted}"]
             procs.append(subprocess.Popen(ssh_cmd))
 
     # babysit: if any worker dies non-zero, kill the rest

@@ -86,6 +86,60 @@ def test_resource_pool_parsing(tmp_path: Path):
     assert pool == {"worker-0": 4, "worker-1": 2}
 
 
+def test_docker_worker_command_assembly():
+    """runner_type=pdsh_docker wraps the worker in docker run with env
+    passthrough (PYTHON* skipped), bind mounts, privileged + host network
+    for TPU devices and the rendezvous (reference: runner.py:54-82)."""
+    from scaling_tpu.runner.runner import build_worker_command
+
+    cfg = RunnerConfig.from_dict({
+        "runner_type": "pdsh_docker",
+        "hosts": ["worker-0", "worker-1"],
+        "script": "scaling_tpu.models.transformer.train",
+        "docker_config": {
+            "docker_container": "my/image:1",
+            "docker_sudo": True,
+            "docker_mounts": [["/data", "/data"], ["/code", "/workdir"]],
+            "docker_args": ["--shm-size=1g"],
+        },
+    })
+    env = {"MASTER_ADDR": "worker-0", "RANK": "1", "PYTHONPATH": "/x"}
+    cmd = build_worker_command(cfg, env, "PAYLOAD")
+    assert cmd[:3] == ["sudo", "docker", "run"]
+    for flag in ("--rm", "--privileged", "--network=host", "--ipc=host",
+                 "--shm-size=1g"):
+        assert flag in cmd, flag
+    assert "--env" in cmd and "MASTER_ADDR=worker-0" in cmd and "RANK=1" in cmd
+    assert not any(a.startswith("PYTHONPATH") for a in cmd)  # container's own
+    assert cmd[cmd.index("-v") + 1] == "/data:/data" and "/code:/workdir" in cmd
+    # image then the in-container entry, payload riding along
+    i = cmd.index("my/image:1")
+    assert cmd[i + 1 :] == ["python", "-u", "-m",
+                            "scaling_tpu.models.transformer.train",
+                            "--payload=PAYLOAD"]
+
+
+def test_docker_mode_requires_container():
+    from scaling_tpu.runner.runner import build_worker_command
+
+    cfg = RunnerConfig.from_dict({"runner_type": "pdsh_docker",
+                                  "hosts": ["worker-0"]})
+    with pytest.raises(ValueError, match="docker_container"):
+        build_worker_command(cfg, {}, "P")
+
+
+def test_plain_worker_command_unchanged():
+    """The default (non-docker) path still launches this interpreter."""
+    import sys
+
+    from scaling_tpu.runner.runner import build_worker_command
+
+    cfg = RunnerConfig.from_dict({"hosts": ["worker-0"]})
+    cmd = build_worker_command(cfg, {"RANK": "0"}, "P")
+    assert cmd == [sys.executable, "-u", "-m",
+                   "scaling_tpu.models.transformer.train", "--payload=P"]
+
+
 class _CountingDataset:
     def __init__(self, n):
         self.n = n

@@ -181,6 +181,41 @@ def test_attention_control_suppression(checkpoint_dir):
     np.testing.assert_allclose(suppressed[0, 0], base[0, 0], atol=1e-5)
 
 
+def test_attention_control_multiplicative(checkpoint_dir):
+    """The control_log_additive=False variant (reference
+    inference_settings.py:24-30): scores shift to a zero minimum then
+    scale by the factors. Factor 0 pins the controlled column at the row
+    minimum (weight exp(0)/Z — NOT fully removed, per the reference's
+    multiplicative semantics), so it must differ from BOTH the baseline
+    and the log-additive factor-0 result, proving the flag actually
+    switches the application path."""
+    from scaling_tpu.models.transformer.attention_control import Control
+
+    module = TransformerInferenceModule.from_checkpoint(checkpoint_dir)
+    prompt = [5, 9, 2, 14, 7, 3]
+    base = np.asarray(module.logits(prompt), np.float32)
+
+    zeroed = np.asarray(
+        module.logits(prompt, controls=[Control(token_index=1, factor=0.0)],
+                      control_log_additive=False),
+        np.float32,
+    )
+    # downstream positions lose most of token 1's contribution
+    assert np.abs(zeroed[0, 2:] - base[0, 2:]).max() > 1e-4
+    # causal: position 0 unaffected
+    np.testing.assert_allclose(zeroed[0, 0], base[0, 0], atol=1e-5)
+
+    zeroed_log = np.asarray(
+        module.logits(prompt, controls=[Control(token_index=1, factor=0.0)]),
+        np.float32,
+    )
+    assert np.abs(zeroed_log[0, 2:] - base[0, 2:]).max() > 1e-4
+    # the variants differ: multiplicative keeps weight exp(0)/Z on the
+    # controlled token where log-additive leaves ~0 — if the flag plumbing
+    # broke and both took the same path, this would be zero
+    assert np.abs(zeroed[0, 2:] - zeroed_log[0, 2:]).max() > 1e-5
+
+
 def test_generate_batched_matches_single(checkpoint_dir):
     """Batched greedy decode (beyond the reference's bs=1 cache,
     attention.py:491): each row of a (b, s) prompt batch must emit exactly
