@@ -206,7 +206,8 @@ def checked_devices():
 
 
 def main() -> None:
-    seq_len, mbs = 2048, 4
+    # BENCH_MBS: apply the winner of chip_session's micro-batch sweep
+    seq_len, mbs = 2048, int(os.environ.get("BENCH_MBS", "4"))
     # ~0.5B: params bf16 + fp32 master/moments + fp32 grads ~ 9G, inside the
     # 16G HBM of the smallest current chip (v5e)
     hidden, layers = 2048, 8

@@ -7,6 +7,8 @@ attn_bench.timeit):
   3. full train step A/B: flash vs torch kernel (shared params)
   4. norm A/B: BENCH_NORM fused vs torch with the flash kernel
   5. trace capture for benchmarks/analyze_trace.py
+  6. micro-batch sweep (4/8/16) after freeing earlier state; winner
+     feeds bench.py's BENCH_MBS
 
 Usage: cd /root/repo && python benchmarks/chip_session.py 2>&1 | tee /tmp/chip_session.log
 """
@@ -100,3 +102,36 @@ print(
     f"python benchmarks/analyze_trace.py {outdir}",
     flush=True,
 )
+
+# ------------------------------------------- 6. micro-batch size sweep
+# bigger per-step batch amortizes per-step overheads and widens MXU tiles;
+# memory-bound upward (fp32 masters dominate). Winner feeds bench.py's
+# BENCH_MBS. Runs LAST so the earlier sections' ~9G of model/optimizer
+# state can be freed first (a duplicate resident model would OOM the
+# larger arms on a 16G v5e), and with BENCH_NORM cleared so the sweep
+# measures the exact configuration bench.py runs.
+del params, opt_state, batch, step_f, step_x, step_fn
+os.environ["BENCH_KERNEL"] = "flash_attention"
+os.environ.pop("BENCH_NORM", None)
+for mbs in (4, 8, 16):
+    try:
+        cfg_m, _, mod_m, opt_m = bench.build(2048, mbs, 2048, 8)
+        step_m = mod_m.build_train_step(opt_m, bench.loss_function, donate=False)
+        p_m = mod_m.shard_params(mod_m.init_params(key))
+        s_m = opt_m.init_state(p_m)
+        b_m = mod_m.shard_batch(
+            bench.synth_batch(np.random.default_rng(0), mbs, 2048,
+                              cfg_m.transformer_architecture.vocab_size, 1),
+            stacked=True,
+        )
+
+        def f_m(pp, ss, _step=step_m, _b=b_m):
+            _, _, loss, _, _ = _step(pp, ss, _b, key)
+            return loss
+
+        t = attn_bench.timeit(f_m, p_m, s_m, iters=3)
+        print(f"6. step mbs={mbs}: {t:8.1f} ms "
+              f"({mbs * 2048 / t * 1000:.0f} tok/s)", flush=True)
+        del p_m, s_m, b_m, step_m
+    except Exception as e:
+        print(f"6. step mbs={mbs}: FAIL {type(e).__name__}: {e}", flush=True)
