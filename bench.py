@@ -159,6 +159,8 @@ def build(seq_len: int, micro_batch_size: int, hidden: int, layers: int):
                 # against the XLA-fused default
                 "layernorm": {"optimization_type": os.environ.get("BENCH_NORM", "torch")},
                 "weight_tying": False,
+                # fused QKV is layout-incompatible with GQA (differing kv
+                # heads), and GQA's KV-bandwidth win matters more here
                 "attention_qkv_in_one": False,
                 "dropout_embedding": 0.0,
                 "dropout_attention_probs": 0.0,

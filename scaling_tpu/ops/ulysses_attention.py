@@ -74,21 +74,35 @@ def _ulysses_local(
     n_kv_h = n_kv // cp
     g = nh // n_kv_h
 
-    # grouped-query attention over the full sequence (stable softmax in f32)
-    qf = qg.astype(jnp.float32).reshape(b, s, n_kv_h, g, d) * sm_scale
-    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kg.astype(jnp.float32))
-    allowed = seg_full[:, :, None] == seg_full[:, None, :]  # (b, s_q, s_k)
-    if causal:
-        pos = jnp.arange(s)
-        allowed = allowed & (pos[None, None, :] <= pos[None, :, None])
-    masked = allowed[:, None, None, :, :]
-    scores = jnp.where(masked, scores, _NEG)
-    m = scores.max(axis=-1, keepdims=True)
-    # fully-masked rows: exp(_NEG - _NEG) would be 1 — the mask kills them
-    p = jnp.exp(scores - m) * masked
-    l = jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-20)
-    out = jnp.einsum("bhgqk,bkhd->bqhgd", p / l, vg.astype(jnp.float32))
-    out = out.reshape(b, s, nh, d).astype(q.dtype)
+    from .flash_attention import flash_attention_supported
+
+    if causal and flash_attention_supported(s, d):
+        # after the exchange each device holds the FULL sequence for its
+        # head shard — ordinary causal attention, which is exactly the
+        # splash kernel's job: O(s·block) score tiles instead of the
+        # O(s^2) einsum below, and the same GQA-unrepeated contract
+        from .flash_attention import flash_attention_fused
+
+        out = flash_attention_fused(
+            qg, kg, vg, seg_full, causal=True, sm_scale=sm_scale
+        ).astype(q.dtype)
+    else:
+        # XLA fallback (non-causal, off-TPU, or unaligned shapes):
+        # grouped-query attention with a stable softmax in f32
+        qf = qg.astype(jnp.float32).reshape(b, s, n_kv_h, g, d) * sm_scale
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kg.astype(jnp.float32))
+        allowed = seg_full[:, :, None] == seg_full[:, None, :]  # (b, s_q, s_k)
+        if causal:
+            pos = jnp.arange(s)
+            allowed = allowed & (pos[None, None, :] <= pos[None, :, None])
+        masked = allowed[:, None, None, :, :]
+        scores = jnp.where(masked, scores, _NEG)
+        m = scores.max(axis=-1, keepdims=True)
+        # fully-masked rows: exp(_NEG - _NEG) would be 1 — the mask kills them
+        p = jnp.exp(scores - m) * masked
+        l = jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-20)
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", p / l, vg.astype(jnp.float32))
+        out = out.reshape(b, s, nh, d).astype(q.dtype)
 
     # all-to-all #2: scatter the sequence back, gather this shard's heads
     return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)

@@ -238,3 +238,92 @@ def test_long_sequence_parity(cp_topology, variant):
                                sm_scale=1.0 / np.sqrt(d))
     )(q, k, v, seg)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5, rtol=3e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False], ids=["causal", "bidir"])
+def test_ring_kv_chunking_exact(cp_topology, causal):
+    """The inner K/V chunking (blockwise score tiles instead of a full
+    (s_loc x s_loc) tensor) must be numerically EXACT vs the unchunked
+    path: force chunk=2 so each ring step runs a 4-step inner scan, and
+    compare fwd + grads against the XLA reference on packed data."""
+    import importlib
+
+    ring_mod = importlib.import_module("scaling_tpu.ops.ring_attention")
+    assert ring_mod._kv_chunk(S // 4, 2) == 2  # s_loc=8 -> 4 chunks per block
+
+    q, k, v = make_qkv(3)
+    seg = jnp.asarray(
+        np.concatenate([np.zeros((B, 13)), np.ones((B, 11)), 2 * np.ones((B, 8))], axis=1),
+        jnp.int32,
+    )
+    ref = xla_reference(q, k, v, seg, causal)
+    out = jax.jit(
+        lambda q, k, v, s: ring_attention(
+            q, k, v, s, cp_topology.mesh, causal=causal,
+            sm_scale=1.0 / np.sqrt(D), kv_chunk=2,
+        )
+    )(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(
+            jnp.sin(
+                ring_attention(q, k, v, seg, cp_topology.mesh, causal=causal,
+                               sm_scale=1.0 / np.sqrt(D), kv_chunk=2)
+            )
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(xla_reference(q, k, v, seg, causal)))
+
+    g_ring = jax.jit(jax.grad(loss_ring, (0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5)
+
+
+def test_ring_kv_chunk_divisor():
+    import importlib
+
+    ring_mod = importlib.import_module("scaling_tpu.ops.ring_attention")
+    for s in (1, 2, 7, 512, 1024, 2048, 3000, 8192):
+        c = ring_mod._kv_chunk(s)
+        assert s % c == 0 and c <= max(ring_mod._DEFAULT_KV_CHUNK, 1), (s, c)
+    assert ring_mod._kv_chunk(8192) == 1024
+    assert ring_mod._kv_chunk(3000) == 1000  # largest divisor <= 1024
+    assert ring_mod._kv_chunk(7) == 7
+    assert ring_mod._kv_chunk(1024, 128) == 128  # explicit request wins
+    # sliver-divisor cliff: a prime s_loc gets ONE full tile, not an
+    # s_loc-step scan of 1-wide einsums
+    assert ring_mod._kv_chunk(8191) == 8191
+    assert ring_mod._kv_chunk(2 * 3 * 43) == 258
+
+
+def test_ring_backward_memory_bounded_by_chunk(cp_topology):
+    """The custom-VJP memory claim, measured: the compiled GRADIENT's temp
+    memory must shrink when the K/V chunk shrinks — autodiff of the
+    forward scan would instead stack per-chunk residuals and grow with
+    1/chunk. Shape chosen so the (s_loc x chunk) score tile dominates.
+    kv_chunk rides the trace as a static argument precisely so this knob
+    cannot be silently ignored by a cached trace."""
+    s, n, d = 4096, 1, 8  # s_loc = 1024 per ring device
+    q = jnp.ones((2, s, n, d), jnp.float32)  # batch divides the data axis
+    seg = jnp.zeros((2, s), jnp.int32)
+
+    def grad_fn(chunk):
+        def f(q, k, v):
+            return jax.grad(
+                lambda q, k, v: jnp.sum(
+                    ring_attention(q, k, v, seg, cp_topology.mesh, causal=True,
+                                   sm_scale=1.0, kv_chunk=chunk)
+                ),
+                (0, 1, 2),
+            )(q, k, v)
+        return f
+
+    temp = {}
+    for chunk in (1024, 128):
+        compiled = jax.jit(grad_fn(chunk)).lower(q, q, q).compile()
+        temp[chunk] = compiled.memory_analysis().temp_size_in_bytes
+    # tile: (1024 x 1024) f32 = 4M vs (1024 x 128) = 512K per buffer
+    assert temp[128] < 0.7 * temp[1024], temp
