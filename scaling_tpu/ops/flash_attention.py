@@ -23,9 +23,10 @@ Block sizes default to 1024/1024 (fastest fwd+bwd in the v5e micro-sweep;
 can be overridden via ``SCALING_TPU_FLASH_BLOCK_Q`` /
 ``SCALING_TPU_FLASH_BLOCK_KV``.
 
-Unsupported cases (KV cache decode, attention-score manipulation,
-probability dropout, local-window heads, non-causal) stay on the XLA path
-in ``nn/attention.py`` — mirroring the reference's flash/torch kernel
+Local-window heads are fused too (per-head LocalMask in the splash mask
+set). Unsupported cases (KV cache decode, attention-score manipulation,
+probability dropout, non-causal) stay on the XLA path in
+``nn/attention.py`` — mirroring the reference's flash/torch kernel
 switch (masked_softmax_config.py:8-37).
 """
 

@@ -23,7 +23,7 @@ from typing import Any, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from pydantic import Field
+from pydantic import Field, model_validator
 
 from ..config import BaseConfig
 from ..nn.param import ParamMeta
@@ -68,6 +68,31 @@ class OptimizerConfig(BaseConfig):
         description="enable zero stage 1: shard fp32 master weights and moments "
         "over the data axis",
     )
+    zero_stage: int = Field(
+        1,
+        description="with zero enabled: 1 shards only optimizer state "
+        "(reference surface); 3 additionally shards the COMPUTE params over "
+        "the data axis (FSDP — beyond the reference), with GSPMD inserting "
+        "the per-use all-gather and the grad reduce-scatter. Stage 2 is "
+        "implicit in SPMD (grads never materialize unsharded) and is "
+        "rejected.",
+        ge=1,
+        le=3,
+    )
+
+    @model_validator(mode="after")
+    def _validate_zero_stage(self):
+        if self.zero_stage == 2:
+            raise ValueError(
+                "zero_stage 2 is implicit under GSPMD (gradients are "
+                "reduce-scattered, never materialized unsharded); use 1 or 3"
+            )
+        if self.zero_stage != 1 and not self.zero:
+            raise ValueError(
+                f"zero_stage {self.zero_stage} requires zero: true — "
+                "without it the stage setting would silently no-op"
+            )
+        return self
     zero_save_static: bool = Field(
         False,
         description="kept for config parity (reference optimizer_config.py:36): "
@@ -164,32 +189,22 @@ class Optimizer:
 
     # --------------------------------------------------------------- state
     def _master_sharding(self, meta: ParamMeta, shape: tuple):
-        """ZeRO-1: additionally shard the master/moments over the data axis.
-
-        The first dimension not already sharded by the param's own spec that
-        divides by dp gets the data axis. Falls back to the param's spec.
-        """
+        """ZeRO: additionally shard the master/moments over the data axis
+        (the rule shared with stage-3 param sharding — aligned placements
+        mean the master->param cast needs no resharding)."""
         from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.sharding import spec_with_data_axis
 
         if self.topology is None:
             return None
         spec = list(meta.partition_spec)
         while len(spec) < len(shape):
             spec.append(None)
-        used_axes = {
-            a
-            for entry in spec
-            if entry is not None
-            for a in (entry if isinstance(entry, tuple) else (entry,))
-        }
-        if self.config.zero and DATA_AXIS not in used_axes:
-            # expert-parallel params already consume the data axis; a mesh
-            # axis can appear at most once in a sharding spec
-            dp = self.topology.data_parallel_size
-            for d in range(len(shape)):
-                if spec[d] is None and shape[d] % max(dp, 1) == 0 and dp > 1:
-                    spec[d] = DATA_AXIS
-                    break
+        if self.config.zero:
+            spec = spec_with_data_axis(
+                spec, shape, self.topology.data_parallel_size
+            )
         return NamedSharding(self.topology.mesh, P(*spec))
 
     def init_state(self, params: Any) -> OptimizerState:

@@ -554,20 +554,31 @@ class ParallelModule:
 
         return jax.jit(fwd)
 
-    def shard_params(self, params: dict) -> dict:
-        """Place params on the mesh according to their metas."""
+    def shard_params(self, params: dict, fsdp_data_axis: bool = False) -> dict:
+        """Place params on the mesh according to their metas.
+
+        ``fsdp_data_axis`` (ZeRO stage 3) additionally shards every param
+        over the data axis on its last free divisible dim — GSPMD inserts
+        the per-use all-gather in forward/backward and the transposed
+        reduce-scatter for the grads, so per-device parameter memory drops
+        by ~dp while the step math is unchanged."""
         if self.topology is None:
             return params
-        from jax.sharding import NamedSharding
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .sharding import spec_with_data_axis
 
         metas = self.param_metas()
+        dp = self.topology.data_parallel_size if fsdp_data_axis else 1
+
+        def place(p, m):
+            spec = m.partition_spec
+            if fsdp_data_axis:
+                spec = spec_with_data_axis(spec, p.shape, dp)
+            return jax.device_put(p, NamedSharding(self.topology.mesh, P(*spec)))
+
         return jax.tree.map(
-            lambda p, m: jax.device_put(
-                p, NamedSharding(self.topology.mesh, m.spec())
-            ),
-            params,
-            metas,
-            is_leaf=lambda x: isinstance(x, ParamMeta),
+            place, params, metas, is_leaf=lambda x: isinstance(x, ParamMeta)
         )
 
     def shard_batch(self, batch: Any, stacked: bool = True) -> Any:

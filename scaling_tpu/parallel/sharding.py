@@ -30,6 +30,35 @@ def constrain(x: jax.Array, mesh: Optional[Mesh], *spec) -> jax.Array:
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
 
+def spec_with_data_axis(spec, shape, dp: int):
+    """Extend a partition spec with DATA_AXIS on the LAST free dim whose
+    size is divisible by ``dp`` — the ZeRO/FSDP sharding rule shared by the
+    optimizer's master/moment placement (stage 1) and the compute params
+    themselves (stage 3). Returns the spec unchanged when the data axis is
+    already consumed (e.g. expert-parallel params) or no dim divides.
+
+    Last-free-dim (the innermost weight dim) keeps per-layer slices of
+    stage-stacked pipeline bodies contiguous on their (pipe, layer)
+    leading dims, so GSPMD's per-use all-gather stays a plain collective
+    rather than a strided reshard."""
+    spec = list(spec)
+    while len(spec) < len(shape):
+        spec.append(None)
+    used = {
+        a
+        for entry in spec
+        if entry is not None
+        for a in (entry if isinstance(entry, tuple) else (entry,))
+    }
+    if dp <= 1 or DATA_AXIS in used:
+        return tuple(spec)
+    for d in reversed(range(len(shape))):
+        if spec[d] is None and shape[d] % dp == 0 and shape[d] > 0:
+            spec[d] = DATA_AXIS
+            break
+    return tuple(spec)
+
+
 def _seq_axis(mesh: Optional[Mesh]):
     """Sequence dims shard over the context axis when it exists (ring
     attention context parallelism); None otherwise."""

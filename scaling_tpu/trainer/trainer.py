@@ -181,7 +181,13 @@ class BaseTrainer:
             else p,
             params,
         )
-        self.params = self.module.shard_params(params)
+        opt_cfg = getattr(self.optimizer, "config", None)
+        fsdp = bool(
+            opt_cfg is not None
+            and getattr(opt_cfg, "zero", False)
+            and getattr(opt_cfg, "zero_stage", 1) == 3
+        )
+        self.params = self.module.shard_params(params, fsdp_data_axis=fsdp)
         self.opt_state = self.optimizer.init_state(self.params)
 
         loaded = False
