@@ -79,6 +79,13 @@ class OptimizerConfig(BaseConfig):
         ge=1,
         le=3,
     )
+    zero_save_static: bool = Field(
+        False,
+        description="kept for config parity (reference optimizer_config.py:36): "
+        "checkpoints here always save per-layer unsharded arrays, so there is "
+        "no merge step to skip",
+    )
+    debug_log: bool = Field(False, description="per-parameter grad/weight norms")
 
     @model_validator(mode="after")
     def _validate_zero_stage(self):
@@ -93,13 +100,6 @@ class OptimizerConfig(BaseConfig):
                 "without it the stage setting would silently no-op"
             )
         return self
-    zero_save_static: bool = Field(
-        False,
-        description="kept for config parity (reference optimizer_config.py:36): "
-        "checkpoints here always save per-layer unsharded arrays, so there is "
-        "no merge step to skip",
-    )
-    debug_log: bool = Field(False, description="per-parameter grad/weight norms")
 
 
 AdamWOptimizerConfig = OptimizerConfig  # reference alias
@@ -198,9 +198,7 @@ class Optimizer:
 
         if self.topology is None:
             return None
-        spec = list(meta.partition_spec)
-        while len(spec) < len(shape):
-            spec.append(None)
+        spec = meta.partition_spec
         if self.config.zero:
             spec = spec_with_data_axis(
                 spec, shape, self.topology.data_parallel_size

@@ -181,12 +181,8 @@ class BaseTrainer:
             else p,
             params,
         )
-        opt_cfg = getattr(self.optimizer, "config", None)
-        fsdp = bool(
-            opt_cfg is not None
-            and getattr(opt_cfg, "zero", False)
-            and getattr(opt_cfg, "zero_stage", 1) == 3
-        )
+        opt_cfg = self.optimizer.config
+        fsdp = opt_cfg.zero and opt_cfg.zero_stage == 3
         self.params = self.module.shard_params(params, fsdp_data_axis=fsdp)
         self.opt_state = self.optimizer.init_state(self.params)
 
