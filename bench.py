@@ -197,14 +197,90 @@ def synth_batch(rng: np.random.Generator, batch: int, seq_len: int, vocab: int, 
 
 
 def checked_devices():
-    """First device contact, watchdogged: a hung bench records nothing, so
-    an unreachable backend aborts with an explicit message instead."""
+    """First device contact, tunnel-proof.
+
+    A dead instant must not zero a round's perf evidence (it did, twice:
+    BENCH_r02 and BENCH_r03 are both ``rc=1`` single-shot aborts). An
+    unreachable backend is therefore retried every ~3 min up to a
+    ``BENCH_WAIT_S`` budget (default 30 min) before aborting.
+
+    Probes run in fresh subprocesses because a hung in-process backend
+    init holds jax's backend lock forever — one dead-tunnel contact would
+    taint every later in-process attempt. Only after a subprocess confirms
+    the link does this process initialize its own backend.
+    """
+    import subprocess
+
     from scaling_tpu.devices import probe_devices
 
-    devs, err = probe_devices(timeout_s=60.0)
-    if devs is None:
-        sys.exit(f"# bench: device backend unreachable ({err}); aborting")
-    return devs
+    budget = float(os.environ.get("BENCH_WAIT_S", "1800"))
+    deadline = time.monotonic() + budget
+    probe_src = (
+        "import sys; from scaling_tpu.devices import probe_devices; "
+        "devs, err = probe_devices(timeout_s=60); "
+        "print(err or '', file=sys.stderr); "
+        "sys.exit(0 if devs is not None else 1)"
+    )
+    # the probe imports scaling_tpu, which is not pip-installed: anchor the
+    # subprocess to the repo root so `python /path/to/bench.py` works from
+    # any cwd
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    last_err = "no probe ran"
+    while True:
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", probe_src],
+                timeout=120,
+                capture_output=True,
+                text=True,
+                cwd=repo_root,
+            )
+            ok = proc.returncode == 0
+            if not ok:
+                tail = proc.stderr.strip().splitlines()[-3:]
+                last_err = "subprocess probe failed: " + (" | ".join(tail) or "?")
+        except subprocess.TimeoutExpired:
+            ok, last_err = False, "subprocess probe timed out"
+        if ok:
+            devs, err = probe_devices(timeout_s=60.0)
+            if devs is not None:
+                return devs
+            if not isinstance(err, str):
+                # init RAISED (returned, no hang): the process is clean —
+                # a transient RPC flap belongs in the ordinary retry loop
+                last_err = f"in-process init raised after probe OK ({err})"
+            else:
+                # a hung in-process init (timeout: err is the description
+                # string) leaves a daemon thread holding jax's backend
+                # lock forever — this process is tainted and every further
+                # in-process attempt would be futile. Re-exec once with
+                # the remaining budget; a second taint aborts.
+                if os.environ.get("_BENCH_REEXECED"):
+                    sys.exit(
+                        f"# bench: in-process backend init hung twice "
+                        f"after probes succeeded ({err}); aborting"
+                    )
+                remaining = max(deadline - time.monotonic(), 0)
+                print(
+                    f"# bench: in-process init hung after probe OK ({err}); "
+                    f"re-execing with {remaining:.0f}s budget",
+                    file=sys.stderr,
+                )
+                os.environ["_BENCH_REEXECED"] = "1"
+                os.environ["BENCH_WAIT_S"] = str(remaining)
+                os.execv(sys.executable, [sys.executable] + sys.argv)
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            sys.exit(
+                f"# bench: device backend unreachable after {budget:.0f}s "
+                f"of retries ({last_err}); aborting"
+            )
+        print(
+            f"# bench: backend unreachable ({last_err}); retrying, "
+            f"{remaining:.0f}s left in BENCH_WAIT_S window",
+            file=sys.stderr,
+        )
+        time.sleep(min(180.0, remaining))
 
 
 def main() -> None:
