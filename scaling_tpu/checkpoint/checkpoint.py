@@ -189,12 +189,16 @@ def load_model_checkpoint(
     allowed_missing_keys: Optional[List[str]] = None,
     allowed_unexpected_keys: Optional[List[str]] = None,
     ignore_keys: Optional[List[str]] = None,
+    restored_keys: Optional[set] = None,
 ) -> Any:
     """Returns a new params tree with checkpoint values loaded by key.
 
     Missing/unexpected keys raise unless matched by the corresponding
     allow-list regexes; ``ignore_keys`` keeps current (re-initialised)
-    values even when the checkpoint has them.
+    values even when the checkpoint has them. When ``restored_keys`` is a
+    set, the meta key of every leaf actually taken from the checkpoint is
+    added to it (callers use this to tell restored from re-initialised
+    subtrees, e.g. the pretrained-CLIP splice gate).
     """
     path = Path(dir)
     allowed_missing = _compile_patterns(allowed_missing_keys)
@@ -227,6 +231,8 @@ def load_model_checkpoint(
         if key not in available or _matches_any(key, ignore):
             new_leaves.append(p)
             continue
+        if restored_keys is not None:
+            restored_keys.add(key)
         f, name = available[key]
         if f not in cache:
             cache[f] = np.load(f)

@@ -66,6 +66,7 @@ def restore_orbax_params(
     allowed_missing_keys: Any = None,
     allowed_unexpected_keys: Any = None,
     ignore_keys: Any = None,
+    restored_keys: Any = None,
 ) -> Any:
     """Restore the param view tree, re-sharded to ``params_view_like``'s
     current layout (orbax reads each shard from tensorstore).
@@ -153,6 +154,8 @@ def restore_orbax_params(
                     f"shape mismatch for {key_by_path[path]}: checkpoint "
                     f"{tuple(md.shape)} vs model {tuple(cur.shape)}"
                 )
+            if restored_keys is not None:
+                restored_keys.add(key_by_path[path])
             node = subset
             parts = [str(getattr(k, "key", k)) for k in path]
             for k in parts[:-1]:

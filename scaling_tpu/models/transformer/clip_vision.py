@@ -54,7 +54,14 @@ def _linear_init(key, d_in, d_out, dtype):
 
 
 def _linear_metas() -> dict:
-    return {"weight": replicated_meta(2), "bias": replicated_meta(1)}
+    # leaf metas carry their own names: checkpoint keys are built by
+    # prefixing, and a nameless leaf would collapse every parameter of a
+    # subtree onto the same key (observed: all 16 block leaves colliding
+    # to one "block_i" npz entry)
+    return {
+        "weight": replicated_meta(2, parameter_name="weight"),
+        "bias": replicated_meta(1, parameter_name="bias"),
+    }
 
 
 def _norm_init(width, dtype):
@@ -116,21 +123,33 @@ class ClipVisionEncoder(BaseLayer):
         return params
 
     def param_metas(self) -> dict:
-        norm_metas = {"weight": replicated_meta(1, no_weight_decay=True),
-                      "bias": replicated_meta(1, no_weight_decay=True)}
+        def norm_metas():
+            return {
+                "weight": replicated_meta(
+                    1, no_weight_decay=True, parameter_name="weight"
+                ),
+                "bias": replicated_meta(
+                    1, no_weight_decay=True, parameter_name="bias"
+                ),
+            }
+
+        def named(tree: dict) -> dict:
+            return {k: tree_prefix(v, k) for k, v in tree.items()}
+
         metas: dict = {
             "class_embedding": replicated_meta(1),
             "patch_embedding": replicated_meta(2),
             "position_embedding": replicated_meta(2),
-            "pre_norm": norm_metas,
+            "pre_norm": norm_metas(),
         }
         for i in range(self.num_layers):
-            metas[f"block_{i}"] = {
-                "ln1": norm_metas, "q": _linear_metas(), "k": _linear_metas(),
-                "v": _linear_metas(), "out": _linear_metas(), "ln2": norm_metas,
+            metas[f"block_{i}"] = named({
+                "ln1": norm_metas(), "q": _linear_metas(), "k": _linear_metas(),
+                "v": _linear_metas(), "out": _linear_metas(),
+                "ln2": norm_metas(),
                 "fc1": _linear_metas(), "fc2": _linear_metas(),
-            }
-        return {k: tree_prefix(v, k) for k, v in metas.items()}
+            })
+        return named(metas)
 
     def _attn(self, p: dict, x: jax.Array) -> jax.Array:
         b, t, w = x.shape

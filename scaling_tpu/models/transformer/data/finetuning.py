@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ....data.base_dataset import BaseDataset
+from ....logging import logger
 from ....data.blended_dataset import BaseBlendedDataset
 from ....data.memory_map import MemoryMapDataset
 from ..tokenizer import Tokenizer, load_tokenizers
@@ -259,6 +260,7 @@ class FinetuningChatDataset(_FinetuningBase):
             path = path.with_suffix(".jsonl")
         self._samples: List[Dict[str, Any]] = []
         eos = self.tokenizer.eos_token_id
+        missing_eos = 0
         for line in Path(path).read_text().splitlines():
             if not line.strip():
                 continue
@@ -293,12 +295,7 @@ class FinetuningChatDataset(_FinetuningBase):
             # too); image placeholders reuse the eos id, so only text
             # elements count
             if eos is not None and not has_text_eos:
-                import warnings
-
-                warnings.warn(
-                    "finetuning_chat_dataset does not add EOS automatically; "
-                    "append it in your data.jsonl"
-                )
+                missing_eos += 1
             self._samples.append(
                 {
                     "input": tokens[:-1],
@@ -307,6 +304,13 @@ class FinetuningChatDataset(_FinetuningBase):
                     "image_paths": image_paths,
                     "image_locations": image_locations,
                 }
+            )
+        if missing_eos:
+            logger.warning(
+                f"finetuning_chat_dataset does not add EOS automatically; "
+                f"{missing_eos}/{len(self._samples)} samples in {path} carry "
+                f"no EOS token — append it in your data.jsonl if completions "
+                f"should terminate"
             )
         self.max_images = max(
             (len(s["image_paths"]) for s in self._samples), default=0

@@ -169,27 +169,57 @@ def main(config: TransformerConfig) -> TransformerTrainer:
         batch_to_model_input=batch_to_model_input,
         profiler=Profiler(config.profiler),
     )
-    trainer.initialize(
-        load_checkpoint=config.trainer.load_dir is not None
-    )
-    clip_ckpt = config.transformer_architecture.image_encoder_clip_checkpoint
-    if clip_ckpt is not None:
-        _apply_pretrained_clip(trainer, module, clip_ckpt)
-    trainer.run_training()
+    from ...determined import DeterminedGlue
+
+    glue = DeterminedGlue.detect()
+    try:
+        if glue is None:
+            trainer.initialize(load_checkpoint=config.trainer.load_dir is not None)
+        else:
+            # under Determined the experiment's own latest checkpoint wins
+            # over the configured load_dir (reference: trainer.py:416-428)
+            glue.attach(trainer)
+            with glue.latest_checkpoint() as det_ckpt:
+                trainer.initialize(
+                    load_checkpoint=(
+                        det_ckpt is not None or config.trainer.load_dir is not None
+                    ),
+                    load_dir=det_ckpt,
+                )
+        clip_ckpt = config.transformer_architecture.image_encoder_clip_checkpoint
+        if clip_ckpt is not None:
+            _apply_pretrained_clip(trainer, module, clip_ckpt)
+        trainer.run_training()
+    finally:
+        if glue is not None:
+            glue.close()
     return trainer
 
 
 def _apply_pretrained_clip(trainer, module, path) -> None:
     """Splice pretrained CLIP vision weights into the image-encoder trunk
     at startup (reference: clip.py constructs its trunk pretrained). Skipped
-    on RESUME — the trained trunk is in the checkpoint; applied on fresh
-    runs and finetunes-from-LM-checkpoints, overwriting whatever the trunk
-    held. Optimizer masters re-derive so the first step can't revert it."""
+    whenever the loaded checkpoint already restored image-encoder weights
+    (resume OR finetune-with-load_context=False — either way the trained
+    trunk is in the checkpoint); applied on fresh runs and
+    finetunes-from-LM-only-checkpoints. Optimizer masters for the spliced
+    subtree re-derive so the first step can't revert it; moments loaded
+    for the REST of the model are kept."""
     from pathlib import Path
 
     if trainer.context.iterations > 0:
         logger.info(f"resume at step {trainer.context.iterations}: "
                     "skipping pretrained CLIP splice (trunk is in the checkpoint)")
+        return
+    restored = trainer.restored_model_keys or set()
+    # gate on the TRUNK specifically: a checkpoint restoring only the
+    # shared non-trunk pieces (image_encoder.proj / final_norm) must not
+    # suppress the splice the config explicitly asked for
+    if any("image_encoder.clip" in k for k in restored):
+        logger.info(
+            "loaded checkpoint already restored the CLIP trunk; "
+            "skipping pretrained CLIP splice"
+        )
         return
     import torch
 
@@ -217,7 +247,36 @@ def _apply_pretrained_clip(trainer, module, path) -> None:
         trainer.params = {
             **trainer.params, name: {**emb_params, "image_encoder": placed},
         }
-        trainer.opt_state = trainer.optimizer.init_state(trainer.params)
+        if trainer.optimizer_states_loaded:
+            # the splice only replaced the clip TRUNK (load_clip_weights
+            # leaves proj/final_norm untouched), so only that subtree gets
+            # fresh masters/zero moments; loaded moments everywhere else —
+            # including image_encoder.proj/final_norm — are kept. `only`
+            # keeps the rest of the fresh tree at cheap placeholders, so
+            # no full fp32 transient on big models.
+            fresh = trainer.optimizer.init_state(
+                trainer.params,
+                only=lambda m: "image_encoder.clip" in m.parameter_name,
+            )
+
+            def graft(dst, src):
+                enc = dst[name]["image_encoder"]
+                fresh_enc = src[name]["image_encoder"]
+                return {
+                    **dst,
+                    name: {
+                        **dst[name],
+                        "image_encoder": {**enc, "clip": fresh_enc["clip"]},
+                    },
+                }
+
+            trainer.opt_state = trainer.opt_state._replace(
+                master=graft(trainer.opt_state.master, fresh.master),
+                exp_avg=graft(trainer.opt_state.exp_avg, fresh.exp_avg),
+                exp_avg_sq=graft(trainer.opt_state.exp_avg_sq, fresh.exp_avg_sq),
+            )
+        else:
+            trainer.opt_state = trainer.optimizer.init_state(trainer.params)
         logger.info(f"loaded pretrained CLIP vision weights from {path}")
         return
     raise ValueError(

@@ -32,6 +32,7 @@ score tile — sequence length scales linearly with the ring size.
 from __future__ import annotations
 
 import functools
+import warnings
 from functools import partial
 from typing import Optional
 
@@ -60,6 +61,17 @@ def _kv_chunk(s_loc: int, requested: Optional[int] = None) -> int:
             if c >= min(128, cap):
                 return c
             break
+    if s_loc > cap:
+        # the memory bound the chunking exists for is silently gone: the
+        # score tile regresses to (s_loc x s_loc). Long-context configs
+        # must hear about it — pick a per-device sequence length with a
+        # divisor in [128, chunk] to restore the bound.
+        warnings.warn(
+            f"ring attention: per-device sequence length {s_loc} has no "
+            f"divisor in [{min(128, cap)}, {cap}]; falling back to one "
+            f"full ({s_loc} x {s_loc}) score tile per step, losing the "
+            f"chunked memory bound"
+        )
     return s_loc
 
 

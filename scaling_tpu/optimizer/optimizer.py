@@ -205,7 +205,15 @@ class Optimizer:
             )
         return NamedSharding(self.topology.mesh, P(*spec))
 
-    def init_state(self, params: Any) -> OptimizerState:
+    def init_state(self, params: Any, only=None) -> OptimizerState:
+        """Fresh state (fp32 masters from ``params``, zero moments).
+
+        ``only`` (an optional ``ParamMeta -> bool`` predicate) limits real
+        allocation to matching leaves; the rest get the same cheap ``(0,)``
+        placeholders as frozen params. Callers that graft a fresh SUBTREE
+        into loaded state (the pretrained-CLIP splice) use it to avoid
+        transiently materializing 12 bytes/param for the whole model."""
+
         def make_master(p, m, gi):
             # explicit copy: astype is a no-op for fp32 params and the master
             # must not alias the compute params (donation would double-free)
@@ -219,9 +227,10 @@ class Optimizer:
         # same buffer donated many times in the jitted step (XLA rejects it)
         empty = lambda: jnp.zeros((0,), dtype=jnp.float32)  # noqa: E731
         for p, m, gi in zip(p_leaves, self._meta_leaves, self._group_index):
-            if gi < 0:
-                # frozen: no fp32 master or moments — a 7B frozen backbone
-                # would otherwise burn 12 bytes/param of device memory
+            if gi < 0 or (only is not None and not only(m)):
+                # frozen (or outside the requested subtree): no fp32 master
+                # or moments — a 7B frozen backbone would otherwise burn
+                # 12 bytes/param of device memory
                 masters.append(empty())
                 avgs.append(empty())
                 avg_sqs.append(empty())

@@ -161,6 +161,12 @@ class BaseTrainer:
 
         self.params: Any = None
         self.opt_state: Optional[OptimizerState] = None
+        # bookkeeping from the last load_checkpoint: which model keys were
+        # actually taken from the checkpoint (None = no checkpoint loaded)
+        # and whether optimizer moments survived the load — startup splices
+        # (pretrained CLIP) gate on these
+        self.restored_model_keys: Optional[set] = None
+        self.optimizer_states_loaded: bool = False
         self._ckpt_writer: Optional[AsyncCheckpointWriter] = None
         self._prefetch_queue: Any = None
         self._prefetch_thread: Any = None
@@ -169,9 +175,18 @@ class BaseTrainer:
         self._eval_step = None
         self.dataloader: Optional[DataLoader] = None
         self.dataloader_evaluation: Optional[DataLoader] = None
+        # generic cluster hook points (Determined glue attaches here; any
+        # scheduler integration can): an extra preemption predicate polled
+        # every step, metric sinks called after logging, and checkpoint
+        # sinks called with each finished step dir
+        self.external_preemption: Optional[Callable[[], bool]] = None
+        self.metrics_hooks: List[Callable[[dict, int], None]] = []
+        self.checkpoint_hooks: List[Callable[[Path, int], None]] = []
 
     # ------------------------------------------------------------ lifecycle
-    def initialize(self, load_checkpoint: bool = True) -> None:
+    def initialize(
+        self, load_checkpoint: bool = True, load_dir: Optional[Path | str] = None
+    ) -> None:
         self.context.initialize(self.config.seed)
         key = self.context.rng.key("model_init")
         params = self.module.init_params(key)
@@ -187,11 +202,12 @@ class BaseTrainer:
         self.opt_state = self.optimizer.init_state(self.params)
 
         loaded = False
-        if load_checkpoint and self.config.load_dir is not None:
-            loaded = self.load_checkpoint(self.config.load_dir)
+        load_dir = load_dir or self.config.load_dir
+        if load_checkpoint and load_dir is not None:
+            loaded = self.load_checkpoint(load_dir)
             if self.config.assert_checkpoint_loaded and not loaded:
                 raise AssertionError(
-                    f"could not load checkpoint from {self.config.load_dir}"
+                    f"could not load checkpoint from {load_dir}"
                 )
 
         self._build_dataloaders()
@@ -362,10 +378,13 @@ class BaseTrainer:
         assert self.config.train_iterations is not None
         while self.context.iterations < self.config.train_iterations:
             output = self.train_step()
-            if getattr(self, "_preempted", False):
+            if getattr(self, "_preempted", False) or (
+                self.external_preemption is not None and self.external_preemption()
+            ):
                 if self.config.save_dir is not None:
-                    self.save_checkpoint()
+                    step_dir = self.save_checkpoint()
                     self.finalize_checkpoints()
+                    self._run_checkpoint_hooks(step_dir)
                     logger.info("preemption: checkpoint saved, exiting cleanly")
                 return
             if (
@@ -373,7 +392,8 @@ class BaseTrainer:
                 and self.config.save_interval is not None
                 and self.context.iterations % self.config.save_interval == 0
             ):
-                self.save_checkpoint()
+                step_dir = self.save_checkpoint()
+                self._run_checkpoint_hooks(step_dir)
             if (
                 self.config.eval_interval is not None
                 and self.dataset_evaluation is not None
@@ -397,7 +417,26 @@ class BaseTrainer:
             if log_metrics_fn is not None:
                 metrics = log_metrics_fn(self, output, metrics)
             logger.log_metrics(metrics, self.context.iterations)
+            for hook in self.metrics_hooks:
+                try:
+                    hook(metrics, self.context.iterations)
+                except Exception as e:
+                    # reporting must never abort a training step
+                    logger.warning(f"metrics hook failed: {e}")
         self.finalize_checkpoints()
+
+    def _run_checkpoint_hooks(self, step_dir: Path) -> None:
+        if not self.checkpoint_hooks:
+            return
+        if self._ckpt_writer is not None:
+            # hooks must see a durable checkpoint, not an in-flight async
+            # write — a torn copy must never leave the machine
+            self._ckpt_writer.wait()
+        for hook in self.checkpoint_hooks:
+            try:
+                hook(step_dir, self.context.iterations)
+            except Exception as e:
+                logger.warning(f"checkpoint hook failed: {e}")
 
     # ----------------------------------------------------------- checkpoint
     def finalize_checkpoints(self) -> None:
@@ -511,7 +550,7 @@ class BaseTrainer:
             },
         )
 
-    def _restore_orbax_params(self, step_dir: Path, metas):
+    def _restore_orbax_params(self, step_dir: Path, metas, restored_keys=None):
         """Restore the param view tree, re-sharded to the CURRENT mesh
         layout (orbax reads each shard from tensorstore). Non-strict under
         the same allow-list regexes as the npz loader, so PEFT/LoRA loads
@@ -525,6 +564,7 @@ class BaseTrainer:
             allowed_missing_keys=self.config.allowed_missing_keys_in_checkpoint,
             allowed_unexpected_keys=self.config.allowed_unexpected_keys_in_checkpoint,
             ignore_keys=self.config.ignore_keys_in_checkpoint,
+            restored_keys=restored_keys,
         )
 
     def _restore_orbax_opt(self, step_dir: Path) -> OptimizerState:
@@ -583,8 +623,11 @@ class BaseTrainer:
                 "falling back to the npz files in the same step dir"
             )
         metas = self.module.ckpt_metas()
+        self.restored_model_keys = set()
         if orbax_backend:
-            params_view = self._restore_orbax_params(step_dir, metas)
+            params_view = self._restore_orbax_params(
+                step_dir, metas, restored_keys=self.restored_model_keys
+            )
         else:
             params_view = load_model_checkpoint(
                 step_dir,
@@ -593,6 +636,7 @@ class BaseTrainer:
                 allowed_missing_keys=self.config.allowed_missing_keys_in_checkpoint,
                 allowed_unexpected_keys=self.config.allowed_unexpected_keys_in_checkpoint,
                 ignore_keys=self.config.ignore_keys_in_checkpoint,
+                restored_keys=self.restored_model_keys,
             )
         self.params = self.module.ckpt_unview(params_view, self.params)
         merged_lora = False
@@ -626,18 +670,27 @@ class BaseTrainer:
                 optimizer_states_loaded = True
             except FileNotFoundError:
                 logger.warning(f"optimizer states absent in {step_dir}")
-            except (KeyError, ValueError, TypeError) as e:
-                if not orbax_backend:
-                    raise
+            except Exception as e:
                 # an orbax TREE MISMATCH (architecture/PEFT change) is the
                 # same situation as absent npz files: fall back to fresh
-                # state. I/O and data-corruption errors (OSError & friends)
-                # are NOT caught — a corrupt checkpoint must abort, not
-                # silently reset Adam moments.
+                # state. Orbax surfaces mismatches through a zoo of types
+                # (KeyError/ValueError/TypeError, AssertionError, its own
+                # classes), so the orbax branch treats every non-I/O error
+                # as a mismatch. I/O, memory and runtime errors are NOT
+                # caught — a corrupt checkpoint or an HBM OOM mid-restore
+                # (XLA's RESOURCE_EXHAUSTED is a RuntimeError subclass)
+                # must abort, not silently reset Adam moments. The npz
+                # path aborts on EVERY error, as before this fallback
+                # existed.
+                if isinstance(e, (OSError, MemoryError, RuntimeError)):
+                    raise
+                if not orbax_backend:
+                    raise
                 logger.warning(
                     f"orbax optimizer tree mismatch ({type(e).__name__}: {e}); "
                     "re-deriving fresh optimizer state"
                 )
+        self.optimizer_states_loaded = optimizer_states_loaded
         if not optimizer_states_loaded:
             # fp32 masters were copied from the random init; re-derive them
             # from the loaded params or the first step would revert the model

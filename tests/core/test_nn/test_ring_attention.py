@@ -294,8 +294,10 @@ def test_ring_kv_chunk_divisor():
     assert ring_mod._kv_chunk(7) == 7
     assert ring_mod._kv_chunk(1024, 128) == 128  # explicit request wins
     # sliver-divisor cliff: a prime s_loc gets ONE full tile, not an
-    # s_loc-step scan of 1-wide einsums
-    assert ring_mod._kv_chunk(8191) == 8191
+    # s_loc-step scan of 1-wide einsums — and the lost memory bound is
+    # announced, not silent
+    with pytest.warns(UserWarning, match="full .8191 x 8191. score tile"):
+        assert ring_mod._kv_chunk(8191) == 8191
     assert ring_mod._kv_chunk(2 * 3 * 43) == 258
 
 

@@ -166,3 +166,113 @@ def test_clip_checkpoint_applied_at_train_startup(tmp_path):
             break
     else:
         raise AssertionError("clip trunk parameter not found")
+
+
+def _splice_cfg(tmp_path, prefix, ckpt, **trainer_overrides):
+    from scaling_tpu.models.transformer import TransformerConfig
+
+    trainer = {"train_iterations": 1, "seed": 42,
+               "save_dir": str(tmp_path / "ckpt"), "save_interval": 1}
+    trainer.update(trainer_overrides)
+    return TransformerConfig.from_dict({
+        "topology": {"model_parallel_size": 1, "pipe_parallel_size": 1,
+                     "data_parallel_size": 1, "micro_batch_size": 2,
+                     "gradient_accumulation_steps": 1},
+        "transformer_architecture": {
+            "vocab_size": 96, "hidden_size": 32, "num_layers": 1,
+            "num_attention_heads": 4, "sequence_length": 160,
+            "image_encoder": True, "image_encoder_width": 64,
+            "image_encoder_layers": 2, "image_encoder_heads": 4,
+            "image_encoder_backbone": "clip",
+            "image_encoder_clip_checkpoint": str(ckpt),
+        },
+        "optimizer": {"gradient_clipping": 1.0},
+        "learning_rate_scheduler": {"learning_rate": 0.01,
+                                    "learning_rate_warmup_steps": 2,
+                                    "learning_rate_decay_iters": 50},
+        "trainer": trainer,
+        "data": {"data_prefixes": [str(prefix)]},
+        "logger": {"log_dir": None},
+    })
+
+
+def _text_data(tmp_path):
+    from scaling_tpu.data.memory_map import MemoryMapDatasetBuilder
+
+    prefix = tmp_path / "data"
+    rng = np.random.default_rng(5)
+    with MemoryMapDatasetBuilder(prefix, dtype=np.uint16) as b:
+        for _ in range(32):
+            doc = rng.integers(1, 96, size=rng.integers(8, 48))
+            b.add(np.append(doc, 0).astype(np.uint16))
+    return prefix
+
+
+def _trunk_class_embedding(trainer):
+    for key, p, _ in trainer.module.named_parameters(trainer.params):
+        if key.endswith("image_encoder.clip.class_embedding"):
+            return np.asarray(p, np.float32)
+    raise AssertionError("clip trunk parameter not found")
+
+
+def _max_abs_exp_avg(trainer):
+    return max(
+        float(np.max(np.abs(np.asarray(leaf))))
+        for leaf in jax.tree.leaves(trainer.opt_state.exp_avg)
+        if leaf.size
+    )
+
+
+def test_clip_splice_skipped_when_checkpoint_restored_trunk(tmp_path):
+    """A finetune that loads a checkpoint containing a trained trunk with
+    load_context=False (iterations stays 0) must NOT re-splice pretrained
+    CLIP over it, and must keep the loaded Adam moments."""
+    from scaling_tpu.models.transformer.train import main
+
+    prefix = _text_data(tmp_path)
+    model = tiny_hf_clip(image_size=384, intermediate=256)
+    ckpt = tmp_path / "clip_vision.pt"
+    torch.save(model.state_dict(), ckpt)
+    main(_splice_cfg(tmp_path, prefix, ckpt))  # trains 1 step, saves
+
+    # second run: same splice knob but pointing at a SHIFTED trunk — if the
+    # gate fails, the shift lands in the weights and the moments reset
+    shifted = {k: v + 1.0 if k == "vision_model.embeddings.class_embedding"
+               else v for k, v in model.state_dict().items()}
+    ckpt2 = tmp_path / "clip_vision_shifted.pt"
+    torch.save(shifted, ckpt2)
+    t2 = main(_splice_cfg(
+        tmp_path, prefix, ckpt2, train_iterations=0, save_dir=None,
+        load_dir=str(tmp_path / "ckpt"), load_context=False,
+    ))
+    want = model.state_dict()["vision_model.embeddings.class_embedding"].numpy()
+    got = _trunk_class_embedding(t2)
+    np.testing.assert_allclose(got, want, atol=1e-3)  # kept, not re-spliced
+    assert _max_abs_exp_avg(t2) > 0  # loaded moments survived
+
+
+def test_clip_splice_graft_keeps_loaded_moments(tmp_path):
+    """When the trunk is deliberately NOT restored (ignore_keys) the splice
+    applies — but only the image-encoder slice of the optimizer state
+    re-derives; the LM's loaded moments survive."""
+    from scaling_tpu.models.transformer.train import main
+
+    prefix = _text_data(tmp_path)
+    model = tiny_hf_clip(image_size=384, intermediate=256)
+    ckpt = tmp_path / "clip_vision.pt"
+    torch.save(model.state_dict(), ckpt)
+    main(_splice_cfg(tmp_path, prefix, ckpt))
+
+    shifted = {k: v + 1.0 if k == "vision_model.embeddings.class_embedding"
+               else v for k, v in model.state_dict().items()}
+    ckpt2 = tmp_path / "clip_vision_shifted.pt"
+    torch.save(shifted, ckpt2)
+    t2 = main(_splice_cfg(
+        tmp_path, prefix, ckpt2, train_iterations=0, save_dir=None,
+        load_dir=str(tmp_path / "ckpt"), load_context=False,
+        ignore_keys_in_checkpoint=[".*image_encoder.*"],
+    ))
+    want = (model.state_dict()["vision_model.embeddings.class_embedding"]
+            .numpy() + 1.0)
+    np.testing.assert_allclose(_trunk_class_embedding(t2), want, atol=1e-3)
+    assert _max_abs_exp_avg(t2) > 0  # LM moments kept through the graft
