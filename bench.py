@@ -128,7 +128,8 @@ def detect_hardware() -> HardwareType:
     return HardwareType.TPU_V5E  # CPU fallback: report against a modest peak
 
 
-def build(seq_len: int, micro_batch_size: int, hidden: int, layers: int):
+def build(seq_len: int, micro_batch_size: int, hidden: int, layers: int,
+          remat: bool = False):
     config = TransformerConfig.from_dict(
         {
             "topology": {
@@ -137,6 +138,11 @@ def build(seq_len: int, micro_batch_size: int, hidden: int, layers: int):
                 "data_parallel_size": 1,
                 "micro_batch_size": micro_batch_size,
                 "gradient_accumulation_steps": 1,
+                **(
+                    {"activation_checkpointing_type": "every_layer"}
+                    if remat
+                    else {}
+                ),
             },
             "transformer_architecture": {
                 "vocab_size": 32768,
@@ -285,16 +291,26 @@ def checked_devices():
 
 def main() -> None:
     seq_len = 2048
-    # ~0.5B: params bf16 + fp32 master/moments + fp32 grads ~ 9G, inside the
-    # 16G HBM of the smallest current chip (v5e)
-    hidden, layers = 2048, 8
+    # default ~0.5B: params bf16 + fp32 master/moments + fp32 grads ~ 9G,
+    # inside the 16G HBM of the smallest current chip (v5e)
+    hidden, layers, remat = 2048, 8, False
+    default_mbs_plan = [4, 8]
+    if os.environ.get("BENCH_MODEL") == "1b":
+        # BASELINE #3's 1B GQA+RoPE+SwiGLU shape. Single-chip this is an
+        # HBM long shot on v5e: fp32 master+moments + bf16 params alone
+        # are 14 bytes/param = 15.3G of the 16G — remat + mbs 1 give it
+        # its best chance, and an OOM records as the mbs-arm failure.
+        # (Per-chip fit of the ACTUAL BASELINE #3 layout, TP=2 x DP=4
+        # with ZeRO-1, is pinned in tests/transformer/test_hlo_cost_pins.)
+        hidden, layers, remat = 2048, 20, True
+        default_mbs_plan = [1, 2]
     on_tpu = checked_devices()[0].platform == "tpu"
     # BENCH_MBS pins the micro-batch; unset, the bench self-tunes: measure
-    # at 4 (known to fit), then try 8 — a bigger per-step batch amortizes
-    # overheads and widens MXU tiles — and keep whichever is faster per
-    # token (the driver runs plain `python bench.py` with no knobs)
+    # at the smallest plan entry, then try the next — a bigger per-step
+    # batch amortizes overheads and widens MXU tiles — and keep whichever
+    # is faster per token (the driver runs plain `python bench.py`)
     mbs_env = os.environ.get("BENCH_MBS")
-    mbs_plan = [int(mbs_env)] if mbs_env else ([4, 8] if on_tpu else [4])
+    mbs_plan = [int(mbs_env)] if mbs_env else (default_mbs_plan if on_tpu else [4])
     if not on_tpu:
         # keep the CPU smoke path fast; numbers only meaningful on TPU
         seq_len, hidden, layers = 512, 512, 4
@@ -314,7 +330,9 @@ def main() -> None:
             )
 
     def setup_and_warm(mbs):
-        config, topology, module, optimizer = build(seq_len, mbs, hidden, layers)
+        config, topology, module, optimizer = build(
+            seq_len, mbs, hidden, layers, remat=remat
+        )
         arch = config.transformer_architecture
         key = jax.random.PRNGKey(0)
         params = module.shard_params(module.init_params(key))
@@ -409,6 +427,7 @@ def main() -> None:
                 "params": param_count,
                 "step_ms": round(dt * 1000, 2),
                 "micro_batch_size": mbs,
+                "model": os.environ.get("BENCH_MODEL", "0.5b"),
                 # which attention kernel actually ran: the flash->XLA
                 # exception fallback sets BENCH_KERNEL, and off-TPU the
                 # layer itself falls back (flash_attention_supported), so
