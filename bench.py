@@ -295,7 +295,10 @@ def main() -> None:
     # inside the 16G HBM of the smallest current chip (v5e)
     hidden, layers, remat = 2048, 8, False
     default_mbs_plan = [4, 8]
-    if os.environ.get("BENCH_MODEL") == "1b":
+    bench_model = os.environ.get("BENCH_MODEL", "0.5b")
+    if bench_model not in ("0.5b", "1b"):
+        sys.exit(f"# bench: unknown BENCH_MODEL {bench_model!r} (0.5b|1b)")
+    if bench_model == "1b":
         # BASELINE #3's 1B GQA+RoPE+SwiGLU shape. Single-chip this is an
         # HBM long shot on v5e: fp32 master+moments + bf16 params alone
         # are 14 bytes/param = 15.3G of the 16G — remat + mbs 1 give it
@@ -427,7 +430,7 @@ def main() -> None:
                 "params": param_count,
                 "step_ms": round(dt * 1000, 2),
                 "micro_batch_size": mbs,
-                "model": os.environ.get("BENCH_MODEL", "0.5b"),
+                "model": bench_model,
                 # which attention kernel actually ran: the flash->XLA
                 # exception fallback sets BENCH_KERNEL, and off-TPU the
                 # layer itself falls back (flash_attention_supported), so

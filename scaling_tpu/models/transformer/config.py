@@ -247,19 +247,33 @@ class TransformerArchitectureConfig(BaseConfig):
         "vit",
         description="'vit' trains the patch backbone from scratch; 'clip' "
         "builds a CLIP-ViT trunk that loads pretrained huggingface "
-        "CLIPVisionModel weights — the pretrained-prior role of the "
-        "reference's CLIP RN50x16 (clip.py). Set "
+        "CLIPVisionModel weights; 'clip_resnet' builds the reference's "
+        "actual trunk — the CLIP ModifiedResNet (RN50x16 at the defaults, "
+        "clip.py) — so reference/magma vision checkpoints transfer. Set "
         "image_encoder_clip_checkpoint to load the weights at startup, or "
         "call ImageEncoder.load_clip_weights manually",
-        pattern="^(vit|clip)$",
+        pattern="^(vit|clip|clip_resnet)$",
+    )
+    image_encoder_resnet_stages: List[int] = Field(
+        [6, 8, 18, 8],
+        description="bottleneck blocks per stage for the clip_resnet "
+        "backbone (default: RN50x16); exactly 4 stages (CLIP layout)",
+        min_length=4,
+        max_length=4,
+    )
+    image_encoder_resnet_channels: int = Field(
+        96,
+        description="stem output channels for the clip_resnet backbone "
+        "(default: RN50x16; feature dim is 8*channels*4)",
+        gt=0,
     )
     image_encoder_clip_checkpoint: Optional[str] = Field(
         None,
         description="path to pretrained CLIP vision weights applied at "
         "train startup (fresh runs only, not resumes): a torch state_dict "
         "file (torch.load) or a local transformers CLIPVisionModel "
-        "directory; requires image_encoder_backbone='clip' with "
-        "width/layers matching the checkpoint",
+        "directory; requires a clip backbone with geometry matching the "
+        "checkpoint",
     )
     dropout_image_encoder: float = Field(
         0.0, description="dropout applied after the image encoder projection",

@@ -13,14 +13,17 @@ but replaces the convolutional backbone with a ViT-style patch encoder:
   (ParallelSelfAttention with causal=False), so TP sharding of the vision
   tower comes for free.
 
-Two backbones:
+Three backbones:
 - ``backbone="vit"`` (default): the from-scratch stack above, trained
   jointly with the language model;
 - ``backbone="clip"``: a faithful CLIP ViT trunk (``clip_vision.py``)
   that loads pretrained huggingface ``CLIPVisionModel`` weights via
   :meth:`ImageEncoder.load_clip_weights` — the pretrained-vision-prior
-  capability of the reference's CLIP RN50x16 (clip.py), re-based onto the
-  ViT family whose weights transfer to a TPU-first stack.
+  capability re-based onto the ViT family whose weights transfer to a
+  TPU-first stack;
+- ``backbone="clip_resnet"``: the reference's ACTUAL trunk — the CLIP
+  ModifiedResNet (RN50x16 at the defaults, ``clip_resnet.py``) — so
+  reference/magma vision checkpoints transfer unchanged.
 """
 
 from __future__ import annotations
@@ -103,14 +106,17 @@ class ImageEncoder(BaseLayer):
         dropout_p: float = 0.0,
         dtype=jnp.float32,
         backbone: str = "vit",
+        resnet_stages=(6, 8, 18, 8),
+        resnet_channels: int = 96,
     ):
         self.out_features = out_features
         self.width = width
         self.num_layers = layers
         self.dropout_p = dropout_p
         self.dtype = dtype
-        assert backbone in ("vit", "clip"), backbone
+        assert backbone in ("vit", "clip", "clip_resnet"), backbone
         self.backbone = backbone
+        trunk_dim = width
         if backbone == "clip":
             from .clip_vision import ClipVisionEncoder
 
@@ -118,6 +124,17 @@ class ImageEncoder(BaseLayer):
                 width=width, layers=layers, heads=heads,
                 patch_size=PATCH_SIZE, image_size=IMAGE_SIZE, dtype=dtype,
             )
+        elif backbone == "clip_resnet":
+            # the reference's actual trunk, ClipRN50x16 at the defaults
+            # (image_encoder.py:15-29): width/layers/heads don't apply —
+            # the feature dim is 8 * channels * 4 (3072 for RN50x16)
+            from .clip_resnet import ClipResNetEncoder
+
+            self.clip = ClipResNetEncoder(
+                stage_blocks=tuple(resnet_stages), channels=resnet_channels,
+                image_size=IMAGE_SIZE, dtype=dtype,
+            )
+            trunk_dim = self.clip.out_dim
         else:
             patch_dim = PATCH_SIZE * PATCH_SIZE * 3  # 3072, the reference's feature dim
             self.patch_proj = ColumnParallelLinear(
@@ -125,7 +142,7 @@ class ImageEncoder(BaseLayer):
             )
             self.blocks = [_VitBlock(width, heads, dtype) for _ in range(layers)]
             self.out_norm = LayerNorm(width, LayerNormConfig(), dtype)
-        self.proj = RowParallelLinear(width, out_features, bias=True, dtype=dtype)
+        self.proj = RowParallelLinear(trunk_dim, out_features, bias=True, dtype=dtype)
         self.final_norm = LayerNorm(out_features, LayerNormConfig(), dtype)
 
     def init(self, key: jax.Array) -> dict:
@@ -134,7 +151,7 @@ class ImageEncoder(BaseLayer):
             "proj": self.proj.init(ks[2]),
             "final_norm": self.final_norm.init(ks[3]),
         }
-        if self.backbone == "clip":
+        if self.backbone in ("clip", "clip_resnet"):
             params["clip"] = self.clip.init(ks[0])
             return params
         params["patch_proj"] = self.patch_proj.init(ks[0])
@@ -148,7 +165,7 @@ class ImageEncoder(BaseLayer):
             "proj": tree_prefix(self.proj.param_metas(), "image_encoder.proj"),
             "final_norm": tree_prefix(self.final_norm.param_metas(), "image_encoder.final_norm"),
         }
-        if self.backbone == "clip":
+        if self.backbone in ("clip", "clip_resnet"):
             metas["clip"] = tree_prefix(self.clip.param_metas(), "image_encoder.clip")
             return metas
         metas["patch_proj"] = tree_prefix(self.patch_proj.param_metas(), "image_encoder.patch_proj")
@@ -159,18 +176,25 @@ class ImageEncoder(BaseLayer):
 
     def load_clip_weights(self, params: dict, state_dict) -> dict:
         """Return ``params`` with the CLIP trunk replaced by pretrained
-        huggingface ``CLIPVisionModel`` weights (the projection into the
-        language stream stays trainable-fresh)."""
-        from .clip_vision import import_clip_vision_weights
+        weights (the projection into the language stream stays
+        trainable-fresh): huggingface ``CLIPVisionModel`` weights for the
+        ViT backbone, OpenAI-CLIP-format ModifiedResNet weights for
+        ``clip_resnet``."""
+        if self.backbone == "clip":
+            from .clip_vision import import_clip_vision_weights
 
-        assert self.backbone == "clip", "load_clip_weights needs backbone='clip'"
-        return {**params, "clip": import_clip_vision_weights(self.clip, state_dict)}
+            return {**params, "clip": import_clip_vision_weights(self.clip, state_dict)}
+        if self.backbone == "clip_resnet":
+            from .clip_resnet import import_clip_resnet_weights
+
+            return {**params, "clip": import_clip_resnet_weights(self.clip, state_dict)}
+        raise AssertionError("load_clip_weights needs a clip backbone")
 
     def patchify(self, images: jax.Array) -> jax.Array:
         return patchify(images, PATCH_SIZE)
 
     def __call__(self, params: dict, images: jax.Array, ctx: ForwardContext) -> jax.Array:
-        if self.backbone == "clip":
+        if self.backbone in ("clip", "clip_resnet"):
             x = self.clip(params["clip"], images, ctx)
         else:
             x = self.patchify(images.astype(self.dtype))

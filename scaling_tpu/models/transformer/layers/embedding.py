@@ -49,6 +49,8 @@ class EmbeddingInput(BaseLayer):
                 dropout_p=architecture.dropout_image_encoder,
                 dtype=architecture.dtype,
                 backbone=architecture.image_encoder_backbone,
+                resnet_stages=architecture.image_encoder_resnet_stages,
+                resnet_channels=architecture.image_encoder_resnet_channels,
             )
 
     def init(self, key: jax.Array) -> dict:

@@ -1,0 +1,263 @@
+"""CLIP ModifiedResNet trunk: our TPU (NHWC) implementation must reproduce
+the torch reference semantics from imported OpenAI-format weights — the
+trunk the reference hard-wires (image_encoder.py:15-29, clip.py:41-168).
+The torch oracle below is an independent implementation of the public
+openai/CLIP ModifiedResNet architecture, state-dict-compatible with the
+published checkpoints (key names follow the public format)."""
+
+from collections import OrderedDict
+
+import jax
+import numpy as np
+import pytest
+import torch
+
+from scaling_tpu.models.transformer.clip_resnet import (
+    ClipResNetEncoder,
+    import_clip_resnet_weights,
+)
+from scaling_tpu.nn import ForwardContext
+from scaling_tpu.nn.param import named_parameters
+
+CTX = ForwardContext()
+
+
+class TorchBottleneck(torch.nn.Module):
+    def __init__(self, c_in, planes, stride=1):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(c_in, planes, 1, bias=False)
+        self.bn1 = torch.nn.BatchNorm2d(planes)
+        self.conv2 = torch.nn.Conv2d(planes, planes, 3, padding=1, bias=False)
+        self.bn2 = torch.nn.BatchNorm2d(planes)
+        self.avgpool = torch.nn.AvgPool2d(stride) if stride > 1 else torch.nn.Identity()
+        self.conv3 = torch.nn.Conv2d(planes, planes * 4, 1, bias=False)
+        self.bn3 = torch.nn.BatchNorm2d(planes * 4)
+        self.downsample = None
+        if stride > 1 or c_in != planes * 4:
+            self.downsample = torch.nn.Sequential(
+                OrderedDict([
+                    ("-1", torch.nn.AvgPool2d(stride) if stride > 1 else torch.nn.Identity()),
+                    ("0", torch.nn.Conv2d(c_in, planes * 4, 1, bias=False)),
+                    ("1", torch.nn.BatchNorm2d(planes * 4)),
+                ])
+            )
+
+    def forward(self, x):
+        out = torch.relu(self.bn1(self.conv1(x)))
+        out = torch.relu(self.bn2(self.conv2(out)))
+        out = self.avgpool(out)
+        out = self.bn3(self.conv3(out))
+        identity = x if self.downsample is None else self.downsample(x)
+        return torch.relu(out + identity)
+
+
+class TorchModifiedResNet(torch.nn.Module):
+    def __init__(self, stage_blocks, channels):
+        super().__init__()
+        half = channels // 2
+        self.conv1 = torch.nn.Conv2d(3, half, 3, stride=2, padding=1, bias=False)
+        self.bn1 = torch.nn.BatchNorm2d(half)
+        self.conv2 = torch.nn.Conv2d(half, half, 3, padding=1, bias=False)
+        self.bn2 = torch.nn.BatchNorm2d(half)
+        self.conv3 = torch.nn.Conv2d(half, channels, 3, padding=1, bias=False)
+        self.bn3 = torch.nn.BatchNorm2d(channels)
+        self.avgpool = torch.nn.AvgPool2d(2)
+        c_in = channels
+        for i, blocks in enumerate(stage_blocks):
+            planes = channels * (2 ** i)
+            stride = 1 if i == 0 else 2
+            mods = [TorchBottleneck(c_in, planes, stride)]
+            c_in = planes * 4
+            for _ in range(1, blocks):
+                mods.append(TorchBottleneck(c_in, planes))
+            setattr(self, f"layer{i + 1}", torch.nn.Sequential(*mods))
+        self.n_stages = len(stage_blocks)
+
+    def forward(self, x):
+        for conv, bn in ((self.conv1, self.bn1), (self.conv2, self.bn2),
+                         (self.conv3, self.bn3)):
+            x = torch.relu(bn(conv(x)))
+        x = self.avgpool(x)
+        for i in range(self.n_stages):
+            x = getattr(self, f"layer{i + 1}")(x)
+        b, c, h, w = x.shape
+        return x.reshape(b, c, h * w).permute(0, 2, 1)  # b (h w) d
+
+
+def randomized(model, seed=0):
+    """Random weights AND random running stats, so a mean/var mapping bug
+    cannot hide behind the zero/one init."""
+    g = torch.Generator().manual_seed(seed)
+    sd = model.state_dict()
+    for k, v in sd.items():
+        if k.endswith("num_batches_tracked"):
+            continue
+        if k.endswith("running_var"):
+            sd[k] = torch.rand(v.shape, generator=g) + 0.5
+        else:
+            sd[k] = torch.randn(v.shape, generator=g) * 0.1
+    model.load_state_dict(sd)
+    return model.eval()
+
+
+STAGES, CHANNELS, IMAGE = (2, 1, 1, 1), 8, 64
+
+
+def oracle_and_ours():
+    torch_model = randomized(TorchModifiedResNet(STAGES, CHANNELS))
+    ours = ClipResNetEncoder(stage_blocks=STAGES, channels=CHANNELS,
+                             image_size=IMAGE)
+    params = import_clip_resnet_weights(ours, torch_model.state_dict())
+    return torch_model, ours, params
+
+
+def test_import_reproduces_torch_features():
+    torch_model, ours, params = oracle_and_ours()
+    rng = np.random.default_rng(1)
+    img = rng.normal(size=(2, IMAGE, IMAGE, 3)).astype(np.float32)
+    with torch.no_grad():
+        want = torch_model(torch.from_numpy(img).permute(0, 3, 1, 2)).numpy()
+    got = np.asarray(ours(params, img, CTX))
+    assert got.shape == want.shape == (2, (IMAGE // 32) ** 2, CHANNELS * 8 * 4)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_import_accepts_prefixed_dicts():
+    torch_model, ours, params = oracle_and_ours()
+    for prefix in ("visual.", "input_encoder."):
+        sd = {prefix + k: v for k, v in torch_model.state_dict().items()}
+        p2 = import_clip_resnet_weights(ours, sd)
+        np.testing.assert_array_equal(
+            np.asarray(p2["stem"]["conv1"]["weight"]),
+            np.asarray(params["stem"]["conv1"]["weight"]),
+        )
+
+
+def test_import_rejects_geometry_mismatch():
+    torch_model = randomized(TorchModifiedResNet(STAGES, CHANNELS))
+    with pytest.raises(ValueError, match="channel mismatch"):
+        import_clip_resnet_weights(
+            ClipResNetEncoder(stage_blocks=STAGES, channels=16, image_size=IMAGE),
+            torch_model.state_dict(),
+        )
+    with pytest.raises(ValueError, match="stage depth mismatch"):
+        import_clip_resnet_weights(
+            ClipResNetEncoder(stage_blocks=(1, 1, 1, 1), channels=CHANNELS,
+                              image_size=IMAGE),
+            torch_model.state_dict(),
+        )
+
+
+def test_rn50x16_defaults_match_reference_interface():
+    """The reference geometry (image_encoder.py:15-36): 384x384 input,
+    down-sample 32, 144 tokens of 3072 features, stages [6,8,18,8] at 96
+    channels."""
+    enc = ClipResNetEncoder()
+    assert enc.out_dim == 3072
+    assert enc.tokens == 144
+    assert enc.stage_blocks == (6, 8, 18, 8)
+    assert [len(s) for s in enc.stages] == [6, 8, 18, 8]
+
+
+def test_params_and_metas_aligned_with_unique_keys():
+    _, ours, params = oracle_and_ours()
+    metas = ours.param_metas()
+    assert jax.tree.structure(params) == jax.tree.structure(
+        metas, is_leaf=lambda x: not isinstance(x, dict)
+    )
+    # every leaf must map to a distinct checkpoint key (the collision class
+    # of bug that made clip-vit checkpoints unloadable)
+    names = [m.parameter_name for _, _, m in named_parameters(params, metas)]
+    assert len(names) == len(set(names))
+    assert "layer1.block_0.downsample_bn.mean" in names
+
+
+def test_image_encoder_clip_resnet_backbone_end_to_end():
+    from scaling_tpu.models.transformer.image_encoder import ImageEncoder
+
+    enc = ImageEncoder(out_features=32, backbone="clip_resnet",
+                       resnet_stages=(1, 1, 1, 1), resnet_channels=8)
+    params = enc.init(jax.random.PRNGKey(0))
+    metas = enc.param_metas()
+    assert jax.tree.structure(params) == jax.tree.structure(
+        metas, is_leaf=lambda x: not isinstance(x, dict)
+    )
+    torch_model = randomized(TorchModifiedResNet((1, 1, 1, 1), 8))
+    params = enc.load_clip_weights(params, torch_model.state_dict())
+    rng = np.random.default_rng(2)
+    images = rng.normal(size=(1, 384, 384, 3)).astype(np.float32)
+    out = enc(params, images, CTX)
+    assert out.shape == (1, 144, 32)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_bn_running_stats_carry_no_gradient():
+    """The frozen-statistics contract: grads through the trunk leave
+    running mean/var at exactly zero gradient while conv kernels and BN
+    affine terms receive real gradients."""
+    _, ours, params = oracle_and_ours()
+    rng = np.random.default_rng(3)
+    img = rng.normal(size=(1, IMAGE, IMAGE, 3)).astype(np.float32)
+
+    def loss(p):
+        return (ours(p, img, CTX) ** 2).mean()
+
+    grads = jax.grad(loss)(params)
+    stem = grads["stem"]
+    assert float(np.abs(np.asarray(stem["bn1"]["mean"])).max()) == 0.0
+    assert float(np.abs(np.asarray(stem["bn1"]["var"])).max()) == 0.0
+    assert float(np.abs(np.asarray(stem["conv1"]["weight"])).max()) > 0.0
+    assert float(np.abs(np.asarray(stem["bn1"]["weight"])).max()) > 0.0
+
+
+def test_clip_resnet_checkpoint_applied_at_train_startup(tmp_path):
+    """The full config chain — image_encoder_backbone: clip_resnet +
+    image_encoder_clip_checkpoint — through the real train entry: the
+    trained model's trunk carries the checkpoint's stem weights."""
+    from scaling_tpu.data.memory_map import MemoryMapDatasetBuilder
+    from scaling_tpu.models.transformer import TransformerConfig
+    from scaling_tpu.models.transformer.train import main
+
+    prefix = tmp_path / "data"
+    rng = np.random.default_rng(5)
+    with MemoryMapDatasetBuilder(prefix, dtype=np.uint16) as b:
+        for _ in range(32):
+            doc = rng.integers(1, 96, size=rng.integers(8, 48))
+            b.add(np.append(doc, 0).astype(np.uint16))
+
+    torch_model = randomized(TorchModifiedResNet((1, 1, 1, 1), 8))
+    ckpt = tmp_path / "rn_vision.pt"
+    torch.save(torch_model.state_dict(), ckpt)
+
+    cfg = TransformerConfig.from_dict({
+        "topology": {"model_parallel_size": 1, "pipe_parallel_size": 1,
+                     "data_parallel_size": 1, "micro_batch_size": 2,
+                     "gradient_accumulation_steps": 1},
+        "transformer_architecture": {
+            "vocab_size": 96, "hidden_size": 32, "num_layers": 1,
+            "num_attention_heads": 4, "sequence_length": 160,
+            "image_encoder": True,
+            "image_encoder_backbone": "clip_resnet",
+            "image_encoder_resnet_stages": [1, 1, 1, 1],
+            "image_encoder_resnet_channels": 8,
+            "image_encoder_clip_checkpoint": str(ckpt),
+        },
+        "optimizer": {"gradient_clipping": 1.0},
+        "learning_rate_scheduler": {"learning_rate": 0.01,
+                                    "learning_rate_warmup_steps": 2,
+                                    "learning_rate_decay_iters": 50},
+        "trainer": {"train_iterations": 1, "seed": 42,
+                    "save_dir": str(tmp_path / "ckpt"), "save_interval": 100},
+        "data": {"data_prefixes": [str(prefix)]},
+        "logger": {"log_dir": None},
+    })
+    trainer = main(cfg)
+    for key, p, _ in trainer.module.named_parameters(trainer.params):
+        if key.endswith("image_encoder.clip.stem.conv1.weight"):
+            want = torch_model.state_dict()["conv1.weight"].numpy()
+            np.testing.assert_allclose(
+                np.asarray(p, np.float32),
+                want.transpose(2, 3, 1, 0), atol=1e-5)
+            break
+    else:
+        raise AssertionError("clip_resnet trunk parameter not found")

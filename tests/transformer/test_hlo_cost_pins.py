@@ -104,23 +104,30 @@ def per_partition_flops(compiled):
 
 
 _COLLECTIVE_RE = re.compile(
-    r"=\s+(?:\()?([a-z0-9]+)\[([0-9,]*)\][^ ]*\s+"
+    r"=\s+((?:\([^)]*\))|(?:[a-z0-9]+\[[0-9,]*\][^ ]*))\s+"
     r"(all-reduce|all-gather|reduce-scatter|collective-permute)\("
 )
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
 _DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
                 "s8": 1, "u8": 1, "pred": 1}
 
 
 def collective_bytes(compiled):
     """Per-partition bytes moved by each collective op kind, parsed from the
-    optimized HLO module."""
+    optimized HLO module. Handles both single-operand shapes and variadic
+    tuple shapes like '(f32[100]{0}, f32[200]{0}) all-reduce(' — dropping
+    the tuple case would silently uncount exactly the fused gradient syncs
+    these pins exist to watch."""
     out: dict = {}
-    for dtype, shape, op in _COLLECTIVE_RE.findall(compiled.as_text()):
-        n = 1
-        for dim in shape.split(","):
-            if dim:
-                n *= int(dim)
-        out[op] = out.get(op, 0) + n * _DTYPE_BYTES.get(dtype, 4)
+    for shapes, op in _COLLECTIVE_RE.findall(compiled.as_text()):
+        total = 0
+        for dtype, shape in _SHAPE_RE.findall(shapes):
+            n = 1
+            for dim in shape.split(","):
+                if dim:
+                    n *= int(dim)
+            total += n * _DTYPE_BYTES.get(dtype, 4)
+        out[op] = out.get(op, 0) + total
     return out
 
 
@@ -167,9 +174,11 @@ def test_sharded_step_balances_flops_and_pins_grad_sync_bytes(devices):
     """TP=2 × DP=4 with ZeRO-1 on the 8-device mesh: (a) per-partition
     FLOPs stay balanced — partitions × per-partition ≈ global-batch-scaled
     single-device FLOPs within [0.98, 1.18] (measured 1.072; replication
-    of the body would double it); (b) gradient-sync traffic stays within
-    [0.2, 1.2] × fp32 parameter bytes (measured 0.56; syncing per micro
-    batch or in fp32-upcast-everything would blow past the top)."""
+    of the body would double it); (b) total sync traffic (DP grad sync +
+    TP activation reductions) stays within [0.6, 2.4] × fp32 parameter
+    bytes (measured 1.70 with variadic tuple collectives counted;
+    syncing per micro batch would blow past the top — and the gas
+    flatness test below pins that directly)."""
     single = per_partition_flops(compile_step(make_config()))
     config = make_config(mp=2, dp=4, zero=True)
     compiled = compile_step(config)
@@ -188,7 +197,7 @@ def test_sharded_step_balances_flops_and_pins_grad_sync_bytes(devices):
         glu=True,
     )
     ratio = sync_bytes / param_bytes_fp32
-    assert 0.2 <= ratio <= 1.2, (cb, ratio)
+    assert 0.6 <= ratio <= 2.4, (cb, ratio)
 
 
 def test_collective_bytes_flat_in_gradient_accumulation(devices):
