@@ -3,9 +3,13 @@ rendezvous on CPU and records what it saw (reference:
 tests/core/test_runner/runner_script.py writes one json per process).
 
 ``payload["case"] == "train"`` additionally runs REAL distributed
-training: every process holds 2 virtual CPU devices, the mesh spans all
+training: every process holds 4 virtual CPU devices, the mesh spans all
 processes, and the jitted train step executes with cross-process
 collectives — the closest single-machine emulation of a multi-host pod.
+``train_losses`` is shared with the test itself, which replays the
+identical computation on its single-process 8-device mesh and asserts
+loss parity: the DCN-style multi-process path and the in-process path
+must be numerically the same program.
 """
 
 import json
@@ -13,14 +17,17 @@ import os
 from pathlib import Path
 
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
 ).strip()
 
 
-def run_distributed_train(cache_dir: Path) -> dict:
-    """Two global train steps over the multi-process mesh; returns losses
-    (every process must see identical, finite values) plus a collective
-    orbax save/restore round-trip flag."""
+def train_losses(n_dev: int) -> tuple:
+    """Two train steps of a fixed tiny transformer over an ``mp=2 x
+    dp=n_dev/2`` mesh spanning ALL visible devices (however many processes
+    they live in). Pure function of ``n_dev``: the global batch is
+    synthesized identically everywhere, so single- and multi-process runs
+    of the same global mesh must produce the same losses. Returns
+    (losses, module, params, opt_state)."""
     import jax
     import numpy as np
 
@@ -32,7 +39,6 @@ def run_distributed_train(cache_dir: Path) -> dict:
     )
     from scaling_tpu.topology import Topology
 
-    n_dev = len(jax.devices())  # all processes' devices
     # mp x dp so BOTH collective families cross process boundaries: the
     # per-layer tensor-parallel all-gathers and the gradient psum
     mp = 2 if n_dev % 2 == 0 else 1
@@ -94,6 +100,16 @@ def run_distributed_train(cache_dir: Path) -> dict:
             params, opt_state, batch, jax.random.PRNGKey(i)
         )
         losses.append(float(loss))  # replicated output: addressable everywhere
+    return losses, module, params, opt_state
+
+
+def run_distributed_train(cache_dir: Path) -> dict:
+    """Two global train steps over the multi-process mesh; returns losses
+    (every process must see identical, finite values) plus a collective
+    orbax save/restore round-trip flag."""
+    import jax
+
+    losses, module, params, opt_state = train_losses(len(jax.devices()))
 
     # distributed checkpointing through the PRODUCT backend (the same
     # functions the trainer's checkpoint_backend=orbax uses): a collective
