@@ -55,6 +55,22 @@ def xla_attn(q, k, v, seg):
     return jnp.einsum("bnst,btnd->bsnd", p, vv)
 
 
+def xla_long(q, k, v, seg):
+    """xla_attn with shapes derived from the inputs (the long-context sweep
+    feeds arbitrary seq lengths; the fixed-S version above keeps the exact
+    program the original A/B measured)."""
+    del seg
+    b, s, n, d = q.shape
+    rep = n // k.shape[2]
+    kk = jnp.repeat(k, rep, axis=2)
+    vv = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bsnd,btnd->bnst", q, kk) * (d**-0.5)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    logits = jnp.where(mask[None, None], logits.astype(jnp.float32), -1e9)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnst,btnd->bsnd", p, vv)
+
+
 def fwd_bwd(fn):
     """fwd+bwd closure: grads of sum(fn) wrt q/k/v, jitted."""
     return jax.jit(
