@@ -31,12 +31,16 @@ print(f"devices: {[d.device_kind for d in devs]}", flush=True)
 import bench  # noqa: E402
 from benchmarks import attn_bench  # noqa: E402
 
+# every section is fault-isolated: a broken arm (or a tunnel hiccup mid-
+# session) must not take the remaining sections' measurements with it
 # ---------------------------------------------------------- 1. micro bench
 q, k, v, seg = attn_bench.make_qkv()
-fb_flash = attn_bench.fwd_bwd(attn_bench.flash)
-fb_xla = attn_bench.fwd_bwd(attn_bench.xla_attn)
-print(f"1. attn flash f+b: {attn_bench.timeit(fb_flash, q, k, v, seg):8.2f} ms", flush=True)
-print(f"1. attn xla   f+b: {attn_bench.timeit(fb_xla, q, k, v, seg):8.2f} ms", flush=True)
+for name, fn in (("flash", attn_bench.flash), ("xla", attn_bench.xla_attn)):
+    try:
+        t = attn_bench.timeit(attn_bench.fwd_bwd(fn), q, k, v, seg)
+        print(f"1. attn {name} f+b: {t:8.2f} ms", flush=True)
+    except Exception as e:
+        print(f"1. attn {name} f+b: FAIL {type(e).__name__}", flush=True)
 
 # ------------------------------------------------------ 2. block-size sweep
 for bq, bkv in ((512, 512), (1024, 1024), (2048, 1024), (1024, 2048)):
@@ -61,16 +65,21 @@ def build_step(kernel, norm="torch"):
 
 
 key = jax.random.PRNGKey(0)
-cfg, module, optimizer, step_f = build_step("flash_attention")
-arch = cfg.transformer_architecture
-params = module.shard_params(module.init_params(key))
-opt_state = optimizer.init_state(params)
-rng = np.random.default_rng(0)
-batch = module.shard_batch(
-    bench.synth_batch(rng, 4, 2048, arch.vocab_size, 1), stacked=True
-)
-_, _, _, step_x = build_step("torch")
-_, _, _, step_fn = build_step("flash_attention", norm="fused")
+step_ab_ready = False
+try:
+    cfg, module, optimizer, step_f = build_step("flash_attention")
+    arch = cfg.transformer_architecture
+    params = module.shard_params(module.init_params(key))
+    opt_state = optimizer.init_state(params)
+    rng = np.random.default_rng(0)
+    batch = module.shard_batch(
+        bench.synth_batch(rng, 4, 2048, arch.vocab_size, 1), stacked=True
+    )
+    _, _, _, step_x = build_step("torch")
+    _, _, _, step_fn = build_step("flash_attention", norm="fused")
+    step_ab_ready = True
+except Exception as e:
+    print(f"3/4. setup: FAIL {type(e).__name__}: {e}", flush=True)
 
 
 def run_step(stp):
@@ -81,27 +90,34 @@ def run_step(stp):
     return f
 
 
-for name, stp in (("flash", step_f), ("xla", step_x), ("flash+fusednorm", step_fn)):
-    try:
-        t = attn_bench.timeit(run_step(stp), params, opt_state, iters=3)
-        print(f"3/4. step {name}: {t:8.1f} ms", flush=True)
-    except Exception as e:
-        print(f"3/4. step {name}: FAIL {type(e).__name__}: {e}", flush=True)
+if step_ab_ready:
+    for name, stp in (("flash", step_f), ("xla", step_x),
+                      ("flash+fusednorm", step_fn)):
+        try:
+            t = attn_bench.timeit(run_step(stp), params, opt_state, iters=3)
+            print(f"3/4. step {name}: {t:8.1f} ms", flush=True)
+        except Exception as e:
+            print(f"3/4. step {name}: FAIL {type(e).__name__}: {e}", flush=True)
 
 # --------------------------------------------------------- 5. trace capture
 os.environ["BENCH_KERNEL"] = "flash_attention"
 os.environ.pop("BENCH_NORM", None)
 outdir = "/tmp/bench_trace_tpu"
-jax.profiler.start_trace(outdir)
-for i in range(2):
-    loss = run_step(step_f)(params, opt_state)
-jax.block_until_ready(loss)
-jax.profiler.stop_trace()
-print(
-    f"5. trace written to {outdir}; analyze with "
-    f"python benchmarks/analyze_trace.py {outdir}",
-    flush=True,
-)
+try:
+    if not step_ab_ready:
+        raise RuntimeError("step A/B setup failed; nothing to trace")
+    jax.profiler.start_trace(outdir)
+    for i in range(2):
+        loss = run_step(step_f)(params, opt_state)
+    jax.block_until_ready(loss)
+    jax.profiler.stop_trace()
+    print(
+        f"5. trace written to {outdir}; analyze with "
+        f"python benchmarks/analyze_trace.py {outdir}",
+        flush=True,
+    )
+except Exception as e:
+    print(f"5. trace capture: FAIL {type(e).__name__}: {e}", flush=True)
 
 # ------------------------------------------- 6. micro-batch size sweep
 # bigger per-step batch amortizes per-step overheads and widens MXU tiles;
@@ -110,7 +126,8 @@ print(
 # state can be freed first (a duplicate resident model would OOM the
 # larger arms on a 16G v5e), and with BENCH_NORM cleared so the sweep
 # measures the exact configuration bench.py runs.
-del params, opt_state, batch, step_f, step_x, step_fn
+for _n in ("params", "opt_state", "batch", "step_f", "step_x", "step_fn"):
+    globals().pop(_n, None)
 os.environ["BENCH_KERNEL"] = "flash_attention"
 os.environ.pop("BENCH_NORM", None)
 for mbs in (4, 8, 16):
