@@ -11,6 +11,10 @@ attn_bench.timeit):
      feeds bench.py's BENCH_MBS
 
 Usage: cd /root/repo && python benchmarks/chip_session.py 2>&1 | tee /tmp/chip_session.log
+
+CHIP_SESSION_SMOKE=1 shrinks every arm to CPU-rehearsable shapes so the
+whole session's plumbing can be validated without the chip (numbers are
+then meaningless; sections that need the TPU print FAIL and move on).
 """
 import os
 import sys
@@ -19,6 +23,7 @@ sys.path.insert(0, "/root/repo")
 os.chdir("/root/repo")
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from scaling_tpu.devices import probe_devices
@@ -30,6 +35,15 @@ print(f"devices: {[d.device_kind for d in devs]}", flush=True)
 
 import bench  # noqa: E402
 from benchmarks import attn_bench  # noqa: E402
+
+SMOKE = bool(os.environ.get("CHIP_SESSION_SMOKE"))
+# (seq, hidden, layers, mbs) of the full-step arms; long-context seqs;
+# 1b-arm layer count
+if SMOKE:
+    STEP_SHAPE, LONG_SEQS, LAYERS_1B = (256, 256, 2, 2), (512, 1024), 3
+else:
+    STEP_SHAPE, LONG_SEQS, LAYERS_1B = (2048, 2048, 8, 4), (8192, 16384, 32768), 20
+SEQ, HIDDEN, LAYERS, MBS = STEP_SHAPE
 
 # every section is fault-isolated: a broken arm (or a tunnel hiccup mid-
 # session) must not take the remaining sections' measurements with it
@@ -59,7 +73,7 @@ os.environ.pop("SCALING_TPU_FLASH_BLOCK_KV", None)
 def build_step(kernel, norm="torch"):
     os.environ["BENCH_KERNEL"] = kernel
     os.environ["BENCH_NORM"] = norm
-    config, topology, module, optimizer = bench.build(2048, 4, 2048, 8)
+    config, topology, module, optimizer = bench.build(SEQ, MBS, HIDDEN, LAYERS)
     step = module.build_train_step(optimizer, bench.loss_function, donate=False)
     return config, module, optimizer, step
 
@@ -73,7 +87,7 @@ try:
     opt_state = optimizer.init_state(params)
     rng = np.random.default_rng(0)
     batch = module.shard_batch(
-        bench.synth_batch(rng, 4, 2048, arch.vocab_size, 1), stacked=True
+        bench.synth_batch(rng, MBS, SEQ, arch.vocab_size, 1), stacked=True
     )
     _, _, _, step_x = build_step("torch")
     _, _, _, step_fn = build_step("flash_attention", norm="fused")
@@ -130,14 +144,14 @@ for _n in ("params", "opt_state", "batch", "step_f", "step_x", "step_fn"):
     globals().pop(_n, None)
 os.environ["BENCH_KERNEL"] = "flash_attention"
 os.environ.pop("BENCH_NORM", None)
-for mbs in (4, 8, 16):
+for mbs in ((2,) if SMOKE else (4, 8, 16)):
     try:
-        cfg_m, _, mod_m, opt_m = bench.build(2048, mbs, 2048, 8)
+        cfg_m, _, mod_m, opt_m = bench.build(SEQ, mbs, HIDDEN, LAYERS)
         step_m = mod_m.build_train_step(opt_m, bench.loss_function, donate=False)
         p_m = mod_m.shard_params(mod_m.init_params(key))
         s_m = opt_m.init_state(p_m)
         b_m = mod_m.shard_batch(
-            bench.synth_batch(np.random.default_rng(0), mbs, 2048,
+            bench.synth_batch(np.random.default_rng(0), mbs, SEQ,
                               cfg_m.transformer_architecture.vocab_size, 1),
             stacked=True,
         )
@@ -148,7 +162,7 @@ for mbs in (4, 8, 16):
 
         t = attn_bench.timeit(f_m, p_m, s_m, iters=3)
         print(f"6. step mbs={mbs}: {t:8.1f} ms "
-              f"({mbs * 2048 / t * 1000:.0f} tok/s)", flush=True)
+              f"({mbs * SEQ / t * 1000:.0f} tok/s)", flush=True)
         del p_m, s_m, b_m, step_m
     except Exception as e:
         print(f"6. step mbs={mbs}: FAIL {type(e).__name__}: {e}", flush=True)
@@ -159,8 +173,6 @@ for mbs in (4, 8, 16):
 # loop with its chunked score tiles) vs XLA full attention, fwd+bwd at
 # seq 8k/16k/32k. XLA is EXPECTED to fail near 32k (the 16*s^2 score tensor
 # alone is ~34G) — that failure is the point of the comparison.
-from functools import partial as _partial
-
 from scaling_tpu.ops.ring_attention import ring_attention
 from scaling_tpu.topology import Topology, TopologyConfig
 
@@ -176,7 +188,7 @@ def _ring_op(q, k, v, seg):
                           sm_scale=attn_bench.SCALE)
 
 
-for s_long in (8192, 16384, 32768):
+for s_long in LONG_SEQS:
     kq = jax.random.PRNGKey(1)
     q_l = jax.random.normal(kq, (1, s_long, 16, 128), jnp.bfloat16)
     k_l = jax.random.normal(kq, (1, s_long, 4, 128), jnp.bfloat16)
@@ -198,12 +210,12 @@ for s_long in (8192, 16384, 32768):
 # 16G v5e, so an OOM here is a legitimate, informative outcome — record it.
 os.environ["BENCH_KERNEL"] = "flash_attention"
 try:
-    cfg_b, _, mod_b, opt_b = bench.build(2048, 1, 2048, 20, remat=True)
+    cfg_b, _, mod_b, opt_b = bench.build(SEQ, 1, HIDDEN, LAYERS_1B, remat=True)
     step_b = mod_b.build_train_step(opt_b, bench.loss_function, donate=False)
     p_b = mod_b.shard_params(mod_b.init_params(key))
     s_b = opt_b.init_state(p_b)
     b_b = mod_b.shard_batch(
-        bench.synth_batch(np.random.default_rng(0), 1, 2048,
+        bench.synth_batch(np.random.default_rng(0), 1, SEQ,
                           cfg_b.transformer_architecture.vocab_size, 1),
         stacked=True,
     )
@@ -213,7 +225,7 @@ try:
         return loss
 
     t = attn_bench.timeit(f_b, p_b, s_b, iters=3)
-    print(f"8. 1b step mbs=1: {t:8.1f} ms ({2048 / t * 1000:.0f} tok/s)",
+    print(f"8. 1b step mbs=1: {t:8.1f} ms ({SEQ / t * 1000:.0f} tok/s)",
           flush=True)
     del p_b, s_b, b_b, step_b
 except Exception as e:
