@@ -117,14 +117,17 @@ if step_ab_ready:
 os.environ["BENCH_KERNEL"] = "flash_attention"
 os.environ.pop("BENCH_NORM", None)
 outdir = "/tmp/bench_trace_tpu"
+_tracing = False
 try:
     if not step_ab_ready:
         raise RuntimeError("step A/B setup failed; nothing to trace")
     jax.profiler.start_trace(outdir)
+    _tracing = True
     for i in range(2):
         loss = run_step(step_f)(params, opt_state)
     jax.block_until_ready(loss)
     jax.profiler.stop_trace()
+    _tracing = False
     print(
         f"5. trace written to {outdir}; analyze with "
         f"python benchmarks/analyze_trace.py {outdir}",
@@ -132,6 +135,14 @@ try:
     )
 except Exception as e:
     print(f"5. trace capture: FAIL {type(e).__name__}: {e}", flush=True)
+finally:
+    if _tracing:
+        # a failure mid-trace must not leave the profiler running under
+        # sections 6-8 (distorted timings, unbounded trace buffers)
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
 
 # ------------------------------------------- 6. micro-batch size sweep
 # bigger per-step batch amortizes per-step overheads and widens MXU tiles;

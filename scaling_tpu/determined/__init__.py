@@ -71,7 +71,14 @@ class DeterminedGlue:
 
     # ------------------------------------------------------------ adapters
     def should_preempt(self) -> bool:
-        return bool(self._core.preempt.should_preempt())
+        try:
+            return bool(self._core.preempt.should_preempt())
+        except Exception as e:
+            # polled after EVERY step: a transient master error must not
+            # kill a training run that was healthy moments ago (the real
+            # preemption signal will come back on a later poll)
+            logger.warning(f"determined preempt poll failed: {e}")
+            return False
 
     def report_metrics(self, metrics: dict, step: int) -> None:
         try:
@@ -95,11 +102,32 @@ class DeterminedGlue:
         (reference: ``determined_save_checkpoint``, trainer.py:356-414 —
         there the save happens INTO determined storage; here the trainer's
         own save stays canonical and determined receives a copy, so the
-        same checkpoint works on and off the cluster)."""
+        same checkpoint works on and off the cluster).
+
+        Multi-host: the orbax backend writes each host's shards to that
+        host's own ``save_dir``, so every process uploads with
+        ``shard=True`` and Determined merges. If the installed SDK lacks
+        sharded upload, process 0 uploads alone — complete only when
+        ``save_dir`` is a shared filesystem, so that fallback warns."""
+        import jax
+
+        metadata = {"steps_completed": int(step)}
         try:
-            self._core.checkpoint.upload(
-                str(step_dir), metadata={"steps_completed": int(step)}
-            )
+            if jax.process_count() > 1:
+                try:
+                    self._core.checkpoint.upload(
+                        str(step_dir), metadata=metadata, shard=True
+                    )
+                    return
+                except TypeError:
+                    if jax.process_index() != 0:
+                        return
+                    logger.warning(
+                        "determined SDK lacks sharded upload; uploading from "
+                        "process 0 only — the checkpoint is complete only if "
+                        "save_dir is a shared filesystem"
+                    )
+            self._core.checkpoint.upload(str(step_dir), metadata=metadata)
         except Exception as e:
             logger.warning(f"determined checkpoint upload failed: {e}")
 
@@ -120,12 +148,13 @@ class DeterminedGlue:
         """Plug this context into the trainer's generic hook points.
 
         Preemption is polled on EVERY process (Determined expects all
-        workers to call should_preempt); metric reporting and checkpoint
-        upload happen once per job, from process 0 — N hosts re-uploading
-        the same checkpoint would race each other in Determined storage."""
+        workers to call should_preempt). Metric reporting happens once
+        per job, from process 0. Checkpoint upload runs on every process:
+        multi-host saves are per-host shards (see upload_checkpoint), and
+        single-process runs upload exactly once anyway."""
         import jax
 
         trainer.external_preemption = self.should_preempt
+        trainer.checkpoint_hooks.append(self.upload_checkpoint)
         if jax.process_index() == 0:
             trainer.metrics_hooks.append(self.report_metrics)
-            trainer.checkpoint_hooks.append(self.upload_checkpoint)
