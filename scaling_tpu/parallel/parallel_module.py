@@ -39,6 +39,14 @@ from ..nn.base_layer import (
 from ..nn.param import ParamMeta, named_parameters, tree_with_layer
 from ..topology import ActivationCheckpointingType, Topology
 from ..topology.topology import MODEL_AXIS, PIPE_AXIS
+
+
+def remat_policy(ckpt_type: ActivationCheckpointingType):
+    """jax.checkpoint policy for a checkpointing mode (None = save nothing,
+    recompute everything inside the checkpointed region)."""
+    if ckpt_type == ActivationCheckpointingType.EVERY_LAYER_SAVE_DOTS:
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    return None
 from .pipeline import PipelinedBody
 
 if TYPE_CHECKING:  # break the optimizer <-> parallel import cycle
@@ -338,6 +346,7 @@ class ParallelModule:
             if self.topology is not None
             else ActivationCheckpointingType.DISABLED
         )
+        policy = remat_policy(ckpt_type)
         for i, layer in enumerate(self.layers):
             layer_p = self._layer_params(params, i)
             if isinstance(layer, PipelinedBody):
@@ -345,10 +354,15 @@ class ParallelModule:
                 x = layer(
                     layer_p, x, ctx, stacked=False,
                     remat=ckpt_type != ActivationCheckpointingType.DISABLED,
+                    remat_policy=policy,
                 )
-            elif ckpt_type == ActivationCheckpointingType.EVERY_LAYER:
+            elif ckpt_type in (
+                ActivationCheckpointingType.EVERY_LAYER,
+                ActivationCheckpointingType.EVERY_LAYER_SAVE_DOTS,
+            ):
                 x = jax.checkpoint(
-                    lambda p, xx, _layer=layer: _layer(p, xx, ctx)
+                    lambda p, xx, _layer=layer: _layer(p, xx, ctx),
+                    policy=policy,
                 )(layer_p, x)
             else:
                 x = layer(layer_p, x, ctx)
@@ -464,6 +478,7 @@ class ParallelModule:
         remat = (
             topo.activation_checkpointing_type != ActivationCheckpointingType.DISABLED
         )
+        policy = remat_policy(topo.activation_checkpointing_type)
         body_ids = [
             i for i, l in enumerate(self.layers) if isinstance(l, PipelinedBody)
         ]
@@ -494,7 +509,8 @@ class ParallelModule:
                 dropout_key=jax.random.fold_in(dropout_key, 0x0B0D),
             )
             xs = self.layers[body_idx](
-                self._layer_params(params, body_idx), xs, body_ctx, remat=remat
+                self._layer_params(params, body_idx), xs, body_ctx, remat=remat,
+                remat_policy=policy,
             )
 
             def run_post(x, mb, k):
@@ -511,7 +527,7 @@ class ParallelModule:
 
             # scan (not vmap) over micro-batches + remat: only one
             # micro-batch worth of vocab-sized logits is ever live
-            run_post_ck = jax.checkpoint(run_post)
+            run_post_ck = jax.checkpoint(run_post, policy=policy)
 
             def post_scan(_, inp):
                 x, mb, k = inp

@@ -32,6 +32,7 @@ equivalent of the reference placing them on the first/last stage only.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Any, Callable, List, Optional
 
 import jax
@@ -157,13 +158,15 @@ class PipelinedBody:
         layer_call: Optional[Callable] = None,
         remat: bool = True,
         stacked: bool = True,
+        remat_policy=None,
     ) -> jax.Array:
         """Run all micro-batches through the pipelined stack.
 
         Returns outputs stacked (n_micro, mbs, ...); with ``stacked=False``
         the input is one micro-batch and the output is unstacked too.
         ``layer_call(params, x, ctx, layer_index)`` defaults to the
-        template's __call__.
+        template's __call__. ``remat_policy`` is forwarded to every
+        ``jax.checkpoint`` here (None = save nothing).
         """
         call = layer_call or (lambda p, xx, c, _i: self.template(p, xx, c))
         pp, per_stage = self.pp, self.layers_per_stage
@@ -171,7 +174,8 @@ class PipelinedBody:
         if not stacked:
             # single micro-batch (eval/inference): run it as a 1-deep stack
             lifted = jax.tree.map(lambda x: x[None], x_microbatches)
-            out = self(params, lifted, ctx, layer_call=layer_call, remat=remat)
+            out = self(params, lifted, ctx, layer_call=layer_call, remat=remat,
+                       remat_policy=remat_policy)
             return jax.tree.map(lambda x: x[0], out)
 
         n_micro = _leading(x_microbatches)
@@ -188,7 +192,7 @@ class PipelinedBody:
                     # rng_tracker.py:59-96)
                     return call(w, h, _fold_key(ctx, mb_key, i), i), None
                 if remat:
-                    body = jax.checkpoint(body)
+                    body = jax.checkpoint(body, policy=remat_policy)
                 squeezed = jax.tree.map(lambda p: p.reshape(self.num_layers, *p.shape[2:]), params)
                 h, _ = jax.lax.scan(body, x, (squeezed, jnp.arange(self.num_layers)))
                 return h
@@ -233,7 +237,7 @@ class PipelinedBody:
             return h
 
         if remat:
-            stage_fn = jax.checkpoint(stage_fn, static_argnums=())
+            stage_fn = jax.checkpoint(stage_fn, static_argnums=(), policy=remat_policy)
 
         base_key = (
             ctx.dropout_key
@@ -276,7 +280,7 @@ class PipelinedBody:
             padded = n_chunks * chunk  # excess ticks produce discarded outputs
             tick_ids = jnp.arange(padded).reshape(n_chunks, chunk)
 
-            @jax.checkpoint
+            @partial(jax.checkpoint, policy=remat_policy)
             def chunk_body(state, ts):
                 return jax.lax.scan(tick, state, ts)
 

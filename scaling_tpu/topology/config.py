@@ -24,6 +24,11 @@ class PipePartitionMethod(Enum):
 class ActivationCheckpointingType(Enum):
     EVERY_PIPE_STAGE = "every_pipe_stage"
     EVERY_LAYER = "every_layer"
+    # every_layer granularity, but matmul outputs are SAVED instead of
+    # recomputed (jax dots_with_no_batch_dims_saveable policy): ~one extra
+    # elementwise forward instead of a full forward — the usual sweet spot
+    # when HBM allows it
+    EVERY_LAYER_SAVE_DOTS = "every_layer_save_dots"
     DISABLED = "disabled"
 
 
@@ -93,7 +98,9 @@ class TopologyConfig(BaseConfig):
 
     activation_checkpointing_type: ActivationCheckpointingType = Field(
         ActivationCheckpointingType.DISABLED,
-        description="",
+        description="disabled | every_layer (full per-layer recompute) | "
+        "every_layer_save_dots (per-layer remat that keeps matmul outputs "
+        "— less recompute, more memory) | every_pipe_stage",
     )
 
     sequence_parallel: bool = Field(

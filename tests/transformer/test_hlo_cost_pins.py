@@ -164,10 +164,16 @@ def test_train_step_flops_match_analytic():
 def test_remat_flop_overhead_within_band():
     """Activation checkpointing must stay a bounded FLOPs-for-memory trade:
     one extra forward at most over the body ([1.05, 1.5]; measured 1.23).
-    A remat policy that recomputes the backward too would land near 2."""
+    A remat policy that recomputes the backward too would land near 2.
+    The save-dots policy must sit strictly between: it keeps the matmul
+    outputs, so its recompute is elementwise-only."""
     base = per_partition_flops(compile_step(make_config()))
     remat = per_partition_flops(compile_step(make_config(remat="every_layer")))
     assert 1.05 <= remat / base <= 1.5, remat / base
+    dots = per_partition_flops(
+        compile_step(make_config(remat="every_layer_save_dots"))
+    )
+    assert base * 0.999 <= dots <= remat, (base, dots, remat)
 
 
 def test_sharded_step_balances_flops_and_pins_grad_sync_bytes(devices):

@@ -151,3 +151,21 @@ def train_capture(trainer, steps):
         ):
             trainer.save_checkpoint()
     return losses
+
+
+def test_remat_policies_do_not_change_the_math(tmp_path, data_prefix, devices):
+    """disabled / every_layer / every_layer_save_dots change only WHAT is
+    saved for backward, never the values: 3 training steps must produce
+    bit-identical losses across all three (fp32 on CPU)."""
+    losses = {}
+    for mode in ("disabled", "every_layer", "every_layer_save_dots"):
+        cfg = make_config(tmp_path / mode, data_prefix, train_iterations=3,
+                          save_interval=100)
+        d = cfg.model_dump(mode="json")
+        d["topology"]["activation_checkpointing_type"] = mode
+        cfg = type(cfg).from_dict(d)
+        t = build_capturing_trainer(cfg)
+        losses[mode] = np.asarray(train_capture(t, 3), np.float32)
+    np.testing.assert_array_equal(losses["disabled"], losses["every_layer"])
+    np.testing.assert_array_equal(losses["disabled"],
+                                  losses["every_layer_save_dots"])
