@@ -241,3 +241,32 @@ try:
     del p_b, s_b, b_b, step_b
 except Exception as e:
     print(f"8. 1b step: FAIL {type(e).__name__}: {e}", flush=True)
+
+# ------------------------------------------- 9. decode throughput
+# Batched KV-cache generate at the bench model size: decode is
+# HBM-bandwidth-bound (each new token re-reads the weights), so this
+# number tracks a different ceiling than the training MFU.
+try:
+    import time as _time
+
+    from scaling_tpu.models.transformer.inference import (
+        TransformerInferenceModule,
+    )
+
+    cfg_i, _, mod_i, _ = bench.build(SEQ, 1, HIDDEN, LAYERS)
+    p_i = mod_i.shard_params(mod_i.init_params(key))
+    im = TransformerInferenceModule(cfg_i, mod_i, p_i)
+    gen_b, prompt_len = 8, 128
+    gen_tokens = 8 if SMOKE else 128
+    prompt = np.random.default_rng(0).integers(
+        1, 1000, size=(gen_b, prompt_len)
+    )
+    im.generate(prompt, max_tokens=2)  # compile prefill + decode
+    t0 = _time.perf_counter()
+    im.generate(prompt, max_tokens=gen_tokens)
+    dt = _time.perf_counter() - t0
+    print(f"9. decode: {gen_b * gen_tokens / dt:8.0f} tok/s "
+          f"(batch {gen_b}, {gen_tokens} new tokens, cached)", flush=True)
+    del p_i, im
+except Exception as e:
+    print(f"9. decode: FAIL {type(e).__name__}: {e}", flush=True)
