@@ -292,6 +292,46 @@ class SimulationEngine:
         }
 
 
+def durations_from_profile(
+    observations: list,
+    gradient_accumulation_steps: int,
+) -> Dict[str, float]:
+    """Calibrate simulator instruction durations from the trainer's
+    recorded profile (``profiler_output`` JSON: one ``step_time`` per
+    step, the whole fused program).
+
+    The fused XLA step has no per-instruction timers — the instructions
+    don't exist at runtime — so the measured step time is split across
+    the schedule's compute instructions at the simulator's own 1:2
+    forward:backward ratio, one (forward + loss + backward) triple per
+    micro-batch. Communication instructions keep their defaults (they are
+    overlapped collective-permutes here). The result feeds
+    ``SimulationEngine``/``illustrate`` to ask layout questions — "what
+    does idle % look like at twice the micro-batches?" — anchored to a
+    real measurement (reference: profile JSON -> SimulationEngine,
+    pipeline_schedule/base.py:568-595)."""
+    steps = [o["step_time"] for o in observations if "step_time" in o]
+    if not steps:
+        raise ValueError("profile has no step_time observations")
+    mean_step = sum(steps) / len(steps)
+    unit = mean_step / (gradient_accumulation_steps * 3.2)
+    return {
+        "forward_pass": unit,
+        "backward_pass": 2.0 * unit,
+        "loss": 0.1 * unit,
+        "optimizer_step": 0.1 * unit,
+        # comm rides overlapped collective-permutes here; scaled with the
+        # computed unit so the ABSOLUTE defaults (tuned for the default
+        # 1.0/2.0 compute times) can't swamp a calibrated fast step
+        "load_micro_batch": 0.05 * unit,
+        "store_micro_batch": 0.05 * unit,
+        "recv_activation": 0.05 * unit,
+        "send_activation": 0.05 * unit,
+        "send_grad": 0.05 * unit,
+        "recv_grad": 0.05 * unit,
+    }
+
+
 def illustrate(
     pipe_parallel_size: int,
     gradient_accumulation_steps: int,

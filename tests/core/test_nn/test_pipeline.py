@@ -232,3 +232,44 @@ def test_visualize_renders_png(tmp_path):
     data = out.read_bytes()
     assert data[:8] == b"\x89PNG\r\n\x1a\n"
     assert len(data) > 5000
+
+
+def test_profile_feeds_the_simulator(tmp_path):
+    """The reference's profile-driven simulation (profile JSON ->
+    SimulationEngine, base.py:568-595): a trainer-format observations file
+    calibrates instruction durations, and the simulated total tracks the
+    measured step time at the profiled layout."""
+    import json
+
+    from scaling_tpu.parallel.pipeline_schedule import (
+        SimulationEngine,
+        durations_from_profile,
+    )
+
+    gas, pp = 8, 4
+    observations = [
+        {"step": s, "data_load": 0.01, "step_time": 3.2} for s in range(10, 13)
+    ]
+    path = tmp_path / "profile.json"
+    path.write_text(json.dumps(observations))
+
+    durations = durations_from_profile(
+        json.loads(path.read_text()), gradient_accumulation_steps=gas
+    )
+    assert durations["backward_pass"] == 2.0 * durations["forward_pass"]
+
+    sim = SimulationEngine(
+        pipe_parallel_size=pp, gradient_accumulation_steps=gas,
+        durations=durations,
+    )
+    result = sim.simulate()
+    # the simulated schedule at the measured layout lands near the
+    # measured step time (fill/drain makes it somewhat larger)
+    assert 0.8 * 3.2 <= result["total_time"] <= 2.0 * 3.2, result["total_time"]
+    # and supports the planning question: more micro-batches -> less idle
+    more = SimulationEngine(
+        pipe_parallel_size=pp, gradient_accumulation_steps=4 * gas,
+        durations=durations,
+    ).simulate()
+    assert max(more["idle_fraction"]) < max(result["idle_fraction"]), (
+        more["idle_fraction"], result["idle_fraction"])
