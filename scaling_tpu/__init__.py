@@ -3,8 +3,8 @@
 A ground-up JAX/XLA/Pallas re-design of the capabilities of Aleph Alpha's
 ``scaling`` library (reference: marcobellagente93/scaling): 4-axis
 parallelism (data x tensor x pipeline x context — ring or ulysses) over
-one ``jax.sharding.Mesh``, Megatron-style sequence parallelism, ZeRO-1
-optimizer-state sharding, mixture-of-experts with expert parallelism,
+one ``jax.sharding.Mesh``, Megatron-style sequence parallelism, ZeRO-1/3
+optimizer-state (and FSDP param) sharding, mixture-of-experts with expert parallelism,
 muP width-transferable hyperparameters, mixed precision with dynamic
 loss scaling, activation rematerialisation, layout-independent npz or
 orbax/tensorstore checkpoints, multi-host training over
@@ -14,14 +14,15 @@ fine-tuning, batched KV-cached and tensor-parallel inference).
 
 Layout:
   scaling_tpu.config     pydantic config base (yaml/json, templates)
-  scaling_tpu.topology   3D device layout -> jax.sharding.Mesh
+  scaling_tpu.topology   4-axis device layout -> jax.sharding.Mesh
   scaling_tpu.data       memory-mapped datasets, deterministic loaders
   scaling_tpu.nn         functional layers + parameter metadata
   scaling_tpu.parallel   collectives, sharding rules, pipeline engine
   scaling_tpu.ops        Pallas TPU kernels (flash attention, fused norms)
-  scaling_tpu.optimizer  AdamW w/ fp32 master, ZeRO-1, loss scaler, LR
+  scaling_tpu.optimizer  AdamW w/ fp32 master, ZeRO-1/3, loss scaler, LR
   scaling_tpu.trainer    generic train loop + checkpoint orchestration
   scaling_tpu.models     model suites (transformer)
+  scaling_tpu.determined optional Determined AI cluster glue
 """
 
 __version__ = "0.1.0"
