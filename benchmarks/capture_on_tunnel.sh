@@ -1,0 +1,67 @@
+#!/bin/bash
+# Probe the TPU tunnel every 4 minutes; on the FIRST healthy probe run the
+# entire capture sequence unattended (a short window must still yield the
+# round's perf evidence), logging everything under .scratch/capture/.
+cd /root/repo
+mkdir -p .scratch/capture
+for i in $(seq 1 200); do
+  ts=$(date +%H:%M:%S)
+  out=$(timeout 75 python -c "
+from scaling_tpu.devices import probe_devices
+devs, err = probe_devices(timeout_s=60)
+print('OK' if devs else f'DEAD {err}')
+" 2>/dev/null | tail -1)
+  echo "$ts $out" >> .scratch/tunnel_status.log
+  if [[ "$out" == OK* ]]; then
+    echo "TUNNEL ALIVE at $ts (iteration $i) — starting capture"
+    # 1. the headline artifact first: a plain bench pass exactly as the
+    #    driver runs it (BENCH_WAIT_S default retries cover flaps)
+    echo "=== bench 0.5b $(date) ===" > .scratch/capture/bench_05b.log
+    timeout 3600 python bench.py >> .scratch/capture/bench_05b.log 2>&1
+    echo "bench 0.5b rc=$?" >> .scratch/capture/bench_05b.log
+    # 2. the full serial measurement session (A/Bs, sweeps, trace)
+    echo "=== chip_session $(date) ===" > .scratch/capture/chip_session.log
+    timeout 7200 python benchmarks/chip_session.py >> .scratch/capture/chip_session.log 2>&1
+    echo "chip_session rc=$?" >> .scratch/capture/chip_session.log
+    # 3. trace attribution
+    timeout 600 python benchmarks/analyze_trace.py /tmp/bench_trace_tpu \
+      > .scratch/capture/trace_analysis.log 2>&1
+    # 4. the 1B single-chip attempt (expected tight on HBM; record it)
+    echo "=== bench 1b $(date) ===" > .scratch/capture/bench_1b.log
+    BENCH_MODEL=1b BENCH_WAIT_S=600 timeout 3600 python bench.py \
+      >> .scratch/capture/bench_1b.log 2>&1
+    echo "bench 1b rc=$?" >> .scratch/capture/bench_1b.log
+    # 5. tuned final pass: pick the fastest mbs and the norm winner out of
+    #    the session log, then run bench once more with those knobs
+    python - <<'PYEOF' > .scratch/capture/winners.env 2>.scratch/capture/winners.err
+import re
+txt = open(".scratch/capture/chip_session.log").read()
+best_mbs, best_t = None, None
+for m in re.finditer(r"6\. step mbs=(\d+):\s+([0-9.]+) ms", txt):
+    mbs, t = int(m.group(1)), float(m.group(2))
+    tok_s = mbs / t
+    if best_t is None or tok_s > best_t:
+        best_mbs, best_t = mbs, tok_s
+steps = dict(re.findall(r"3/4\. step ([a-z+]+):\s+([0-9.]+) ms", txt))
+norm = ""
+if "flash" in steps and "flash+fusednorm" in steps:
+    if float(steps["flash+fusednorm"]) < float(steps["flash"]):
+        norm = "fused"
+print(f"BENCH_MBS={best_mbs or ''}")
+print(f"BENCH_NORM={norm}")
+PYEOF
+    set -a; source .scratch/capture/winners.env 2>/dev/null; set +a
+    [ -z "$BENCH_MBS" ] && unset BENCH_MBS
+    [ -z "$BENCH_NORM" ] && unset BENCH_NORM
+    echo "=== bench tuned (BENCH_MBS=$BENCH_MBS BENCH_NORM=$BENCH_NORM) $(date) ===" \
+      > .scratch/capture/bench_tuned.log
+    BENCH_WAIT_S=600 timeout 3600 python bench.py \
+      >> .scratch/capture/bench_tuned.log 2>&1
+    echo "bench tuned rc=$?" >> .scratch/capture/bench_tuned.log
+    echo "CAPTURE COMPLETE at $(date)"
+    exit 0
+  fi
+  sleep 240
+done
+echo "TUNNEL never came up"
+exit 1
