@@ -294,9 +294,10 @@ def main() -> None:
     # default ~0.5B: params bf16 + fp32 master/moments + fp32 grads ~ 9G,
     # inside the 16G HBM of the smallest current chip (v5e)
     hidden, layers, remat = 2048, 8, False
-    # the ladder stops at the first arm that isn't faster per token, so
-    # 16 only runs if 8 already won
-    default_mbs_plan = [4, 8, 16]
+    # the ladder stops at the first arm that isn't faster per token (and an
+    # arm that OOMs keeps the last recorded winner), so the tail only runs
+    # while each rung keeps winning
+    default_mbs_plan = [4, 8, 16, 32]
     bench_model = os.environ.get("BENCH_MODEL", "0.5b")
     if bench_model not in ("0.5b", "1b"):
         sys.exit(f"# bench: unknown BENCH_MODEL {bench_model!r} (0.5b|1b)")
