@@ -202,6 +202,28 @@ def synth_batch(rng: np.random.Generator, batch: int, seq_len: int, vocab: int, 
     }
 
 
+def climb_mbs_ladder(measure, mbs_plan, arch, dt):
+    """Self-tune the micro-batch: keep climbing the plan while each rung is
+    faster PER TOKEN than the last kept one; an arm that fails (OOM on a
+    16G chip is the expected failure) or stops winning keeps the recorded
+    winner. ``measure(mbs) -> (arch, step_seconds)``; returns the winning
+    ``(arch, step_seconds, mbs)``."""
+    mbs = mbs_plan[0]
+    for trial in mbs_plan[1:]:
+        try:
+            arch_t, dt_t = measure(trial)
+        except Exception as e:
+            # bigger batches may simply not fit; keep the recorded number
+            print(f"# mbs={trial} arm failed ({type(e).__name__}); "
+                  f"keeping mbs={mbs}", file=sys.stderr)
+            break
+        if trial / dt_t > mbs / dt:
+            arch, dt, mbs = arch_t, dt_t, trial
+        else:
+            break
+    return arch, dt, mbs
+
+
 def checked_devices():
     """First device contact, tunnel-proof.
 
@@ -387,19 +409,7 @@ def main() -> None:
         print(f"# flash kernel failed ({type(e).__name__}); XLA fallback", file=sys.stderr)
         os.environ["BENCH_KERNEL"] = "torch"
         arch, dt = measure(mbs_plan[0])
-    mbs = mbs_plan[0]
-    for trial in mbs_plan[1:]:
-        try:
-            arch_t, dt_t = measure(trial)
-        except Exception as e:
-            # bigger batches may simply not fit; keep the recorded number
-            print(f"# mbs={trial} arm failed ({type(e).__name__}); "
-                  f"keeping mbs={mbs}", file=sys.stderr)
-            break
-        if trial * seq_len / dt_t > mbs * seq_len / dt:
-            arch, dt, mbs = arch_t, dt_t, trial
-        else:
-            break
+    arch, dt, mbs = climb_mbs_ladder(measure, mbs_plan, arch, dt)
 
     tokens_per_sec = mbs * seq_len / dt
     param_count = get_model_parameter_count(

@@ -68,3 +68,39 @@ def test_bench_aborts_cleanly_when_backend_unreachable():
     assert proc.returncode != 0
     assert "unreachable" in (proc.stderr + proc.stdout)
     assert not any(ln.startswith("{") for ln in proc.stdout.splitlines())
+
+
+def test_mbs_ladder_logic():
+    """The self-tune ladder (pure logic, faked measurements): climbs while
+    per-token speed improves, stops on the first non-winner, and an OOM
+    arm keeps the recorded winner instead of killing the bench."""
+    sys.path.insert(0, str(REPO_ROOT))
+    import bench
+
+    def fake_measure(times):
+        def measure(mbs):
+            t = times[mbs]
+            if t is None:
+                raise RuntimeError("RESOURCE_EXHAUSTED")
+            return f"arch{mbs}", t
+        return measure
+
+    # 8 wins per token (8/1.5 > 4/1), 16 loses (16/4 < 8/1.5) -> keep 8
+    times = {4: 1.0, 8: 1.5, 16: 4.0, 32: 0.1}
+    arch, dt, mbs = bench.climb_mbs_ladder(
+        fake_measure(times), [4, 8, 16, 32], "arch4", times[4]
+    )
+    assert (arch, dt, mbs) == ("arch8", 1.5, 8)  # 32 never measured
+
+    # 8 OOMs -> stay at 4
+    arch, dt, mbs = bench.climb_mbs_ladder(
+        fake_measure({4: 1.0, 8: None}), [4, 8, 16], "arch4", 1.0
+    )
+    assert (arch, dt, mbs) == ("arch4", 1.0, 4)
+
+    # monotone winner climbs to the top rung
+    times = {4: 1.0, 8: 1.9, 16: 3.7}
+    arch, dt, mbs = bench.climb_mbs_ladder(
+        fake_measure(times), [4, 8, 16], "arch4", 1.0
+    )
+    assert mbs == 16
