@@ -261,3 +261,65 @@ def test_clip_resnet_checkpoint_applied_at_train_startup(tmp_path):
             break
     else:
         raise AssertionError("clip_resnet trunk parameter not found")
+
+
+def test_clip_resnet_checkpoint_roundtrip(tmp_path):
+    """The trunk's params must survive save -> fresh-trainer load on BOTH
+    on-disk formats (the meta-key collision bug class made exactly this
+    impossible for the ViT trunk)."""
+    import jax as _jax
+
+    from scaling_tpu.data.memory_map import MemoryMapDatasetBuilder
+    from scaling_tpu.models.transformer import TransformerConfig
+    from scaling_tpu.models.transformer.train import main
+
+    prefix = tmp_path / "data"
+    rng = np.random.default_rng(5)
+    with MemoryMapDatasetBuilder(prefix, dtype=np.uint16) as b:
+        for _ in range(32):
+            doc = rng.integers(1, 96, size=rng.integers(8, 48))
+            b.add(np.append(doc, 0).astype(np.uint16))
+
+    def cfg(save_dir, load_dir, backend, iters):
+        return TransformerConfig.from_dict({
+            "topology": {"model_parallel_size": 1, "pipe_parallel_size": 1,
+                         "data_parallel_size": 1, "micro_batch_size": 2,
+                         "gradient_accumulation_steps": 1},
+            "transformer_architecture": {
+                "vocab_size": 96, "hidden_size": 32, "num_layers": 1,
+                "num_attention_heads": 4, "sequence_length": 160,
+                "image_encoder": True,
+                "image_encoder_backbone": "clip_resnet",
+                "image_encoder_resnet_stages": [1, 1, 1, 1],
+                "image_encoder_resnet_channels": 8,
+            },
+            "optimizer": {"gradient_clipping": 1.0},
+            "learning_rate_scheduler": {"learning_rate": 0.01,
+                                        "learning_rate_warmup_steps": 2,
+                                        "learning_rate_decay_iters": 50},
+            "trainer": {"train_iterations": iters, "seed": 42,
+                        "save_dir": str(save_dir) if save_dir else None,
+                        "save_interval": 1,
+                        "checkpoint_backend": backend,
+                        "load_dir": str(load_dir) if load_dir else None,
+                        "assert_checkpoint_loaded": load_dir is not None},
+            "data": {"data_prefixes": [str(prefix)]},
+            "logger": {"log_dir": None},
+        })
+
+    for backend in ("npz", "orbax"):
+        root = tmp_path / backend
+        t1 = main(cfg(root, None, backend, iters=1))  # trains 1, saves
+        t2 = main(cfg(None, root, backend, iters=1))  # loads; 1 >= iters: no extra steps
+
+        def trunk(trainer):
+            return {
+                k: np.asarray(p, np.float32)
+                for k, p, _ in trainer.module.named_parameters(trainer.params)
+                if ".image_encoder.clip." in f".{k}"
+            }
+
+        a, b_ = trunk(t1), trunk(t2)
+        assert a.keys() == b_.keys() and len(a) >= 30, len(a)
+        for k in a:
+            np.testing.assert_array_equal(a[k], b_[k], err_msg=f"{backend}:{k}")
