@@ -1,0 +1,84 @@
+"""Turn a capture session's logs into the PERF.md evidence table.
+
+Reads the files `capture_on_tunnel.sh` writes (or any directory holding
+bench/chip-session output) and prints one markdown block: the bench JSON
+rows, every chip-session measurement, and the tuned-pass winners — so a
+healthy-tunnel window turns into committed evidence in one paste.
+
+Usage: python benchmarks/summarize_capture.py [capture_dir]
+       (default .scratch/capture)
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from pathlib import Path
+
+SECTION_RE = re.compile(r"^([0-9]+(?:/[0-9]+)?)\. (.+?):\s*(.+)$")
+
+
+def bench_rows(capture: Path) -> list:
+    rows = []
+    for name in ("bench_05b", "bench_1b", "bench_tuned"):
+        f = capture / f"{name}.log"
+        if not f.is_file():
+            continue
+        rec = None
+        for line in f.read_text().splitlines():
+            if line.startswith("{"):
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        rc = re.search(r"rc=(\d+)", f.read_text())
+        rows.append((name, rec, int(rc.group(1)) if rc else None))
+    return rows
+
+
+def session_lines(capture: Path) -> list:
+    f = capture / "chip_session.log"
+    if not f.is_file():
+        return []
+    out = []
+    for line in f.read_text().splitlines():
+        m = SECTION_RE.match(line.strip())
+        if m:
+            out.append((m.group(1), m.group(2), m.group(3)))
+    return out
+
+
+def main() -> None:
+    capture = Path(sys.argv[1] if len(sys.argv) > 1 else ".scratch/capture")
+    if not capture.is_dir():
+        sys.exit(f"no capture directory at {capture}")
+
+    print("### Captured on-chip evidence\n")
+    rows = bench_rows(capture)
+    if rows:
+        print("| bench arm | tokens/s | MFU | vs measured peak | mbs | kernel | rc |")
+        print("|---|---|---|---|---|---|---|")
+        for name, rec, rc in rows:
+            if rec is None:
+                print(f"| {name} | — | — | — | — | — | {rc} |")
+                continue
+            print(
+                f"| {name} ({rec.get('model', '?')}) | {rec['value']} "
+                f"| {rec.get('mfu')} | {rec.get('mfu_vs_measured_peak')} "
+                f"| {rec.get('micro_batch_size')} | {rec.get('kernel')} | {rc} |"
+            )
+        print()
+    lines = session_lines(capture)
+    if lines:
+        print("| session arm | measurement |")
+        print("|---|---|")
+        for _num, name, value in lines:
+            print(f"| {name} | {value} |")
+        print()
+    if not rows and not lines:
+        print("(capture directory holds no parseable results)")
+
+
+if __name__ == "__main__":
+    main()
