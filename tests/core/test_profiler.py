@@ -33,3 +33,44 @@ def test_synchronized_timer():
     x = jnp.ones((64, 64)) @ jnp.ones((64, 64))
     d = t.stop(wait_for=x)
     assert d > 0 and t.durations == [d]
+
+
+def test_capture_xla_trace_produces_parseable_xplane(tmp_path):
+    """capture_xla_trace writes a real xplane dump next to the
+    observations, and the analyzer's wire-format walk parses it — the
+    exact pipeline a profiled training run hands to analyze_trace.py."""
+    import jax
+    import jax.numpy as jnp
+
+    out = tmp_path / "profile.json"
+    p = Profiler(ProfilerConfig(profile_steps=1, profile_start_at_step=0,
+                                profiler_output=out, capture_xla_trace=True))
+
+    @jax.jit
+    def work(x):
+        return (x @ x).sum()
+
+    x = jnp.ones((128, 128))
+    jax.block_until_ready(work(x))
+    p.begin_step(0)
+    jax.block_until_ready(work(x))
+    p.record(0, {"step_time": 0.01})
+    p.end_step(0)
+
+    assert json.loads(out.read_text())[0]["step"] == 0
+    trace_dir = out.parent / "xla_trace"
+    files = list(trace_dir.glob("**/*.xplane.pb"))
+    assert files, "capture_xla_trace produced no xplane file"
+
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[2]
+    proc = subprocess.run(
+        [sys.executable, str(repo / "benchmarks" / "analyze_trace.py"),
+         str(trace_dir)],
+        capture_output=True, text=True, timeout=120, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "ms total" in proc.stdout
