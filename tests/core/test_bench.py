@@ -104,3 +104,14 @@ def test_mbs_ladder_logic():
         fake_measure(times), [4, 8, 16], "arch4", 1.0
     )
     assert mbs == 16
+
+
+def test_bench_rejects_unknown_model():
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "bench.py")],
+        capture_output=True, text=True, timeout=300,
+        env=_bench_env(BENCH_MODEL="7b", BENCH_WAIT_S="60"),
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode != 0
+    assert "unknown BENCH_MODEL" in (proc.stderr + proc.stdout)

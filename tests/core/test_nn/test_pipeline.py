@@ -273,3 +273,12 @@ def test_profile_feeds_the_simulator(tmp_path):
     ).simulate()
     assert max(more["idle_fraction"]) < max(result["idle_fraction"]), (
         more["idle_fraction"], result["idle_fraction"])
+
+
+def test_durations_from_profile_rejects_empty_profiles():
+    import pytest
+
+    from scaling_tpu.parallel.pipeline_schedule import durations_from_profile
+
+    with pytest.raises(ValueError, match="no step_time"):
+        durations_from_profile([{"step": 1, "data_load": 0.1}], 8)
