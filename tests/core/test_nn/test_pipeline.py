@@ -282,3 +282,15 @@ def test_durations_from_profile_rejects_empty_profiles():
 
     with pytest.raises(ValueError, match="no step_time"):
         durations_from_profile([{"step": 1, "data_load": 0.1}], 8)
+
+
+def test_visualize_renders_png(tmp_path):
+    """The PNG Gantt render (the reference's matplotlib timeline,
+    base.py:276-690) must actually produce an image file."""
+    from scaling_tpu.parallel.pipeline_schedule import visualize
+
+    out = tmp_path / "schedule.png"
+    visualize(pipe_parallel_size=4, gradient_accumulation_steps=6,
+              output_path=out)
+    assert out.is_file() and out.stat().st_size > 1000
+    assert out.read_bytes()[:8] == b"\x89PNG\r\n\x1a\n"
