@@ -30,7 +30,17 @@ class Tokenizer:
     def from_file(cls, vocab_file: Path | str) -> "Tokenizer":
         from tokenizers import Tokenizer as HFTokenizer
 
-        return cls(HFTokenizer.from_file(str(vocab_file)))
+        try:
+            return cls(HFTokenizer.from_file(str(vocab_file)))
+        except Exception as e:
+            # the rust parser's bare "expected `,` or `}` at line 1" gives
+            # no hint WHAT format was expected or WHICH file failed
+            raise ValueError(
+                f"{vocab_file} is not a serialized huggingface tokenizer "
+                f"(tokenizer.json format, as written by "
+                f"tokenizers.Tokenizer.save or shipped with hf models); "
+                f"a bare vocab map is not loadable ({e})"
+            ) from e
 
     @classmethod
     def from_str(cls, json_str: str) -> "Tokenizer":

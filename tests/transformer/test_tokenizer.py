@@ -53,3 +53,19 @@ def test_pair_loader_strips_prefix_space(tmp_path):
     normal, no_prefix = load_tokenizers(path)
     assert normal.encode("hi") == [0]  # leading metaspace applied
     assert no_prefix.encode("hi") == [1]  # mid-sentence continuation form
+
+
+def test_from_file_names_the_expected_format(tmp_path):
+    """A bare vocab map must fail with a message naming the file and the
+    expected tokenizer.json format, not the rust parser's bare
+    'expected `,` or `}`'."""
+    import json
+
+    import pytest
+
+    from scaling_tpu.models.transformer.tokenizer import Tokenizer
+
+    bad = tmp_path / "vocab.json"
+    bad.write_text(json.dumps({"a": 1, "b": 2}))
+    with pytest.raises(ValueError, match="tokenizer.json format"):
+        Tokenizer.from_file(bad)
