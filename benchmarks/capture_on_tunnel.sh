@@ -6,11 +6,7 @@ cd /root/repo
 mkdir -p .scratch/capture
 for i in $(seq 1 200); do
   ts=$(date +%H:%M:%S)
-  out=$(timeout 75 python -c "
-from scaling_tpu.devices import probe_devices
-devs, err = probe_devices(timeout_s=60)
-print('OK' if devs else f'DEAD {err}')
-" 2>/dev/null | tail -1)
+  out=$(bash benchmarks/probe_tunnel.sh)
   echo "$ts $out" >> .scratch/tunnel_status.log
   if [[ "$out" == OK* ]]; then
     echo "TUNNEL ALIVE at $ts (iteration $i) — starting capture"
@@ -21,7 +17,15 @@ print('OK' if devs else f'DEAD {err}')
     echo "bench 0.5b rc=$?" >> .scratch/capture/bench_05b.log
     # 2. the full serial measurement session (A/Bs, sweeps, trace)
     echo "=== chip_session $(date) ===" > .scratch/capture/chip_session.log
-    timeout 7200 python benchmarks/chip_session.py >> .scratch/capture/chip_session.log 2>&1
+    # chip_session bounds each section's subprocess itself; the backstop is
+    # derived from the session's own per-section budgets so adding or
+    # growing a section can't silently outlive it
+    session_budget=$(python - <<'PYB'
+from benchmarks import chip_session
+print(sum(t for _, _, t in chip_session._sections()) + 600)
+PYB
+)
+    timeout "${session_budget:-14400}" python benchmarks/chip_session.py >> .scratch/capture/chip_session.log 2>&1
     echo "chip_session rc=$?" >> .scratch/capture/chip_session.log
     # 3. trace attribution
     timeout 600 python benchmarks/analyze_trace.py /tmp/bench_trace_tpu \

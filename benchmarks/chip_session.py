@@ -1,20 +1,34 @@
 """One serial on-chip measurement session (run when the chip is healthy).
 
-Runs, in order, each timed with block_until_ready (median-of-3 via
-attn_bench.timeit):
+Every section runs in its OWN subprocess. The round-4 capture proved why:
+one RESOURCE_EXHAUSTED arm (the XLA full-step A/B duplicating ~9G of
+model/optimizer state on a 16G v5e) poisoned the process's device memory
+and every later section — mbs sweep, trace, long-context, 1b, decode —
+failed with it, and an allocation outside a try block then killed the
+session outright. A fresh process per section returns all HBM to the
+backend between sections, so an OOM (often an *informative* result, e.g.
+XLA attention at seq 32k) costs exactly one measurement.
+
+Sections (labels are stable — summarize_capture.py and the tuned-pass
+winner parser in capture_on_tunnel.sh grep them):
   1. attention micro-bench: flash vs XLA fwd+bwd at the bench shape
   2. flash block-size sweep
-  3. full train step A/B: flash vs torch kernel (shared params)
-  4. norm A/B: BENCH_NORM fused vs torch with the flash kernel
+  3/4. full train step A/B: flash vs XLA kernel vs flash+fused-norm
+       (one arm per process; identical params from the same PRNGKey)
   5. trace capture for benchmarks/analyze_trace.py
-  6. micro-batch sweep (4/8/16) after freeing earlier state; winner
-     feeds bench.py's BENCH_MBS
+  6. micro-batch sweep (4/8/16); winner feeds bench.py's BENCH_MBS
+  7. long-context attention sweep, seq 8k/16k/32k (splash vs the ring's
+     blockwise kernel vs XLA full attention — XLA OOM near 32k expected)
+  8. 1B single-chip attempt (BASELINE #3 shape, every-layer remat, mbs 1)
+  9. decode throughput (batched KV-cache generate)
 
 Usage: cd /root/repo && python benchmarks/chip_session.py 2>&1 | tee /tmp/chip_session.log
+       python benchmarks/chip_session.py <section>   # one section, in-process
 
 CHIP_SESSION_SMOKE=1 shrinks every arm to CPU-rehearsable shapes so the
-whole session's plumbing can be validated without the chip (numbers are
-then meaningless; sections that need the TPU print FAIL and move on).
+whole session's plumbing — including the subprocess fan-out — can be
+validated without the chip (numbers are then meaningless; sections that
+need the TPU print FAIL and move on).
 """
 import os
 import sys
@@ -22,184 +36,178 @@ import sys
 sys.path.insert(0, "/root/repo")
 os.chdir("/root/repo")
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from scaling_tpu.devices import probe_devices
-
-devs, err = probe_devices(timeout_s=60)
-if devs is None:
-    sys.exit(f"backend unreachable: {err}")
-print(f"devices: {[d.device_kind for d in devs]}", flush=True)
-
-import bench  # noqa: E402
-from benchmarks import attn_bench  # noqa: E402
-
 SMOKE = bool(os.environ.get("CHIP_SESSION_SMOKE"))
 # (seq, hidden, layers, mbs) of the full-step arms; long-context seqs;
 # 1b-arm layer count
 if SMOKE:
     STEP_SHAPE, LONG_SEQS, LAYERS_1B = (256, 256, 2, 2), (512, 1024), 3
+    MBS_SWEEP = (2,)
 else:
     STEP_SHAPE, LONG_SEQS, LAYERS_1B = (2048, 2048, 8, 4), (8192, 16384, 32768), 20
+    MBS_SWEEP = (4, 8, 16)
 SEQ, HIDDEN, LAYERS, MBS = STEP_SHAPE
 
-# every section is fault-isolated: a broken arm (or a tunnel hiccup mid-
-# session) must not take the remaining sections' measurements with it
-# ---------------------------------------------------------- 1. micro bench
-q, k, v, seg = attn_bench.make_qkv()
-for name, fn in (("flash", attn_bench.flash), ("xla", attn_bench.xla_attn)):
-    try:
-        t = attn_bench.timeit(attn_bench.fwd_bwd(fn), q, k, v, seg)
-        print(f"1. attn {name} f+b: {t:8.2f} ms", flush=True)
-    except Exception as e:
-        print(f"1. attn {name} f+b: FAIL {type(e).__name__}", flush=True)
 
-# ------------------------------------------------------ 2. block-size sweep
-for bq, bkv in ((512, 512), (1024, 1024), (2048, 1024), (1024, 2048)):
-    os.environ["SCALING_TPU_FLASH_BLOCK_Q"] = str(bq)
-    os.environ["SCALING_TPU_FLASH_BLOCK_KV"] = str(bkv)
-    try:
-        t = attn_bench.timeit(attn_bench.fwd_bwd(attn_bench.flash), q, k, v, seg)
-        print(f"2. flash blocks q={bq} kv={bkv}: {t:8.2f} ms", flush=True)
-    except Exception as e:
-        print(f"2. flash blocks q={bq} kv={bkv}: FAIL {type(e).__name__}", flush=True)
-os.environ.pop("SCALING_TPU_FLASH_BLOCK_Q", None)
-os.environ.pop("SCALING_TPU_FLASH_BLOCK_KV", None)
+# ------------------------------------------------------------ child plumbing
+def _init_backend():
+    """First device contact, fail-fast (shared with bench.py/dryrun)."""
+    from scaling_tpu.devices import probe_devices
+
+    devs, err = probe_devices(timeout_s=60)
+    if devs is None:
+        sys.exit(f"backend unreachable: {err}")
+    return devs
 
 
-# ------------------------------------------------- 3./4. full-step A/B
-def build_step(kernel, norm="torch"):
+def _build_step(mbs, layers=None, remat=False, kernel="flash_attention",
+                norm=None):
+    """Fresh model+optimizer+jitted step at the bench shape.
+
+    Each section process builds its own copy from PRNGKey(0), so A/B arms
+    in different processes still measure identical parameter values.
+    """
+    import jax
+    import numpy as np
+
+    import bench
+
     os.environ["BENCH_KERNEL"] = kernel
-    os.environ["BENCH_NORM"] = norm
-    config, topology, module, optimizer = bench.build(SEQ, MBS, HIDDEN, LAYERS)
+    if norm is None:
+        os.environ.pop("BENCH_NORM", None)
+    else:
+        os.environ["BENCH_NORM"] = norm
+    key = jax.random.PRNGKey(0)
+    cfg, _, module, optimizer = bench.build(
+        SEQ, mbs, HIDDEN, layers if layers is not None else LAYERS, remat=remat
+    )
     step = module.build_train_step(optimizer, bench.loss_function, donate=False)
-    return config, module, optimizer, step
-
-
-key = jax.random.PRNGKey(0)
-step_ab_ready = False
-try:
-    cfg, module, optimizer, step_f = build_step("flash_attention")
-    arch = cfg.transformer_architecture
     params = module.shard_params(module.init_params(key))
     opt_state = optimizer.init_state(params)
-    rng = np.random.default_rng(0)
     batch = module.shard_batch(
-        bench.synth_batch(rng, MBS, SEQ, arch.vocab_size, 1), stacked=True
+        bench.synth_batch(np.random.default_rng(0), mbs, SEQ,
+                          cfg.transformer_architecture.vocab_size, 1),
+        stacked=True,
     )
-    _, _, _, step_x = build_step("torch")
-    _, _, _, step_fn = build_step("flash_attention", norm="fused")
-    step_ab_ready = True
-except Exception as e:
-    print(f"3/4. setup: FAIL {type(e).__name__}: {e}", flush=True)
 
-
-def run_step(stp):
-    def f(params, opt_state):
-        _, _, loss, _, _ = stp(params, opt_state, batch, key)
+    def f(pp, ss):
+        _, _, loss, _, _ = step(pp, ss, batch, key)
         return loss
 
-    return f
+    return cfg, f, params, opt_state
 
 
-if step_ab_ready:
-    for name, stp in (("flash", step_f), ("xla", step_x),
-                      ("flash+fusednorm", step_fn)):
+# ---------------------------------------------------------------- sections
+def sec_attn():
+    from benchmarks import attn_bench
+
+    q, k, v, seg = attn_bench.make_qkv()
+    for name, fn in (("flash", attn_bench.flash), ("xla", attn_bench.xla_attn)):
         try:
-            t = attn_bench.timeit(run_step(stp), params, opt_state, iters=3)
-            print(f"3/4. step {name}: {t:8.1f} ms", flush=True)
+            t = attn_bench.timeit(attn_bench.fwd_bwd(fn), q, k, v, seg)
+            print(f"1. attn {name} f+b: {t:8.2f} ms", flush=True)
         except Exception as e:
-            print(f"3/4. step {name}: FAIL {type(e).__name__}: {e}", flush=True)
+            print(f"1. attn {name} f+b: FAIL {type(e).__name__}", flush=True)
 
-# --------------------------------------------------------- 5. trace capture
-os.environ["BENCH_KERNEL"] = "flash_attention"
-os.environ.pop("BENCH_NORM", None)
-outdir = "/tmp/bench_trace_tpu"
-_tracing = False
-try:
-    if not step_ab_ready:
-        raise RuntimeError("step A/B setup failed; nothing to trace")
-    jax.profiler.start_trace(outdir)
-    _tracing = True
-    for i in range(2):
-        loss = run_step(step_f)(params, opt_state)
-    jax.block_until_ready(loss)
-    jax.profiler.stop_trace()
-    _tracing = False
-    print(
-        f"5. trace written to {outdir}; analyze with "
-        f"python benchmarks/analyze_trace.py {outdir}",
-        flush=True,
-    )
-except Exception as e:
-    print(f"5. trace capture: FAIL {type(e).__name__}: {e}", flush=True)
-finally:
-    if _tracing:
-        # a failure mid-trace must not leave the profiler running under
-        # sections 6-8 (distorted timings, unbounded trace buffers)
+
+def sec_blocks():
+    from benchmarks import attn_bench
+
+    q, k, v, seg = attn_bench.make_qkv()
+    for bq, bkv in ((512, 512), (1024, 1024), (2048, 1024), (1024, 2048)):
+        os.environ["SCALING_TPU_FLASH_BLOCK_Q"] = str(bq)
+        os.environ["SCALING_TPU_FLASH_BLOCK_KV"] = str(bkv)
         try:
-            jax.profiler.stop_trace()
-        except Exception:
-            pass
+            t = attn_bench.timeit(attn_bench.fwd_bwd(attn_bench.flash),
+                                  q, k, v, seg)
+            print(f"2. flash blocks q={bq} kv={bkv}: {t:8.2f} ms", flush=True)
+        except Exception as e:
+            print(f"2. flash blocks q={bq} kv={bkv}: FAIL {type(e).__name__}",
+                  flush=True)
 
-# ------------------------------------------- 6. micro-batch size sweep
-# bigger per-step batch amortizes per-step overheads and widens MXU tiles;
-# memory-bound upward (fp32 masters dominate). Winner feeds bench.py's
-# BENCH_MBS. Runs LAST so the earlier sections' ~9G of model/optimizer
-# state can be freed first (a duplicate resident model would OOM the
-# larger arms on a 16G v5e), and with BENCH_NORM cleared so the sweep
-# measures the exact configuration bench.py runs.
-for _n in ("params", "opt_state", "batch", "step_f", "step_x", "step_fn"):
-    globals().pop(_n, None)
-os.environ["BENCH_KERNEL"] = "flash_attention"
-os.environ.pop("BENCH_NORM", None)
-for mbs in ((2,) if SMOKE else (4, 8, 16)):
+
+def sec_step(label, kernel, norm=None):
+    from benchmarks import attn_bench
+
     try:
-        cfg_m, _, mod_m, opt_m = bench.build(SEQ, mbs, HIDDEN, LAYERS)
-        step_m = mod_m.build_train_step(opt_m, bench.loss_function, donate=False)
-        p_m = mod_m.shard_params(mod_m.init_params(key))
-        s_m = opt_m.init_state(p_m)
-        b_m = mod_m.shard_batch(
-            bench.synth_batch(np.random.default_rng(0), mbs, SEQ,
-                              cfg_m.transformer_architecture.vocab_size, 1),
-            stacked=True,
+        _, f, params, opt_state = _build_step(MBS, kernel=kernel, norm=norm)
+        t = attn_bench.timeit(f, params, opt_state, iters=3)
+        print(f"3/4. step {label}: {t:8.1f} ms", flush=True)
+    except Exception as e:
+        print(f"3/4. step {label}: FAIL {type(e).__name__}: {e}", flush=True)
+
+
+def sec_trace():
+    import jax
+
+    outdir = "/tmp/bench_trace_tpu"
+    _tracing = False
+    try:
+        _, f, params, opt_state = _build_step(MBS)
+        loss = f(params, opt_state)  # compile OUTSIDE the trace window
+        jax.block_until_ready(loss)
+        jax.profiler.start_trace(outdir)
+        _tracing = True
+        for _ in range(2):
+            loss = f(params, opt_state)
+        jax.block_until_ready(loss)
+        jax.profiler.stop_trace()
+        _tracing = False
+        print(
+            f"5. trace written to {outdir}; analyze with "
+            f"python benchmarks/analyze_trace.py {outdir}",
+            flush=True,
         )
+    except Exception as e:
+        print(f"5. trace capture: FAIL {type(e).__name__}: {e}", flush=True)
+    finally:
+        if _tracing:
+            # a failure mid-trace must not leave the profiler running
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
 
-        def f_m(pp, ss, _step=step_m, _b=b_m):
-            _, _, loss, _, _ = _step(pp, ss, _b, key)
-            return loss
 
-        t = attn_bench.timeit(f_m, p_m, s_m, iters=3)
+def sec_mbs(mbs):
+    # bigger per-step batch amortizes per-step overheads and widens MXU
+    # tiles; memory-bound upward (fp32 masters dominate). Winner feeds
+    # bench.py's BENCH_MBS. BENCH_NORM stays cleared so the sweep measures
+    # the exact configuration bench.py runs.
+    from benchmarks import attn_bench
+
+    try:
+        _, f, params, opt_state = _build_step(mbs)
+        t = attn_bench.timeit(f, params, opt_state, iters=3)
         print(f"6. step mbs={mbs}: {t:8.1f} ms "
               f"({mbs * SEQ / t * 1000:.0f} tok/s)", flush=True)
-        del p_m, s_m, b_m, step_m
     except Exception as e:
         print(f"6. step mbs={mbs}: FAIL {type(e).__name__}: {e}", flush=True)
 
-# ------------------------------- 7. long-context attention sweep (one chip)
-# The no-O(s^2) story at wall-clock (VERDICT r3 #8): splash flash kernel vs
-# the ring's blockwise kernel (cp=1: one ring step IS the blockwise inner
-# loop with its chunked score tiles) vs XLA full attention, fwd+bwd at
-# seq 8k/16k/32k. XLA is EXPECTED to fail near 32k (the 16*s^2 score tensor
-# alone is ~34G) — that failure is the point of the comparison.
-from scaling_tpu.ops.ring_attention import ring_attention
-from scaling_tpu.topology import Topology, TopologyConfig
 
-_topo1 = Topology(TopologyConfig.from_dict({
-    "model_parallel_size": 1, "pipe_parallel_size": 1,
-    "data_parallel_size": 1, "context_parallel_size": 1,
-    "micro_batch_size": 1, "gradient_accumulation_steps": 1,
-}))
+def sec_long(s_long):
+    # The no-O(s^2) story at wall-clock (VERDICT r3 #8): splash flash kernel
+    # vs the ring's blockwise kernel (cp=1: one ring step IS the blockwise
+    # inner loop with its chunked score tiles) vs XLA full attention,
+    # fwd+bwd. XLA is EXPECTED to fail near 32k (the 16*s^2 score tensor
+    # alone is ~34G) — that failure is the point of the comparison, and the
+    # per-section process means it cannot poison the other arms.
+    import jax
+    import jax.numpy as jnp
 
+    from benchmarks import attn_bench
+    from scaling_tpu.ops.ring_attention import ring_attention
+    from scaling_tpu.topology import Topology, TopologyConfig
 
-def _ring_op(q, k, v, seg):
-    return ring_attention(q, k, v, seg, _topo1.mesh, causal=True,
-                          sm_scale=attn_bench.SCALE)
+    _topo1 = Topology(TopologyConfig.from_dict({
+        "model_parallel_size": 1, "pipe_parallel_size": 1,
+        "data_parallel_size": 1, "context_parallel_size": 1,
+        "micro_batch_size": 1, "gradient_accumulation_steps": 1,
+    }))
 
+    def _ring_op(q, k, v, seg):
+        return ring_attention(q, k, v, seg, _topo1.mesh, causal=True,
+                              sm_scale=attn_bench.SCALE)
 
-for s_long in LONG_SEQS:
     kq = jax.random.PRNGKey(1)
     q_l = jax.random.normal(kq, (1, s_long, 16, 128), jnp.bfloat16)
     k_l = jax.random.normal(kq, (1, s_long, 4, 128), jnp.bfloat16)
@@ -213,60 +221,109 @@ for s_long in LONG_SEQS:
             print(f"7. seq={s_long} {name}: {t:8.1f} ms", flush=True)
         except Exception as e:
             print(f"7. seq={s_long} {name}: FAIL {type(e).__name__}", flush=True)
-    del q_l, k_l, v_l, seg_l
 
-# ----------------------------------------- 8. 1B single-chip attempt
-# BASELINE #3's shape with every-layer remat at mbs 1 (bench.py's
-# BENCH_MODEL=1b arm). fp32 master+moments + bf16 params are 15.3G of the
-# 16G v5e, so an OOM here is a legitimate, informative outcome — record it.
-os.environ["BENCH_KERNEL"] = "flash_attention"
-try:
-    cfg_b, _, mod_b, opt_b = bench.build(SEQ, 1, HIDDEN, LAYERS_1B, remat=True)
-    step_b = mod_b.build_train_step(opt_b, bench.loss_function, donate=False)
-    p_b = mod_b.shard_params(mod_b.init_params(key))
-    s_b = opt_b.init_state(p_b)
-    b_b = mod_b.shard_batch(
-        bench.synth_batch(np.random.default_rng(0), 1, SEQ,
-                          cfg_b.transformer_architecture.vocab_size, 1),
-        stacked=True,
-    )
 
-    def f_b(pp, ss):
-        _, _, loss, _, _ = step_b(pp, ss, b_b, key)
-        return loss
+def sec_1b():
+    # BASELINE #3's shape with every-layer remat at mbs 1 (bench.py's
+    # BENCH_MODEL=1b arm). fp32 master+moments + bf16 params are 15.3G of
+    # the 16G v5e, so an OOM here is a legitimate, informative outcome.
+    from benchmarks import attn_bench
 
-    t = attn_bench.timeit(f_b, p_b, s_b, iters=3)
-    print(f"8. 1b step mbs=1: {t:8.1f} ms ({SEQ / t * 1000:.0f} tok/s)",
-          flush=True)
-    del p_b, s_b, b_b, step_b
-except Exception as e:
-    print(f"8. 1b step: FAIL {type(e).__name__}: {e}", flush=True)
+    try:
+        _, f, params, opt_state = _build_step(1, layers=LAYERS_1B, remat=True)
+        t = attn_bench.timeit(f, params, opt_state, iters=3)
+        print(f"8. 1b step mbs=1: {t:8.1f} ms ({SEQ / t * 1000:.0f} tok/s)",
+              flush=True)
+    except Exception as e:
+        print(f"8. 1b step: FAIL {type(e).__name__}: {e}", flush=True)
 
-# ------------------------------------------- 9. decode throughput
-# Batched KV-cache generate at the bench model size: decode is
-# HBM-bandwidth-bound (each new token re-reads the weights), so this
-# number tracks a different ceiling than the training MFU.
-try:
-    import time as _time
 
-    from scaling_tpu.models.transformer.inference import (
-        TransformerInferenceModule,
-    )
+def sec_decode():
+    # Batched KV-cache generate at the bench model size: decode is
+    # HBM-bandwidth-bound (each new token re-reads the weights), so this
+    # number tracks a different ceiling than the training MFU.
+    try:
+        import time as _time
 
-    cfg_i, _, mod_i, _ = bench.build(SEQ, 1, HIDDEN, LAYERS)
-    p_i = mod_i.shard_params(mod_i.init_params(key))
-    im = TransformerInferenceModule(cfg_i, mod_i, p_i)
-    gen_b, prompt_len = 8, 128
-    gen_tokens = 8 if SMOKE else 128
-    prompt = np.random.default_rng(0).integers(
-        1, 1000, size=(gen_b, prompt_len)
-    )
-    im.generate(prompt, max_tokens=2)  # compile prefill + decode
-    t0 = _time.perf_counter()
-    im.generate(prompt, max_tokens=gen_tokens)
-    dt = _time.perf_counter() - t0
-    print(f"9. decode: {gen_b * gen_tokens / dt:8.0f} tok/s "
-          f"(batch {gen_b}, {gen_tokens} new tokens, cached)", flush=True)
-    del p_i, im
-except Exception as e:
-    print(f"9. decode: FAIL {type(e).__name__}: {e}", flush=True)
+        import jax
+        import numpy as np
+
+        import bench
+        from scaling_tpu.models.transformer.inference import (
+            TransformerInferenceModule,
+        )
+
+        os.environ["BENCH_KERNEL"] = "flash_attention"
+        os.environ.pop("BENCH_NORM", None)  # measure the bench-default norm
+        cfg_i, _, mod_i, _ = bench.build(SEQ, 1, HIDDEN, LAYERS)
+        p_i = mod_i.shard_params(mod_i.init_params(jax.random.PRNGKey(0)))
+        im = TransformerInferenceModule(cfg_i, mod_i, p_i)
+        gen_b, prompt_len = 8, 128
+        gen_tokens = 8 if SMOKE else 128
+        prompt = np.random.default_rng(0).integers(
+            1, 1000, size=(gen_b, prompt_len)
+        )
+        im.generate(prompt, max_tokens=2)  # compile prefill + decode
+        t0 = _time.perf_counter()
+        im.generate(prompt, max_tokens=gen_tokens)
+        dt = _time.perf_counter() - t0
+        print(f"9. decode: {gen_b * gen_tokens / dt:8.0f} tok/s "
+              f"(batch {gen_b}, {gen_tokens} new tokens, cached)", flush=True)
+    except Exception as e:
+        print(f"9. decode: FAIL {type(e).__name__}: {e}", flush=True)
+
+
+def _sections():
+    """(name, thunk, timeout_s) in run order. Timeouts bound a wedged
+    tunnel per-section instead of letting one hang eat the session."""
+    secs = [
+        ("attn", sec_attn, 900),
+        ("blocks", sec_blocks, 900),
+        ("step-flash", lambda: sec_step("flash", "flash_attention"), 900),
+        ("step-xla", lambda: sec_step("xla", "torch"), 900),
+        ("step-fusednorm",
+         lambda: sec_step("flash+fusednorm", "flash_attention", norm="fused"),
+         900),
+        ("trace", sec_trace, 900),
+    ]
+    secs += [(f"mbs-{m}", (lambda m=m: sec_mbs(m)), 900) for m in MBS_SWEEP]
+    secs += [(f"long-{s}", (lambda s=s: sec_long(s)), 1200) for s in LONG_SEQS]
+    secs += [("1b", sec_1b, 1500), ("decode", sec_decode, 900)]
+    return secs
+
+
+def run_section(name):
+    for n, thunk, _ in _sections():
+        if n == name:
+            _init_backend()
+            thunk()
+            return
+    sys.exit(f"unknown section {name!r}")
+
+
+def main():
+    """Dispatcher: one subprocess per section, output streamed to this
+    stdout; crash/timeout/OOM in a section costs only that section."""
+    import subprocess
+
+    for name, _, timeout_s in _sections():
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), name],
+                timeout=timeout_s,
+            )
+            if p.returncode != 0:
+                print(f"-- section {name}: exited rc={p.returncode}",
+                      flush=True)
+        except subprocess.TimeoutExpired:
+            print(f"-- section {name}: FAIL timeout after {timeout_s}s",
+                  flush=True)
+    print("session complete", flush=True)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1:
+        run_section(sys.argv[1])
+    else:
+        # child processes re-read these; the parent never touches jax
+        main()

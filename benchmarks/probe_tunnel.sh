@@ -1,0 +1,12 @@
+#!/bin/bash
+# Shared probe: prints "OK" and exits 0 when the TPU tunnel answers within
+# 60s, else prints "DEAD <reason>" and exits 1. Sourced-by/called-from
+# capture_on_tunnel.sh and recapture_sections.sh so probe semantics can't
+# drift between the two capture paths.
+out=$(timeout 75 python -c "
+from scaling_tpu.devices import probe_devices
+devs, err = probe_devices(timeout_s=60)
+print('OK' if devs else f'DEAD {err}')
+" 2>/dev/null | tail -1)
+echo "${out:-DEAD probe subprocess died}"
+[[ "$out" == OK* ]]

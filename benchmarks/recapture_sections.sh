@@ -1,0 +1,32 @@
+#!/bin/bash
+# Re-run chip_session sections listed in $SECTIONS (default: all) one at a
+# time, each gated on its own healthy probe — the per-section process
+# means a mid-list tunnel death costs only the sections not yet run, and
+# sections already measured don't repeat. Probes every 4 min; if the
+# tunnel stays dead through one section's full probe budget (~13h), the
+# remaining sections are logged as skipped rather than each restarting
+# their own probe loop.
+cd /root/repo
+LOG=${LOG:-.scratch/capture/recapture.log}
+SECTIONS=${SECTIONS:-"step-xla step-fusednorm trace mbs-4 mbs-8 mbs-16 long-8192 long-16384 long-32768 1b decode"}
+mkdir -p "$(dirname "$LOG")"
+echo "=== recapture $(date): $SECTIONS ===" >> "$LOG"
+for sec in $SECTIONS; do
+  ran=0
+  for i in $(seq 1 200); do
+    if bash benchmarks/probe_tunnel.sh > /dev/null; then
+      echo "-- $(date +%H:%M:%S) tunnel alive; running $sec" >> "$LOG"
+      timeout 1500 python benchmarks/chip_session.py "$sec" >> "$LOG" 2>&1 \
+        || echo "-- section $sec: exited rc=$?" >> "$LOG"
+      ran=1
+      break
+    fi
+    sleep 240
+  done
+  if [[ $ran == 0 ]]; then
+    echo "-- gave up: tunnel dead through $sec's whole probe budget;" \
+         "skipping remaining sections" >> "$LOG"
+    break
+  fi
+done
+echo "=== recapture done $(date) ===" >> "$LOG"

@@ -65,20 +65,23 @@ def _block_rows(n: int) -> int:
     return b if n % b == 0 else 1
 
 
-def _fwd_kernel(eps, x_ref, w_ref, y_ref, rstd_ref):
+def _fwd_kernel(eps, x_ref, w_ref, y_ref):
     x = x_ref[:].astype(jnp.float32)
     r = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
     y = x * r * w_ref[:].astype(jnp.float32)
     y_ref[:] = y.astype(y_ref.dtype)
-    rstd_ref[:] = r[:, 0]
 
 
-def _bwd_kernel(eps, x_ref, w_ref, rstd_ref, g_ref, dx_ref, dw_ref):
-    del eps
+def _bwd_kernel(eps, x_ref, w_ref, g_ref, dx_ref, dw_ref):
+    # r is recomputed rather than saved: a 1-D (n,) rstd residual blocked
+    # (br,) trips Mosaic's layout verifier on real TPUs (XLA tiles the full
+    # array, Mosaic the block — "XLA layout {0:T(512)} does not match
+    # Mosaic layout {0:T(256)}"), and one fused mean-of-squares per row
+    # block is cheaper than the HBM round-trip anyway
     x = x_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)
     w = w_ref[:].astype(jnp.float32)
-    r = rstd_ref[:][:, None]
+    r = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
     gw = g * w
     mean_gwx = jnp.mean(gw * x, axis=-1, keepdims=True)
     dx = r * gw - x * (r**3) * mean_gwx
@@ -94,8 +97,7 @@ def _bwd_kernel(eps, x_ref, w_ref, rstd_ref, g_ref, dx_ref, dw_ref):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def rms_norm_fused(x: jax.Array, w: jax.Array, eps: float = 1e-5) -> jax.Array:
     """y = x * rsqrt(mean(x^2, -1) + eps) * w over the last dim, fused."""
-    y, _ = _rms_fwd_impl(x, w, eps)
-    return y
+    return _rms_fwd_impl(x, w, eps)
 
 
 def _rows(x: jax.Array) -> jax.Array:
@@ -107,33 +109,26 @@ def _rms_fwd_impl(x: jax.Array, w: jax.Array, eps: float):
     x2 = _rows(x)
     n, d = x2.shape
     br = _block_rows(n)
-    y, rstd = pl.pallas_call(
+    y = pl.pallas_call(
         functools.partial(_fwd_kernel, eps),
         grid=(n // br,),
         in_specs=[
             pl.BlockSpec((br, d), lambda i: (i, 0)),
             pl.BlockSpec((d,), lambda i: (0,)),
         ],
-        out_specs=[
-            pl.BlockSpec((br, d), lambda i: (i, 0)),
-            pl.BlockSpec((br,), lambda i: (i,)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((n, d), x.dtype),
-            jax.ShapeDtypeStruct((n,), jnp.float32),
-        ],
+        out_specs=pl.BlockSpec((br, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
         interpret=_FORCE_INTERPRET,
     )(x2, w)
-    return y.reshape(orig_shape), rstd
+    return y.reshape(orig_shape)
 
 
 def _rms_fwd(x, w, eps):
-    y, rstd = _rms_fwd_impl(x, w, eps)
-    return y, (x, w, rstd)
+    return _rms_fwd_impl(x, w, eps), (x, w)
 
 
 def _rms_bwd(eps, res, g):
-    x, w, rstd = res
+    x, w = res
     orig_shape = x.shape
     x2, g2 = _rows(x), _rows(g)
     n, d = x2.shape
@@ -144,7 +139,6 @@ def _rms_bwd(eps, res, g):
         in_specs=[
             pl.BlockSpec((br, d), lambda i: (i, 0)),
             pl.BlockSpec((d,), lambda i: (0,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
             pl.BlockSpec((br, d), lambda i: (i, 0)),
         ],
         out_specs=[
@@ -157,7 +151,7 @@ def _rms_bwd(eps, res, g):
             jax.ShapeDtypeStruct((d,), jnp.float32),
         ],
         interpret=_FORCE_INTERPRET,
-    )(x2, w, rstd, g2)
+    )(x2, w, g2)
     return dx.reshape(orig_shape), dw.astype(w.dtype)
 
 
