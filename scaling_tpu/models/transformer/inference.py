@@ -8,7 +8,13 @@ the cache is a fixed-capacity buffer written with ``dynamic_update_slice``
 (static shapes — one compiled decode step serves the whole generation).
 
 Cached vs uncached generate (reference: inference_model.py:159-235):
-- cached: one prefill over the prompt, then a jitted 1-token decode step.
+- cached (default): one prefill over the prompt, then ONE jitted
+  ``lax.while_loop`` running every decode step on-device — KV caches in
+  the carry, tokens/logits written into preallocated buffers, per-row
+  stop masks, early exit when all rows are done. The reference (and the
+  ``fused_decode=False`` escape hatch here) instead dispatches one jit
+  call per token; on TPU each of those dispatches pays host-round-trip
+  latency, which dominates decode wall-clock.
 - uncached: the whole padded sequence is re-fed each step (parity baseline).
 """
 
@@ -87,6 +93,8 @@ class TransformerInferenceModule:
         self._logits_fn = None
         self._decode_fn = None
         self._decode_len: Optional[int] = None
+        self._decode_loop = None
+        self._decode_loop_key = None
 
     # ------------------------------------------------------------- loading
     @classmethod
@@ -303,6 +311,54 @@ class TransformerInferenceModule:
         logits, kvs = jax.jit(run)(self.params, token_ids, pos)
         return logits, self._alloc_caches(kvs, max_len)
 
+    def _build_decode_loop(self, sample, stop_ids, steps):
+        """The whole decode as one device program: ``lax.while_loop`` whose
+        carry holds the KV caches, the last token, and preallocated
+        (b, steps+1) token / (b, steps+1, vocab) logit buffers. The key
+        sequence matches the per-step path exactly (first token sampled
+        with the caller's key outside, each loop step splits), so fused
+        and unfused decode produce identical generations."""
+        stop_arr = jnp.asarray(stop_ids, jnp.int32) if stop_ids else None
+
+        def is_stop(tok):
+            if stop_arr is None:
+                return jnp.zeros(tok.shape, bool)
+            return jnp.isin(tok, stop_arr)
+
+        def loop(params, caches, tok0, logits0, prompt_len, key):
+            b = tok0.shape[0]
+            tok0 = tok0.astype(jnp.int32)
+            toks = jnp.zeros((b, steps + 1), jnp.int32)
+            toks = jax.lax.dynamic_update_slice(toks, tok0[:, None], (0, 0))
+            lgts = jnp.zeros((b, steps + 1, logits0.shape[-1]), logits0.dtype)
+            lgts = jax.lax.dynamic_update_slice(lgts, logits0[:, None], (0, 0, 0))
+
+            def cond(c):
+                t, done = c[0], c[-1]
+                return (t <= steps) & ~jnp.all(done)
+
+            def body(c):
+                t, caches, tok, key, toks, lgts, done = c
+                key, sub = jax.random.split(key)
+                offset = prompt_len + t - 1
+                pos = jnp.broadcast_to(offset[None, None], (b, 1))
+                batch = self._make_batch(tok[:, None], pos)
+                logits, caches = self._run_layers(params, batch, caches, offset)
+                nxt = sample(logits[:, -1], sub).astype(jnp.int32)
+                # finished rows keep stepping (their output is trimmed on
+                # the host), matching the per-step path's lockstep advance
+                toks = jax.lax.dynamic_update_slice(toks, nxt[:, None], (0, t))
+                lgts = jax.lax.dynamic_update_slice(
+                    lgts, logits[:, -1][:, None], (0, t, 0)
+                )
+                return (t + 1, caches, nxt, key, toks, lgts, done | is_stop(nxt))
+
+            init = (jnp.int32(1), caches, tok0, key, toks, lgts, is_stop(tok0))
+            _, _, _, _, toks, lgts, done = jax.lax.while_loop(cond, body, init)
+            return toks, lgts, done
+
+        return loop
+
     def generate(
         self,
         input_ids,
@@ -312,6 +368,7 @@ class TransformerInferenceModule:
         eos_token_id: Optional[int] = None,
         stop_tokens: Optional[List[int]] = None,
         seed: int = 0,
+        fused_decode: bool = True,
     ) -> CompletionOutput:
         """Autoregressive decode (reference: inference_model.py:195-263).
 
@@ -358,6 +415,34 @@ class TransformerInferenceModule:
             max_len = prompt_len + max_tokens
             logits, caches = self._prefill(prompt, max_len)
             next_tok = sample(logits[:, -1], key)
+
+        if use_cache and fused_decode:
+            # max_tokens<=1 still emits the prologue's one token (matching
+            # the per-step path); the loop body just never runs
+            steps = max(0, max_tokens - 1)
+            stop_ids = tuple(sorted(stop))
+            fkey = (steps, sample, stop_ids)
+            # shapes (batch, cache length, vocab) re-trace via jit; only
+            # the baked-in constants need an explicit cache key
+            if self._decode_loop is None or self._decode_loop_key != fkey:
+                self._decode_loop = jax.jit(
+                    self._build_decode_loop(sample, stop_ids, steps)
+                )
+                self._decode_loop_key = fkey
+            toks, lgts, _ = self._decode_loop(
+                self.params, caches, next_tok, logits[:, -1],
+                jnp.asarray(prompt_len, jnp.int32), key,
+            )
+            toks_host = np.asarray(toks)  # ONE device->host transfer
+            for i in range(b):
+                end = toks_host.shape[1]
+                for j in range(toks_host.shape[1]):
+                    if int(toks_host[i, j]) in stop:
+                        end = j + 1  # the stop token itself is emitted
+                        break
+                row_tokens[i] = [int(x) for x in toks_host[i, :end]]
+                row_logits[i] = lgts[i, :end]  # contiguous, already stacked
+        elif use_cache:
             collect(next_tok, logits[:, -1])
 
             # the jitted decode closure bakes in the sampler: invalidate on
@@ -413,13 +498,18 @@ class TransformerInferenceModule:
                 )
                 cur += 1
 
+        def row_logits_out(rl):
+            if isinstance(rl, list):  # per-step paths collect step arrays
+                return jnp.stack(rl, axis=0) if rl else None
+            return rl  # fused path already holds the contiguous (end, vocab) slice
+
         outs = [
             CompletionOutput(
                 completion_ids=row_tokens[i],
                 completion=(
                     self.tokenizer.decode(row_tokens[i]) if self.tokenizer else None
                 ),
-                logits=jnp.stack(row_logits[i], axis=0) if row_logits[i] else None,
+                logits=row_logits_out(row_logits[i]),
             )
             for i in range(b)
         ]

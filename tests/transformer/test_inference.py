@@ -256,3 +256,41 @@ def test_tensor_parallel_inference_matches_single_device(checkpoint_dir):
     out_s = single.generate(prompt, max_tokens=6, use_cache=True)
     out_p = sharded.generate(prompt, max_tokens=6, use_cache=True)
     assert out_p.completion_ids == out_s.completion_ids
+
+
+def test_fused_decode_matches_per_step(checkpoint_dir):
+    """The single-dispatch ``lax.while_loop`` decode (fused_decode=True,
+    the default) must emit exactly the tokens and logits of the
+    one-jit-call-per-token path, including independent per-row stopping
+    and a stochastic sampler's key sequence."""
+    from scaling_tpu.models.transformer.inference import make_sampler
+
+    module = TransformerInferenceModule.from_checkpoint(checkpoint_dir)
+    prompts = [[5, 9, 2, 14, 7], [3, 3, 8, 1, 12]]
+    fused = module.generate(prompts, max_tokens=6, use_cache=True)
+    stepped = module.generate(
+        prompts, max_tokens=6, use_cache=True, fused_decode=False
+    )
+    for f, s in zip(fused, stepped):
+        assert f.completion_ids == s.completion_ids
+        np.testing.assert_allclose(
+            np.asarray(f.logits), np.asarray(s.logits), atol=1e-5
+        )
+
+    # per-row early stop: stop row 0 on its first emitted token; row 1 runs on
+    first0 = fused[0].completion_ids[0]
+    f2 = module.generate(prompts, max_tokens=6, stop_tokens=[first0])
+    s2 = module.generate(
+        prompts, max_tokens=6, stop_tokens=[first0], fused_decode=False
+    )
+    assert [o.completion_ids for o in f2] == [o.completion_ids for o in s2]
+    assert f2[0].completion_ids == [first0]
+
+    # stochastic sampler: the fused loop splits the PRNG key in the same
+    # order as the per-step loop, so generations match token for token
+    sampler = make_sampler(temperature=0.8, top_p=0.9)
+    f3 = module.generate(prompts, max_tokens=6, sample_fn=sampler, seed=7)
+    s3 = module.generate(
+        prompts, max_tokens=6, sample_fn=sampler, seed=7, fused_decode=False
+    )
+    assert [o.completion_ids for o in f3] == [o.completion_ids for o in s3]
