@@ -28,7 +28,10 @@ Usage: cd /root/repo && python benchmarks/chip_session.py 2>&1 | tee /tmp/chip_s
 CHIP_SESSION_SMOKE=1 shrinks every arm to CPU-rehearsable shapes so the
 whole session's plumbing — including the subprocess fan-out — can be
 validated without the chip (numbers are then meaningless; sections that
-need the TPU print FAIL and move on).
+need the TPU print FAIL and move on). Add CHIP_SESSION_CPU=1 to actually
+KEEP the rehearsal off the chip: the sitecustomize forces the TPU
+platform in every subprocess regardless of JAX_PLATFORMS, so the pin has
+to happen via jax.config inside the child (see _init_backend).
 """
 import os
 import sys
@@ -50,7 +53,18 @@ SEQ, HIDDEN, LAYERS, MBS = STEP_SHAPE
 
 # ------------------------------------------------------------ child plumbing
 def _init_backend():
-    """First device contact, fail-fast (shared with bench.py/dryrun)."""
+    """First device contact, fail-fast (shared with bench.py/dryrun).
+
+    CHIP_SESSION_CPU=1 pins the section to the host CPU backend — the
+    sitecustomize registers the TPU plugin and overrides JAX_PLATFORMS in
+    every subprocess, so an env var alone cannot keep a rehearsal off the
+    chip (round 4's "SMOKE" run measured the real TPU this way); only
+    jax.config, applied before first device use, actually sticks. This is
+    what lets the suite exercise the dispatcher without touching hardware."""
+    import jax
+
+    if os.environ.get("CHIP_SESSION_CPU"):
+        jax.config.update("jax_platforms", "cpu")
     from scaling_tpu.devices import probe_devices
 
     devs, err = probe_devices(timeout_s=60)
@@ -263,7 +277,10 @@ def sec_decode():
         prompt = np.random.default_rng(0).integers(
             1, 1000, size=(gen_b, prompt_len)
         )
-        im.generate(prompt, max_tokens=2)  # compile prefill + decode
+        # warm-up at the MEASURED length: the fused decode loop's compile
+        # is keyed on the step count (and prefill on cache length), so a
+        # shorter warm-up would leave the real compile inside the window
+        im.generate(prompt, max_tokens=gen_tokens)
         t0 = _time.perf_counter()
         im.generate(prompt, max_tokens=gen_tokens)
         dt = _time.perf_counter() - t0

@@ -398,7 +398,10 @@ class TransformerInferenceModule:
         sample = sample_fn or sample_argmax
         key = jax.random.PRNGKey(seed)
         row_tokens: List[List[int]] = [[] for _ in range(b)]
-        row_logits: List[List[jax.Array]] = [[] for _ in range(b)]
+        # per row: a list of per-step (vocab,) arrays (per-step paths) OR
+        # one contiguous (steps, vocab) slice (fused path); row_logits_out
+        # below normalizes the union
+        row_logits: List[Any] = [[] for _ in range(b)]
         finished = [False] * b
 
         def collect(tok, step_logits):

@@ -1,0 +1,63 @@
+"""The on-chip measurement session's plumbing, rehearsed off-chip.
+
+chip_session.py is capture-day tooling: it runs when a healthy-tunnel
+window opens and cannot be debugged then. These tests pin the parts that
+broke in practice — the section registry, the per-section subprocess
+entry, and the CPU pin that keeps rehearsals off the chip (round 4's
+SMOKE rehearsal silently measured the real TPU because the sitecustomize
+overrides JAX_PLATFORMS in subprocesses)."""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+SCRIPT = os.path.join(REPO, "benchmarks", "chip_session.py")
+
+
+def _smoke_env():
+    env = dict(os.environ)
+    env["CHIP_SESSION_SMOKE"] = "1"
+    env["CHIP_SESSION_CPU"] = "1"
+    return env
+
+
+def test_section_registry_names_are_unique_and_bounded():
+    sys.path.insert(0, REPO)
+    import importlib
+
+    import benchmarks.chip_session as cs
+
+    importlib.reload(cs)
+    secs = cs._sections()
+    names = [n for n, _, _ in secs]
+    assert len(names) == len(set(names))
+    assert all(t > 0 for _, _, t in secs)
+    # the capture driver derives its backstop from this sum; it must stay
+    # computable without touching jax (module import is device-free)
+    assert sum(t for _, _, t in secs) > 0
+
+
+def test_unknown_section_exits_with_error():
+    p = subprocess.run(
+        [sys.executable, SCRIPT, "no-such-section"],
+        capture_output=True, text=True, env=_smoke_env(), timeout=120,
+    )
+    assert p.returncode != 0
+    assert "unknown section" in p.stderr
+
+
+@pytest.mark.slow
+def test_single_section_runs_on_cpu_and_prints_measurement():
+    """One real section end to end in a subprocess, pinned to the CPU
+    backend (this test must pass with the TPU tunnel dead)."""
+    p = subprocess.run(
+        [sys.executable, SCRIPT, "mbs-2"],
+        capture_output=True, text=True, env=_smoke_env(), timeout=600,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    m = re.search(r"6\. step mbs=2:\s+[0-9.]+ ms", p.stdout)
+    assert m, p.stdout
