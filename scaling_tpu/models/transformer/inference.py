@@ -354,8 +354,14 @@ class TransformerInferenceModule:
                 return (t + 1, caches, nxt, key, toks, lgts, done | is_stop(nxt))
 
             init = (jnp.int32(1), caches, tok0, key, toks, lgts, is_stop(tok0))
-            _, _, _, _, toks, lgts, done = jax.lax.while_loop(cond, body, init)
-            return toks, lgts, done
+            _, caches, _, _, toks, lgts, done = jax.lax.while_loop(
+                cond, body, init
+            )
+            # the final caches are dead weight to the caller, but returning
+            # them is what makes donate_argnums=(1,) real: donation only
+            # frees an input when it aliases a same-shaped OUTPUT, and the
+            # cache input has no other output to alias
+            return toks, lgts, done, caches
 
         return loop
 
@@ -428,11 +434,17 @@ class TransformerInferenceModule:
             # shapes (batch, cache length, vocab) re-trace via jit; only
             # the baked-in constants need an explicit cache key
             if self._decode_loop is None or self._decode_loop_key != fkey:
+                # the prefill caches die with this call — donating them
+                # lets XLA run the loop carry in place instead of holding
+                # a second (b, max_len) KV copy during decode. CPU can't
+                # donate (every call would warn), so only accelerators do.
+                donate = (1,) if jax.default_backend() != "cpu" else ()
                 self._decode_loop = jax.jit(
-                    self._build_decode_loop(sample, stop_ids, steps)
+                    self._build_decode_loop(sample, stop_ids, steps),
+                    donate_argnums=donate,
                 )
                 self._decode_loop_key = fkey
-            toks, lgts, _ = self._decode_loop(
+            toks, lgts, _, _ = self._decode_loop(
                 self.params, caches, next_tok, logits[:, -1],
                 jnp.asarray(prompt_len, jnp.int32), key,
             )

@@ -294,3 +294,39 @@ def test_fused_decode_matches_per_step(checkpoint_dir):
         prompts, max_tokens=6, sample_fn=sampler, seed=7, fused_decode=False
     )
     assert [o.completion_ids for o in f3] == [o.completion_ids for o in s3]
+
+
+def test_decode_loop_returns_caches_matching_input_for_donation(checkpoint_dir):
+    """donate_argnums only frees the KV-cache input if it aliases a
+    same-shaped output; this pins the aliasing precondition (the loop
+    returns the final caches with the input's exact tree/shapes/dtypes),
+    which CPU CI can check even though CPU never donates."""
+    import jax
+
+    from scaling_tpu.models.transformer.inference import sample_argmax
+
+    module = TransformerInferenceModule.from_checkpoint(checkpoint_dir)
+    out = module.generate([5, 9, 2], max_tokens=4)
+    assert out.completion_ids  # loop ran
+    loop = module._build_decode_loop(sample_argmax, (), steps=3)
+    import jax.numpy as jnp
+
+    logits, caches = module._prefill(jnp.asarray([[5, 9, 2]], jnp.int32), 7)
+    tok0 = sample_argmax(logits[:, -1])
+    res = loop(module.params, caches, tok0, logits[:, -1],
+               jnp.asarray(3, jnp.int32), jax.random.PRNGKey(0))
+    caches_out = res[-1]
+    assert jax.tree.structure(caches_out) == jax.tree.structure(caches)
+    for a, b_ in zip(jax.tree.leaves(caches), jax.tree.leaves(caches_out)):
+        assert a.shape == b_.shape and a.dtype == b_.dtype
+
+
+def test_fused_decode_never_builds_per_step_dispatch(checkpoint_dir):
+    """The fused path's whole point is ONE device program per generation;
+    if a regression routes any token through the per-step jit, this pin
+    catches it (the per-step closure is built lazily, so its absence
+    proves no per-token dispatch happened)."""
+    module = TransformerInferenceModule.from_checkpoint(checkpoint_dir)
+    module.generate([5, 9, 2], max_tokens=6)
+    assert module._decode_fn is None
+    assert module._decode_loop is not None
