@@ -257,6 +257,30 @@ class Optimizer:
     def scale_loss(self, loss: jax.Array, state: OptimizerState) -> jax.Array:
         return self.loss_scaler.scale_loss(loss, state.loss_scaler)
 
+    def freeze_frozen_params(self, params: Any) -> Any:
+        """stop_gradient every leaf that belongs to no parameter group.
+
+        A PEFT step would otherwise compute, DP-sync and overflow-check
+        full model-sized gradients that ``step`` then drops on the floor:
+        the frozen weight-grad matmuls stay live because
+        ``has_inf_or_nan_tree`` consumes every grad leaf, and GSPMD's
+        gradient psum over the data axis rides along with them (measured
+        at TP=2 × DP=4: LoRA's collective bytes *exceeded* full
+        finetuning's). With frozen leaves stopped inside the loss, their
+        gradients are constant zeros and XLA deletes the matmuls and
+        collectives outright — backward cost scales with the adapters,
+        which is the point of BASELINE #5's PEFT layout."""
+        if all(gi >= 0 for gi in self._group_index):
+            return params
+        leaves, td = jax.tree.flatten(params)
+        return jax.tree.unflatten(
+            td,
+            [
+                leaf if gi >= 0 else jax.lax.stop_gradient(leaf)
+                for leaf, gi in zip(leaves, self._group_index)
+            ],
+        )
+
     def step(
         self,
         params: Any,

@@ -404,6 +404,9 @@ class ParallelModule:
             return self._build_spatial_train_step(optimizer, loss_function, donate)
 
         def microbatch_loss(params, mb, dropout_key, loss_scale):
+            # PEFT: frozen leaves produce constant-zero grads, so XLA drops
+            # their weight-grad matmuls and DP syncs (optimizer.py)
+            params = optimizer.freeze_frozen_params(params)
             ctx = self._make_ctx(deterministic=False, dropout_key=dropout_key)
             out = self.forward(params, mb, ctx)
             loss, metrics = loss_function(out, mb)
@@ -491,6 +494,7 @@ class ParallelModule:
         post_ids = list(range(body_idx + 1, len(self.layers)))
 
         def spatial_loss(params, micro_batches, dropout_key, loss_scale):
+            params = optimizer.freeze_frozen_params(params)
             mb_keys = jax.vmap(
                 lambda m: jax.random.fold_in(dropout_key, m)
             )(jnp.arange(gas))
