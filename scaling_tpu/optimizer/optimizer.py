@@ -205,6 +205,39 @@ class Optimizer:
             )
         return NamedSharding(self.topology.mesh, P(*spec))
 
+    def abstract_state(self, params: Any) -> OptimizerState:
+        """``init_state``'s output as ShapeDtypeStructs with the ZeRO
+        master shardings attached.
+
+        ``jax.eval_shape(init_state, ...)`` drops shardings, which would
+        let an AOT compile place the fp32 masters replicated — hiding
+        exactly the per-chip memory ZeRO-1 exists to shard. This keeps the
+        placement so huge layouts (the BASELINE #4 7B at TP×PP×DP) can be
+        ``step.lower(...)``-compiled and cost/memory-pinned without
+        materializing 12 bytes/param."""
+        empty = jax.ShapeDtypeStruct((0,), jnp.float32)
+        masters = []
+        for p, m, gi in zip(
+            jax.tree.leaves(params), self._meta_leaves, self._group_index
+        ):
+            if gi < 0:
+                masters.append(empty)
+                continue
+            sh = self._master_sharding(m, p.shape)
+            masters.append(
+                jax.ShapeDtypeStruct(p.shape, jnp.float32, sharding=sh)
+                if sh is not None
+                else jax.ShapeDtypeStruct(p.shape, jnp.float32)
+            )
+        tree = jax.tree.unflatten(self._treedef, masters)
+        return OptimizerState(
+            step=jax.ShapeDtypeStruct((), jnp.int32),
+            master=tree,
+            exp_avg=tree,
+            exp_avg_sq=tree,
+            loss_scaler=jax.eval_shape(self.loss_scaler.init_state),
+        )
+
     def init_state(self, params: Any, only=None) -> OptimizerState:
         """Fresh state (fp32 masters from ``params``, zero moments).
 
