@@ -64,6 +64,10 @@ class TrainStepOutput(NamedTuple):
     current_loss_scale: Optional[Any] = None
     debug_dict: Optional[Dict[str, Any]] = None
     step_duration: Optional[float] = None
+    # False on steps where trainer.log_interval skipped the device->host
+    # sync: numeric fields are still-in-flight jax arrays, not floats, and
+    # the logging path must not touch them (that would reintroduce the sync)
+    fetched: bool = True
 
 
 class EvaluationStepOutput(NamedTuple):

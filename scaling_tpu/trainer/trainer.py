@@ -89,6 +89,16 @@ class TrainerConfig(BaseConfig):
         False, description="merge LoRa weights after loading"
     )
     seed: int = Field(42, description="")
+    log_interval: int = Field(
+        1,
+        description="fetch and log step metrics every n steps. Intermediate "
+        "steps skip the device-to-host sync entirely, so consecutive steps "
+        "chain on-device and host/tunnel latency leaves the critical path "
+        "(the reference logs every step; 1 keeps that behavior). Steps "
+        "inside an active profiler window always sync so recorded step "
+        "times stay honest",
+        ge=1,
+    )
     eval_iterations: int = Field(0, description="number of eval micro batches per eval pass")
     eval_interval: Optional[int] = Field(None, description="evaluate every n train steps")
     dataloader_num_workers: int = Field(0, description="kept for config parity")
@@ -161,6 +171,11 @@ class BaseTrainer:
 
         self.params: Any = None
         self.opt_state: Optional[OptimizerState] = None
+        # log_interval bookkeeping: steps dispatched since the last
+        # device->host fetch, and the wall clock of that fetch (for
+        # amortized per-step durations)
+        self._unfetched_steps = 0
+        self._last_fetch_wall: Optional[float] = None
         # bookkeeping from the last load_checkpoint: which model keys were
         # actually taken from the checkpoint (None = no checkpoint loaded)
         # and whether optimizer moments survived the load — startup splices
@@ -308,6 +323,16 @@ class BaseTrainer:
 
     def train_step(self) -> TrainStepOutput:
         step_idx = self.context.iterations
+        if (
+            self.profiler is not None
+            and self.profiler.enabled_at(step_idx)
+            and self._unfetched_steps
+        ):
+            # the profiled window must open with a drained device queue or
+            # its first step_time absorbs the unfetched backlog
+            jax.block_until_ready(self.opt_state.step)
+            self._unfetched_steps = 0
+            self._last_fetch_wall = time.time()
         if self.profiler is not None:
             self.profiler.begin_step(step_idx)
         start = time.time()
@@ -318,11 +343,44 @@ class BaseTrainer:
             self.params, self.opt_state, micro_batches, dropout_key
         )
         self.context.step()
+        # profiler windows always sync (recorded step times must cover the
+        # device work); otherwise log_interval decides whether this step
+        # fetches or stays in flight so the next dispatch isn't gated on
+        # host/tunnel latency
+        profiling = self.profiler is not None and self.profiler.enabled_at(step_idx)
+        fetch = profiling or (
+            self.context.iterations % self.config.log_interval == 0
+        )
+        if not fetch:
+            self._unfetched_steps += 1
+            return TrainStepOutput(
+                loss=loss,
+                metrics=metrics,
+                global_grad_norm=opt_out.global_grad_norm,
+                learning_rates=opt_out.learning_rates,
+                overflow=opt_out.overflow,
+                no_overflow_steps=opt_out.no_overflow_steps,
+                current_loss_scale=opt_out.current_loss_scale,
+                step_duration=None,  # dispatch time would masquerade as step time
+                fetched=False,
+            )
         loss = float(loss)  # host sync: the step's device work is drained
+        # a fetch after unfetched steps drains their whole device backlog,
+        # so this step's wall time covers several steps of device work;
+        # report the amortized per-step time (what tokens/s and the TFLOPs
+        # estimators divide by) instead of the ~interval-x drain time
+        backlog = self._unfetched_steps
+        self._unfetched_steps = 0
+        now = time.time()
+        if backlog and self._last_fetch_wall is not None:
+            step_duration = (now - self._last_fetch_wall) / (backlog + 1)
+        else:
+            step_duration = now - start
+        self._last_fetch_wall = now
         if self.profiler is not None:
             self.profiler.record(
                 step_idx,
-                {"data_load": t_data, "step_time": time.time() - start - t_data},
+                {"data_load": t_data, "step_time": step_duration - t_data},
             )
             self.profiler.end_step(step_idx)
         return TrainStepOutput(
@@ -333,7 +391,7 @@ class BaseTrainer:
             overflow=_maybe_bool(opt_out.overflow),
             no_overflow_steps=_maybe_int(opt_out.no_overflow_steps),
             current_loss_scale=_maybe_float(opt_out.current_loss_scale),
-            step_duration=time.time() - start,
+            step_duration=step_duration,
         )
 
     def eval_step(self) -> EvaluationStepOutput:
@@ -404,25 +462,29 @@ class BaseTrainer:
                     {"eval_loss": eval_out.loss, **{f"eval_{k}": v for k, v in eval_out.metrics.items()}},
                     self.context.iterations,
                 )
-            metrics = {
-                "loss": output.loss,
-                **output.metrics,
-                **(output.learning_rates or {}),
-            }
-            if output.global_grad_norm is not None:
-                metrics["global_grad_norm"] = output.global_grad_norm
-            if output.current_loss_scale is not None:
-                metrics["loss_scale"] = output.current_loss_scale
-            metrics["step_duration"] = output.step_duration
-            if log_metrics_fn is not None:
-                metrics = log_metrics_fn(self, output, metrics)
-            logger.log_metrics(metrics, self.context.iterations)
-            for hook in self.metrics_hooks:
-                try:
-                    hook(metrics, self.context.iterations)
-                except Exception as e:
-                    # reporting must never abort a training step
-                    logger.warning(f"metrics hook failed: {e}")
+            if output.fetched:
+                # unfetched steps (log_interval > 1) carry in-flight device
+                # arrays; touching them here would reintroduce the per-step
+                # sync the knob exists to remove
+                metrics = {
+                    "loss": output.loss,
+                    **output.metrics,
+                    **(output.learning_rates or {}),
+                }
+                if output.global_grad_norm is not None:
+                    metrics["global_grad_norm"] = output.global_grad_norm
+                if output.current_loss_scale is not None:
+                    metrics["loss_scale"] = output.current_loss_scale
+                metrics["step_duration"] = output.step_duration
+                if log_metrics_fn is not None:
+                    metrics = log_metrics_fn(self, output, metrics)
+                logger.log_metrics(metrics, self.context.iterations)
+                for hook in self.metrics_hooks:
+                    try:
+                        hook(metrics, self.context.iterations)
+                    except Exception as e:
+                        # reporting must never abort a training step
+                        logger.warning(f"metrics hook failed: {e}")
         self.finalize_checkpoints()
 
     def _run_checkpoint_hooks(self, step_dir: Path) -> None:

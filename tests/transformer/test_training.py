@@ -169,3 +169,30 @@ def test_remat_policies_do_not_change_the_math(tmp_path, data_prefix, devices):
     np.testing.assert_array_equal(losses["disabled"], losses["every_layer"])
     np.testing.assert_array_equal(losses["disabled"],
                                   losses["every_layer_save_dots"])
+
+
+def test_log_interval_skips_sync_without_changing_the_math(
+    tmp_path, data_prefix, devices
+):
+    """trainer.log_interval > 1 keeps intermediate steps in flight (no
+    device->host sync, loss is a jax array, no step_duration) while the
+    training math stays bit-identical to the every-step-logging default."""
+    import jax as _jax
+
+    cfg1 = make_config(tmp_path / "a", data_prefix, train_iterations=4,
+                       save_interval=100)
+    losses1 = [float(x) for x in train_capture(build_capturing_trainer(cfg1), 4)]
+
+    d = make_config(tmp_path / "b", data_prefix, train_iterations=4,
+                    save_interval=100).model_dump(mode="json")
+    d["trainer"]["log_interval"] = 2
+    t2 = build_capturing_trainer(TransformerConfig.from_dict(d))
+    outs = [t2.train_step() for _ in range(4)]
+    assert [o.fetched for o in outs] == [False, True, False, True]
+    assert isinstance(outs[0].loss, _jax.Array)
+    assert outs[0].step_duration is None
+    assert isinstance(outs[1].loss, float)
+    # fetched steps report the amortized per-step time (the fetch drains
+    # the unfetched backlog, so raw wall time would be ~interval x)
+    assert outs[1].step_duration is not None and outs[3].step_duration > 0
+    assert [float(o.loss) for o in outs] == losses1
