@@ -65,6 +65,13 @@ def _init_backend():
 
     if os.environ.get("CHIP_SESSION_CPU"):
         jax.config.update("jax_platforms", "cpu")
+    # share bench.py's persistent executable cache: each section is a
+    # fresh process, and without the cache every one re-pays its compiles
+    # through the tunnel's remote-compile service
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("SCALING_TPU_BENCH_CACHE", "/tmp/scaling_tpu_bench_jaxcache"),
+    )
     from scaling_tpu.devices import probe_devices
 
     devs, err = probe_devices(timeout_s=60)
