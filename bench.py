@@ -80,28 +80,43 @@ def measure_achievable_tflops() -> float:
     occasional early return the tunnel produces under load (a bogus
     22 PFLOP/s best-of-N reading made it into one artifact), and the
     nominal hardware peak clamps the physical ceiling.
+
+    Each window must hold device work far exceeding the link's round-trip
+    latency: the r1-r4 probe timed ONE ~22 ms chain per sample, so over
+    the ~90 ms tunnel RTT it read ~50 TF on a chip the train step was
+    simultaneously driving at an implied ~148 TF (the source of the
+    impossible ``mfu_vs_measured_peak`` > 1 in the r4 artifacts). Several
+    chains are now dispatched back-to-back — each consuming the last's
+    output, all async — and blocked once, amortizing the RTT the same way
+    the train-step windows do.
     """
     a = jax.random.normal(jax.random.PRNGKey(0), (4096, 4096), jnp.bfloat16)
     b = jax.random.normal(jax.random.PRNGKey(1), (4096, 4096), jnp.bfloat16)
+    # ~140 TFLOP of device work per window (~0.7 s at the v5e peak), so a
+    # ~100 ms tunnel RTT perturbs the reading <15% instead of 4x
+    length, repeats = 128, 8
 
     @jax.jit
-    def chain(a, b):
+    def chain(x, b):
         def body(x, _):
+            # bf16 products overflow to inf after a few multiplies; inf
+            # flows through the MXU at full speed, so timing is unaffected
             return x @ b, None
 
-        # the scan serializes its 32 matmuls, so the block below bounds the
-        # full computation; a scalar fetch can hang on a degraded tunnel
-        x, _ = jax.lax.scan(body, a, None, length=32)
-        return x.sum()
+        x, _ = jax.lax.scan(body, x, None, length=length)
+        return x
 
     jax.block_until_ready(chain(a, b))  # compile
     times = []
-    for i in range(5):
+    for _ in range(5):
         t0 = time.perf_counter()
-        jax.block_until_ready(chain(a + float(i), b))
+        x = a
+        for _ in range(repeats):
+            x = chain(x, b)  # chained async dispatches; one drain below
+        jax.block_until_ready(x)
         times.append(time.perf_counter() - t0)
     t_med = max(sorted(times)[len(times) // 2], 1e-9)
-    measured = 32 * 2 * 4096**3 / t_med / 1e12
+    measured = repeats * length * 2 * 4096**3 / t_med / 1e12
     return min(measured, detect_hardware().max_tflops)
 
 

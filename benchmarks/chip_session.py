@@ -11,6 +11,7 @@ XLA attention at seq 32k) costs exactly one measurement.
 
 Sections (labels are stable — summarize_capture.py and the tuned-pass
 winner parser in capture_on_tunnel.sh grep them):
+  0. achievable-peak probe (amortized dispatch, see bench.py)
   1. attention micro-bench: flash vs XLA fwd+bwd at the bench shape
   2. flash block-size sweep
   3/4. full train step A/B: flash vs XLA kernel vs flash+fused-norm
@@ -118,6 +119,28 @@ def _build_step(mbs, layers=None, remat=False, kernel="flash_attention",
 
 
 # ---------------------------------------------------------------- sections
+def sec_peak():
+    # the achievable-TFLOPs probe with amortized dispatch (bench.py fixed
+    # the r1-r4 probe, which timed one 22 ms chain inside a ~90 ms tunnel
+    # RTT and read ~50 TF against a step sustaining ~148); this section
+    # gives the reading its own fault-isolated slot on capture day
+    import jax
+
+    import bench
+
+    if SMOKE or jax.default_backend() != "tpu":
+        # SMOKE's contract is plumbing-only (and without the CPU pin it
+        # would burn ~850 TFLOP on the live chip); off-TPU the matmuls
+        # take an hour on a CPU core and the reading would mean nothing
+        print("0. peak probe: SKIP (smoke or non-tpu)", flush=True)
+        return
+    try:
+        t = bench.measure_achievable_tflops()
+        print(f"0. peak probe: {t:8.1f} TF (amortized dispatch)", flush=True)
+    except Exception as e:
+        print(f"0. peak probe: FAIL {type(e).__name__}: {e}", flush=True)
+
+
 def sec_attn():
     from benchmarks import attn_bench
 
@@ -301,6 +324,7 @@ def _sections():
     """(name, thunk, timeout_s) in run order. Timeouts bound a wedged
     tunnel per-section instead of letting one hang eat the session."""
     secs = [
+        ("peak", sec_peak, 600),
         ("attn", sec_attn, 900),
         ("blocks", sec_blocks, 900),
         ("step-flash", lambda: sec_step("flash", "flash_attention"), 900),
