@@ -21,7 +21,8 @@ SECTION_RE = re.compile(r"^([0-9]+(?:/[0-9]+)?)\. (.+?):\s*(.+)$")
 
 def bench_rows(capture: Path) -> list:
     rows = []
-    for name in ("bench_05b", "bench_1b", "bench_tuned"):
+    for name in ("bench_05b", "bench_1b", "bench_tuned",
+                 "bench_final_05b", "bench_final_1b"):
         f = capture / f"{name}.log"
         if not f.is_file():
             continue
@@ -38,15 +39,26 @@ def bench_rows(capture: Path) -> list:
 
 
 def session_lines(capture: Path) -> list:
-    f = capture / "chip_session.log"
-    if not f.is_file():
-        return []
-    out = []
-    for line in f.read_text().splitlines():
-        m = SECTION_RE.match(line.strip())
-        if m:
-            out.append((m.group(1), m.group(2), m.group(3)))
-    return out
+    """Section measurements from every session/recapture log, later files
+    winning on duplicate labels (a recaptured section supersedes the
+    original run's FAIL)."""
+    seen: dict = {}
+    order: list = []
+    for fname in ("chip_session.log", "chip_session2.log", "recapture.log"):
+        f = capture / fname
+        if not f.is_file():
+            continue
+        for line in f.read_text().splitlines():
+            m = SECTION_RE.match(line.strip())
+            if m:
+                key = (m.group(1), m.group(2))
+                if key not in seen:
+                    order.append(key)
+                elif m.group(3).startswith("FAIL") and not seen[key].startswith("FAIL"):
+                    # a failed re-run must not clobber a real measurement
+                    continue
+                seen[key] = m.group(3)
+    return [(num, name, seen[(num, name)]) for num, name in order]
 
 
 def main() -> None:
