@@ -97,6 +97,41 @@ def main():
                         f"   {d/1e9:9.3f} ms {100*d/tot:5.1f}% "
                         f"x{counts[name]:<5} {name[:100]}"
                     )
+                buckets = defaultdict(int)
+                for name, d in totals.items():
+                    buckets[classify(name)] += d
+                summary = "  ".join(
+                    f"{b}={100*d/tot:.1f}%"
+                    for b, d in sorted(buckets.items(), key=lambda kv: -kv[1])
+                )
+                print(f"   buckets: {summary}")
+
+
+_BUCKETS = (
+    # substring -> bucket; first match wins, so collectives beat the
+    # generic 'fusion' and pallas custom-calls beat 'copy' inside names
+    (("all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+      "all-to-all"), "collective"),
+    (("custom-call", "tpu_custom_call", "splash", "flash", "mosaic"), "pallas"),
+    (("dot", "convolution", "cublas", "matmul"), "matmul"),
+    (("copy", "transpose", "bitcast", "reshape", "slice",
+      "concatenate"), "layout"),
+    (("fusion", "loop_"), "fused-elementwise"),
+)
+
+
+def classify(name: str) -> str:
+    """Coarse MFU-attribution buckets by op-name substring. 'matmul' +
+    'pallas' is the useful-FLOPs share; 'layout' + 'collective' is the
+    overhead to attack. XLA names fusions after their root op
+    ('loop_dot_fusion', 'loop_slice_fusion'), so a named root wins the
+    bucket — that root dominates the fusion's time — and only anonymous
+    fusions fall to the catch-all 'fused-elementwise' bucket."""
+    low = name.lower()
+    for subs, bucket in _BUCKETS:
+        if any(s in low for s in subs):
+            return bucket
+    return "other"
 
 
 if __name__ == "__main__":
