@@ -92,7 +92,8 @@ class TransformerInferenceModule:
         self.tokenizer = tokenizer
         self._logits_fn = None
         self._decode_fn = None
-        self._decode_len: Optional[int] = None
+        # (max_len, ragged) the per-step decode closure was traced for
+        self._decode_len: Optional[tuple] = None
         self._decode_loop = None
         self._decode_loop_key = None
 
@@ -197,16 +198,26 @@ class TransformerInferenceModule:
                 x = layer(p, x, ctx)
         return x["activations"], new_caches
 
-    def _make_batch(self, token_ids: jax.Array, position_ids: jax.Array) -> dict:
+    def _make_batch(
+        self,
+        token_ids: jax.Array,
+        position_ids: jax.Array,
+        segment_ids: Optional[jax.Array] = None,
+        scores_manipulation: Optional[jax.Array] = None,
+    ) -> dict:
         b, s = token_ids.shape
         return {
             "token_ids": token_ids.astype(jnp.int32),
             "target_token_ids": jnp.zeros((b, s), jnp.int32),
             "position_ids": position_ids.astype(jnp.int32),
-            "segment_ids": jnp.zeros((b, s), jnp.int32),
+            "segment_ids": (
+                jnp.zeros((b, s), jnp.int32)
+                if segment_ids is None
+                else segment_ids.astype(jnp.int32)
+            ),
             "loss_weights": None,
             "embeddings": None,
-            "attention_scores_manipulation": None,
+            "attention_scores_manipulation": scores_manipulation,
         }
 
     def logits(self, token_ids, controls=None, control_log_additive=True) -> jax.Array:
@@ -290,14 +301,29 @@ class TransformerInferenceModule:
             )
         return caches
 
-    def _prefill(self, token_ids: jax.Array, max_len: int):
-        """Prompt pass collecting per-layer KV, then seed fixed-size caches."""
+    def _prefill(
+        self,
+        token_ids: jax.Array,
+        max_len: int,
+        position_ids: Optional[jax.Array] = None,
+        segment_ids: Optional[jax.Array] = None,
+    ):
+        """Prompt pass collecting per-layer KV, then seed fixed-size caches.
+
+        ``position_ids``/``segment_ids`` carry left-padded (ragged) prompt
+        batches: pads sit in their own segment so content never attends to
+        them, and positions restart at the first content token so rotary
+        phases match the unpadded prompt."""
         b, s = token_ids.shape
-        pos = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+        pos = (
+            jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+            if position_ids is None
+            else position_ids
+        )
         ctx = self.module._make_ctx(deterministic=True, dropout_key=None)
 
-        def run(params, t, po):
-            x = self._make_batch(t, po)
+        def run(params, t, po, sg):
+            x = self._make_batch(t, po, segment_ids=sg)
             kvs = []
             for i, layer in enumerate(self.module.layers):
                 p = self.module._layer_params(params, i)
@@ -308,16 +334,21 @@ class TransformerInferenceModule:
                     x = layer(p, x, ctx)
             return x["activations"], kvs
 
-        logits, kvs = jax.jit(run)(self.params, token_ids, pos)
+        logits, kvs = jax.jit(run)(self.params, token_ids, pos, segment_ids)
         return logits, self._alloc_caches(kvs, max_len)
 
-    def _build_decode_loop(self, sample, stop_ids, steps):
+    def _build_decode_loop(self, sample, stop_ids, steps, ragged=False):
         """The whole decode as one device program: ``lax.while_loop`` whose
         carry holds the KV caches, the last token, and preallocated
         (b, steps+1) token / (b, steps+1, vocab) logit buffers. The key
         sequence matches the per-step path exactly (first token sampled
         with the caller's key outside, each loop step splits), so fused
-        and unfused decode produce identical generations."""
+        and unfused decode produce identical generations.
+
+        ``ragged``: the loop additionally takes per-row content lengths
+        (the rotary clock — cache slots stay the causal clock, see
+        nn/attention.py) and an additive pad mask that blanks the
+        left-pad cache slots."""
         stop_arr = jnp.asarray(stop_ids, jnp.int32) if stop_ids else None
 
         def is_stop(tok):
@@ -325,7 +356,8 @@ class TransformerInferenceModule:
                 return jnp.zeros(tok.shape, bool)
             return jnp.isin(tok, stop_arr)
 
-        def loop(params, caches, tok0, logits0, prompt_len, key):
+        def loop(params, caches, tok0, logits0, prompt_len, key,
+                 content_len=None, pad_mask=None):
             b = tok0.shape[0]
             tok0 = tok0.astype(jnp.int32)
             toks = jnp.zeros((b, steps + 1), jnp.int32)
@@ -341,8 +373,14 @@ class TransformerInferenceModule:
                 t, caches, tok, key, toks, lgts, done = c
                 key, sub = jax.random.split(key)
                 offset = prompt_len + t - 1
-                pos = jnp.broadcast_to(offset[None, None], (b, 1))
-                batch = self._make_batch(tok[:, None], pos)
+                if ragged:
+                    pos = (content_len + (t - 1))[:, None]
+                    batch = self._make_batch(
+                        tok[:, None], pos, scores_manipulation=pad_mask
+                    )
+                else:
+                    pos = jnp.broadcast_to(offset[None, None], (b, 1))
+                    batch = self._make_batch(tok[:, None], pos)
                 logits, caches = self._run_layers(params, batch, caches, offset)
                 nxt = sample(logits[:, -1], sub).astype(jnp.int32)
                 # finished rows keep stepping (their output is trimmed on
@@ -383,19 +421,59 @@ class TransformerInferenceModule:
         come back in ``CompletionOutput.logits`` like the reference's
         ``completion_logits``.
 
-        Accepts a batch of same-length prompts as a (b, s) array (or a list
-        of b token lists) and decodes all rows in one pass, each row
-        stopping independently — the reference's cache is bs=1 only
-        (attention.py:491). Batched input returns a list of
-        ``CompletionOutput``; 1-D input keeps the single-output form."""
+        Accepts a batch of prompts — a (b, s) array or a list of b token
+        lists, including RAGGED lists of unequal length — and decodes all
+        rows in one pass, each row stopping independently (the reference's
+        cache is bs=1 only, attention.py:491). Ragged prompts are
+        left-padded internally: pads sit in their own attention segment
+        during prefill, decode masks their cache slots, and per-row rotary
+        positions start at each row's first content token, so every row
+        generates exactly what it would alone. Batched input returns a
+        list of ``CompletionOutput``; 1-D input keeps the single-output
+        form."""
         if isinstance(input_ids, str):
             assert self.tokenizer is not None, "text prompt needs a tokenizer"
             input_ids = self.tokenizer.encode(input_ids)
+        pad_start = None
+        if (
+            isinstance(input_ids, (list, tuple))
+            and input_ids
+            and isinstance(input_ids[0], (list, tuple))
+            and len({len(r) for r in input_ids}) > 1
+        ):
+            lens = [len(r) for r in input_ids]
+            longest = max(lens)
+            pad_start = jnp.asarray([longest - n for n in lens], jnp.int32)
+            input_ids = [
+                [0] * (longest - n) + list(r) for r, n in zip(input_ids, lens)
+            ]
         prompt = jnp.asarray(input_ids, jnp.int32)
         single = prompt.ndim == 1
         if single:
             prompt = prompt[None]
         b, prompt_len = prompt.shape
+        if pad_start is not None:
+            # one left-padded layout over the full generation buffer:
+            # positions restart at each row's first content token and run
+            # straight into the generated slots; pads keep their own
+            # segment. Prefill slices the prompt prefix; the uncached
+            # path uses the full-buffer views directly.
+            slots_all = jnp.arange(prompt_len + max_tokens)[None]
+            ps = pad_start[:, None]
+            pos_all = jnp.clip(slots_all - ps, 0)
+            seg_all = jnp.where(slots_all >= ps, 0, 1).astype(jnp.int32)
+            prompt_pos = pos_all[:, :prompt_len]
+            prompt_seg = seg_all[:, :prompt_len]
+            content_len = prompt_len - pad_start  # per-row rotary clock base
+            # additive mask blanking the left-pad cache slots for decode
+            pad_mask = (
+                jnp.where(slots_all < ps, -1e9, 0.0)[:, None, None, :]
+                if use_cache
+                else None
+            )
+        else:
+            pos_all = seg_all = None
+            prompt_pos = prompt_seg = content_len = pad_mask = None
         if eos_token_id is None and self.tokenizer is not None:
             eos_token_id = self.tokenizer.eos_token_id
         stop = set(stop_tokens or [])
@@ -422,7 +500,9 @@ class TransformerInferenceModule:
 
         if use_cache:
             max_len = prompt_len + max_tokens
-            logits, caches = self._prefill(prompt, max_len)
+            logits, caches = self._prefill(
+                prompt, max_len, position_ids=prompt_pos, segment_ids=prompt_seg
+            )
             next_tok = sample(logits[:, -1], key)
 
         if use_cache and fused_decode:
@@ -430,7 +510,8 @@ class TransformerInferenceModule:
             # the per-step path); the loop body just never runs
             steps = max(0, max_tokens - 1)
             stop_ids = tuple(sorted(stop))
-            fkey = (steps, sample, stop_ids)
+            ragged = pad_start is not None
+            fkey = (steps, sample, stop_ids, ragged)
             # shapes (batch, cache length, vocab) re-trace via jit; only
             # the baked-in constants need an explicit cache key
             if self._decode_loop is None or self._decode_loop_key != fkey:
@@ -440,13 +521,14 @@ class TransformerInferenceModule:
                 # donate (every call would warn), so only accelerators do.
                 donate = (1,) if jax.default_backend() != "cpu" else ()
                 self._decode_loop = jax.jit(
-                    self._build_decode_loop(sample, stop_ids, steps),
+                    self._build_decode_loop(sample, stop_ids, steps, ragged),
                     donate_argnums=donate,
                 )
                 self._decode_loop_key = fkey
+            extra = (content_len, pad_mask) if ragged else ()
             toks, lgts, _, _ = self._decode_loop(
                 self.params, caches, next_tok, logits[:, -1],
-                jnp.asarray(prompt_len, jnp.int32), key,
+                jnp.asarray(prompt_len, jnp.int32), key, *extra,
             )
             toks_host = np.asarray(toks)  # ONE device->host transfer
             for i in range(b):
@@ -461,23 +543,30 @@ class TransformerInferenceModule:
             collect(next_tok, logits[:, -1])
 
             # the jitted decode closure bakes in the sampler: invalidate on
-            # either a new length or a different sample_fn, or a later call
-            # with the default sampler would silently reuse a stale one
+            # a new length, a different sample_fn, or a raggedness change,
+            # or a later call would silently reuse a stale closure
+            ragged = pad_start is not None
             if (
                 self._decode_fn is None
-                or self._decode_len != max_len
+                or self._decode_len != (max_len, ragged)
                 or getattr(self, "_decode_sampler", None) is not sample
             ):
-                def decode(params, caches, tok, offset, k):
+                def decode(params, caches, tok, offset, k, base=None, pm=None):
                     bb = tok.shape[0]
-                    pos = jnp.broadcast_to(offset[None, None], (bb, 1))
-                    batch = self._make_batch(tok[:, None], pos)
+                    if base is not None:
+                        pos = base[:, None]
+                        batch = self._make_batch(
+                            tok[:, None], pos, scores_manipulation=pm
+                        )
+                    else:
+                        pos = jnp.broadcast_to(offset[None, None], (bb, 1))
+                        batch = self._make_batch(tok[:, None], pos)
                     logits, new_caches = self._run_layers(params, batch, caches, offset)
                     nxt = sample(logits[:, -1], k)
                     return nxt, logits[:, -1], new_caches
 
                 self._decode_fn = jax.jit(decode)
-                self._decode_len = max_len
+                self._decode_len = (max_len, ragged)
                 self._decode_sampler = sample
 
             tok = next_tok
@@ -487,8 +576,10 @@ class TransformerInferenceModule:
                 key, sub = jax.random.split(key)
                 # finished rows keep stepping (their output is discarded);
                 # rows advance in lockstep so one shared cache_offset works
+                extra = (content_len + (t - 1), pad_mask) if ragged else ()
                 tok, step_logits, caches = self._decode_fn(
-                    self.params, caches, tok, jnp.asarray(prompt_len + t - 1, jnp.int32), sub
+                    self.params, caches, tok,
+                    jnp.asarray(prompt_len + t - 1, jnp.int32), sub, *extra,
                 )
                 collect(tok, step_logits)
         else:
@@ -497,14 +588,20 @@ class TransformerInferenceModule:
             buf = jnp.zeros((b, max_len), jnp.int32)
             buf = jax.lax.dynamic_update_slice_in_dim(buf, prompt, 0, axis=1)
             fwd = jax.jit(
-                lambda p, t, po: self._run_layers(p, self._make_batch(t, po), None, None)[0]
+                lambda p, t, po, sg: self._run_layers(
+                    p, self._make_batch(t, po, segment_ids=sg), None, None
+                )[0]
             )
-            pos = jnp.broadcast_to(jnp.arange(max_len)[None], (b, max_len))
+            if pad_start is not None:
+                pos, seg = pos_all, seg_all  # the shared left-padded layout
+            else:
+                pos = jnp.broadcast_to(jnp.arange(max_len)[None], (b, max_len))
+                seg = None
             cur = prompt_len
             for _ in range(max_tokens):
                 if all(finished):
                     break
-                logits = fwd(self.params, buf, pos)
+                logits = fwd(self.params, buf, pos, seg)
                 key, sub = jax.random.split(key)
                 nxt = sample(logits[:, cur - 1], sub)
                 collect(nxt, logits[:, cur - 1])

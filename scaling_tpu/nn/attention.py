@@ -312,8 +312,6 @@ class ParallelSelfAttention(BaseLayer):
 
         new_kv = (k, v) if return_kv else None
 
-        positions_q = position_ids
-        positions_k = position_ids
         if kv_cache is not None:
             # incremental decode: append new k/v at cache_offset
             ck, cv = kv_cache
@@ -323,12 +321,18 @@ class ParallelSelfAttention(BaseLayer):
             k, v = ck, cv
             new_kv = (ck, cv)
             s_k = k.shape[1]
-            positions_k = jnp.broadcast_to(jnp.arange(s_k)[None, :], (b, s_k))
-            if positions_q is None:
-                positions_q = cache_offset + jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
-            # mask out unwritten cache slots + causal vs absolute positions
-            valid_k = positions_k < (cache_offset + s)
-            allowed = valid_k[:, None, :] & (positions_k[:, None, :] <= positions_q[:, :, None])
+            # masking runs on CACHE SLOT indices, not on position_ids:
+            # under left-padded (ragged) prompts a row's rotary positions
+            # lag its slot indices by the pad width, and masking by rotary
+            # position would forbid the most recent slots. position_ids
+            # stays the rotary clock; slots are the causal clock.
+            slots_k = jnp.broadcast_to(jnp.arange(s_k)[None, :], (b, s_k))
+            slots_q = cache_offset + jnp.broadcast_to(
+                jnp.arange(s)[None, :], (b, s)
+            )
+            # mask out unwritten cache slots + causal vs slot order
+            valid_k = slots_k < (cache_offset + s)
+            allowed = valid_k[:, None, :] & (slots_k[:, None, :] <= slots_q[:, :, None])
             mask = ~allowed[:, None, :, :]
         else:
             if segment_ids is None:

@@ -330,3 +330,25 @@ def test_fused_decode_never_builds_per_step_dispatch(checkpoint_dir):
     module.generate([5, 9, 2], max_tokens=6)
     assert module._decode_fn is None
     assert module._decode_loop is not None
+
+
+def test_generate_ragged_prompts_match_single(checkpoint_dir):
+    """A ragged batch (unequal prompt lengths, left-padded internally)
+    must emit per row exactly the tokens of that prompt generated alone —
+    pads invisible to attention, rotary phases unshifted, on the fused,
+    per-step, and uncached paths alike (beyond the reference's bs=1 and
+    this framework's own same-length batching)."""
+    module = TransformerInferenceModule.from_checkpoint(checkpoint_dir)
+    prompts = [[5, 9, 2, 14, 7], [3, 3, 8], [20, 4, 6, 9, 2, 11, 13]]
+    alone = [module.generate(p, max_tokens=6) for p in prompts]
+
+    for kwargs in ({}, {"fused_decode": False}, {"use_cache": False}):
+        batched = module.generate(prompts, max_tokens=6, **kwargs)
+        assert isinstance(batched, list) and len(batched) == 3
+        for row, ref in zip(batched, alone):
+            assert row.completion_ids == ref.completion_ids, kwargs
+        # logits agree too (pad masking is exact, not approximate)
+        np.testing.assert_allclose(
+            np.asarray(batched[0].logits), np.asarray(alone[0].logits),
+            atol=2e-4, rtol=2e-4,
+        )
