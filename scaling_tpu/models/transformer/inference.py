@@ -322,6 +322,11 @@ class TransformerInferenceModule:
         )
         ctx = self.module._make_ctx(deterministic=True, dropout_key=None)
 
+        last_tl = max(
+            i for i, l in enumerate(self.module.layers)
+            if isinstance(l, TransformerLayer)
+        )
+
         def run(params, t, po, sg):
             x = self._make_batch(t, po, segment_ids=sg)
             kvs = []
@@ -332,6 +337,14 @@ class TransformerInferenceModule:
                     kvs.append(kv)
                 else:
                     x = layer(p, x, ctx)
+                if i == last_tl:
+                    # only the final position feeds sampling, and the
+                    # post-trunk layers (final norm, lm head) are
+                    # position-pointwise — running the vocab projection
+                    # over the whole prompt would materialize (b, s, vocab)
+                    # logits (>1 GB at bench shapes, ~8 GB at a 32k prompt)
+                    x = dict(x)
+                    x["activations"] = x["activations"][:, -1:]
             return x["activations"], kvs
 
         logits, kvs = jax.jit(run)(self.params, token_ids, pos, segment_ids)
