@@ -25,4 +25,4 @@ Layout:
   scaling_tpu.determined optional Determined AI cluster glue
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
