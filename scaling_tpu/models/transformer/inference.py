@@ -447,6 +447,15 @@ class TransformerInferenceModule:
         if isinstance(input_ids, str):
             assert self.tokenizer is not None, "text prompt needs a tokenizer"
             input_ids = self.tokenizer.encode(input_ids)
+        elif (
+            isinstance(input_ids, (list, tuple))
+            and input_ids
+            and isinstance(input_ids[0], str)
+        ):
+            # a batch of text prompts: encode each; unequal lengths ride
+            # the ragged (left-padded) path below
+            assert self.tokenizer is not None, "text prompts need a tokenizer"
+            input_ids = [self.tokenizer.encode(s) for s in input_ids]
         pad_start = None
         if (
             isinstance(input_ids, (list, tuple))

@@ -352,3 +352,48 @@ def test_generate_ragged_prompts_match_single(checkpoint_dir):
             np.asarray(batched[0].logits), np.asarray(alone[0].logits),
             atol=2e-4, rtol=2e-4,
         )
+
+
+def test_generate_text_batch(tmp_path):
+    """A list of text prompts encodes per row and rides the ragged path,
+    matching each prompt generated alone."""
+    import json
+
+    from scaling_tpu.models.transformer import TransformerConfig
+    from tokenizers import Tokenizer as HFTokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    vocab = {"<|endoftext|>": 0, "<unk>": 1, "a": 2, "b": 3, "c": 4}
+    tok = HFTokenizer(WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = Whitespace()
+    vocab_path = tmp_path / "vocab.json"
+    tok.save(str(vocab_path))
+    rows = [{"prompt": "a b", "completion": "c"}] * 4
+    data = tmp_path / "ft.jsonl"
+    data.write_text("\n".join(json.dumps(r) for r in rows))
+    config = TransformerConfig.from_dict(
+        {
+            "topology": {
+                "model_parallel_size": 1, "pipe_parallel_size": 1,
+                "data_parallel_size": 1, "micro_batch_size": 2,
+                "gradient_accumulation_steps": 1,
+            },
+            "transformer_architecture": {
+                "vocab_size": 8, "hidden_size": 16, "num_layers": 1,
+                "num_attention_heads": 2, "sequence_length": 8,
+                "vocab_file": str(vocab_path),
+            },
+            "trainer": {"train_iterations": 1, "seed": 1,
+                        "save_dir": str(tmp_path / "ckpt"), "save_interval": 1},
+            "data": {"data_prefixes": [str(data)], "finetuning_dataset": True},
+            "logger": {"log_dir": None},
+        }
+    )
+    train_capture(build_capturing_trainer(config), 1)
+    module = TransformerInferenceModule.from_checkpoint(tmp_path / "ckpt")
+    outs = module.generate(["a b", "a"], max_tokens=3)  # unequal lengths
+    assert isinstance(outs, list) and len(outs) == 2
+    for text, out in zip(["a b", "a"], outs):
+        alone = module.generate(text, max_tokens=3)
+        assert out.completion_ids == alone.completion_ids
