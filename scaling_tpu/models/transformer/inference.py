@@ -47,6 +47,51 @@ def sample_argmax(logits: jax.Array, key: Optional[jax.Array] = None) -> jax.Arr
     return jnp.argmax(logits, axis=-1)
 
 
+class _LeftPadLayout(NamedTuple):
+    """Position/segment/mask views for a left-padded (ragged) batch; all
+    None when the batch is rectangular."""
+
+    pos_all: Optional[jax.Array] = None  # (b, prompt+gen) rotary positions
+    seg_all: Optional[jax.Array] = None  # (b, prompt+gen) pad segment = 1
+    prompt_pos: Optional[jax.Array] = None  # prompt-prefix slices of the above
+    prompt_seg: Optional[jax.Array] = None
+    content_len: Optional[jax.Array] = None  # (b,) per-row rotary clock base
+    pad_mask: Optional[jax.Array] = None  # (b,1,1,prompt+gen) additive -1e9
+
+    @property
+    def ragged(self) -> bool:
+        return self.pos_all is not None
+
+
+def _left_pad_layout(
+    pad_start: Optional[jax.Array], prompt_len: int, max_tokens: int,
+    use_cache: bool,
+) -> _LeftPadLayout:
+    """One left-padded layout over the full generation buffer: positions
+    restart at each row's first content token and run straight into the
+    generated slots; pads keep their own segment. Prefill slices the
+    prompt prefix; the uncached path uses the full-buffer views directly;
+    the decode paths blank the pad cache slots with the additive mask."""
+    if pad_start is None:
+        return _LeftPadLayout()
+    slots_all = jnp.arange(prompt_len + max_tokens)[None]
+    ps = pad_start[:, None]
+    pos_all = jnp.clip(slots_all - ps, 0)
+    seg_all = jnp.where(slots_all >= ps, 0, 1).astype(jnp.int32)
+    return _LeftPadLayout(
+        pos_all=pos_all,
+        seg_all=seg_all,
+        prompt_pos=pos_all[:, :prompt_len],
+        prompt_seg=seg_all[:, :prompt_len],
+        content_len=prompt_len - pad_start,
+        pad_mask=(
+            jnp.where(slots_all < ps, -1e9, 0.0)[:, None, None, :]
+            if use_cache
+            else None
+        ),
+    )
+
+
 def make_sampler(
     temperature: float = 1.0,
     top_k: Optional[int] = None,
@@ -93,7 +138,7 @@ class TransformerInferenceModule:
         self._logits_fn = None
         self._decode_fn = None
         # (max_len, ragged) the per-step decode closure was traced for
-        self._decode_len: Optional[tuple] = None
+        self._decode_key: Optional[tuple] = None
         self._decode_loop = None
         self._decode_loop_key = None
 
@@ -474,28 +519,7 @@ class TransformerInferenceModule:
         if single:
             prompt = prompt[None]
         b, prompt_len = prompt.shape
-        if pad_start is not None:
-            # one left-padded layout over the full generation buffer:
-            # positions restart at each row's first content token and run
-            # straight into the generated slots; pads keep their own
-            # segment. Prefill slices the prompt prefix; the uncached
-            # path uses the full-buffer views directly.
-            slots_all = jnp.arange(prompt_len + max_tokens)[None]
-            ps = pad_start[:, None]
-            pos_all = jnp.clip(slots_all - ps, 0)
-            seg_all = jnp.where(slots_all >= ps, 0, 1).astype(jnp.int32)
-            prompt_pos = pos_all[:, :prompt_len]
-            prompt_seg = seg_all[:, :prompt_len]
-            content_len = prompt_len - pad_start  # per-row rotary clock base
-            # additive mask blanking the left-pad cache slots for decode
-            pad_mask = (
-                jnp.where(slots_all < ps, -1e9, 0.0)[:, None, None, :]
-                if use_cache
-                else None
-            )
-        else:
-            pos_all = seg_all = None
-            prompt_pos = prompt_seg = content_len = pad_mask = None
+        lay = _left_pad_layout(pad_start, prompt_len, max_tokens, use_cache)
         if eos_token_id is None and self.tokenizer is not None:
             eos_token_id = self.tokenizer.eos_token_id
         stop = set(stop_tokens or [])
@@ -523,7 +547,7 @@ class TransformerInferenceModule:
         if use_cache:
             max_len = prompt_len + max_tokens
             logits, caches = self._prefill(
-                prompt, max_len, position_ids=prompt_pos, segment_ids=prompt_seg
+                prompt, max_len, position_ids=lay.prompt_pos, segment_ids=lay.prompt_seg
             )
             next_tok = sample(logits[:, -1], key)
 
@@ -532,7 +556,7 @@ class TransformerInferenceModule:
             # the per-step path); the loop body just never runs
             steps = max(0, max_tokens - 1)
             stop_ids = tuple(sorted(stop))
-            ragged = pad_start is not None
+            ragged = lay.ragged
             fkey = (steps, sample, stop_ids, ragged)
             # shapes (batch, cache length, vocab) re-trace via jit; only
             # the baked-in constants need an explicit cache key
@@ -547,7 +571,7 @@ class TransformerInferenceModule:
                     donate_argnums=donate,
                 )
                 self._decode_loop_key = fkey
-            extra = (content_len, pad_mask) if ragged else ()
+            extra = (lay.content_len, lay.pad_mask) if ragged else ()
             toks, lgts, _, _ = self._decode_loop(
                 self.params, caches, next_tok, logits[:, -1],
                 jnp.asarray(prompt_len, jnp.int32), key, *extra,
@@ -567,10 +591,10 @@ class TransformerInferenceModule:
             # the jitted decode closure bakes in the sampler: invalidate on
             # a new length, a different sample_fn, or a raggedness change,
             # or a later call would silently reuse a stale closure
-            ragged = pad_start is not None
+            ragged = lay.ragged
             if (
                 self._decode_fn is None
-                or self._decode_len != (max_len, ragged)
+                or self._decode_key != (max_len, ragged)
                 or getattr(self, "_decode_sampler", None) is not sample
             ):
                 def decode(params, caches, tok, offset, k, base=None, pm=None):
@@ -588,7 +612,7 @@ class TransformerInferenceModule:
                     return nxt, logits[:, -1], new_caches
 
                 self._decode_fn = jax.jit(decode)
-                self._decode_len = (max_len, ragged)
+                self._decode_key = (max_len, ragged)
                 self._decode_sampler = sample
 
             tok = next_tok
@@ -598,7 +622,7 @@ class TransformerInferenceModule:
                 key, sub = jax.random.split(key)
                 # finished rows keep stepping (their output is discarded);
                 # rows advance in lockstep so one shared cache_offset works
-                extra = (content_len + (t - 1), pad_mask) if ragged else ()
+                extra = (lay.content_len + (t - 1), lay.pad_mask) if ragged else ()
                 tok, step_logits, caches = self._decode_fn(
                     self.params, caches, tok,
                     jnp.asarray(prompt_len + t - 1, jnp.int32), sub, *extra,
@@ -614,8 +638,8 @@ class TransformerInferenceModule:
                     p, self._make_batch(t, po, segment_ids=sg), None, None
                 )[0]
             )
-            if pad_start is not None:
-                pos, seg = pos_all, seg_all  # the shared left-padded layout
+            if lay.ragged:
+                pos, seg = lay.pos_all, lay.seg_all  # the shared left-padded layout
             else:
                 pos = jnp.broadcast_to(jnp.arange(max_len)[None], (b, max_len))
                 seg = None
