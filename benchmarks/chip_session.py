@@ -64,14 +64,20 @@ def _init_backend():
     what lets the suite exercise the dispatcher without touching hardware."""
     import jax
 
-    if os.environ.get("CHIP_SESSION_CPU"):
+    cpu_pin = bool(os.environ.get("CHIP_SESSION_CPU"))
+    if cpu_pin:
         jax.config.update("jax_platforms", "cpu")
     # share bench.py's persistent executable cache: each section is a
     # fresh process, and without the cache every one re-pays its compiles
-    # through the tunnel's remote-compile service
+    # through the tunnel's remote-compile service. CPU rehearsals get a
+    # separate cache — their XLA:CPU AOT entries carry different host
+    # feature flags and would pollute capture day's cache with
+    # machine-mismatch warnings
+    cache = os.environ.get(
+        "SCALING_TPU_BENCH_CACHE", "/tmp/scaling_tpu_bench_jaxcache"
+    )
     jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get("SCALING_TPU_BENCH_CACHE", "/tmp/scaling_tpu_bench_jaxcache"),
+        "jax_compilation_cache_dir", cache + "_cpu" if cpu_pin else cache
     )
     from scaling_tpu.devices import probe_devices
 
