@@ -332,3 +332,27 @@ def test_baseline4_layout_compile_pin_small_proxy():
     assert rec["fits_v5p_95g"] is True
     assert rec["per_chip_gb"] < 1.0
     assert rec["collective_bytes_per_iter"]
+
+
+def test_abstract_state_mirrors_init_state(devices):
+    """benchmarks/compile_pin_7b.py trusts Optimizer.abstract_state to be a
+    faithful aval mirror of init_state — structure, shapes, dtypes, and
+    the ZeRO master shardings eval_shape would drop. A drift (say, a new
+    OptimizerState field) must fail here, not silently skew the 7B pin."""
+    config = make_config(mp=2, dp=4, zero=True)
+    topology = Topology(config.topology)
+    module = init_model(config, topology)
+    optimizer = init_optimizer(config, module, topology)
+    params = module.shard_params(module.init_params(jax.random.PRNGKey(0)))
+    real = optimizer.init_state(params)
+    abstract = optimizer.abstract_state(params)
+    assert jax.tree.structure(real) == jax.tree.structure(abstract)
+    for r, a in zip(jax.tree.leaves(real), jax.tree.leaves(abstract)):
+        assert r.shape == a.shape and r.dtype == a.dtype, (r.shape, a.shape)
+    for field in ("master", "exp_avg", "exp_avg_sq"):
+        for r, a in zip(
+            jax.tree.leaves(getattr(real, field)),
+            jax.tree.leaves(getattr(abstract, field)),
+        ):
+            if r.size:  # (0,) placeholders for frozen leaves carry none
+                assert a.sharding == r.sharding, (field, a.sharding, r.sharding)
