@@ -466,6 +466,10 @@ def main() -> None:
                 "mfu": round(mfu, 4),
                 "mfu_vs_measured_peak": mfu_achievable,
                 "measured_peak_tflops": round(achievable, 1) if achievable else None,
+                # r1-r4 probes timed single ~22ms chains inside the tunnel
+                # RTT (~50 TF misreads); 'amortized-v2' marks readings from
+                # the ~140-TFLOP-per-window probe
+                "peak_probe": "amortized-v2" if achievable else None,
                 "hardware": hardware.value,
                 "params": param_count,
                 "step_ms": round(dt * 1000, 2),
