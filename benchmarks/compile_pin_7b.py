@@ -11,6 +11,7 @@ same pin at a scaled-down layout (tests/transformer/test_hlo_cost_pins).
 
 Usage: python benchmarks/compile_pin_7b.py          # ~7B, 64 devices
        python benchmarks/compile_pin_7b.py --small  # CI-sized proxy
+       python benchmarks/compile_pin_7b.py --peft   # BASELINE #5: 7B+LoRA, TP=4 x DP=16
 """
 
 import json
@@ -36,7 +37,7 @@ V5P_HBM = 95e9  # bytes per chip
 V5P_PEAK_TFLOPS = 459  # bf16
 
 
-def build_abstract(small: bool):
+def build_abstract(small: bool, peft: bool = False):
     from scaling_tpu.models.transformer import TransformerConfig
     from scaling_tpu.models.transformer.model import (
         init_model,
@@ -53,13 +54,24 @@ def build_abstract(small: bool):
         hidden, layers, heads, kv, vocab, seq, mbs, gas = (
             4096, 32, 32, 8, 32768, 2048, 1, 8,
         )
-    d = {
-        "topology": {
+    if peft:
+        # BASELINE #5: PEFT finetune layout — TP=4 x DP=16, no pipeline
+        topo_d = {
+            "model_parallel_size": 4, "pipe_parallel_size": 1,
+            "data_parallel_size": 16, "micro_batch_size": mbs,
+            "gradient_accumulation_steps": gas,
+            "activation_checkpointing_type": "every_layer",
+        }
+    else:
+        # BASELINE #4: pretraining layout — TP=4 x PP=2 x DP=8
+        topo_d = {
             "model_parallel_size": 4, "pipe_parallel_size": 2,
             "data_parallel_size": 8, "micro_batch_size": mbs,
             "gradient_accumulation_steps": gas,
             "activation_checkpointing_type": "every_layer",
-        },
+        }
+    d = {
+        "topology": topo_d,
         "transformer_architecture": {
             "vocab_size": vocab, "hidden_size": hidden, "num_layers": layers,
             "num_attention_heads": heads, "attention_num_kv_heads": kv,
@@ -79,6 +91,11 @@ def build_abstract(small: bool):
         "trainer": {"train_iterations": 10, "seed": 0},
         "data": {}, "logger": {"log_dir": None},
     }
+    if peft:
+        d["transformer_architecture"]["lora_config"] = {
+            "name": "lo", "rank": 16, "alpha": 32,
+        }
+        d["training"] = {"finetune": True, "finetunable_parameters": []}
     config = TransformerConfig.from_dict(d)
     topology = Topology(config.topology)
     module = init_model(config, topology)
@@ -119,8 +136,9 @@ def build_abstract(small: bool):
 
 def main():
     small = "--small" in sys.argv
+    peft = "--peft" in sys.argv
     t0 = time.time()
-    config, step, args = build_abstract(small)
+    config, step, args = build_abstract(small, peft)
     lowered = step.lower(*args)
     compiled = lowered.compile()
     compile_s = time.time() - t0
@@ -159,7 +177,10 @@ def main():
     floor_ms = step_flops_analytic / n_dev / (V5P_PEAK_TFLOPS * 1e12) * 1e3
 
     print(json.dumps({
-        "layout": "tp4.pp2.dp8+zero1+every_layer_remat",
+        "layout": (
+            "tp4.dp16+lora16+zero1+every_layer_remat" if peft
+            else "tp4.pp2.dp8+zero1+every_layer_remat"
+        ),
         "model": "small-proxy" if small else "7b",
         "params": int(n_params),
         "devices": n_dev,
