@@ -98,7 +98,14 @@ def make_sampler(
     top_p: Optional[float] = None,
 ) -> Callable[[jax.Array, jax.Array], jax.Array]:
     """Temperature / top-k / top-p (nucleus) sampling, composable like the
-    reference's transform chain (reference: inference/sample.py:17-45)."""
+    reference's transform chain (reference: inference/sample.py:17-45).
+
+    The returned closure carries ``_sampler_key`` (its configuration), so
+    the jitted decode loops recognise two ``make_sampler(...)`` calls with
+    identical settings as the same sampler instead of re-tracing the whole
+    while-loop program per ``generate()`` call. Custom sampler callables
+    without the attribute fall back to object identity — reuse one object
+    across calls to keep the compiled loop warm."""
 
     def sample(logits: jax.Array, key: jax.Array) -> jax.Array:
         scaled = logits.astype(jnp.float32) / max(temperature, 1e-6)
@@ -117,7 +124,14 @@ def make_sampler(
             scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
         return jax.random.categorical(key, scaled, axis=-1)
 
+    sample._sampler_key = ("make_sampler", temperature, top_k, top_p)
     return sample
+
+
+def _sampler_cache_id(sample: Callable) -> Any:
+    """Cache identity for a sampler: its configuration when it advertises
+    one, the object itself otherwise."""
+    return getattr(sample, "_sampler_key", sample)
 
 
 class TransformerInferenceModule:
@@ -367,10 +381,17 @@ class TransformerInferenceModule:
         )
         ctx = self.module._make_ctx(deterministic=True, dropout_key=None)
 
-        last_tl = max(
+        transformer_idxs = [
             i for i, l in enumerate(self.module.layers)
             if isinstance(l, TransformerLayer)
-        )
+        ]
+        if not transformer_idxs:
+            raise ValueError(
+                "cannot run cached generation on a module with no "
+                "TransformerLayer (nothing produces KV caches); use "
+                "generate(use_cache=False) or fix the layer stack"
+            )
+        last_tl = max(transformer_idxs)
 
         def run(params, t, po, sg):
             x = self._make_batch(t, po, segment_ids=sg)
@@ -557,7 +578,7 @@ class TransformerInferenceModule:
             steps = max(0, max_tokens - 1)
             stop_ids = tuple(sorted(stop))
             ragged = lay.ragged
-            fkey = (steps, sample, stop_ids, ragged)
+            fkey = (steps, _sampler_cache_id(sample), stop_ids, ragged)
             # shapes (batch, cache length, vocab) re-trace via jit; only
             # the baked-in constants need an explicit cache key
             if self._decode_loop is None or self._decode_loop_key != fkey:
@@ -595,7 +616,8 @@ class TransformerInferenceModule:
             if (
                 self._decode_fn is None
                 or self._decode_key != (max_len, ragged)
-                or getattr(self, "_decode_sampler", None) is not sample
+                or getattr(self, "_decode_sampler", None)
+                != _sampler_cache_id(sample)
             ):
                 def decode(params, caches, tok, offset, k, base=None, pm=None):
                     bb = tok.shape[0]
@@ -613,7 +635,7 @@ class TransformerInferenceModule:
 
                 self._decode_fn = jax.jit(decode)
                 self._decode_key = (max_len, ragged)
-                self._decode_sampler = sample
+                self._decode_sampler = _sampler_cache_id(sample)
 
             tok = next_tok
             for t in range(1, max_tokens):

@@ -302,7 +302,16 @@ class Optimizer:
         finetuning's). With frozen leaves stopped inside the loss, their
         gradients are constant zeros and XLA deletes the matmuls and
         collectives outright — backward cost scales with the adapters,
-        which is the point of BASELINE #5's PEFT layout."""
+        which is the point of BASELINE #5's PEFT layout.
+
+        Deliberate loss-scaling consequence: under fp16 dynamic scaling,
+        a non-finite value confined to a FROZEN leaf's gradient no longer
+        trips ``has_inf_or_nan_tree`` (the leaf's grad is now a constant
+        zero rather than inf/nan), so it causes neither a skipped step nor
+        a scale backoff. That is correct — those gradients were discarded
+        anyway, and an overflow that only a dropped tensor would have seen
+        should not perturb the training of the live adapters. Covered by
+        ``test_frozen_leaf_overflow_invisible_to_scaler``."""
         if all(gi >= 0 for gi in self._group_index):
             return params
         leaves, td = jax.tree.flatten(params)

@@ -348,7 +348,15 @@ class BaseTrainer:
         # fetches or stays in flight so the next dispatch isn't gated on
         # host/tunnel latency
         profiling = self.profiler is not None and self.profiler.enabled_at(step_idx)
-        fetch = profiling or (
+        # the run's last step always fetches: otherwise a train_iterations
+        # that isn't a log_interval multiple ends with the tail steps'
+        # metrics (including the final loss) never logged, their device
+        # work drained only implicitly by checkpointing
+        last_step = (
+            self.config.train_iterations is not None
+            and self.context.iterations >= self.config.train_iterations
+        )
+        fetch = profiling or last_step or (
             self.context.iterations % self.config.log_interval == 0
         )
         if not fetch:
@@ -445,23 +453,37 @@ class BaseTrainer:
                     self._run_checkpoint_hooks(step_dir)
                     logger.info("preemption: checkpoint saved, exiting cleanly")
                 return
-            if (
+            will_save = (
                 self.config.save_dir is not None
                 and self.config.save_interval is not None
                 and self.context.iterations % self.config.save_interval == 0
-            ):
-                step_dir = self.save_checkpoint()
-                self._run_checkpoint_hooks(step_dir)
-            if (
+            )
+            will_eval = (
                 self.config.eval_interval is not None
                 and self.dataset_evaluation is not None
                 and self.context.iterations % self.config.eval_interval == 0
-            ):
+            )
+            if (will_save or will_eval) and self._unfetched_steps:
+                # checkpoint/eval sync the device anyway; draining FIRST
+                # pins the unfetched backlog's device work inside the train
+                # window, so the aux-time exclusion below can't swallow
+                # real step time that would have drained during the aux work
+                jax.block_until_ready(self.opt_state.step)
+            aux_start = time.time()
+            if will_save:
+                step_dir = self.save_checkpoint()
+                self._run_checkpoint_hooks(step_dir)
+            if will_eval:
                 eval_out = self.eval_step()
                 logger.log_metrics(
                     {"eval_loss": eval_out.loss, **{f"eval_{k}": v for k, v in eval_out.metrics.items()}},
                     self.context.iterations,
                 )
+            if (will_save or will_eval) and self._last_fetch_wall is not None:
+                # the amortized step_duration divides (next fetch - last
+                # fetch) by the backlog; checkpoint/eval wall time between
+                # fetches is not train-step work and would inflate it
+                self._last_fetch_wall += time.time() - aux_start
             if output.fetched:
                 # unfetched steps (log_interval > 1) carry in-flight device
                 # arrays; touching them here would reintroduce the per-step

@@ -183,6 +183,50 @@ def test_overflow_skips_step_and_backs_off_scale():
     assert int(new_state.step) == 0
 
 
+def test_frozen_leaf_overflow_invisible_to_scaler():
+    """freeze_frozen_params changes dynamic-scaling semantics ON PURPOSE:
+    a non-finite value confined to a frozen-backbone gradient used to trip
+    has_inf_or_nan_tree (step skip + scale backoff); with the frozen leaf
+    stop_gradient'd inside the loss that gradient is a constant zero, so
+    the overflow is invisible and the live parameters keep training at
+    full scale — correct, because the frozen grad was discarded anyway
+    (see Optimizer.freeze_frozen_params docstring)."""
+    m = metas()
+    groups = [
+        OptimizerParamGroup(keys={m["bias"].key}, learning_rate_scheduler=const_lr(0.1))
+    ]
+    cfg = OptimizerConfig(
+        loss_scaler=LossScalerConfig(enable=True, initial_scale=2.0**16, hysteresis=1)
+    )
+    optimizer = Optimizer(cfg, groups, m)
+    params = {"weight": jnp.ones((4, 4)), "bias": jnp.ones((4,))}
+    state = optimizer.init_state(params)
+
+    def loss_fn(p, freeze):
+        if freeze:
+            p = optimizer.freeze_frozen_params(p)
+        # finite forward (sqrt(0) = 0) with an INF gradient confined to the
+        # frozen leaf (d/dw sqrt(w-1) at w=1 -> inf); d/dbias = 1 is finite
+        return jnp.sum(jnp.sqrt(p["weight"] - 1.0)) + jnp.sum(p["bias"])
+
+    # control: WITHOUT the freeze, the inf weight-grad trips the scaler
+    raw_grads = jax.grad(lambda p: loss_fn(p, False))(params)
+    assert not np.isfinite(np.asarray(raw_grads["weight"])).any()
+    _, skipped_state, out = optimizer.step(params, raw_grads, state)
+    assert bool(out.overflow)
+    assert float(skipped_state.loss_scaler.current_scale) == 2.0**15
+
+    # with the freeze: zero frozen grad, no overflow, live param trains
+    frozen_grads = jax.grad(lambda p: loss_fn(p, True))(params)
+    np.testing.assert_array_equal(np.asarray(frozen_grads["weight"]), 0.0)
+    new_params, new_state, out = optimizer.step(params, frozen_grads, state)
+    assert not bool(out.overflow)
+    assert float(new_state.loss_scaler.current_scale) == 2.0**16
+    assert int(new_state.step) == 1
+    np.testing.assert_array_equal(np.asarray(new_params["weight"]), 1.0)
+    assert not np.allclose(np.asarray(new_params["bias"]), 1.0)
+
+
 def test_loss_scale_grows_after_window():
     from scaling_tpu.optimizer import LossScaler, LossScalerConfig
 
