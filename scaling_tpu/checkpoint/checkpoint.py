@@ -82,6 +82,14 @@ class AsyncCheckpointWriter:
 
 
 def _write_npz(path: Path, arrays: Dict[str, np.ndarray]) -> None:
+    # numpy serializes ml_dtypes extension dtypes (bfloat16, fp8) as raw
+    # void records that np.load returns as uncastable |V2 — store them as
+    # float32 instead (lossless widening for bf16); the loader casts every
+    # array back to the model's parameter dtype anyway
+    arrays = {
+        k: v.astype(np.float32) if v.dtype.kind == "V" else v
+        for k, v in arrays.items()
+    }
     np.savez(path, **arrays)
 
 
@@ -316,6 +324,13 @@ def load_optimizer_checkpoint(dir: Path | str, opt_state, metas: Any):
         c_leaves, treedef = jax.tree.flatten(current)
         new_leaves = []
         for p, m in zip(c_leaves, m_leaves):
+            if getattr(p, "size", None) == 0:
+                # frozen-leaf (0,) placeholder (PEFT: no master/moments for
+                # the backbone): nothing meaningful to load — and a
+                # device_put would COMMIT it to one device, which then
+                # conflicts with the mesh-committed params inside jit
+                new_leaves.append(p)
+                continue
             arr = load_entry(field, m.layer_index, m.parameter_name)
             new_leaves.append(
                 jax.device_put(jnp.asarray(arr, dtype=p.dtype), p.sharding)

@@ -21,12 +21,16 @@ os.environ["XLA_FLAGS"] = (
 ).strip()
 
 
-def train_losses(n_dev: int) -> tuple:
-    """Two train steps of a fixed tiny transformer over an ``mp=2 x
-    dp=n_dev/2`` mesh spanning ALL visible devices (however many processes
-    they live in). Pure function of ``n_dev``: the global batch is
-    synthesized identically everywhere, so single- and multi-process runs
-    of the same global mesh must produce the same losses. Returns
+def train_losses(n_dev: int, pp: int = 1) -> tuple:
+    """Two train steps of a fixed tiny transformer over a mesh spanning
+    ALL visible devices (however many processes they live in): ``mp=2 x
+    dp=n_dev/2`` by default, ``pp=2 x mp=2 x dp`` when ``pp == 2`` — the
+    pipe axis is the mesh's outermost, so with one process per 4-device
+    group the pipeline's stage-shift collective-permute crosses the
+    process boundary (the DCN path a multi-host pod's pipeline rides).
+    Pure function of ``(n_dev, pp)``: the global batch is synthesized
+    identically everywhere, so single- and multi-process runs of the same
+    global mesh must produce the same losses. Returns
     (losses, module, params, opt_state)."""
     import jax
     import numpy as np
@@ -42,20 +46,21 @@ def train_losses(n_dev: int) -> tuple:
     # mp x dp so BOTH collective families cross process boundaries: the
     # per-layer tensor-parallel all-gathers and the gradient psum
     mp = 2 if n_dev % 2 == 0 else 1
-    dp = n_dev // mp
+    dp = n_dev // (mp * pp)
+    gas = 1 if pp == 1 else 2 * pp
     config = TransformerConfig.from_dict(
         {
             "topology": {
                 "model_parallel_size": mp,
-                "pipe_parallel_size": 1,
+                "pipe_parallel_size": pp,
                 "data_parallel_size": dp,
                 "micro_batch_size": 2,
-                "gradient_accumulation_steps": 1,
+                "gradient_accumulation_steps": gas,
             },
             "transformer_architecture": {
                 "vocab_size": 64,
                 "hidden_size": 32,
-                "num_layers": 1,
+                "num_layers": 1 if pp == 1 else 2 * pp,
                 "num_attention_heads": 2,
                 "sequence_length": 16,
                 "precision": "float32",
@@ -83,16 +88,17 @@ def train_losses(n_dev: int) -> tuple:
         # every process synthesizes the IDENTICAL global batch (pure
         # function of the seed); shard_batch materializes local shards only
         rng = np.random.default_rng(i)
-        tokens = rng.integers(1, 64, size=(1, 2 * dp, 16))
+        shape = (gas, 2 * dp, 16)
+        tokens = rng.integers(1, 64, size=shape)
         batch = module.shard_batch(
             {
                 "token_ids": tokens.astype(np.int32),
                 "target_token_ids": np.roll(tokens, -1, axis=-1).astype(np.int32),
                 "position_ids": np.broadcast_to(
-                    np.arange(16, dtype=np.int32), (1, 2 * dp, 16)
+                    np.arange(16, dtype=np.int32), shape
                 ),
-                "segment_ids": np.zeros((1, 2 * dp, 16), np.int32),
-                "loss_weights": np.ones((1, 2 * dp, 16), np.float32),
+                "segment_ids": np.zeros(shape, np.int32),
+                "loss_weights": np.ones(shape, np.float32),
             },
             stacked=True,
         )
@@ -103,13 +109,13 @@ def train_losses(n_dev: int) -> tuple:
     return losses, module, params, opt_state
 
 
-def run_distributed_train(cache_dir: Path) -> dict:
+def run_distributed_train(cache_dir: Path, pp: int = 1) -> dict:
     """Two global train steps over the multi-process mesh; returns losses
     (every process must see identical, finite values) plus a collective
     orbax save/restore round-trip flag."""
     import jax
 
-    losses, module, params, opt_state = train_losses(len(jax.devices()))
+    losses, module, params, opt_state = train_losses(len(jax.devices()), pp=pp)
 
     # distributed checkpointing through the PRODUCT backend (the same
     # functions the trainer's checkpoint_backend=orbax uses): a collective
@@ -166,7 +172,9 @@ def main() -> None:
     }
     cache_dir = Path(lc.payload["cache_dir"])
     if lc.payload.get("case") == "train":
-        out.update(run_distributed_train(cache_dir))
+        out.update(
+            run_distributed_train(cache_dir, pp=int(lc.payload.get("pp", 1)))
+        )
     (cache_dir / f"rank_{lc.global_rank}.json").write_text(json.dumps(out))
 
 

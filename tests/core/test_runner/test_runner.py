@@ -199,15 +199,22 @@ def test_dataloader_per_host_dp_rank(devices):
     assert next(loader) == per_rank_batches[0][4:8]
 
 
-def test_distributed_train_step_across_processes(tmp_path: Path, devices):
+@pytest.mark.parametrize("pp", [1, 2], ids=["mp2xdp4", "pp2xmp2xdp2"])
+def test_distributed_train_step_across_processes(tmp_path: Path, devices, pp):
     """The full sharded train step executes across two real OS processes
-    (4 devices each, TP x DP mesh spanning both) with cross-process
-    collectives — the closest one-machine emulation of a multi-host pod.
-    Both processes must report identical finite losses, and those losses
-    must MATCH the same 8-device program run single-process in this test:
-    multi-process DCN-style execution is numerically the same program as
-    the in-process mesh (VERDICT r3 #7; reference analogue:
-    tests/core/utils.py:244-307 spawning NCCL process groups)."""
+    (4 devices each, mesh spanning both) with cross-process collectives —
+    the closest one-machine emulation of a multi-host pod. pp=1 crosses
+    the boundary with TP all-gathers and DP psums (VERDICT r3 #7); pp=2
+    makes the pipe axis (the mesh's outermost) span it instead, so stage 0
+    lives entirely in process 0 and stage 1 in process 1 and the spatial
+    pipeline's stage-shift collective-permute is forced across the
+    boundary — the one collective family the multi-process harness had
+    never exercised (VERDICT r4 #5). Losses must be identical in both
+    processes, finite, and MATCH the same program run single-process on
+    this test's 8-device mesh: multi-process DCN-style execution is
+    numerically the same program as the in-process mesh (reference
+    analogue: tests/core/utils.py:244-307 spawning NCCL process groups,
+    with pp in the training grid at test_training.py:46-67)."""
     config = RunnerConfig.from_dict(
         {
             "runner_type": "pdsh",
@@ -218,18 +225,20 @@ def test_distributed_train_step_across_processes(tmp_path: Path, devices):
             "default_gpu_count": 2,
         }
     )
-    rc = runner_main(config, payload={"cache_dir": str(tmp_path), "case": "train"})
+    rc = runner_main(
+        config, payload={"cache_dir": str(tmp_path), "case": "train", "pp": pp}
+    )
     assert rc == 0
     outs = sorted(tmp_path.glob("rank_*.json"))
     assert len(outs) == 2
     records = [json.loads(f.read_text()) for f in outs]
+    import math
+
     for rec in records:
         assert rec["process_count"] == 2
         assert rec["global_devices"] == 8  # 2 processes x 4 virtual devices
-        losses = rec["losses"]
-        import math
-
-        assert len(losses) == 2 and all(math.isfinite(l) for l in losses)
+        assert len(rec["losses"]) == 2
+        assert all(math.isfinite(l) for l in rec["losses"])
     # SPMD: every process computed the same global step
     assert records[0]["losses"] == records[1]["losses"]
     # loss parity vs the single-process 8-device mesh (same global mesh,
@@ -238,12 +247,13 @@ def test_distributed_train_step_across_processes(tmp_path: Path, devices):
 
     from tests.core.test_runner.runner_script import train_losses
 
-    single_proc_losses, _, _, _ = train_losses(len(devices))
+    single_proc_losses, _, _, _ = train_losses(len(devices), pp=pp)
     np.testing.assert_allclose(
         np.asarray(records[0]["losses"], np.float64),
         np.asarray(single_proc_losses, np.float64),
-        rtol=1e-6,
+        rtol=1e-6 if pp == 1 else 1e-5,
     )
     # the collective orbax save/restore (each process writing only its own
-    # shards) reproduced the trained params bit-exactly on both processes
+    # shards — pipe-sharded ones included at pp=2) reproduced the trained
+    # params bit-exactly on both processes
     assert all(rec["orbax_roundtrip"] for rec in records)
