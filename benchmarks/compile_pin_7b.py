@@ -176,6 +176,54 @@ def main():
     step_flops_analytic = analytic_step_flops(config)
     floor_ms = step_flops_analytic / n_dev / (V5P_PEAK_TFLOPS * 1e12) * 1e3
 
+    # pipeline economics for this layout (PERF.md "Spatial pipeline vs a
+    # 1F1B executor"): n_micro/(n_micro+pp-1) is BOTH the spatial
+    # pipeline's useful-FLOP fraction (fill/drain garbage ticks) and a
+    # non-interleaved 1F1B's bubble fraction — the same useful-token MFU
+    # ceiling either way. The only extra wall-clock the spatial form can
+    # pay is the chunked tick-remat's body forward, reported here along
+    # with whether the carry budget actually engages it at this layout.
+    from scaling_tpu.parallel.pipeline import _tick_carries_exceed_budget
+    from scaling_tpu.topology.config import ActivationCheckpointingType
+
+    pp = topo.pipe_parallel_size
+    n_micro = topo.gradient_accumulation_steps
+    n_ticks = n_micro + pp - 1
+    act_bytes = 2 if arch.precision.value == "bfloat16" else 4
+    carry_mb = (
+        topo.micro_batch_size * arch.sequence_length * arch.hidden_size
+        * act_bytes / 2**20
+    )
+    # the SAME gate the runtime evaluates (pipeline.py), on the state's
+    # global abstract shape — a re-implementation here drifted once
+    # (missing dp factor + the remat/n_ticks>=4 conditions) and published
+    # a pin that disagreed with the compiled program
+    state = {
+        "activations": jax.ShapeDtypeStruct(
+            (pp, topo.micro_batch_size * topo.data_parallel_size,
+             arch.sequence_length, arch.hidden_size),
+            jnp.bfloat16 if act_bytes == 2 else jnp.float32,
+        )
+    }
+    remat_on = (
+        topo.activation_checkpointing_type
+        != ActivationCheckpointingType.DISABLED
+    )
+    pipeline_pin = {
+        "pp": pp,
+        "n_micro": n_micro,
+        "ticks": n_ticks,
+        "useful_token_mfu_ceiling": round(n_micro / n_ticks, 4),
+        "tick_carry_mb_per_device": round(carry_mb, 1),
+        "scan_carries_mb_per_device": round(carry_mb * n_ticks, 1),
+        "chunked_remat_active": bool(
+            remat_on and n_ticks >= 4 and _tick_carries_exceed_budget(
+                state, n_ticks,
+                pp * topo.data_parallel_size * topo.context_parallel_size,
+            )
+        ),
+    }
+
     print(json.dumps({
         "layout": (
             "tp4.dp16+lora16+zero1+every_layer_remat" if peft
@@ -195,6 +243,7 @@ def main():
         "analytic_step_flops": step_flops_analytic,
         "device_time_floor_ms_at_v5p_peak": round(floor_ms, 1),
         "step_budget_ms_for_45pct_mfu": round(floor_ms / 0.45, 1),
+        "pipeline": pipeline_pin,
     }))
 
 

@@ -268,7 +268,15 @@ class PipelinedBody:
         )
         zero_state = constrain_state(zero_state)
         n_ticks = n_micro + pp - 1
-        if remat and n_ticks >= 4:
+        state_shards = pp * (
+            self.topology.data_parallel_size
+            * self.topology.context_parallel_size
+            if self.topology is not None
+            else 1
+        )
+        if remat and n_ticks >= 4 and _tick_carries_exceed_budget(
+            zero_state, n_ticks, state_shards
+        ):
             # sqrt(T)-chunked remat over the tick scan: a plain scan saves
             # every tick's carry for backward — O(n_micro * pp) boundary
             # activations, where the reference's 1F1B holds only its pp
@@ -276,6 +284,14 @@ class PipelinedBody:
             # Checkpointing chunks of ~sqrt(T) ticks stores only chunk-edge
             # carries + one chunk's internal carries during its backward:
             # O(sqrt(n_micro) * pp) memory for one extra body forward.
+            #
+            # That extra forward is ~+25% step time (b = 2f: (3f+b)/(2f+b))
+            # — real wall-clock, unlike the fill/drain garbage ticks which
+            # overlap 1F1B's bubble — so it is paid ONLY when the carries
+            # would actually strain HBM (at BASELINE #4's pp=2 gas=8 the
+            # carries are ~144 MB/device: the plain scan matches a 1F1B
+            # executor's wall-clock there; see PERF.md "Spatial pipeline
+            # vs a 1F1B executor").
             chunk, n_chunks = _remat_chunking(n_ticks)
             padded = n_chunks * chunk  # excess ticks produce discarded outputs
             tick_ids = jnp.arange(padded).reshape(n_chunks, chunk)
@@ -291,6 +307,33 @@ class PipelinedBody:
             return outs
         _, outs = jax.lax.scan(tick, zero_state, jnp.arange(n_ticks))
         return jax.tree.map(lambda o: o[pp - 1 :], outs)
+
+
+def _tick_carries_exceed_budget(state: Any, n_ticks: int,
+                                n_state_shards: int) -> bool:
+    """Decide whether the tick scan's saved carries justify chunked remat.
+
+    A plain scan saves one state carry per tick for the backward; the
+    state's GLOBAL shape is ``(pp, mbs*dp, s, ...)`` sharded over
+    ``(pipe, data, context)`` (``constrain_state``), so ``n_state_shards``
+    is ``pp * dp * cp`` — dividing by ``pp`` alone would overestimate
+    per-device carries by the data-parallel factor and engage the chunked
+    trade dp-times too early. When the per-device total fits comfortably
+    in HBM, chunked remat would trade nothing for an extra full body
+    forward — pure wall-clock loss.
+    ``SCALING_TPU_PIPE_CARRY_BUDGET_MB`` (default 1024) sets the
+    per-device budget; 0 forces chunking (the memory-lean mode, and what
+    the chunking tests pin). Works on concrete arrays and
+    ShapeDtypeStructs alike (the compile pin evaluates the same gate on
+    abstract shapes)."""
+    import os
+
+    budget_mb = float(os.environ.get("SCALING_TPU_PIPE_CARRY_BUDGET_MB", "1024"))
+    per_device_tick = sum(
+        int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree.leaves(state)
+    ) / max(n_state_shards, 1)
+    return per_device_tick * n_ticks > budget_mb * 2**20
 
 
 def _remat_chunking(n_ticks: int) -> tuple[int, int]:

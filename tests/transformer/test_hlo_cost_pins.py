@@ -332,6 +332,15 @@ def test_baseline4_layout_compile_pin_small_proxy():
     assert rec["fits_v5p_95g"] is True
     assert rec["per_chip_gb"] < 1.0
     assert rec["collective_bytes_per_iter"]
+    # the useful-token MFU ceiling n_micro/(n_micro+pp-1) — identical for
+    # the spatial pipeline (fill/drain garbage) and non-interleaved 1F1B
+    # (bubble) — must be reported per layout (VERDICT r4 #7)
+    pl = rec["pipeline"]
+    assert pl["pp"] == 2
+    assert pl["useful_token_mfu_ceiling"] == pytest.approx(
+        pl["n_micro"] / (pl["n_micro"] + pl["pp"] - 1), abs=1e-4
+    )
+    assert pl["scan_carries_mb_per_device"] > 0
 
 
 def test_abstract_state_mirrors_init_state(devices):

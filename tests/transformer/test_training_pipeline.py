@@ -222,13 +222,16 @@ def test_pipeline_step_flops_quantify_fill_drain(tmp_path, data_prefix):
     assert 0.95 <= ratio <= 10 / 9 + 0.08, (flops, ratio)
 
 
-def test_pp2_remat_with_padding_loss_parity(tmp_path, data_prefix):
+def test_pp2_remat_with_padding_loss_parity(tmp_path, data_prefix, monkeypatch):
     """The PADDED chunked-remat path end to end: gas=13 gives T=14 ticks,
     which factors as 3x5 with one discarded padding tick — a garbage tick
     leaking into outputs or gradients would break the 1e-5 loss parity
     with pp=1 immediately (the FLOPs test runs remat-off and cannot see
     this path)."""
     from scaling_tpu.parallel.pipeline import _remat_chunking
+
+    # tiny test shapes fit the carry budget easily; force the chunked path
+    monkeypatch.setenv("SCALING_TPU_PIPE_CARRY_BUDGET_MB", "0")
 
     gas = 13
     chunk, n_chunks = _remat_chunking(gas + 1)
@@ -256,16 +259,59 @@ def test_pp2_remat_with_padding_loss_parity(tmp_path, data_prefix):
     )
 
 
-def test_pipeline_memory_sublinear_in_microbatch_count(tmp_path, data_prefix):
+def test_pipeline_memory_sublinear_in_microbatch_count(
+    tmp_path, data_prefix, monkeypatch
+):
     """The 1F1B-comparable-memory claim, measured (VERDICT r1 asked for
     numbers, not assertions): with activation checkpointing on, the pp=2
     train step's compiled temp memory must grow sublinearly in the
     micro-batch count — the sqrt(T)-chunked tick remat stores chunk-edge
     carries only (pipeline.py), where a plain scan would hold every tick's
     carry (linear, ~1.7x per doubling when measured)."""
+    monkeypatch.setenv("SCALING_TPU_PIPE_CARRY_BUDGET_MB", "0")
     temp_bytes = {}
     for gas in (8, 16):
         compiled = _compile_train_step(tmp_path / f"gas{gas}", data_prefix,
                                        pp=2, gas=gas, remat=True)
         temp_bytes[gas] = compiled.memory_analysis().temp_size_in_bytes
     assert temp_bytes[16] < 1.6 * temp_bytes[8], temp_bytes
+
+
+def test_pipeline_carry_budget_gates_chunked_remat(tmp_path, data_prefix,
+                                                   monkeypatch):
+    """Chunked tick-remat costs one extra full body forward (~+25% step
+    time at b=2f), so it must engage ONLY when the plain scan's saved
+    carries would strain HBM (PERF.md 'Spatial pipeline vs a 1F1B
+    executor'). Measured on compiled buffer assignment: under a roomy
+    budget the step must hold MORE temp memory (every tick's carry saved)
+    than the chunked build of the identical config — the observable
+    signature that the extra-forward trade was skipped."""
+    from scaling_tpu.parallel.pipeline import _tick_carries_exceed_budget
+
+    import jax
+    import jax.numpy as jnp
+
+    state = {"activations": jnp.zeros((2, 2, 64, 32), jnp.float32)}
+    monkeypatch.setenv("SCALING_TPU_PIPE_CARRY_BUDGET_MB", "1024")
+    assert not _tick_carries_exceed_budget(state, n_ticks=9, n_state_shards=2)
+    monkeypatch.setenv("SCALING_TPU_PIPE_CARRY_BUDGET_MB", "0")
+    assert _tick_carries_exceed_budget(state, n_ticks=9, n_state_shards=2)
+    # BASELINE #4's flagship numbers through the same gate: (pp=2, dp=8,
+    # mbs=1, s=2048, h=4096, bf16) = 16 MiB/tick/device x 9 ticks =
+    # 144 MiB — comfortably under the 1 GiB default, so the plain scan
+    # (1F1B wall-clock parity) must win; dividing by pp alone would read
+    # 8x that and wrongly engage the extra-forward trade
+    monkeypatch.setenv("SCALING_TPU_PIPE_CARRY_BUDGET_MB", "1024")
+    b4 = {"activations": jax.ShapeDtypeStruct((2, 8, 2048, 4096), jnp.bfloat16)}
+    assert not _tick_carries_exceed_budget(b4, n_ticks=9, n_state_shards=16)
+    assert _tick_carries_exceed_budget(b4, n_ticks=9, n_state_shards=2)
+
+    # gas high enough that the T saved carries dominate the temp budget
+    # (at tiny gas the chunked build's padding buffers mask the difference)
+    temp = {}
+    for label, budget in (("plain", "100000"), ("chunked", "0")):
+        monkeypatch.setenv("SCALING_TPU_PIPE_CARRY_BUDGET_MB", budget)
+        compiled = _compile_train_step(tmp_path / label, data_prefix,
+                                       pp=2, gas=48, remat=True)
+        temp[label] = compiled.memory_analysis().temp_size_in_bytes
+    assert temp["plain"] > temp["chunked"], temp
