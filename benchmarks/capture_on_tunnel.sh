@@ -1,67 +1,73 @@
 #!/bin/bash
 # Probe the TPU tunnel every 4 minutes; on the FIRST healthy probe run the
-# entire capture sequence unattended (a short window must still yield the
-# round's perf evidence), logging everything under .scratch/capture/.
+# capture sequence unattended (a short window must still yield the round's
+# perf evidence), logging everything under .scratch/capture/.
+#
+# Round-5 priority order (VERDICT r4): the headline bench refreshes
+# LAST_GOOD + the amortized peak probe (#1/#2), then the unmeasured
+# capabilities — LoRA finetune tok/s (#6), fused-loop decode (#3), trace
+# attribution + long-context sweep + mbs confirmation (#4) — then the 1b
+# arm and the remaining A/B sections. Every section is its own probed
+# subprocess, so a mid-list tunnel death costs only what hasn't run yet.
 cd /root/repo
-mkdir -p .scratch/capture
+CAP=.scratch/capture
+mkdir -p $CAP
+
+run_bench() {  # run_bench <label> [env VAR=val ...]
+  local label=$1; shift
+  echo "=== bench $label $(date) ===" > $CAP/bench_$label.log
+  if ! bash benchmarks/probe_tunnel.sh > /dev/null; then
+    # skip in ~75s instead of burning the bench's whole retry window —
+    # a mid-list tunnel death must not starve the later sections
+    echo "bench $label skipped: tunnel dead" >> $CAP/bench_$label.log
+    return
+  fi
+  env "$@" BENCH_WAIT_S=600 timeout 1800 python bench.py \
+    >> $CAP/bench_$label.log 2>&1
+  echo "bench $label rc=$?" >> $CAP/bench_$label.log
+}
+
+run_section() {  # one chip_session section, probed first
+  local sec=$1
+  if bash benchmarks/probe_tunnel.sh > /dev/null; then
+    echo "-- $(date +%H:%M:%S) running section $sec" >> $CAP/chip_session.log
+    timeout 1800 python benchmarks/chip_session.py "$sec" \
+      >> $CAP/chip_session.log 2>&1 \
+      || echo "-- section $sec: exited rc=$?" >> $CAP/chip_session.log
+  else
+    echo "-- $(date +%H:%M:%S) tunnel dead; skipping $sec" >> $CAP/chip_session.log
+  fi
+}
+
 for i in $(seq 1 200); do
   ts=$(date +%H:%M:%S)
   out=$(bash benchmarks/probe_tunnel.sh)
   echo "$ts $out" >> .scratch/tunnel_status.log
   if [[ "$out" == OK* ]]; then
-    echo "TUNNEL ALIVE at $ts (iteration $i) — starting capture"
-    # 1. the headline artifact first: a plain bench pass exactly as the
-    #    driver runs it (BENCH_WAIT_S default retries cover flaps)
-    echo "=== bench 0.5b $(date) ===" > .scratch/capture/bench_05b.log
-    timeout 3600 python bench.py >> .scratch/capture/bench_05b.log 2>&1
-    echo "bench 0.5b rc=$?" >> .scratch/capture/bench_05b.log
-    # 2. the full serial measurement session (A/Bs, sweeps, trace)
-    echo "=== chip_session $(date) ===" > .scratch/capture/chip_session.log
-    # chip_session bounds each section's subprocess itself; the backstop is
-    # derived from the session's own per-section budgets so adding or
-    # growing a section can't silently outlive it
-    session_budget=$(python - <<'PYB'
-from benchmarks import chip_session
-print(sum(t for _, _, t in chip_session._sections()) + 600)
-PYB
-)
-    timeout "${session_budget:-14400}" python benchmarks/chip_session.py >> .scratch/capture/chip_session.log 2>&1
-    echo "chip_session rc=$?" >> .scratch/capture/chip_session.log
-    # 3. trace attribution
+    echo "TUNNEL ALIVE at $ts (iteration $i) — starting r5 capture"
+    : > $CAP/chip_session.log
+    # 1. headline artifact exactly as the driver runs it (also refreshes
+    #    benchmarks/artifacts/LAST_GOOD.json and runs the amortized-v2
+    #    peak probe -> mfu_vs_measured_peak should finally read <= 1)
+    run_bench 05b
+    # 2. BASELINE #5 on-chip: LoRA finetune step throughput
+    run_bench 05b_lora BENCH_MODEL=0.5b-lora
+    # 3. fused single-dispatch decode (replaces the RTT-bound 12 tok/s)
+    run_section decode
+    # 4. trace attribution + long-context wall-clock + mbs confirmation
+    run_section trace
     timeout 600 python benchmarks/analyze_trace.py /tmp/bench_trace_tpu \
-      > .scratch/capture/trace_analysis.log 2>&1
-    # 4. the 1B single-chip attempt (expected tight on HBM; record it)
-    echo "=== bench 1b $(date) ===" > .scratch/capture/bench_1b.log
-    BENCH_MODEL=1b BENCH_WAIT_S=600 timeout 3600 python bench.py \
-      >> .scratch/capture/bench_1b.log 2>&1
-    echo "bench 1b rc=$?" >> .scratch/capture/bench_1b.log
-    # 5. tuned final pass: pick the fastest mbs and the norm winner out of
-    #    the session log, then run bench once more with those knobs
-    python - <<'PYEOF' > .scratch/capture/winners.env 2>.scratch/capture/winners.err
-import re
-txt = open(".scratch/capture/chip_session.log").read()
-best_mbs, best_t = None, None
-for m in re.finditer(r"6\. step mbs=(\d+):\s+([0-9.]+) ms", txt):
-    mbs, t = int(m.group(1)), float(m.group(2))
-    tok_s = mbs / t
-    if best_t is None or tok_s > best_t:
-        best_mbs, best_t = mbs, tok_s
-steps = dict(re.findall(r"3/4\. step ([a-z+]+):\s+([0-9.]+) ms", txt))
-norm = ""
-if "flash" in steps and "flash+fusednorm" in steps:
-    if float(steps["flash+fusednorm"]) < float(steps["flash"]):
-        norm = "fused"
-print(f"BENCH_MBS={best_mbs or ''}")
-print(f"BENCH_NORM={norm}")
-PYEOF
-    set -a; source .scratch/capture/winners.env 2>/dev/null; set +a
-    [ -z "$BENCH_MBS" ] && unset BENCH_MBS
-    [ -z "$BENCH_NORM" ] && unset BENCH_NORM
-    echo "=== bench tuned (BENCH_MBS=$BENCH_MBS BENCH_NORM=$BENCH_NORM) $(date) ===" \
-      > .scratch/capture/bench_tuned.log
-    BENCH_WAIT_S=600 timeout 3600 python bench.py \
-      >> .scratch/capture/bench_tuned.log 2>&1
-    echo "bench tuned rc=$?" >> .scratch/capture/bench_tuned.log
+      > $CAP/trace_analysis.log 2>&1
+    for sec in long-8192 long-16384 long-32768 mbs-4 mbs-8 mbs-16; do
+      run_section $sec
+    done
+    # 5. the 1B single-chip arm (BASELINE #3 shape; tight on HBM)
+    run_bench 1b BENCH_MODEL=1b
+    # 6. remaining A/B sections (peak probe slot, attention kernels,
+    #    block sweep, step A/Bs, 1b step probe)
+    for sec in peak attn blocks step-flash step-xla step-fusednorm 1b; do
+      run_section $sec
+    done
     echo "CAPTURE COMPLETE at $(date)"
     exit 0
   fi

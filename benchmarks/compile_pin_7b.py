@@ -31,6 +31,7 @@ jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
 from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 
 V5P_HBM = 95e9  # bytes per chip
@@ -190,10 +191,6 @@ def main():
     n_micro = topo.gradient_accumulation_steps
     n_ticks = n_micro + pp - 1
     act_bytes = 2 if arch.precision.value == "bfloat16" else 4
-    carry_mb = (
-        topo.micro_batch_size * arch.sequence_length * arch.hidden_size
-        * act_bytes / 2**20
-    )
     # the SAME gate the runtime evaluates (pipeline.py), on the state's
     # global abstract shape — a re-implementation here drifted once
     # (missing dp factor + the remat/n_ticks>=4 conditions) and published
@@ -205,6 +202,13 @@ def main():
             jnp.bfloat16 if act_bytes == 2 else jnp.float32,
         )
     }
+    n_state_shards = pp * topo.data_parallel_size * topo.context_parallel_size
+    # the reported MB come from the same leaf-bytes/shards expression the
+    # gate divides, so artifact numbers can never disagree with its decision
+    carry_mb = sum(
+        int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree.leaves(state)
+    ) / n_state_shards / 2**20
     remat_on = (
         topo.activation_checkpointing_type
         != ActivationCheckpointingType.DISABLED
@@ -218,8 +222,7 @@ def main():
         "scan_carries_mb_per_device": round(carry_mb * n_ticks, 1),
         "chunked_remat_active": bool(
             remat_on and n_ticks >= 4 and _tick_carries_exceed_budget(
-                state, n_ticks,
-                pp * topo.data_parallel_size * topo.context_parallel_size,
+                state, n_ticks, n_state_shards
             )
         ),
     }
