@@ -26,6 +26,56 @@ def orbax_abstract(tree: Any) -> Any:
     )
 
 
+def _empty(leaf: Any) -> bool:
+    return getattr(leaf, "size", 1) == 0
+
+
+def _sentinel_empties(tree: Any) -> Any:
+    """Replace zero-size leaves with a 1-element zero of the same dtype.
+
+    PEFT optimizer states carry ``(0,)`` placeholders for frozen-backbone
+    leaves (optimizer.py init_state), and orbax refuses zero-size arrays
+    outright ("Cannot save arrays with zero size") — a LoRA finetune with
+    checkpoint_backend=orbax would crash at its first save. The sentinel
+    keeps the tree structure identical both ways; restore discards the
+    sentinel values and keeps the live placeholders
+    (``_restore_keeping_empties``), which also preserves their
+    uncommitted placement (the npz loader once committed them to one
+    device, breaking the next jitted step under a mesh).
+
+    Sentinels are built REPLICATED over the mesh of an adjacent real leaf
+    when one exists: every entry point here is collective, and a plain
+    per-process ``jnp.zeros`` would be a host-local array that orbax
+    cannot treat as one global tensor on multi-host."""
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = None
+    for leaf in jax.tree.leaves(tree):
+        sh = getattr(leaf, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            mesh = sh.mesh
+            break
+
+    def sentinel(x):
+        if mesh is None:
+            return jnp.zeros((1,), x.dtype)
+        return jax.make_array_from_callback(
+            (1,),
+            NamedSharding(mesh, PartitionSpec()),
+            lambda idx: np.zeros((1,), x.dtype),
+        )
+
+    return jax.tree.map(lambda x: sentinel(x) if _empty(x) else x, tree)
+
+
+def _restore_keeping_empties(current: Any, restored: Any) -> Any:
+    return jax.tree.map(
+        lambda cur, res: cur if _empty(cur) else res, current, restored
+    )
+
+
 def save_orbax(step_dir: Path, params_view: Any, opt_view: Dict[str, Any]) -> None:
     """Write ``step_dir/orbax/{model,optimizer}``; overwrites an existing
     save of the same step (crash-recovery re-reaches steps)."""
@@ -34,7 +84,9 @@ def save_orbax(step_dir: Path, params_view: Any, opt_view: Dict[str, Any]) -> No
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save((step_dir / "orbax" / "model").absolute(), params_view, force=True)
         ckptr.save(
-            (step_dir / "orbax" / "optimizer").absolute(), opt_view, force=True
+            (step_dir / "orbax" / "optimizer").absolute(),
+            _sentinel_empties(opt_view),
+            force=True,
         )
 
 
@@ -219,4 +271,7 @@ def restore_orbax_opt(step_dir: Path, opt_view_like: Dict[str, Any]) -> Dict[str
             "(torn save?); delete it to resume with fresh optimizer state"
         )
     with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.restore(opt_dir.absolute(), orbax_abstract(opt_view_like))
+        restored = ckptr.restore(
+            opt_dir.absolute(), orbax_abstract(_sentinel_empties(opt_view_like))
+        )
+    return _restore_keeping_empties(opt_view_like, restored)

@@ -106,6 +106,41 @@ def test_orbax_non_strict_lora_load(tmp_path, data_prefix):
         build_capturing_trainer(cfg3, load=True)
 
 
+def test_orbax_peft_resume_loss_exact_under_mesh(tmp_path, data_prefix):
+    """PEFT + orbax + TP, the multi-host checkpoint path for BASELINE #5:
+    the frozen-backbone (0,) optimizer placeholders used to crash the
+    orbax SAVE outright ("Cannot save arrays with zero size"), so a LoRA
+    finetune with checkpoint_backend=orbax died at its first checkpoint.
+    The sentinel scheme (orbax_backend._sentinel_empties) must round-trip
+    the state with loss-exact resume, and the restored placeholders must
+    stay uncommitted so the next jitted step accepts the mesh-committed
+    params (the npz loader's committed-placeholder bug, fixed the same
+    round)."""
+
+    def peft_cfg(path, load_dir=None):
+        cfg = orbax_config(
+            tmp_path / path, data_prefix, mp=2, load_dir=load_dir,
+            **{"lora_config": {"name": "lo", "rank": 2, "alpha": 4}},
+        )
+        d = cfg.model_dump(mode="json")
+        d["training"] = {"finetune": True, "finetunable_parameters": []}
+        return type(cfg).from_dict(d)
+
+    cfg = peft_cfg("full")
+    t = build_capturing_trainer(cfg)
+    full = train_capture(t, 10)
+    t.finalize_checkpoints()
+
+    cfg_r = peft_cfg("resume", load_dir=Path(cfg.trainer.save_dir))
+    t2 = build_capturing_trainer(cfg_r, load=True)
+    assert t2.context.iterations == 6
+    assert t2.optimizer_states_loaded  # Adam moments came from the ckpt
+    resumed = train_capture(t2, 4)
+    np.testing.assert_array_equal(
+        np.asarray(full[6:], np.float32), np.asarray(resumed, np.float32)
+    )
+
+
 def test_torn_orbax_save_falls_back_to_npz(tmp_path, data_prefix):
     """An uncommitted orbax dir (crashed save) must not shadow valid npz
     files in the same step dir — and must fail loudly when nothing else
