@@ -45,6 +45,10 @@ for i in $(seq 1 200); do
   echo "$ts $out" >> .scratch/tunnel_status.log
   if [[ "$out" == OK* ]]; then
     echo "TUNNEL ALIVE at $ts (iteration $i) — starting r5 capture"
+    # clear previous sessions' logs: the artifacts pass below must only
+    # ever see arms run in THIS capture (a leftover round-4 bench_tuned
+    # log would otherwise be stamped as fresh round-5 evidence)
+    rm -f $CAP/bench_*.log $CAP/summary.md $CAP/summary.err
     : > $CAP/chip_session.log
     # 1. headline artifact exactly as the driver runs it (also refreshes
     #    benchmarks/artifacts/LAST_GOOD.json and runs the amortized-v2
@@ -68,6 +72,10 @@ for i in $(seq 1 200); do
     for sec in peak attn blocks step-flash step-xla step-fusednorm 1b; do
       run_section $sec
     done
+    # turn fresh bench rows into committed artifacts + a summary table,
+    # so an unattended capture still lands round evidence
+    python benchmarks/summarize_capture.py $CAP --artifacts r05 \
+      > $CAP/summary.md 2>> $CAP/summary.err || true
     echo "CAPTURE COMPLETE at $(date)"
     exit 0
   fi

@@ -5,8 +5,10 @@ bench/chip-session output) and prints one markdown block: the bench JSON
 rows, every chip-session measurement, and the tuned-pass winners — so a
 healthy-tunnel window turns into committed evidence in one paste.
 
-Usage: python benchmarks/summarize_capture.py [capture_dir]
-       (default .scratch/capture)
+Usage: python benchmarks/summarize_capture.py [capture_dir] [--artifacts TAG]
+       (default .scratch/capture; --artifacts writes each fresh non-stale
+       bench row to benchmarks/artifacts/BENCH_MIDROUND_{TAG}_{arm}.json so
+       a capture that completes unattended still lands committed evidence)
 """
 
 from __future__ import annotations
@@ -14,6 +16,7 @@ from __future__ import annotations
 import json
 import re
 import sys
+import time
 from pathlib import Path
 
 SECTION_RE = re.compile(r"^([0-9]+(?:/[0-9]+)?)\. (.+?):\s*(.+)$")
@@ -21,19 +24,20 @@ SECTION_RE = re.compile(r"^([0-9]+(?:/[0-9]+)?)\. (.+?):\s*(.+)$")
 
 def bench_rows(capture: Path) -> list:
     rows = []
-    for name in ("bench_05b", "bench_1b", "bench_tuned",
+    for name in ("bench_05b", "bench_05b_lora", "bench_1b", "bench_tuned",
                  "bench_final_05b", "bench_final_1b"):
         f = capture / f"{name}.log"
         if not f.is_file():
             continue
+        text = f.read_text()
         rec = None
-        for line in f.read_text().splitlines():
+        for line in text.splitlines():
             if line.startswith("{"):
                 try:
                     rec = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-        rc = re.search(r"rc=(\d+)", f.read_text())
+        rc = re.search(r"rc=(\d+)", text)
         rows.append((name, rec, int(rc.group(1)) if rc else None))
     return rows
 
@@ -61,13 +65,38 @@ def session_lines(capture: Path) -> list:
     return [(num, name, seen[(num, name)]) for num, name in order]
 
 
+def write_artifacts(rows: list, tag: str) -> None:
+    """One committed artifact per fresh (non-stale, rc=0) bench row."""
+    outdir = Path(__file__).resolve().parent / "artifacts"
+    outdir.mkdir(exist_ok=True)
+    for name, rec, rc in rows:
+        if rec is None or rec.get("stale") or rc != 0:
+            continue
+        arm = name.replace("bench_", "")
+        out = outdir / f"BENCH_MIDROUND_{tag}_{arm}.json"
+        out.write_text(json.dumps({
+            "captured": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "command": f"capture_on_tunnel.sh arm {name}",
+            "result": rec,
+        }, indent=1) + "\n")
+        print(f"wrote {out}", file=sys.stderr)
+
+
 def main() -> None:
-    capture = Path(sys.argv[1] if len(sys.argv) > 1 else ".scratch/capture")
+    argv = list(sys.argv[1:])
+    tag = None
+    if "--artifacts" in argv:
+        i = argv.index("--artifacts")
+        tag = argv[i + 1] if i + 1 < len(argv) else "r0"
+        del argv[i : i + 2]  # by index: a capture dir named like the tag survives
+    capture = Path(argv[0] if argv else ".scratch/capture")
     if not capture.is_dir():
         sys.exit(f"no capture directory at {capture}")
+    rows = bench_rows(capture)
+    if tag:
+        write_artifacts(rows, tag)
 
     print("### Captured on-chip evidence\n")
-    rows = bench_rows(capture)
     if rows:
         print("| bench arm | tokens/s | MFU | vs measured peak | mbs | kernel | rc |")
         print("|---|---|---|---|---|---|---|")

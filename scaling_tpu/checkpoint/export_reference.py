@@ -58,7 +58,17 @@ def export_layer(arrays: Dict[str, np.ndarray]) -> Dict[str, Any]:
     out: Dict[str, Any] = {}
     for name, arr in arrays.items():
         ref_name, ref_arr = _unmap_param(name, np.asarray(arr))
-        out[ref_name] = torch.from_numpy(np.ascontiguousarray(ref_arr))
+        ref_arr = np.ascontiguousarray(ref_arr)
+        # dtype matched by NAME so the npz->pt conversion path keeps its
+        # numpy+torch-only dependency footprint (no jax import)
+        if ref_arr.dtype.name == "bfloat16":
+            # torch.from_numpy rejects ml_dtypes outright; the bit pattern
+            # is torch.bfloat16's, so view through uint16 (npz-sourced
+            # exports arrive as float32 already — checkpoint.py widens)
+            tensor = torch.from_numpy(ref_arr.view(np.uint16)).view(torch.bfloat16)
+        else:
+            tensor = torch.from_numpy(ref_arr)
+        out[ref_name] = tensor
     return out
 
 

@@ -218,6 +218,36 @@ def test_export_then_import_is_bit_exact(tmp_path):
                 np.testing.assert_array_equal(orig[k], rt[k], err_msg=f"{f.name}:{k}")
 
 
+def test_export_layer_handles_bf16_arrays():
+    """Live bf16 arrays export as torch.bfloat16 through the shared bit
+    pattern (uint16 view): torch.from_numpy rejects ml_dtypes outright,
+    which would crash any direct export of a bf16-precision model's
+    in-memory params (npz-sourced exports arrive pre-widened to float32
+    by checkpoint._write_npz and are unaffected)."""
+    import jax.numpy as jnp
+    import torch
+
+    from scaling_tpu.checkpoint.export_reference import export_layer
+
+    rng = np.random.default_rng(3)
+    bias = rng.normal(size=(16,)).astype(jnp.bfloat16)
+    weight = rng.normal(size=(16, 32)).astype(jnp.bfloat16)
+    out = export_layer({
+        "attention.dense.bias": bias,
+        "mlp.dense_in.weight": weight,
+    })
+    t = out["self_attention.dense.bias"]
+    assert t.dtype == torch.bfloat16
+    np.testing.assert_array_equal(
+        t.float().numpy(), bias.astype(np.float32)
+    )
+    w = out["mlp.dense_in.weight"]
+    assert w.dtype == torch.bfloat16 and w.shape == (32, 16)  # torch (out, in)
+    np.testing.assert_array_equal(
+        w.float().numpy(), weight.astype(np.float32).T
+    )
+
+
 def test_export_restores_tied_head_duplicate(tmp_path):
     """Tied models hold one structural table copy; the exported reference
     checkpoint regains the duplicate TransformerLMHeadTied file."""
