@@ -44,6 +44,10 @@ MFU_TARGET = 0.45  # BASELINE.json: ">=45% MFU on a 7B on v5p-128"
 
 _EMIT_LOCK = threading.Lock()
 _EMITTED = False
+# a fresh measurement that passed the plausibility gate but hasn't emitted
+# yet (the peak probe still running): the fallback paths prefer it over
+# LAST_GOOD — a probe hang must never cost the primary metric
+_PENDING_FRESH: dict | None = None
 
 
 def _emit_line(payload: dict) -> bool:
@@ -59,6 +63,13 @@ def _emit_line(payload: dict) -> bool:
 
 
 def _stale_payload(reason: str) -> dict:
+    if _PENDING_FRESH is not None:
+        # this run's own gate-passed numbers beat any committed fallback;
+        # only the secondary peak cross-check is missing
+        payload = dict(_PENDING_FRESH)
+        payload["peak_probe"] = "interrupted"
+        payload["peak_probe_interrupted_by"] = reason
+        return payload
     try:
         with open(LAST_GOOD_PATH) as f:
             rec = json.load(f)
@@ -667,13 +678,11 @@ def main() -> None:
         param_count, arch.num_layers, arch.hidden_size, arch.sequence_length,
         tokens_per_sec, world_size=1, hardware=hardware,
     )
-    achievable = measure_achievable_tflops() if on_tpu else None
-    mfu_achievable = (
-        round(mfu * hardware.max_tflops / achievable, 4) if achievable else None
-    )
     if mfu > 1.0:
         # physically impossible: the tunnel returned a block early and the
         # timing is garbage — better the stale truth than a fantasy number
+        # (checked BEFORE the peak probe: re-probing can never rescue a
+        # reading the clamp-to-nominal bounds away from sanity)
         finish_stale(f"timing implausible (mfu={mfu:.2f} > 1)")
     payload = {
         "metric": "tokens_per_sec_per_chip",
@@ -681,12 +690,9 @@ def main() -> None:
         "unit": "tokens/s",
         "vs_baseline": round(mfu / MFU_TARGET, 4),
         "mfu": round(mfu, 4),
-        "mfu_vs_measured_peak": mfu_achievable,
-        "measured_peak_tflops": round(achievable, 1) if achievable else None,
-        # r1-r4 probes timed single ~22ms chains inside the tunnel
-        # RTT (~50 TF misreads); 'amortized-v2' marks readings from
-        # the ~140-TFLOP-per-window probe
-        "peak_probe": "amortized-v2" if achievable else None,
+        "mfu_vs_measured_peak": None,
+        "measured_peak_tflops": None,
+        "peak_probe": None,
         "hardware": hardware.value,
         "params": param_count,
         "step_ms": round(dt * 1000, 2),
@@ -700,6 +706,35 @@ def main() -> None:
         # perf drop
         "kernel": actual_kernel(seq_len, arch),
     }
+    # from here the fresh primary metric is safe: a hang/SIGTERM/watchdog
+    # during the (secondary) peak probe emits THIS payload, not LAST_GOOD
+    global _PENDING_FRESH
+    _PENDING_FRESH = payload
+    achievable = measure_achievable_tflops() if on_tpu else None
+    if achievable:
+        # the step windows themselves prove a lower bound on achievable
+        # throughput; a probe reading below it means a co-tenant burst ate
+        # the probe's window (transient on a time-shared chip) — re-probe
+        # up to twice and keep the max median (peak capacity is a maximum
+        # over median-filtered trials; the median inside each trial still
+        # rejects bogus early returns)
+        for _ in range(2):
+            if mfu * hardware.max_tflops / achievable <= 1.0:
+                break
+            print(
+                f"# peak probe ({achievable:.1f} TF) below step-implied "
+                "throughput; re-probing",
+                file=sys.stderr,
+            )
+            achievable = max(achievable, measure_achievable_tflops())
+        payload["mfu_vs_measured_peak"] = round(
+            mfu * hardware.max_tflops / achievable, 4
+        )
+        payload["measured_peak_tflops"] = round(achievable, 1)
+        # r1-r4 probes timed single ~22ms chains inside the tunnel RTT
+        # (~50 TF misreads); 'amortized-v2' marks the
+        # ~140-TFLOP-per-window probe
+        payload["peak_probe"] = "amortized-v2"
     if on_tpu:
         _write_last_good(payload, bench_model)
     _emit_line(payload)

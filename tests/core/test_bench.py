@@ -192,6 +192,29 @@ def test_mbs_ladder_logic():
     assert mbs == 16
 
 
+def test_last_good_refresh_guard(tmp_path, monkeypatch):
+    """Only the default driver configuration may rewrite the stale
+    fallback: an A/B or debug override arm becoming LAST_GOOD would turn
+    a later dead-tunnel round's headline into that arm's number."""
+    sys.path.insert(0, str(REPO_ROOT))
+    import bench
+
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(tmp_path / "LAST_GOOD.json"))
+    payload = {"metric": "tokens_per_sec_per_chip", "value": 1.0}
+    for var in ("BENCH_KERNEL", "BENCH_NORM", "BENCH_ROTARY", "BENCH_MBS"):
+        monkeypatch.delenv(var, raising=False)
+
+    bench._write_last_good(payload, "1b")  # non-default arm: no write
+    assert not (tmp_path / "LAST_GOOD.json").exists()
+    monkeypatch.setenv("BENCH_MBS", "4")
+    bench._write_last_good(payload, "0.5b")  # override set: no write
+    assert not (tmp_path / "LAST_GOOD.json").exists()
+    monkeypatch.delenv("BENCH_MBS")
+    bench._write_last_good(payload, "0.5b")  # the driver's exact arm
+    rec = json.loads((tmp_path / "LAST_GOOD.json").read_text())
+    assert rec["result"] == payload and rec["captured"]
+
+
 def test_bench_rejects_unknown_model():
     """Usage errors stay loud (rc!=0 for the operator) but still emit the
     parseable line — NO exit path is lineless."""
