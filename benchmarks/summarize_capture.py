@@ -25,7 +25,7 @@ SECTION_RE = re.compile(r"^([0-9]+(?:/[0-9]+)?)\. (.+?):\s*(.+)$")
 def bench_rows(capture: Path) -> list:
     rows = []
     for name in ("bench_05b", "bench_05b_lora", "bench_1b", "bench_tuned",
-                 "bench_final_05b", "bench_final_1b"):
+                 "bench_final_05b", "bench_final_1b", "bench_final_05b_lora"):
         f = capture / f"{name}.log"
         if not f.is_file():
             continue

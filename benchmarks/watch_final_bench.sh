@@ -1,8 +1,9 @@
 #!/bin/bash
 # After recapture_sections.sh finishes (or if it's not running), wait for
-# a healthy tunnel and run the two bench arms once each — the final
-# evidence pass. Compiles hit the persistent cache, so a short window
-# suffices. Logs under .scratch/capture/.
+# a healthy tunnel and run the three bench arms (0.5b, 1b, 0.5b-lora)
+# once each — the final evidence pass. Compiles hit the persistent cache,
+# so a short window suffices; a dead tunnel costs one BENCH_TOTAL_S
+# watchdog window per arm at worst. Logs under .scratch/capture/.
 cd /root/repo
 LOG_DIR=.scratch/capture
 mkdir -p "$LOG_DIR"
@@ -13,16 +14,18 @@ for i in $(seq 1 200); do
       sleep 240
       continue
     fi
-    echo "=== final bench 0.5b $(date) ===" > "$LOG_DIR/bench_final_05b.log"
-    BENCH_WAIT_S=600 timeout 3600 python bench.py >> "$LOG_DIR/bench_final_05b.log" 2>&1
-    echo "rc=$?" >> "$LOG_DIR/bench_final_05b.log"
-    echo "=== final bench 1b $(date) ===" > "$LOG_DIR/bench_final_1b.log"
-    BENCH_MODEL=1b BENCH_WAIT_S=600 timeout 3600 python bench.py >> "$LOG_DIR/bench_final_1b.log" 2>&1
-    echo "rc=$?" >> "$LOG_DIR/bench_final_1b.log"
+    for arm in ":05b" "1b:1b" "0.5b-lora:05b_lora"; do
+      model="${arm%%:*}"
+      label="${arm##*:}"
+      echo "=== final bench $label $(date) ===" > "$LOG_DIR/bench_final_$label.log"
+      env ${model:+BENCH_MODEL=$model} BENCH_WAIT_S=600 timeout 3600 \
+        python bench.py >> "$LOG_DIR/bench_final_$label.log" 2>&1
+      echo "rc=$?" >> "$LOG_DIR/bench_final_$label.log"
+    done
     echo "FINAL BENCH DONE $(date)"
     exit 0
   fi
   sleep 240
 done
-echo "tunnel never returned"
+echo "FINAL BENCH: tunnel never came up"
 exit 1

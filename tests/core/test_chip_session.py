@@ -61,3 +61,18 @@ def test_single_section_runs_on_cpu_and_prints_measurement():
     assert p.returncode == 0, p.stderr[-2000:]
     m = re.search(r"6\. step mbs=2:\s+[0-9.]+ ms", p.stdout)
     assert m, p.stdout
+
+
+@pytest.mark.slow
+def test_decode_section_runs_on_cpu():
+    """The decode section is capture day's top-priority measurement
+    (VERDICT r4 #3) and rides the fused while-loop generate path that
+    changed this round (sampler cache key) — its plumbing must survive a
+    CPU rehearsal, not be debugged inside a healthy-tunnel window."""
+    p = subprocess.run(
+        [sys.executable, SCRIPT, "decode"],
+        capture_output=True, text=True, env=_smoke_env(), timeout=600,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    m = re.search(r"9\. decode:\s+[0-9]+ tok/s", p.stdout)
+    assert m, p.stdout
