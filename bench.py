@@ -42,6 +42,15 @@ LAST_GOOD_PATH = os.path.join(REPO_ROOT, "benchmarks", "artifacts", "LAST_GOOD.j
 
 MFU_TARGET = 0.45  # BASELINE.json: ">=45% MFU on a 7B on v5p-128"
 
+# The operator's A/B overrides, snapshotted at import: these define the
+# arm boundary exactly like _write_last_good's refresh guard, and must be
+# read BEFORE the flash->XLA fallback mutates BENCH_KERNEL mid-run (that
+# fallback is still the default arm, so its stale row may replay LAST_GOOD)
+_ARM_OVERRIDES = tuple(
+    k for k in ("BENCH_KERNEL", "BENCH_NORM", "BENCH_ROTARY", "BENCH_MBS")
+    if os.environ.get(k)
+)
+
 _EMIT_LOCK = threading.Lock()
 _EMITTED = False
 # a fresh measurement that passed the plausibility gate but hasn't emitted
@@ -62,32 +71,62 @@ def _emit_line(payload: dict) -> bool:
     return True
 
 
+def _zero_payload(reason: str) -> dict:
+    return {
+        "metric": "tokens_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "stale": True,
+        "stale_reason": reason,
+        "stale_captured": None,
+    }
+
+
 def _stale_payload(reason: str) -> dict:
     if _PENDING_FRESH is not None:
         # this run's own gate-passed numbers beat any committed fallback;
-        # only the secondary peak cross-check is missing
+        # at most the secondary peak cross-check is missing — and only if
+        # it hadn't already completed (a late signal must not clobber a
+        # finished probe's 'amortized-v2' tag, ADVICE r5)
         payload = dict(_PENDING_FRESH)
-        payload["peak_probe"] = "interrupted"
-        payload["peak_probe_interrupted_by"] = reason
+        if payload.get("measured_peak_tflops") is None:
+            payload["peak_probe"] = "interrupted"
+            payload["peak_probe_interrupted_by"] = reason
         return payload
     try:
         with open(LAST_GOOD_PATH) as f:
             rec = json.load(f)
         payload = dict(rec["result"])
+        # LAST_GOOD only ever holds the default 0.5b no-override arm
+        # (_write_last_good's refresh guard); replaying it for any other
+        # requested arm — a different model OR a kernel/norm/rotary/mbs
+        # A/B override — would report the wrong arm's numbers as this
+        # arm's result (ADVICE r5). Zero the row instead.
+        requested = os.environ.get("BENCH_MODEL", "0.5b")
+        # records lacking 'model' predate the field — _write_last_good only
+        # ever stores the default arm, so missing means 0.5b, not "any arm"
+        stored = payload.get("model", "0.5b")
+        if stored != requested or _ARM_OVERRIDES:
+            what = (
+                f"LAST_GOOD holds arm {stored!r}, not the requested "
+                f"{requested!r}"
+                if stored != requested
+                else "LAST_GOOD holds the no-override arm, but "
+                + "/".join(_ARM_OVERRIDES) + " is set"
+            )
+            zeroed = _zero_payload(f"{reason}; {what}")
+            zeroed["stale_arm_mismatch"] = True
+            zeroed["model"] = requested
+            return zeroed
         payload["stale"] = True
         payload["stale_reason"] = reason
         payload["stale_captured"] = rec.get("captured")
         return payload
     except Exception as e:  # no committed capture: still emit SOMETHING parseable
-        return {
-            "metric": "tokens_per_sec_per_chip",
-            "value": 0.0,
-            "unit": "tokens/s",
-            "vs_baseline": 0.0,
-            "stale": True,
-            "stale_reason": f"{reason}; LAST_GOOD unavailable ({type(e).__name__})",
-            "stale_captured": None,
-        }
+        return _zero_payload(
+            f"{reason}; LAST_GOOD unavailable ({type(e).__name__})"
+        )
 
 
 def finish_stale(reason: str, rc: int = 0) -> None:

@@ -155,10 +155,9 @@ def main():
         + ma.output_size_in_bytes - ma.alias_size_in_bytes
     )
 
-    from tests.transformer.test_hlo_cost_pins import (
-        analytic_step_flops,
-        collective_bytes,
-    )
+    from tests.transformer.test_hlo_cost_pins import analytic_step_flops
+
+    from scaling_tpu.analysis.hlo_audit import collective_bytes
 
     from scaling_tpu.models.transformer.utils.get_tflops import (
         get_model_parameter_count,

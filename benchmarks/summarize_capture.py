@@ -20,6 +20,48 @@ import time
 from pathlib import Path
 
 SECTION_RE = re.compile(r"^([0-9]+(?:/[0-9]+)?)\. (.+?):\s*(.+)$")
+HEADER_RE = re.compile(r"^=== bench \S+ (.+?) ===$")
+
+# `$(date)` spellings capture_on_tunnel.sh may have written, with and
+# without a timezone token
+_DATE_FORMATS = (
+    "%a %b %d %H:%M:%S %Z %Y",
+    "%a %d %b %H:%M:%S %Z %Y",
+    "%a %b %d %H:%M:%S %Y",
+    "%Y-%m-%dT%H:%M:%SZ",
+)
+
+
+def _parse_header_date(raw: str):
+    """ISO-8601 UTC string for the log header's `$(date)` output, or None.
+
+    Only UTC/GMT (or tz-less) headers get the 'Z' stamp — claiming UTC
+    for a 'CEST' wall-clock time would be hours wrong, worse than the
+    flagged summarize-time fallback."""
+    raw = raw.strip()
+    tz_tokens = {t for t in raw.split() if t.isalpha() and t.isupper()
+                 and 2 <= len(t) <= 5 and t not in ("AM", "PM")}
+    if tz_tokens - {"UTC", "GMT"}:
+        return None
+    for fmt in _DATE_FORMATS:
+        try:
+            return time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.strptime(raw, fmt)
+            )
+        except ValueError:
+            continue
+    return None
+
+
+def bench_captured_at(text: str):
+    """When the bench actually ran: the `=== bench <label> <date> ===`
+    header capture_on_tunnel.sh writes (ADVICE r5 — the summarizer's own
+    run time mislabels artifacts when old logs are summarized later)."""
+    for line in text.splitlines():
+        m = HEADER_RE.match(line.strip())
+        if m:
+            return _parse_header_date(m.group(1))
+    return None
 
 
 def bench_rows(capture: Path) -> list:
@@ -38,7 +80,10 @@ def bench_rows(capture: Path) -> list:
                 except json.JSONDecodeError:
                     continue
         rc = re.search(r"rc=(\d+)", text)
-        rows.append((name, rec, int(rc.group(1)) if rc else None))
+        rows.append(
+            (name, rec, int(rc.group(1)) if rc else None,
+             bench_captured_at(text))
+        )
     return rows
 
 
@@ -66,19 +111,25 @@ def session_lines(capture: Path) -> list:
 
 
 def write_artifacts(rows: list, tag: str) -> None:
-    """One committed artifact per fresh (non-stale, rc=0) bench row."""
+    """One committed artifact per fresh (non-stale, rc=0) bench row,
+    stamped with the bench run's own log-header time (falling back to the
+    summarizer's clock, flagged, only when no header parsed)."""
     outdir = Path(__file__).resolve().parent / "artifacts"
     outdir.mkdir(exist_ok=True)
-    for name, rec, rc in rows:
+    for name, rec, rc, captured in rows:
         if rec is None or rec.get("stale") or rc != 0:
             continue
         arm = name.replace("bench_", "")
         out = outdir / f"BENCH_MIDROUND_{tag}_{arm}.json"
-        out.write_text(json.dumps({
-            "captured": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        payload = {
+            "captured": captured
+            or time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "command": f"capture_on_tunnel.sh arm {name}",
             "result": rec,
-        }, indent=1) + "\n")
+        }
+        if captured is None:
+            payload["captured_is_summarize_time"] = True
+        out.write_text(json.dumps(payload, indent=1) + "\n")
         print(f"wrote {out}", file=sys.stderr)
 
 
@@ -100,7 +151,7 @@ def main() -> None:
     if rows:
         print("| bench arm | tokens/s | MFU | vs measured peak | mbs | kernel | rc |")
         print("|---|---|---|---|---|---|---|")
-        for name, rec, rc in rows:
+        for name, rec, rc, _captured in rows:
             if rec is None:
                 print(f"| {name} | — | — | — | — | — | {rc} |")
                 continue

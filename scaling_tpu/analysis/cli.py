@@ -1,0 +1,145 @@
+"""CLI: ``python -m scaling_tpu.analysis [lint|audit|all]``.
+
+Emits a human table on stdout and, with ``--json``, a machine-readable
+report. Exit code 0 == clean tree (no unsuppressed lint findings, no
+golden drift); non-zero == the gate fired. ``audit --repin`` rewrites the
+goldens from the current tree (commit the diff deliberately).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def _lint(args) -> tuple[int, dict]:
+    from .lint import lint_paths
+
+    paths = [Path(p) for p in (args.paths or [REPO_ROOT / "scaling_tpu"])]
+    findings = lint_paths(paths, root=args.root or REPO_ROOT)
+    active = [f for f in findings if not f.suppressed]
+    for f in findings:
+        print(str(f))
+    print(
+        f"lint: {len(active)} finding(s) "
+        f"({len(findings) - len(active)} suppressed) over {len(paths)} path(s)"
+    )
+    payload = {
+        "findings": [f.to_dict() for f in findings],
+        "unsuppressed": len(active),
+    }
+    return (1 if active else 0), payload
+
+
+def _ensure_virtual_mesh() -> None:
+    """Best-effort 8-device CPU setup for programmatic ``main()`` callers.
+
+    ``python -m scaling_tpu.analysis`` does this properly in ``__main__``
+    (XLA_FLAGS must precede the first jax import); from an interpreter
+    where jax is already up, ``jax_num_cpu_devices`` still works before
+    backend init. If neither took, fail with a clear message instead of a
+    confusing Topology device-count error mid-audit."""
+    import os
+
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+    except Exception:
+        pass
+    if len(jax.devices()) < 8:
+        raise SystemExit(
+            "audit needs the 8-device virtual CPU mesh; run it as "
+            "`python -m scaling_tpu.analysis audit` (jax was already "
+            f"initialized here with {len(jax.devices())} device(s))"
+        )
+
+
+def _audit(args) -> tuple[int, dict]:
+    _ensure_virtual_mesh()
+    from . import hlo_audit
+
+    sections = args.sections.split(",") if args.sections else None
+    golden_dir = Path(args.goldens) if args.goldens else None
+    reports = hlo_audit.run_audit(sections)
+    drift: list[str] = []
+    for name, report in reports.items():
+        mesh = ",".join(f"{k}={v}" for k, v in report["mesh"].items() if v > 1)
+        print(f"== audit section {name} ({mesh or 'single device'}) ==")
+        for rec in report["collectives"]:
+            print(
+                f"  {rec['op']:<20} axis={rec['axis']:<14} "
+                f"x{rec['count']:<3} {rec['bytes']:>12} B"
+            )
+        if not report["collectives"]:
+            print("  (no collectives)")
+        print(
+            f"  dots={report['dot_general_count']} "
+            f"bf16->f32 dot upcasts={report['bf16_to_f32_dot_upcasts']} "
+            f"host callbacks={report['host_callbacks']} "
+            f"infeed/outfeed={report['infeed_outfeed']} "
+            f"rng ops={report['rng_ops']}"
+        )
+        print(f"  recompile key {report['recompile_key']['hash']} "
+              f"({report['recompile_key']['leaves']} leaves)")
+        if args.repin:
+            path = hlo_audit.write_golden(name, report, golden_dir)
+            print(f"  repinned -> {path}")
+        else:
+            section_drift = hlo_audit.compare_to_golden(
+                name, report, golden_dir
+            )
+            drift.extend(section_drift)
+            print(f"  golden: {'OK' if not section_drift else 'DRIFT'}")
+    for line in drift:
+        print(f"DRIFT: {line}")
+    payload = {"sections": reports, "drift": drift, "repinned": bool(args.repin)}
+    return (1 if drift else 0), payload
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m scaling_tpu.analysis",
+        description="JAX-aware static lint + lowered-HLO audit",
+    )
+    parser.add_argument("command", choices=["lint", "audit", "all"])
+    parser.add_argument("--json", metavar="FILE",
+                        help="also write a machine-readable report")
+    parser.add_argument("--paths", nargs="*",
+                        help="lint targets (default: scaling_tpu/)")
+    parser.add_argument("--root", help="path findings are reported relative to")
+    parser.add_argument("--sections",
+                        help="comma list of audit sections "
+                             "(default: all; see hlo_audit.SECTIONS)")
+    parser.add_argument("--goldens", help="override the golden-report directory")
+    parser.add_argument("--repin", action="store_true",
+                        help="rewrite audit goldens from the current tree")
+    args = parser.parse_args(argv)
+
+    rc = 0
+    payload: dict = {}
+    if args.command in ("lint", "all"):
+        lint_rc, lint_payload = _lint(args)
+        rc = max(rc, lint_rc)
+        payload["lint"] = lint_payload
+    if args.command in ("audit", "all"):
+        audit_rc, audit_payload = _audit(args)
+        rc = max(rc, audit_rc)
+        payload["audit"] = audit_payload
+    payload["exit_code"] = rc
+    if args.json:
+        Path(args.json).write_text(json.dumps(payload, indent=1) + "\n")
+    print(f"analysis: {'CLEAN' if rc == 0 else 'GATE FIRED'} (exit {rc})")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,6 +85,29 @@ def export_reference_checkpoint(src_dir: Path | str, dst_dir: Path | str) -> int
     dst = Path(dst_dir)
     dst.mkdir(parents=True, exist_ok=True)
 
+    config_file = src / "config.yml"
+    cfg = (
+        yaml.safe_load(config_file.read_text()) or {}
+        if config_file.is_file()
+        else {}
+    )
+    arch = cfg.get("transformer_architecture", {})
+    # npz checkpoints arrive pre-widened (checkpoint._write_npz stores bf16
+    # as lossless float32); when the configured precision is bfloat16, cast
+    # back so BOTH export paths (live params / npz round trip) produce the
+    # same on-disk torch.bfloat16 (ADVICE r5)
+    cast_bf16 = arch.get("precision") == "bfloat16"
+    if cast_bf16:
+        import ml_dtypes
+
+        def _restore_precision(arr: np.ndarray) -> np.ndarray:
+            if arr.dtype == np.float32:
+                return arr.astype(ml_dtypes.bfloat16)
+            return arr
+    else:
+        def _restore_precision(arr: np.ndarray) -> np.ndarray:
+            return arr
+
     written = 0
     embedding_table = None
     norm_index = None
@@ -101,7 +124,10 @@ def export_reference_checkpoint(src_dir: Path | str, dst_dir: Path | str) -> int
             ref_stem = f"model_state_layer_{layer_index}_{stem}"
             if stem == "LayerNormWrapper":
                 norm_index = layer_index
-        arrays = dict(np.load(f))
+        arrays = {
+            k: _restore_precision(np.asarray(v))
+            for k, v in np.load(f).items()
+        }
         if layer_index == 0 and "embedding.weight" in arrays:
             embedding_table = np.asarray(arrays["embedding.weight"])
         torch.save(export_layer(arrays), dst / f"{ref_stem}.pt")
@@ -113,19 +139,17 @@ def export_reference_checkpoint(src_dir: Path | str, dst_dir: Path | str) -> int
     # order: embedding, layers, LayerNormWrapper, head[, embedding head]) —
     # NOT max-index + 1, which an embedding-head or PEFT side file after
     # the head's slot would push past the hole the head must fill.
-    config_file = src / "config.yml"
-    if config_file.is_file():
-        cfg = yaml.safe_load(config_file.read_text()) or {}
-        arch = cfg.get("transformer_architecture", {})
-        if arch.get("weight_tying") and embedding_table is not None:
-            if norm_index is None:
-                raise ValueError(
-                    "weight-tied checkpoint without a LayerNormWrapper "
-                    "layer file: cannot place the tied head's slot"
-                )
-            torch.save(
-                {"embedding.weight": torch.from_numpy(embedding_table)},
-                dst / f"model_state_layer_{norm_index + 1}_TransformerLMHeadTied.pt",
+    if arch.get("weight_tying") and embedding_table is not None:
+        if norm_index is None:
+            raise ValueError(
+                "weight-tied checkpoint without a LayerNormWrapper "
+                "layer file: cannot place the tied head's slot"
             )
-            written += 1
+        # export_layer (not a bare from_numpy) so a bf16-restored table
+        # takes the same uint16-view path as every other bf16 array
+        torch.save(
+            export_layer({"embedding.weight": embedding_table}),
+            dst / f"model_state_layer_{norm_index + 1}_TransformerLMHeadTied.pt",
+        )
+        written += 1
     return written

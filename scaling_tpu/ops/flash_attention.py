@@ -208,7 +208,7 @@ def flash_attention_fused(
         # GSPMD, which would otherwise gather heads to every device. With
         # uniform causal masks each model shard runs an identical kernel on
         # its contiguous slice of q (and kv) heads; batch splits over data.
-        from jax import shard_map
+        from ..parallel.sharding import shard_map
         from jax.sharding import PartitionSpec as P
 
         from ..topology.topology import DATA_AXIS, MODEL_AXIS

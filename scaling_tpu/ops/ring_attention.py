@@ -278,7 +278,7 @@ def ring_attention(
     ring. Requires seq divisible by the context axis size. ``kv_chunk``
     (STATIC — part of the trace, not a baked-in global) caps the inner
     score-tile width; default _DEFAULT_KV_CHUNK."""
-    from jax import shard_map
+    from ..parallel.sharding import shard_map
 
     if segment_ids is None:
         segment_ids = jnp.zeros(q.shape[:2], jnp.int32)

@@ -190,7 +190,7 @@ def rms_norm_fused_sharded(
     local rows with the replicated gain; shard_map's transpose inserts the
     psum that reduces the per-shard weight grads (the manual analogue of
     GSPMD's backward collective for the XLA path)."""
-    from jax import shard_map
+    from ..parallel.sharding import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..topology.topology import CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS

@@ -119,7 +119,7 @@ def ulysses_attention(
 ) -> jax.Array:
     """shard_map entry mirroring ``ring_attention``'s contract: shards
     q/k/v over (data, context, model) and runs the head exchange."""
-    from jax import shard_map
+    from ..parallel.sharding import shard_map
 
     if segment_ids is None:
         segment_ids = jnp.zeros(q.shape[:2], jnp.int32)

@@ -1,0 +1,70 @@
+"""Seeded lint violations — every rule must fire EXACTLY where marked.
+
+This file lives under a ``nn/`` directory so the traced-context module
+allowlist treats it as model code. Line numbers are asserted by
+tests/core/test_analysis/test_lint.py; keep edits additive at the bottom.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def tracer_branch(x):
+    if jnp.any(x > 0):  # STA001: branch on device value
+        return x + 1
+    return x - 1
+
+
+@jax.jit
+def numpy_on_traced(x):
+    y = np.tanh(x)  # STA002: numpy op on a traced value
+    return jnp.asarray(y)
+
+
+@functools.partial(jax.jit, static_argnums=())
+def host_sync(x):
+    scale = float(x.mean())  # STA003: float() is a device->host sync
+    total = x.sum().item()  # STA003: .item() host sync
+    host = np.asarray(x)  # STA003: np.asarray host pull
+    return x * scale + total + jnp.asarray(host)
+
+
+def key_reuse(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))  # STA004: key consumed twice
+    return a + b
+
+
+def key_split_ok(key):
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, (4,))
+    key2 = jax.random.fold_in(key, 1)
+    return a + jax.random.normal(key2, (4,))
+
+
+def mutable_default(x, acc=[]):  # STA005: mutable default
+    acc.append(x)
+    return acc
+
+
+def f16_literal(x):
+    return x.astype(jnp.float16)  # STA006: f16 bypasses precision policy
+
+
+@jax.jit
+def suppressed_sync(x):
+    return float(x)  # sta: disable=STA003
+
+
+def scan_body_branch(carry, x):
+    if jnp.all(x == 0):  # STA001: body is traced via lax.scan below
+        return carry, x
+    return carry + 1, x
+
+
+def run_scan(xs):
+    return jax.lax.scan(scan_body_branch, 0, xs)

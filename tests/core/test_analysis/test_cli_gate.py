@@ -1,0 +1,109 @@
+"""Tier-1 CI gate: shell the analysis CLI exactly as an operator would.
+
+Fails on new lint findings or golden-report drift, so the gate runs
+inside the existing tier-1 command with no new infra (ISSUE 2). The
+fast tier audits the train sections (the whole three-section compile
+measures ~41 s cold on the 2-core CI host, seconds warm via the shared
+compile cache); the full `all` invocation rides the slow tier.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[3]
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+
+def run_cli(*args, timeout=600):
+    return subprocess.run(
+        [sys.executable, "-m", "scaling_tpu.analysis", *args],
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+def test_lint_gate_clean_tree_exits_zero():
+    p = run_cli("lint", timeout=120)
+    assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+    assert "lint: 0 finding(s)" in p.stdout
+
+
+def test_lint_gate_seeded_violations_exit_nonzero(tmp_path):
+    out = tmp_path / "lint.json"
+    p = run_cli("lint", "--paths", str(FIXTURES), "--json", str(out),
+                timeout=120)
+    assert p.returncode != 0
+    payload = json.loads(out.read_text())
+    rules = {f["rule"] for f in payload["lint"]["findings"]}
+    assert {"STA001", "STA002", "STA003", "STA004", "STA005", "STA006"} <= rules
+    assert payload["lint"]["unsuppressed"] > 0
+    assert payload["exit_code"] != 0
+
+
+def test_audit_gate_matches_golden(tmp_path):
+    """The enforced baseline: today's clean tree reproduces the committed
+    goldens (collective inventory, precision audit, recompile keys) for
+    the single-device AND the pp=2/mp=2 mesh train steps."""
+    out = tmp_path / "audit.json"
+    p = run_cli(
+        "audit", "--sections", "train_single,train_pp2_mp2",
+        "--json", str(out),
+    )
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-2000:]
+    payload = json.loads(out.read_text())
+    assert payload["audit"]["drift"] == []
+    sec = payload["audit"]["sections"]["train_single"]
+    assert sec["host_callbacks"] == 0
+    assert sec["bf16_to_f32_dot_upcasts"] == 0
+    pp2 = payload["audit"]["sections"]["train_pp2_mp2"]
+    axes = {r["axis"] for r in pp2["collectives"]}
+    # the layout's signature collectives, attributed to their mesh axes
+    assert "model" in axes and any("pipe" in a for a in axes), axes
+
+
+def test_audit_gate_detects_seeded_drift(tmp_path):
+    """A doctored golden (one extra all-gather, a flipped recompile key)
+    must make the same CLI invocation exit non-zero — proving the gate
+    bites, not just agrees with itself."""
+    from scaling_tpu.analysis.hlo_audit import GOLDEN_DIR
+
+    gdir = tmp_path / "goldens"
+    gdir.mkdir()
+    golden = json.loads((GOLDEN_DIR / "train_single.json").read_text())
+    golden["collectives"].append(
+        {"op": "all-gather", "axis": "model", "count": 1, "bytes": 4096}
+    )
+    golden["recompile_key"]["hash"] = "sha256:0000000000000000"
+    (gdir / "train_single.json").write_text(json.dumps(golden))
+    p = run_cli("audit", "--sections", "train_single", "--goldens", str(gdir))
+    assert p.returncode != 0
+    assert "DRIFT" in p.stdout
+
+
+@pytest.mark.slow
+def test_full_cli_all_clean(tmp_path):
+    """The acceptance-criteria invocation: `all` (lint + every audit
+    section, including the pp=2/mp=2 mesh step and the fused decode
+    loop) exits 0 on the clean tree with a parseable JSON report."""
+    out = tmp_path / "all.json"
+    p = run_cli("all", "--json", str(out), timeout=1500)
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-2000:]
+    payload = json.loads(out.read_text())
+    assert payload["exit_code"] == 0
+    assert set(payload["audit"]["sections"]) == {
+        "train_single", "train_pp2_mp2", "decode_fused"
+    }
+    pp2 = payload["audit"]["sections"]["train_pp2_mp2"]
+    axes = {(r["op"], r["axis"]) for r in pp2["collectives"]}
+    # the mesh layout's signature collectives: TP activation reductions on
+    # the model axis, pipe-edge transfers on the pipe axis
+    assert any(ax == "model" for _, ax in axes), axes
+    assert any("pipe" in ax for _, ax in axes), axes

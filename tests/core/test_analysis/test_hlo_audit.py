@@ -1,0 +1,208 @@
+"""HLO-audit internals: collective parsing + axis attribution on synthetic
+HLO text (no compile), StableHLO precision detection on a real tiny
+lowering, and golden drift detection on doctored reports."""
+
+import json
+
+import pytest
+
+from scaling_tpu.analysis.hlo_audit import (
+    MeshAxes,
+    collective_inventory,
+    compare_to_golden,
+    recompile_signature,
+    stablehlo_precision_audit,
+    write_golden,
+)
+
+AXES = ("pipe", "data", "context", "model")
+
+
+# ------------------------------------------------------ parsing + axes
+SYNTH_HLO = """
+HloModule synth
+ENTRY main {
+  %ar1 = f32[128]{0} all-reduce(f32[128]{0} %a), channel_id=1, replica_groups={{0,1},{2,3},{4,5},{6,7}}, use_global_device_ids=true, to_apply=%add
+  %ar2 = (f32[100]{0}, f32[200]{0}) all-reduce(f32[100]{0} %b, f32[200]{0} %c), replica_groups={{0,2},{1,3},{4,6},{5,7}}, to_apply=%add
+  %ag = bf16[64,8]{1,0} all-gather(bf16[32,8]{1,0} %d), replica_groups=[4,2]<=[8], dimensions={0}
+  %cp = f32[16]{0} collective-permute(f32[16]{0} %e), source_target_pairs={{0,4},{4,0},{1,5},{5,1},{2,6},{6,2},{3,7},{7,3}}
+  %done = f32[128]{0} all-reduce-done(f32[128]{0} %ar1)
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    # (pipe=2, data=2, context=1, model=2): flat rank = ((pp*2+dp)*1+cp)*2+mp
+    return MeshAxes(AXES, (2, 2, 1, 2))
+
+
+def test_collective_inventory_axis_and_bytes(mesh):
+    inv = {(r["op"], r["axis"]): r for r in collective_inventory(SYNTH_HLO, mesh)}
+    # groups {0,1}... vary the last (model) coordinate
+    assert inv[("all-reduce", "model")]["bytes"] == 128 * 4
+    # variadic tuple result: both operands counted (the fused grad sync
+    # case the cost pins exist to watch); groups {0,2}.. vary data
+    assert inv[("all-reduce", "data")]["bytes"] == (100 + 200) * 4
+    # iota form [4,2]<=[8]: {0,1},{2,3},{4,5},{6,7} == model axis again
+    assert inv[("all-gather", "model")]["bytes"] == 64 * 8 * 2
+    # permute pairs flip the leading (pipe) coordinate
+    assert inv[("collective-permute", "pipe")]["count"] == 1
+    # async -done lines are not double counted
+    assert inv[("all-reduce", "model")]["count"] == 1
+
+
+def test_unknown_groups_are_not_misattributed(mesh):
+    text = "%x = f32[8]{0} all-reduce(f32[8]{0} %a), replica_groups={{0,3},{1,2},{4,7},{5,6}}, to_apply=%add"
+    (rec,) = collective_inventory(text, mesh)
+    assert rec["axis"] == "unknown"
+
+
+def test_world_axis(mesh):
+    text = "%x = f32[8]{0} all-reduce(f32[8]{0} %a), replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add"
+    (rec,) = collective_inventory(text, mesh)
+    assert rec["axis"] in ("world", "pipe+data+model")
+
+
+# ------------------------------------------------- stablehlo precision
+def test_bf16_upcast_into_dot_detected():
+    import jax
+    import jax.numpy as jnp
+
+    def bad(a, b):
+        return jnp.dot(a.astype(jnp.float32), b.astype(jnp.float32))
+
+    def good(a, b):
+        return jnp.dot(a, b)
+
+    x = jnp.zeros((4, 4), jnp.bfloat16)
+    bad_rep = stablehlo_precision_audit(jax.jit(bad).lower(x, x).as_text())
+    good_rep = stablehlo_precision_audit(jax.jit(good).lower(x, x).as_text())
+    assert bad_rep["bf16_to_f32_dot_upcasts"] == 1
+    assert good_rep["bf16_to_f32_dot_upcasts"] == 0
+    assert good_rep["dot_general_count"] == 1
+
+
+def test_host_callback_detected():
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        jax.debug.callback(lambda v: None, x)
+        return x + 1
+
+    rep = stablehlo_precision_audit(
+        jax.jit(f).lower(jnp.zeros((2,))).as_text()
+    )
+    assert rep["host_callbacks"] >= 1
+
+
+# ----------------------------------------------------- recompile keys
+def test_recompile_signature_tracks_shape_drift():
+    import jax.numpy as jnp
+
+    a = {"w": jnp.zeros((4, 4)), "b": jnp.zeros((4,))}
+    sig1 = recompile_signature((a,), {"kind": "t"})
+    sig2 = recompile_signature((a,), {"kind": "t"})
+    assert sig1["hash"] == sig2["hash"]
+    b = {"w": jnp.zeros((4, 8)), "b": jnp.zeros((4,))}
+    assert recompile_signature((b,), {"kind": "t"})["hash"] != sig1["hash"]
+    assert (
+        recompile_signature((a,), {"kind": "other"})["hash"] != sig1["hash"]
+    )
+
+
+# -------------------------------------------------------- golden drift
+def _report():
+    return {
+        "dot_general_count": 10,
+        "bf16_to_f32_dot_upcasts": 0,
+        "host_callbacks": 0,
+        "infeed_outfeed": 0,
+        "rng_ops": 0,
+        "collectives": [
+            {"op": "all-reduce", "axis": "data", "count": 2, "bytes": 1000},
+        ],
+        "recompile_key": {"hash": "sha256:abc", "leaves": 3, "static": {}},
+        "flops": 1e6,
+        "mesh": {"pipe": 1, "data": 2, "context": 1, "model": 1},
+    }
+
+
+def test_golden_roundtrip_and_drift(tmp_path):
+    write_golden("sec", _report(), tmp_path)
+    assert compare_to_golden("sec", _report(), tmp_path) == []
+
+    # counts are exact
+    drifted = _report()
+    drifted["collectives"][0]["count"] = 4
+    assert any("count 2 -> 4" in d for d in compare_to_golden("sec", drifted, tmp_path))
+
+    # bytes get a band, not exactness
+    banded = _report()
+    banded["collectives"][0]["bytes"] = 1100  # +10% < 15% band
+    assert compare_to_golden("sec", banded, tmp_path) == []
+    blown = _report()
+    blown["collectives"][0]["bytes"] = 2000
+    assert any("bytes" in d for d in compare_to_golden("sec", blown, tmp_path))
+
+    # a brand-new collective is drift (the extra all-gather on the wrong
+    # mesh axis this subsystem exists to catch)
+    extra = _report()
+    extra["collectives"].append(
+        {"op": "all-gather", "axis": "model", "count": 1, "bytes": 64}
+    )
+    assert any("NEW collective" in d for d in compare_to_golden("sec", extra, tmp_path))
+
+    # a changed recompile key is drift
+    rekey = _report()
+    rekey["recompile_key"]["hash"] = "sha256:def"
+    assert any("recompile_key" in d for d in compare_to_golden("sec", rekey, tmp_path))
+
+    # a new host sync in the lowered program is drift
+    sync = _report()
+    sync["host_callbacks"] = 1
+    assert any("host_callbacks" in d for d in compare_to_golden("sec", sync, tmp_path))
+
+
+def test_async_start_counts_result_not_operand_alias(mesh):
+    """`all-reduce-start` returns the (operand, result) tuple; counting
+    both would report 2x bytes versus the same collective in sync form —
+    a backend flipping sync->async must not read as false DRIFT."""
+    sync = "%x = f32[128]{0} all-reduce(f32[128]{0} %a), replica_groups={{0,1},{2,3},{4,5},{6,7}}, to_apply=%add"
+    start = "%x = (f32[128]{0}, f32[128]{0}) all-reduce-start(f32[128]{0} %a), replica_groups={{0,1},{2,3},{4,5},{6,7}}, to_apply=%add"
+    (s_rec,) = collective_inventory(sync, mesh)
+    (a_rec,) = collective_inventory(start, mesh)
+    assert a_rec["bytes"] == s_rec["bytes"] == 128 * 4
+    assert a_rec["axis"] == s_rec["axis"] == "model"
+
+
+def test_flops_dying_to_zero_or_none_is_drift(tmp_path):
+    """Cost analysis silently dying (flops -> 0.0 or the key vanishing
+    -> None) must fire the gate, not un-enforce the pin."""
+    write_golden("sec", _report(), tmp_path)
+    zeroed = _report()
+    zeroed["flops"] = 0.0
+    assert any("flops" in d for d in compare_to_golden("sec", zeroed, tmp_path))
+    gone = _report()
+    gone["flops"] = None
+    assert any(
+        "availability" in d for d in compare_to_golden("sec", gone, tmp_path)
+    )
+
+
+def test_missing_golden_reports_drift(tmp_path):
+    drift = compare_to_golden("nope", _report(), tmp_path)
+    assert drift and "no golden" in drift[0]
+
+
+def test_committed_goldens_exist_and_parse():
+    """The shipped golden set covers every audit section (the CLI's
+    default gate is meaningless without them)."""
+    from scaling_tpu.analysis.hlo_audit import GOLDEN_DIR, SECTIONS
+
+    for name in SECTIONS:
+        path = GOLDEN_DIR / f"{name}.json"
+        assert path.is_file(), f"missing golden {path}"
+        rep = json.loads(path.read_text())
+        assert "collectives" in rep and "recompile_key" in rep, name

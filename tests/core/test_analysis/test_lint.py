@@ -1,0 +1,127 @@
+"""Lint rules fire exactly where the seeded fixtures say — and nowhere
+in the clean tree (ISSUE 2 satellite: fixture modules with known
+violations per rule ID, plus a clean-tree run asserting zero
+unsuppressed findings)."""
+
+from pathlib import Path
+
+import pytest
+
+from scaling_tpu.analysis.lint import RULES, lint_paths
+
+REPO = Path(__file__).resolve().parents[3]
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+# (rule, line) pairs seeded in fixtures/nn/violations.py — line numbers are
+# part of the fixture's contract (edits there stay additive at the bottom)
+EXPECTED = [
+    ("STA001", 17),   # if jnp.any(...)
+    ("STA002", 24),   # np.tanh on traced
+    ("STA003", 30),   # float()
+    ("STA003", 31),   # .item()
+    ("STA003", 32),   # np.asarray
+    ("STA004", 38),   # key consumed twice
+    ("STA005", 49),   # mutable default
+    ("STA006", 55),   # astype(jnp.float16)
+    ("STA001", 64),   # branch inside lax.scan body
+]
+SUPPRESSED = [("STA003", 60)]  # sta: disable=STA003
+
+
+@pytest.fixture(scope="module")
+def fixture_findings():
+    return lint_paths([FIXTURES], root=REPO)
+
+
+@pytest.mark.parametrize("rule,line", EXPECTED)
+def test_seeded_violation_fires(fixture_findings, rule, line):
+    hits = [
+        f for f in fixture_findings
+        if f.rule == rule and f.line == line and not f.suppressed
+    ]
+    assert len(hits) == 1, (
+        f"expected exactly one unsuppressed {rule} at line {line}, got "
+        f"{[str(f) for f in fixture_findings]}"
+    )
+
+
+def test_no_unexpected_findings(fixture_findings):
+    """The fixture fires its seeded set EXACTLY — extra findings mean a
+    rule got noisier, missing ones mean it got blind."""
+    got = sorted((f.rule, f.line) for f in fixture_findings)
+    assert got == sorted(EXPECTED + SUPPRESSED), got
+
+
+@pytest.mark.parametrize("rule,line", SUPPRESSED)
+def test_suppression_comment_downgrades(fixture_findings, rule, line):
+    hits = [f for f in fixture_findings if f.rule == rule and f.line == line]
+    assert len(hits) == 1 and hits[0].suppressed
+
+
+def test_clean_tree_has_zero_unsuppressed_findings():
+    """Today's clean state is the enforced baseline: the whole package
+    lints clean (suppressions are visible and deliberate)."""
+    findings = lint_paths([REPO / "scaling_tpu"], root=REPO)
+    active = [f for f in findings if not f.suppressed]
+    assert not active, "\n".join(str(f) for f in active)
+
+
+def _lint_source(tmp_path, src: str):
+    from scaling_tpu.analysis.lint import lint_file
+
+    f = tmp_path / "mod.py"
+    f.write_text("import jax\n" + src)
+    return lint_file(f, root=tmp_path)
+
+
+def test_key_reuse_caught_through_same_line_reassign(tmp_path):
+    """`key = jax.random.normal(key, ...)` after a prior draw IS reuse —
+    the RHS consumes before the statement's own assign clears."""
+    findings = _lint_source(tmp_path, (
+        "def f(key):\n"
+        "    a = jax.random.normal(key, (2,))\n"
+        "    key = jax.random.uniform(key, (2,))\n"
+        "    return a + key\n"
+    ))
+    assert [f.rule for f in findings] == ["STA004"]
+    assert findings[0].line == 4
+
+
+def test_key_reuse_not_flagged_across_exclusive_branches(tmp_path):
+    """One draw per if/else branch is correct code (only one executes);
+    a draw AFTER the branches still conflicts with either."""
+    clean = _lint_source(tmp_path, (
+        "def f(key, cond):\n"
+        "    if cond:\n"
+        "        a = jax.random.normal(key, (2,))\n"
+        "    else:\n"
+        "        a = jax.random.uniform(key, (2,))\n"
+        "    return a\n"
+    ))
+    assert clean == []
+    after = _lint_source(tmp_path, (
+        "def f(key, cond):\n"
+        "    if cond:\n"
+        "        a = jax.random.normal(key, (2,))\n"
+        "    else:\n"
+        "        a = jax.random.uniform(key, (2,))\n"
+        "    return a + jax.random.normal(key, (2,))\n"
+    ))
+    assert [f.rule for f in after] == ["STA004"] and after[0].line == 7
+
+
+def test_rule_table_is_stable():
+    """Rule IDs are a public contract (suppression comments, docs,
+    golden reports reference them)."""
+    assert set(RULES) == {
+        "STA001", "STA002", "STA003", "STA004", "STA005", "STA006"
+    }
+    for rule, (severity, _) in RULES.items():
+        assert severity in ("error", "warning"), rule
+
+
+def test_findings_are_json_serializable(fixture_findings):
+    import json
+
+    payload = json.dumps([f.to_dict() for f in fixture_findings])
+    assert "STA004" in payload

@@ -215,6 +215,65 @@ def test_last_good_refresh_guard(tmp_path, monkeypatch):
     assert rec["result"] == payload and rec["captured"]
 
 
+def test_stale_payload_flags_mismatched_arm(tmp_path, monkeypatch):
+    """LAST_GOOD only ever holds the default 0.5b arm; a dead-tunnel
+    BENCH_MODEL=1b run must NOT replay 0.5b numbers as the 1b row
+    (ADVICE r5) — the row zeroes and carries the mismatch flag."""
+    sys.path.insert(0, str(REPO_ROOT))
+    import bench
+
+    last_good = tmp_path / "LAST_GOOD.json"
+    last_good.write_text(json.dumps({
+        "captured": "2026-08-01T00:00:00Z",
+        "result": {"metric": "tokens_per_sec_per_chip", "value": 123.4,
+                   "vs_baseline": 1.0, "model": "0.5b"},
+    }))
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(last_good))
+    monkeypatch.setattr(bench, "_PENDING_FRESH", None)
+    monkeypatch.setattr(bench, "_ARM_OVERRIDES", ())
+
+    monkeypatch.setenv("BENCH_MODEL", "1b")
+    rec = bench._stale_payload("tunnel dead")
+    assert rec["stale"] is True and rec["stale_arm_mismatch"] is True
+    assert rec["value"] == 0.0 and rec["model"] == "1b"
+    assert "'0.5b'" in rec["stale_reason"] and "'1b'" in rec["stale_reason"]
+
+    # an A/B override is its own arm even with the default model: the
+    # committed no-override capture must not masquerade as its row
+    monkeypatch.delenv("BENCH_MODEL")
+    monkeypatch.setattr(bench, "_ARM_OVERRIDES", ("BENCH_KERNEL",))
+    rec = bench._stale_payload("tunnel dead")
+    assert rec["stale_arm_mismatch"] is True and rec["value"] == 0.0
+    assert "BENCH_KERNEL" in rec["stale_reason"]
+
+    # the matching arm still replays the capture untouched
+    monkeypatch.setattr(bench, "_ARM_OVERRIDES", ())
+    rec = bench._stale_payload("tunnel dead")
+    assert rec["value"] == 123.4 and "stale_arm_mismatch" not in rec
+    assert rec["stale_captured"] == "2026-08-01T00:00:00Z"
+
+
+def test_stale_payload_keeps_completed_peak_probe(monkeypatch):
+    """A late signal during wrap-up must not clobber a finished probe:
+    peak_probe only downgrades to 'interrupted' while
+    measured_peak_tflops is still None (ADVICE r5)."""
+    sys.path.insert(0, str(REPO_ROOT))
+    import bench
+
+    done = {"value": 10.0, "measured_peak_tflops": 180.0,
+            "peak_probe": "amortized-v2"}
+    monkeypatch.setattr(bench, "_PENDING_FRESH", done)
+    rec = bench._stale_payload("signal 15")
+    assert rec["peak_probe"] == "amortized-v2"
+    assert "peak_probe_interrupted_by" not in rec
+
+    pending = {"value": 10.0, "measured_peak_tflops": None, "peak_probe": None}
+    monkeypatch.setattr(bench, "_PENDING_FRESH", pending)
+    rec = bench._stale_payload("signal 15")
+    assert rec["peak_probe"] == "interrupted"
+    assert rec["peak_probe_interrupted_by"] == "signal 15"
+
+
 def test_bench_rejects_unknown_model():
     """Usage errors stay loud (rc!=0 for the operator) but still emit the
     parseable line — NO exit path is lineless."""

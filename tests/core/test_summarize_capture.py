@@ -1,0 +1,75 @@
+"""summarize_capture stamps artifacts with the bench run's OWN time.
+
+ADVICE r5: artifacts used to carry the summarizer's clock, so an old log
+summarized later committed a misleading capture date. The `=== bench
+<label> <date> ===` header capture_on_tunnel.sh writes is the truth."""
+
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO_ROOT))
+
+from benchmarks.summarize_capture import (  # noqa: E402
+    bench_captured_at,
+    bench_rows,
+    write_artifacts,
+)
+
+
+def _log(header_date: str | None, rec: dict, rc: int = 0) -> str:
+    lines = []
+    if header_date is not None:
+        lines.append(f"=== bench 05b {header_date} ===")
+    lines += [json.dumps(rec), f"bench 05b rc={rc}"]
+    return "\n".join(lines) + "\n"
+
+
+def test_header_date_parses_to_iso():
+    text = _log("Mon Aug  3 09:15:22 UTC 2026", {"value": 1.0})
+    assert bench_captured_at(text) == "2026-08-03T09:15:22Z"
+
+
+def test_unparseable_or_missing_header_yields_none():
+    assert bench_captured_at(_log("not a date", {"value": 1.0})) is None
+    assert bench_captured_at(_log(None, {"value": 1.0})) is None
+
+
+def test_rows_carry_captured_and_artifacts_stamp_it(tmp_path, monkeypatch):
+    import benchmarks.summarize_capture as sc
+
+    cap = tmp_path / "capture"
+    cap.mkdir()
+    rec = {"value": 5.0, "model": "0.5b"}
+    (cap / "bench_05b.log").write_text(
+        _log("Sun Aug  2 23:59:59 UTC 2026", rec)
+    )
+    rows = bench_rows(cap)
+    assert rows == [("bench_05b", rec, 0, "2026-08-02T23:59:59Z")]
+
+    outdir = tmp_path / "bench_home"
+    outdir.mkdir()
+    monkeypatch.setattr(
+        sc, "__file__", str(outdir / "summarize_capture.py")
+    )
+    write_artifacts(rows, "rT")
+    out = json.loads(
+        (outdir / "artifacts" / "BENCH_MIDROUND_rT_05b.json").read_text()
+    )
+    assert out["captured"] == "2026-08-02T23:59:59Z"
+    assert "captured_is_summarize_time" not in out
+
+
+def test_artifact_falls_back_to_summarize_time_flagged(tmp_path, monkeypatch):
+    import benchmarks.summarize_capture as sc
+
+    monkeypatch.setattr(
+        sc, "__file__", str(tmp_path / "summarize_capture.py")
+    )
+    write_artifacts([("bench_1b", {"value": 2.0}, 0, None)], "rT")
+    out = json.loads(
+        (tmp_path / "artifacts" / "BENCH_MIDROUND_rT_1b.json").read_text()
+    )
+    assert out["captured_is_summarize_time"] is True
+    assert out["captured"]  # still stamped with SOMETHING parseable

@@ -1,0 +1,70 @@
+"""Both export paths produce the same on-disk dtype (ADVICE r5).
+
+``checkpoint._write_npz`` widens bf16 arrays to lossless float32 on save,
+so an npz-sourced export used to emit torch.float32 where a live-params
+export of the same bf16-precision model emits torch.bfloat16. The
+exporter now reads ``config.yml``'s precision and casts float32 arrays
+back to bf16 before torch conversion — bit-identical values, matching
+dtypes. Float32-precision checkpoints keep exporting float32 untouched
+(pinned by test_reference_weight_import's bit-exact round trip)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+def _write_src(tmp_path, precision: str):
+    import yaml
+
+    src = tmp_path / f"ours_{precision}"
+    src.mkdir()
+    rng = np.random.default_rng(7)
+    # values that survive f32 -> bf16 -> f32 exactly (bf16-representable)
+    w = rng.integers(-8, 8, size=(16, 32)).astype(np.float32) / 4.0
+    b = rng.integers(-8, 8, size=(32,)).astype(np.float32) / 4.0
+    np.savez(
+        src / "model_state_layer_1_TransformerLayer.npz",
+        **{"attention.dense.weight": w, "attention.dense.bias": b},
+    )
+    (src / "config.yml").write_text(
+        yaml.safe_dump({"transformer_architecture": {"precision": precision}})
+    )
+    return src, w, b
+
+
+def test_bf16_checkpoint_exports_torch_bfloat16(tmp_path):
+    from scaling_tpu.checkpoint.export_reference import (
+        export_reference_checkpoint,
+    )
+
+    src, w, b = _write_src(tmp_path, "bfloat16")
+    dst = tmp_path / "ref"
+    assert export_reference_checkpoint(src, dst) == 1
+    sd = torch.load(
+        dst / "model_state_layer_1_TransformerLayer.pt", weights_only=False
+    )
+    t = sd["self_attention.dense.weight"]
+    assert t.dtype == torch.bfloat16
+    np.testing.assert_array_equal(t.float().numpy(), w.T)
+    assert sd["self_attention.dense.bias"].dtype == torch.bfloat16
+    np.testing.assert_array_equal(
+        sd["self_attention.dense.bias"].float().numpy(), b
+    )
+
+
+def test_float32_checkpoint_export_unchanged(tmp_path):
+    from scaling_tpu.checkpoint.export_reference import (
+        export_reference_checkpoint,
+    )
+
+    src, w, _ = _write_src(tmp_path, "float32")
+    dst = tmp_path / "ref32"
+    assert export_reference_checkpoint(src, dst) == 1
+    sd = torch.load(
+        dst / "model_state_layer_1_TransformerLayer.pt", weights_only=False
+    )
+    assert sd["self_attention.dense.weight"].dtype == torch.float32
+    np.testing.assert_array_equal(
+        sd["self_attention.dense.weight"].numpy(), w.T
+    )

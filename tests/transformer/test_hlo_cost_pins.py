@@ -14,18 +14,14 @@ Reference analogue: the runtime TFLOPs instrumentation it logs each step
 compile-time assertions.
 """
 
-import re
-
 import jax
-import jax.numpy as jnp
-import numpy as np
 import pytest
 
+from scaling_tpu.analysis.hlo_audit import collective_bytes
 from scaling_tpu.models.transformer import TransformerConfig
 from scaling_tpu.models.transformer.model import (
     init_model,
     init_optimizer,
-    loss_function,
 )
 from scaling_tpu.models.transformer.utils.get_tflops import (
     get_model_parameter_count,
@@ -35,66 +31,25 @@ from scaling_tpu.topology import Topology
 
 def make_config(seq=256, mbs=2, hidden=256, layers=4, vocab=2048, mp=1, dp=1,
                 gas=1, zero=False, remat=None):
-    d = {
-        "topology": {
-            "model_parallel_size": mp, "pipe_parallel_size": 1,
-            "data_parallel_size": dp, "micro_batch_size": mbs,
-            "gradient_accumulation_steps": gas,
-        },
-        "transformer_architecture": {
-            # the bench's flagship structure: GQA + RoPE + SwiGLU + RMS
-            "vocab_size": vocab, "hidden_size": hidden, "num_layers": layers,
-            "num_attention_heads": hidden // 64,
-            "attention_num_kv_heads": max(1, hidden // 128),
-            "sequence_length": seq, "precision": "bfloat16",
-            "mlp_type": "swiglu", "mlp_factor": 2.75, "norm_type": "rms",
-            "relative_position_embedding_type": "rotary", "causal": True,
-            "masked_softmax": {"kernel": "torch"},
-            "weight_tying": False, "attention_qkv_in_one": False,
-            "dropout_embedding": 0.0, "dropout_attention_probs": 0.0,
-            "dropout_after_attention": 0.0, "dropout_after_mlp": 0.0,
-        },
-        "optimizer": {"gradient_clipping": 1.0, "zero": zero,
-                      "loss_scaler": {"enable": False}},
-        "learning_rate_scheduler": {"learning_rate": 3e-4,
-                                    "learning_rate_warmup_steps": 10,
-                                    "learning_rate_decay_iters": 1000},
-        "trainer": {"train_iterations": 10, "seed": 0},
-        "data": {}, "logger": {"log_dir": None},
-    }
-    if remat:
-        d["topology"]["activation_checkpointing_type"] = remat
-    return TransformerConfig.from_dict(d)
+    """The bench's flagship structure (GQA + RoPE + SwiGLU + RMS) through
+    the shared auditor builder — one config recipe for the pins and the
+    analysis goldens."""
+    from scaling_tpu.analysis.hlo_audit import make_train_config
+
+    return make_train_config(
+        seq=seq, mbs=mbs, hidden=hidden, layers=layers, vocab=vocab,
+        mp=mp, dp=dp, gas=gas, zero=zero, remat=remat,
+        kv_heads=max(1, hidden // 128), mlp_factor=2.75,
+    )
 
 
 def compile_step(config):
-    """Compile (never run) the real jitted train step for ``config``."""
-    topology = Topology(config.topology)
-    module = init_model(config, topology)
-    optimizer = init_optimizer(config, module, topology)
-    key = jax.random.PRNGKey(0)
-    params = module.shard_params(module.init_params(key))
-    opt_state = optimizer.init_state(params)
-    step = module.build_train_step(optimizer, loss_function)
-    arch = config.transformer_architecture
-    topo = config.topology
-    b = topo.micro_batch_size * topo.data_parallel_size
-    gas, seq = topo.gradient_accumulation_steps, arch.sequence_length
-    rng = np.random.default_rng(0)
-    tokens = rng.integers(1, arch.vocab_size, size=(gas, b, seq), dtype=np.int64)
-    batch = module.shard_batch(
-        {
-            "token_ids": jnp.asarray(tokens, jnp.int32),
-            "target_token_ids": jnp.asarray(np.roll(tokens, -1, -1), jnp.int32),
-            "position_ids": jnp.asarray(
-                np.broadcast_to(np.arange(seq, dtype=np.int32), (gas, b, seq))
-            ),
-            "segment_ids": jnp.zeros((gas, b, seq), jnp.int32),
-            "loss_weights": jnp.ones((gas, b, seq), jnp.float32),
-        },
-        stacked=True,
-    )
-    return step.lower(params, opt_state, batch, key).compile()
+    """Compile (never run) the real jitted train step for ``config`` —
+    the shared auditor recipe, so these pins and the analysis goldens
+    measure the same program."""
+    from scaling_tpu.analysis.hlo_audit import lower_train_step
+
+    return lower_train_step(config)[0].compile()
 
 
 def per_partition_flops(compiled):
@@ -103,32 +58,10 @@ def per_partition_flops(compiled):
     return float(an["flops"])
 
 
-_COLLECTIVE_RE = re.compile(
-    r"=\s+((?:\([^)]*\))|(?:[a-z0-9]+\[[0-9,]*\][^ ]*))\s+"
-    r"(all-reduce|all-gather|reduce-scatter|collective-permute)\("
-)
-_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
-_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
-                "s8": 1, "u8": 1, "pred": 1}
-
-
-def collective_bytes(compiled):
-    """Per-partition bytes moved by each collective op kind, parsed from the
-    optimized HLO module. Handles both single-operand shapes and variadic
-    tuple shapes like '(f32[100]{0}, f32[200]{0}) all-reduce(' — dropping
-    the tuple case would silently uncount exactly the fused gradient syncs
-    these pins exist to watch."""
-    out: dict = {}
-    for shapes, op in _COLLECTIVE_RE.findall(compiled.as_text()):
-        total = 0
-        for dtype, shape in _SHAPE_RE.findall(shapes):
-            n = 1
-            for dim in shape.split(","):
-                if dim:
-                    n *= int(dim)
-            total += n * _DTYPE_BYTES.get(dtype, 4)
-        out[op] = out.get(op, 0) + total
-    return out
+# collective_bytes moved to scaling_tpu.analysis.hlo_audit (the shared
+# auditor these pins seeded — ISSUE 2); same parsing, same per-partition
+# result-bytes accounting, plus replica-group axis attribution the CLI
+# report adds on top.
 
 
 def analytic_step_flops(config):
