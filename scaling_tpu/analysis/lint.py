@@ -20,6 +20,13 @@ STA005    warning   mutable default argument value.
 STA006    warning   dtype literal that bypasses the configured precision
                     policy (hardcoded f16/f64 in model code; the policy
                     admits bf16/f32 via ``precision`` config only).
+STA007    error     swallowed exception in resilience-critical code
+                    (``trainer/``, ``checkpoint/``, ``data/``,
+                    ``resilience/``): a bare ``except:`` /
+                    ``except Exception`` / ``except BaseException``
+                    handler that neither re-raises, logs, nor uses the
+                    bound exception — a fault-masking black hole in the
+                    exact layer whose job is surfacing faults.
 ========  ========  ==========================================================
 
 Suppress a finding on its line with ``# sta: disable=STA003`` (comma list)
@@ -51,6 +58,8 @@ RULES = {
     "STA004": ("error", "PRNG key consumed twice without split/fold_in"),
     "STA005": ("warning", "mutable default argument"),
     "STA006": ("warning", "dtype literal bypasses the precision policy"),
+    "STA007": ("error", "swallowed exception (broad except without "
+                        "re-raise/logging/use)"),
 }
 
 # Module allowlist for traced-context rules (ISSUE 2: nn/, parallel/, ops/;
@@ -61,6 +70,22 @@ TRACED_MODULE_DIRS = (
     "ops",
     "models/transformer/layers",
 )
+
+# Directory allowlist for STA007 (ISSUE 3): the layers that stand between
+# a fault and a lost run — an exception silently eaten here is exactly
+# how a torn checkpoint or a dead data mount goes unnoticed for days.
+SWALLOW_SCOPE_DIRS = (
+    "trainer",
+    "checkpoint",
+    "data",
+    "resilience",
+)
+
+# calls that count as "the handler surfaced the problem"
+_LOG_CALL_ATTRS = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "log", "print_exc", "print_exception",
+}
 
 # jax transforms whose function argument (or decorated function) is traced
 _TRACING_TRANSFORMS = {
@@ -208,6 +233,10 @@ class _ModuleLint:
             f"/{d}/" in f"/{norm}" or norm.startswith(f"scaling_tpu/{d}/")
             for d in TRACED_MODULE_DIRS
         )
+        self.in_swallow_scope = any(
+            f"/{d}/" in f"/{norm}" or norm.startswith(f"scaling_tpu/{d}/")
+            for d in SWALLOW_SCOPE_DIRS
+        )
         self.is_config_module = Path(rel).name == "config.py"
         self._parents: Dict[ast.AST, ast.AST] = {}
         for parent in ast.walk(self.tree):
@@ -319,7 +348,70 @@ class _ModuleLint:
                 self._check_key_reuse(node)
         if self.in_traced_dir and not self.is_config_module:
             self._check_dtype_policy()
+        if self.in_swallow_scope:
+            self._check_swallowed_exceptions()
         return self.findings
+
+    # ------------------------------------------------------ STA007 driver
+    def _check_swallowed_exceptions(self) -> None:
+        """A broad handler must do SOMETHING with the exception: re-raise,
+        log it (any ``logger``-style method, ``warnings.warn``, ``print``,
+        ``traceback.print_exc``), or at least reference the bound name
+        (propagating it by other means, e.g. queueing it for a consumer).
+        """
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad_catch(node.type):
+                continue
+            if not self._handler_surfaces(node):
+                caught = (
+                    "bare except" if node.type is None
+                    else f"except {self.aliases.resolve(node.type) or '...'}"
+                )
+                self._emit(
+                    "STA007", node,
+                    f"{caught} swallows the exception (no re-raise, no "
+                    "logging, bound name unused); faults in this layer "
+                    "must surface",
+                )
+
+    def _is_broad_catch(self, type_node: Optional[ast.AST]) -> bool:
+        if type_node is None:
+            return True  # bare except:
+        types = (
+            list(type_node.elts)
+            if isinstance(type_node, ast.Tuple)
+            else [type_node]
+        )
+        for t in types:
+            name = self.aliases.resolve(t)
+            if name and name.rsplit(".", 1)[-1] in ("Exception", "BaseException"):
+                return True
+        return False
+
+    def _handler_surfaces(self, handler: ast.ExceptHandler) -> bool:
+        bound = handler.name
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call):
+                fname = self.aliases.resolve(n.func)
+                if fname in ("print", "warnings.warn", "traceback.print_exc"):
+                    return True
+                if (
+                    isinstance(n.func, ast.Attribute)
+                    and n.func.attr in _LOG_CALL_ATTRS
+                ):
+                    return True
+            if (
+                bound
+                and isinstance(n, ast.Name)
+                and n.id == bound
+                and isinstance(n.ctx, ast.Load)
+            ):
+                return True
+        return False
 
     # ------------------------------------------------ traced-context rules
     def _own_nodes(self, fn: ast.AST) -> Iterable[ast.AST]:

@@ -41,47 +41,64 @@ class AsyncCheckpointWriter:
     a new save waits for the previous one so files never interleave.
 
     Once any write fails, every later-submitted task of the same save is
-    skipped (so e.g. the trailing "latest" pointer never lands on a
+    skipped (so e.g. the trailing commit/"latest" tasks never land on a
     partially-written checkpoint); the original exception re-raises from
-    ``wait()``. Submission applies backpressure past ``max_queued`` pending
-    writes to bound host RAM at a few layers' worth of arrays.
+    ``wait()`` — and ONLY from ``wait()``: the backpressure drain in
+    ``submit`` records a writer failure instead of re-raising it on the
+    submitting (train-loop) thread, which used to leave
+    ``_pending``/``_failed`` inconsistent mid-loop. Submission applies
+    backpressure past ``max_queued`` pending writes to bound host RAM at
+    a few layers' worth of arrays.
     """
 
     def __init__(self, max_queued: int = 4) -> None:
         self._pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="ckpt-writer")
         self._pending: List[Future] = []
         self._max_queued = max_queued
-        self._failed = False
+        # first failure of the in-flight save; tasks record it here (so
+        # futures themselves never carry exceptions) and later tasks
+        # no-op while it is set
+        self._first_error: Optional[BaseException] = None
 
     def submit(self, fn, *args) -> None:
         def run():
-            if self._failed:
+            if self._first_error is not None:
                 return
             try:
                 fn(*args)
-            except BaseException:
-                self._failed = True
-                raise
+            except BaseException as e:
+                self._first_error = e
+                logger.error(f"checkpoint writer task failed: {e!r}")
 
         while len([f for f in self._pending if not f.done()]) >= self._max_queued:
+            # drain for backpressure only — failures stay recorded in
+            # _first_error and re-raise from wait(), not here
             self._pending[0].result()
             self._pending.pop(0)
         self._pending.append(self._pool.submit(run))
 
     def wait(self) -> None:
         pending, self._pending = self._pending, []
-        try:
-            for f in pending:
-                f.result()  # re-raises writer-thread exceptions
-        finally:
-            self._failed = False  # a later save may retry on a healthy disk
+        for f in pending:
+            f.result()  # tasks never raise; this is a completion barrier
+        err, self._first_error = self._first_error, None
+        if err is not None:
+            raise err  # a later save may retry on a healthy disk
 
     def close(self) -> None:
         self.wait()
         self._pool.shutdown(wait=True)
 
 
-def _write_npz(path: Path, arrays: Dict[str, np.ndarray]) -> None:
+def _write_npz(path: Path, arrays: Dict[str, np.ndarray],
+               recorder=None) -> None:
+    import io
+    import os
+
+    from ..resilience.faults import get_fault_plan
+    from ..resilience.guards import retry_io
+    from ..resilience.manifest import crc32_bytes
+
     # numpy serializes ml_dtypes extension dtypes (bfloat16, fp8) as raw
     # void records that np.load returns as uncastable |V2 — store them as
     # float32 instead (lossless widening for bf16); the loader casts every
@@ -90,15 +107,33 @@ def _write_npz(path: Path, arrays: Dict[str, np.ndarray]) -> None:
         k: v.astype(np.float32) if v.dtype.kind == "V" else v
         for k, v in arrays.items()
     }
-    np.savez(path, **arrays)
+    # serialize once, off disk: the digest recorded for the manifest is of
+    # the INTENDED bytes, so corruption introduced at/after the write
+    # (torn page, bad DMA, injected) is caught by restore verification
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    data = buf.getvalue()
+    if recorder is not None:
+        recorder(path, len(data), crc32_bytes(data))
+
+    def _put():
+        act = get_fault_plan().fire("ckpt.write", path=path)
+        with open(path, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        if act == "corrupt":
+            get_fault_plan().corrupt_file(path)
+
+    retry_io(_put, what=f"checkpoint write {path.name}")
 
 
 def _emit(writer: Optional[AsyncCheckpointWriter], path: Path,
-          arrays: Dict[str, np.ndarray]) -> None:
+          arrays: Dict[str, np.ndarray], recorder=None) -> None:
     if writer is None:
-        _write_npz(path, arrays)
+        _write_npz(path, arrays, recorder)
     else:
-        writer.submit(_write_npz, path, arrays)
+        writer.submit(_write_npz, path, arrays, recorder)
 
 
 def _meta_leaves(metas: Any) -> list[ParamMeta]:
@@ -124,11 +159,14 @@ def save_model_checkpoint(
     metas: Any,
     separate_file_for_parameters: Optional[List[str]] = None,
     writer: Optional[AsyncCheckpointWriter] = None,
+    recorder=None,
 ) -> None:
     """One npz per layer; PEFT params split into ``..._{name}.npz`` files.
 
     Arrays are host-gathered here; with ``writer`` the disk writes happen on
-    its background thread instead of blocking the train loop.
+    its background thread instead of blocking the train loop. ``recorder``
+    (``CheckpointCommit.record``) collects each file's intended (size,
+    crc32) for the integrity manifest.
     """
     path = Path(dir)
     path.mkdir(parents=True, exist_ok=True)
@@ -148,12 +186,12 @@ def save_model_checkpoint(
                 separate.setdefault(target, {})[name] = np_arr
         fname = f"model_state_layer_{layer_index}_{layer_class}.npz"
         if main:
-            _emit(writer, path / fname, main)
+            _emit(writer, path / fname, main, recorder)
         # double underscore separates the PEFT suffix from the class name so
         # the loader can recover the class unambiguously
         for sep, group_arrs in separate.items():
             sep_name = f"model_state_layer_{layer_index}_{layer_class}__{sep}.npz"
-            _emit(writer, path / sep_name, group_arrs)
+            _emit(writer, path / sep_name, group_arrs, recorder)
 
 
 def _compile_patterns(patterns: Optional[List[str]]) -> list:
@@ -265,6 +303,7 @@ OPT_FIELDS = ("master", "exp_avg", "exp_avg_sq")
 def save_optimizer_checkpoint(
     dir: Path | str, opt_state, metas: Any,
     writer: Optional[AsyncCheckpointWriter] = None,
+    recorder=None,
 ) -> None:
     """One ``optimizer_state_layer_{i}.npz`` per layer, written exactly once,
     holding all three Adam fields as ``{field}.{param_name}`` entries."""
@@ -283,7 +322,8 @@ def save_optimizer_checkpoint(
                 bucket[f"{field}.{name}"] = arr
     for layer_index, refs in per_layer.items():
         arrays = {k: np.asarray(jax.device_get(v)) for k, v in refs.items()}
-        _emit(writer, path / f"optimizer_state_layer_{layer_index}.npz", arrays)
+        _emit(writer, path / f"optimizer_state_layer_{layer_index}.npz", arrays,
+              recorder)
 
     scalars = {
         "step": int(opt_state.step),

@@ -170,7 +170,12 @@ def restore_orbax_params(
             for (path, _), m in zip(cur_flat, m_leaves)
         }
 
-        saved_tree = ckptr.metadata(model_dir).item_metadata.tree
+        # orbax API drift: newer releases wrap the saved-tree metadata in
+        # CheckpointMetadata (.item_metadata.tree); orbax 0.7.x returns
+        # the tree directly from StandardCheckpointer.metadata()
+        saved_tree = ckptr.metadata(model_dir)
+        for attr in ("item_metadata", "tree"):
+            saved_tree = getattr(saved_tree, attr, saved_tree)
         saved_by_path = dict(jtu.tree_flatten_with_path(saved_tree)[0])
 
         def saved_key(path) -> str:
@@ -228,14 +233,54 @@ def restore_orbax_params(
                 subset,
             )
             with ocp.PyTreeCheckpointer() as pt_ckptr:
-                restored = pt_ckptr.restore(
-                    model_dir,
-                    ocp.args.PyTreeRestore(
-                        item=subset,
-                        restore_args=restore_args,
-                        partial_restore=True,
-                    ),
-                )
+                try:
+                    restored = pt_ckptr.restore(
+                        model_dir,
+                        ocp.args.PyTreeRestore(
+                            item=subset,
+                            restore_args=restore_args,
+                            partial_restore=True,
+                        ),
+                    )
+                except TypeError:
+                    # orbax API drift: 0.7.x has no partial_restore — fall
+                    # back to restoring the FULL saved tree (unwanted
+                    # leaves read at their saved layout, then dropped).
+                    # Costs extra tensorstore reads on old orbax only;
+                    # the targeted subset still lands re-sharded/cast.
+                    full_item: dict = {}
+                    full_args: dict = {}
+
+                    def _nest(root, path, value):
+                        node = root
+                        parts = [str(getattr(k, "key", k)) for k in path]
+                        for k in parts[:-1]:
+                            node = node.setdefault(k, {})
+                        node[parts[-1]] = value
+
+                    sub_flat = dict(jtu.tree_flatten_with_path(subset)[0])
+                    arg_flat = dict(jtu.tree_flatten_with_path(restore_args)[0])
+                    for path, md in saved_by_path.items():
+                        if path in sub_flat:
+                            _nest(full_item, path, sub_flat[path])
+                            _nest(full_args, path, arg_flat[path])
+                        else:
+                            _nest(full_item, path, jax.ShapeDtypeStruct(
+                                tuple(md.shape), md.dtype))
+                            _nest(full_args, path, ocp.ArrayRestoreArgs())
+                    restored_full = pt_ckptr.restore(
+                        model_dir,
+                        ocp.args.PyTreeRestore(
+                            item=full_item, restore_args=full_args
+                        ),
+                    )
+                    full_by_path = dict(
+                        jtu.tree_flatten_with_path(restored_full)[0]
+                    )
+                    restored = jtu.tree_unflatten(
+                        jtu.tree_structure(subset),
+                        [full_by_path[p] for p in sub_flat],
+                    )
             restored_by_path = dict(jtu.tree_flatten_with_path(restored)[0])
         new_leaves = [restored_by_path.get(path, cur) for path, cur in cur_flat]
         # every wanted leaf must have round-tripped through the rebuilt

@@ -26,6 +26,12 @@ from typing import Any, Generator, Optional
 
 import numpy as np
 
+from ..resilience.faults import get_fault_plan
+from ..resilience.guards import (
+    DEFAULT_RETRY_ATTEMPTS,
+    DEFAULT_RETRY_BACKOFF_SECONDS,
+    retry_io,
+)
 from ..topology import Topology
 from .base_dataset import BaseDataset
 
@@ -115,11 +121,15 @@ class DataLoader:
         topology: Topology,
         shuffle: bool = True,
         dp_rank: Optional[int] = None,
+        retry_attempts: int = DEFAULT_RETRY_ATTEMPTS,
+        retry_backoff: float = DEFAULT_RETRY_BACKOFF_SECONDS,
     ):
         self.seed = seed
         self.consumed_samples = consumed_samples
         self.dataset = dataset
         self.topology = topology
+        self.retry_attempts = retry_attempts
+        self.retry_backoff = retry_backoff
         if len(dataset) < topology.config.micro_batch_size:
             raise AssertionError(
                 f"cannot instantiate data loader with micro_batch_size "
@@ -136,10 +146,24 @@ class DataLoader:
         )
         self._iter = iter(self._sampler)
 
+    def _read_batch(self, indices: list) -> Any:
+        # fault point + item reads together: both retried, and the reads
+        # are index-based (idempotent), so a retry re-reads the same
+        # samples — the stream stays a pure function of consumed_samples
+        get_fault_plan().fire("data.read")
+        items = [self.dataset[i] for i in indices]
+        return self.dataset.collate(items)
+
     def __next__(self) -> Any:
         indices = next(self._iter)
-        items = [self.dataset[i] for i in indices]
-        batch = self.dataset.collate(items)
+        # the sampler is NOT retried (re-advancing it would skip
+        # samples); only the idempotent reads/collate are
+        batch = retry_io(
+            lambda: self._read_batch(indices),
+            attempts=self.retry_attempts,
+            base_delay=self.retry_backoff,
+            what=f"dataloader read ({len(indices)} samples)",
+        )
         self.consumed_samples = self._sampler.consumed_samples
         return batch
 

@@ -99,7 +99,12 @@ class MemoryMapDataset:
         return np.asarray(self._data[start : start + size])
 
     def read_span(self, start_token: int, num_tokens: int) -> np.ndarray:
-        """Read a flat token span irrespective of document boundaries."""
+        """Read a flat token span irrespective of document boundaries.
+
+        Deliberately NOT retried here: transient-I/O retry (and the
+        ``data.read`` fault point) live at exactly one layer — the
+        DataLoader batch read that drives this — so retry budgets don't
+        multiply and fault-injection hit counts stay aimable."""
         return np.asarray(self._data[start_token : start_token + num_tokens])
 
     def __iter__(self) -> Iterator[np.ndarray]:

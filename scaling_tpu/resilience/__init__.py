@@ -1,0 +1,71 @@
+"""Crash-consistency + fault-tolerance layer (ISSUE 3).
+
+The subsystem that decides whether a preempted/crashed run loses ten
+minutes or ten days (PAPERS: Megatron-LM's fault-tolerant harness). Four
+pieces, all host-side (nothing here touches the lowered step program):
+
+- :mod:`.faults` — deterministic fault injection. Production code calls
+  ``get_fault_plan().fire("point")`` at named points; with no plan
+  configured that is a counter bump and a dict lookup (no-op). Tests set
+  ``SCALING_TPU_FAULTS`` to kill/fail/corrupt at precise moments.
+- :mod:`.manifest` — per-checkpoint ``MANIFEST.json`` (file list, sizes,
+  crc32 digests, step, config fingerprint, schema version) and its
+  verifier.
+- :mod:`.commit` — the atomic commit protocol: write into a
+  ``.tmp-global_stepN`` staging dir, manifest, fsync, atomic rename,
+  then the ``latest`` pointer. A kill at ANY instant leaves either the
+  old committed checkpoint or the new one — never a half-written dir
+  that ``latest`` points at.
+- :mod:`.guards` — in-loop protection: bounded retry-with-backoff for
+  transient I/O, the non-finite-loss budget, and a step-stall watchdog
+  that dumps thread stacks.
+- :mod:`.restore` — verified restore: scan ``global_step*`` newest-first
+  for the most recent checkpoint that passes manifest verification.
+- :mod:`.resume` — ``run_with_resume``: bounded auto-restart from the
+  newest valid checkpoint after a recoverable failure.
+
+Import cost matters (subprocess restarts pay it on the reclaim critical
+path), so nothing in this package imports jax at module level.
+
+See docs/RESILIENCE.md for the operator-facing guide.
+"""
+
+from .commit import CheckpointCommit
+from .faults import FaultPlan, InjectedFault, get_fault_plan, set_fault_plan
+from .guards import (
+    NonFiniteGuard,
+    NonFiniteLossError,
+    StepStallWatchdog,
+    dump_thread_stacks,
+    retry_io,
+)
+from .manifest import (
+    MANIFEST_NAME,
+    CheckpointCorruptionError,
+    prune_manifest_entries,
+    verify_checkpoint,
+    write_manifest,
+)
+from .restore import scan_step_dirs, select_checkpoint
+from .resume import run_with_resume
+
+__all__ = [
+    "CheckpointCommit",
+    "FaultPlan",
+    "InjectedFault",
+    "get_fault_plan",
+    "set_fault_plan",
+    "NonFiniteGuard",
+    "NonFiniteLossError",
+    "StepStallWatchdog",
+    "dump_thread_stacks",
+    "retry_io",
+    "MANIFEST_NAME",
+    "CheckpointCorruptionError",
+    "prune_manifest_entries",
+    "verify_checkpoint",
+    "write_manifest",
+    "scan_step_dirs",
+    "select_checkpoint",
+    "run_with_resume",
+]

@@ -1,0 +1,114 @@
+"""Atomic checkpoint commit protocol.
+
+A checkpoint is written entirely inside a dot-prefixed staging directory
+(``.tmp-global_stepN`` — invisible to the ``global_step*`` globs the
+loader, the fallback scanner and the optimizer-state pruner use), then:
+
+1. ``MANIFEST.json`` is written from the recorded/scanned digests
+   (fault point ``ckpt.manifest``);
+2. every staged file and the staging dir itself are fsynced;
+3. the staging dir is atomically renamed onto ``global_stepN``
+   (fault point ``ckpt.rename``; an existing dir from a crash-recovery
+   re-reach of the same step is removed first);
+4. the parent dir is fsynced, then the ``latest`` pointer is updated via
+   its own write-tmp-then-rename.
+
+A ``kill -9`` at any instant therefore leaves either the previous
+committed checkpoint (staging debris is swept by the next save) or the
+new one — never a half-written directory that ``latest`` points at.
+
+Works the same for both backends: the npz writer records per-file
+digests as it serializes; orbax writes its tree into the staging dir and
+is digested from disk at manifest time.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from pathlib import Path
+from typing import Dict, Optional, Tuple
+
+from ..logging import logger
+from .faults import get_fault_plan
+from .manifest import write_manifest
+
+TMP_PREFIX = ".tmp-global_step"
+LATEST_NAME = "latest"
+
+
+def _fsync_path(path: Path) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class CheckpointCommit:
+    """One checkpoint save's staging dir + commit sequence.
+
+    Thread contract: ``record`` and ``finalize``/``update_latest`` run
+    either all on the caller's thread (sync save) or all on the single
+    async-writer thread in FIFO order — never concurrently.
+    """
+
+    def __init__(self, base: Path | str, step: int,
+                 config_fingerprint: Optional[str] = None):
+        self.base = Path(base)
+        self.step = step
+        self.config_fingerprint = config_fingerprint
+        self.final_dir = self.base / f"global_step{step}"
+        self.tmp_dir = self.base / f"{TMP_PREFIX}{step}"
+        self._recorded: Dict[str, Tuple[int, str]] = {}
+        self.sweep_stale_tmp(self.base)
+        if self.tmp_dir.exists():
+            shutil.rmtree(self.tmp_dir)
+        self.tmp_dir.mkdir(parents=True)
+
+    @staticmethod
+    def sweep_stale_tmp(base: Path) -> None:
+        """Remove staging debris left by crashed saves (never committed,
+        so never loadable — safe to delete unconditionally)."""
+        for stale in Path(base).glob(f"{TMP_PREFIX}*"):
+            logger.warning(f"removing stale checkpoint staging dir {stale}")
+            shutil.rmtree(stale, ignore_errors=True)
+
+    def record(self, path: Path | str, size: int, crc32_hex: str) -> None:
+        """Register the intended (size, crc32) of a file written under
+        the staging dir, so the manifest detects write-time corruption."""
+        rel = Path(path).resolve().relative_to(self.tmp_dir.resolve()).as_posix()
+        self._recorded[rel] = (size, crc32_hex)
+
+    def finalize(self) -> Path:
+        """Manifest -> fsync -> atomic rename. Returns the final dir."""
+        plan = get_fault_plan()
+        plan.fire("ckpt.manifest", path=self.tmp_dir)
+        write_manifest(
+            self.tmp_dir, self.step, recorded=self._recorded,
+            config_fingerprint=self.config_fingerprint,
+        )
+        # npz writes fsync themselves; sync the rest (manifest, context,
+        # config, orbax tree) plus every directory so the rename never
+        # commits names whose contents are still in flight
+        for p in sorted(self.tmp_dir.rglob("*")):
+            if p.is_file() and p.suffix != ".npz":
+                _fsync_path(p)
+            elif p.is_dir():
+                _fsync_path(p)
+        _fsync_path(self.tmp_dir)
+        plan.fire("ckpt.rename", path=self.final_dir)
+        if self.final_dir.exists():
+            # crash recovery re-reached this step; replace the old save
+            shutil.rmtree(self.final_dir)
+        os.replace(self.tmp_dir, self.final_dir)
+        _fsync_path(self.base)
+        return self.final_dir
+
+    def update_latest(self) -> None:
+        """Atomically point ``latest`` at the committed step."""
+        tmp = self.base / f"{LATEST_NAME}.tmp"
+        tmp.write_text(self.final_dir.name)
+        _fsync_path(tmp)
+        os.replace(tmp, self.base / LATEST_NAME)
+        _fsync_path(self.base)

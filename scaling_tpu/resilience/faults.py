@@ -1,0 +1,180 @@
+"""Deterministic fault injection.
+
+Production code paths call ``get_fault_plan().fire("<point>")`` at named
+injection points; with no plan configured that is a counter bump (the
+hooks are no-ops in production). Tests arm a plan — via the
+``SCALING_TPU_FAULTS`` environment variable (inherited by training
+subprocesses, so a parent test can kill a child at an exact write) or
+programmatically with :func:`set_fault_plan` — to fail, kill, or corrupt
+at precise, reproducible moments.
+
+Named injection points wired into the framework:
+
+==================  =====================================================
+point               fired
+==================  =====================================================
+``ckpt.write``      once per checkpoint file write, BEFORE the bytes land
+                    (``checkpoint._write_npz``); ``corrupt`` applies to
+                    the file AFTER the write completes
+``ckpt.manifest``   before ``MANIFEST.json`` is written
+                    (``CheckpointCommit.finalize``)
+``ckpt.rename``     after the manifest, before the atomic
+                    tmp-dir -> final-dir rename
+``data.read``       once per dataloader micro-batch read
+                    (``DataLoader.__next__`` — the single retry/fault
+                    layer for dataset reads, memory-mapped included)
+``step.nan_grads``  once per train step after the jitted step returns;
+                    the ``nan`` action poisons the OBSERVED loss
+                    (params stay clean — it emulates a transient
+                    hardware NaN burst for the non-finite policy)
+``signal.sigterm``  at the top of every ``run_training`` loop iteration;
+                    the ``sigterm`` action delivers a real SIGTERM to
+                    this process (exercises the preemption path)
+==================  =====================================================
+
+Spec grammar (comma list): ``point=action[@N][xM]`` — fire ``action`` on
+hits ``N .. N+M-1`` of ``point`` (1-based; ``N`` defaults to 1, ``M`` to
+1, ``x*`` means every hit from ``N`` on). Actions:
+
+- ``kill``    SIGKILL this process (no cleanup runs — a real crash)
+- ``fail``    raise :class:`InjectedFault` (an ``IOError``, so the
+              bounded-retry guards treat it as transient)
+- ``sigterm`` deliver SIGTERM to this process
+- ``corrupt`` advisory: returned to the call site, which truncates the
+              file it just wrote (write-time corruption; manifest
+              digests are computed from the intended bytes, so restore
+              detects it)
+- ``nan``     advisory: returned to the call site, which poisons the
+              observed loss
+
+Example: ``SCALING_TPU_FAULTS="ckpt.write=kill@13,data.read=fail@1x2"``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import signal
+from typing import Dict, Optional
+
+from ..logging import logger
+
+ENV_VAR = "SCALING_TPU_FAULTS"
+
+ACTIONS = ("kill", "fail", "sigterm", "corrupt", "nan")
+
+# actions fire() executes itself; "corrupt"/"nan" are advisory returns
+_EXECUTED = ("kill", "fail", "sigterm")
+
+_SPEC_RE = re.compile(
+    r"^(?P<point>[a-z_.]+)=(?P<action>[a-z]+)"
+    r"(?:@(?P<first>\d+))?(?:x(?P<count>\d+|\*))?$"
+)
+
+
+class InjectedFault(IOError):
+    """A deliberately injected transient I/O failure (retryable)."""
+
+
+class _Rule:
+    __slots__ = ("action", "first", "count")
+
+    def __init__(self, action: str, first: int, count: Optional[int]):
+        self.action = action
+        self.first = first
+        self.count = count  # None -> every hit from `first` on
+
+    def matches(self, hit: int) -> bool:
+        if hit < self.first:
+            return False
+        return self.count is None or hit < self.first + self.count
+
+
+class FaultPlan:
+    """Parsed injection plan + per-point hit counters."""
+
+    def __init__(self, spec: str = ""):
+        self.spec = spec
+        self._rules: Dict[str, _Rule] = {}
+        self._hits: Dict[str, int] = {}
+        for entry in filter(None, (s.strip() for s in spec.split(","))):
+            m = _SPEC_RE.match(entry)
+            if not m:
+                raise ValueError(
+                    f"bad fault spec entry {entry!r}; expected "
+                    "point=action[@N][xM] (e.g. ckpt.write=kill@13)"
+                )
+            action = m.group("action")
+            if action not in ACTIONS:
+                raise ValueError(
+                    f"unknown fault action {action!r} in {entry!r}; "
+                    f"one of {ACTIONS}"
+                )
+            count = m.group("count")
+            self._rules[m.group("point")] = _Rule(
+                action,
+                int(m.group("first") or 1),
+                None if count == "*" else int(count or 1),
+            )
+
+    def hits(self, point: str) -> int:
+        return self._hits.get(point, 0)
+
+    def fire(self, point: str, path=None) -> Optional[str]:
+        """Count a hit at ``point``; execute/return the armed action.
+
+        Returns the action name for advisory actions (``corrupt``,
+        ``nan``) so the call site applies them, None when nothing fired.
+        ``fail`` raises, ``kill``/``sigterm`` signal this process.
+        """
+        hit = self._hits.get(point, 0) + 1
+        self._hits[point] = hit
+        rule = self._rules.get(point)
+        if rule is None or not rule.matches(hit):
+            return None
+        if rule.action in _EXECUTED:
+            logger.warning(
+                f"FAULT INJECTION: {rule.action} at {point} (hit {hit}"
+                f"{f', path={path}' if path else ''})"
+            )
+        if rule.action == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        if rule.action == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+            return None
+        if rule.action == "fail":
+            raise InjectedFault(
+                f"injected fault at {point} (hit {hit}"
+                f"{f', path={path}' if path else ''})"
+            )
+        return rule.action  # advisory: "corrupt" / "nan"
+
+    @staticmethod
+    def corrupt_file(path) -> None:
+        """Truncate ``path`` to half its size (write-time corruption)."""
+        from pathlib import Path
+
+        p = Path(path)
+        size = p.stat().st_size
+        with open(p, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+        logger.warning(f"FAULT INJECTION: corrupted {p} ({size} -> {max(size // 2, 1)} B)")
+
+
+_plan: Optional[FaultPlan] = None
+
+
+def get_fault_plan() -> FaultPlan:
+    """The process-wide plan; parsed once from ``SCALING_TPU_FAULTS``."""
+    global _plan
+    if _plan is None:
+        _plan = FaultPlan(os.environ.get(ENV_VAR, ""))
+        if _plan._rules:
+            logger.warning(f"fault injection armed: {_plan.spec}")
+    return _plan
+
+
+def set_fault_plan(plan: Optional[FaultPlan]) -> None:
+    """Install (tests) or clear (None re-reads the env on next use)."""
+    global _plan
+    _plan = plan

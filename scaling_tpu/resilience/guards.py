@@ -1,0 +1,175 @@
+"""In-loop guards: bounded I/O retry, non-finite-loss budget, stall watchdog.
+
+All host-side — nothing here enters the jitted step program, so the
+lowered HLO (and the analysis goldens pinned against it) is unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional, Tuple, Type
+
+from ..logging import logger
+
+DEFAULT_RETRY_ATTEMPTS = 3
+DEFAULT_RETRY_BACKOFF_SECONDS = 0.05
+
+
+class NonFiniteLossError(RuntimeError):
+    """The non-finite budget was exhausted; carries the diagnosis."""
+
+
+def retry_io(
+    fn: Callable,
+    *,
+    attempts: int = DEFAULT_RETRY_ATTEMPTS,
+    base_delay: float = DEFAULT_RETRY_BACKOFF_SECONDS,
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+    what: str = "i/o operation",
+):
+    """Call ``fn()``; on a transient error retry with exponential backoff.
+
+    Deterministic (no jitter): delay doubles each attempt starting at
+    ``base_delay``. The final failure re-raises the original exception.
+    Only use around idempotent operations (index-based reads, whole-file
+    writes) — a retried side effect must be safe to repeat.
+    """
+    assert attempts >= 1
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt == attempts:
+                logger.error(
+                    f"{what} failed after {attempts} attempt(s): {e!r}"
+                )
+                raise
+            delay = base_delay * (2 ** (attempt - 1))
+            logger.warning(
+                f"{what} failed (attempt {attempt}/{attempts}): {e!r}; "
+                f"retrying in {delay:.3f}s"
+            )
+            time.sleep(delay)
+
+
+class NonFiniteGuard:
+    """Skip-then-abort policy for overflow/NaN training signals.
+
+    Sits ON TOP of the dynamic loss scaler: the scaler already turns a
+    NaN-grad step into a no-op update plus a scale backoff, which rides
+    out isolated bursts; this guard bounds how long a PERSISTENT
+    non-finite condition (diverged optimum, poisoned data shard, sick
+    chip) is allowed to burn pod-hours. ``observe`` returns True while
+    the budget tolerates the streak; once more than ``budget``
+    consecutive non-finite observations arrive it raises
+    :class:`NonFiniteLossError` with a diagnosis (the caller saves a
+    checkpoint first so the run can be resumed from a finite state).
+    """
+
+    def __init__(self, budget: int):
+        assert budget >= 0
+        self.budget = budget
+        self.streak = 0
+
+    def observe(self, step: int, loss: Optional[float],
+                overflow: Optional[bool], loss_scale: Optional[float]) -> bool:
+        nonfinite = bool(overflow) or (
+            loss is not None and not math.isfinite(loss)
+        )
+        if not nonfinite:
+            self.streak = 0
+            return True
+        self.streak += 1
+        logger.warning(
+            f"non-finite training signal at step {step}: loss={loss} "
+            f"overflow={overflow} loss_scale={loss_scale} "
+            f"({self.streak}/{self.budget} consecutive tolerated)"
+        )
+        if self.streak <= self.budget:
+            return True
+        raise NonFiniteLossError(
+            f"aborting after {self.streak} consecutive non-finite steps "
+            f"(budget {self.budget}): last step {step}, loss={loss}, "
+            f"overflow={overflow}, loss_scale={loss_scale}. Likely causes: "
+            "diverged optimization (check LR/warmup), a poisoned data "
+            "shard (check consumed_samples against the data manifest), "
+            "or bad hardware. Resume from the checkpoint just saved — or "
+            "an earlier one if the saved state is already non-finite."
+        )
+
+
+def dump_thread_stacks() -> str:
+    """Every thread's current Python stack, formatted (stall forensics)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        out.append("".join(traceback.format_stack(frame)))
+    return "\n".join(out)
+
+
+class StepStallWatchdog:
+    """Background thread that fires when the train loop stops beating.
+
+    The loop calls ``beat(step)`` at the top of every iteration; if no
+    beat arrives for ``timeout_s`` the watchdog logs every thread's
+    stack (the post-mortem for hung collectives, wedged storage mounts,
+    stuck data workers) and invokes ``on_stall(step, elapsed)`` once per
+    stall. It cannot safely snapshot device state mid-step (the jitted
+    step donates its input buffers), so saving is the callback's job at
+    the next safe point — the trainer's default callback flags
+    preemption, which saves-and-exits the moment the step completes.
+    """
+
+    def __init__(self, timeout_s: float,
+                 on_stall: Optional[Callable[[int, float], None]] = None,
+                 poll_interval_s: Optional[float] = None):
+        assert timeout_s > 0
+        self.timeout_s = timeout_s
+        self.on_stall = on_stall
+        self._poll = poll_interval_s or min(timeout_s / 4, 1.0)
+        self._last_beat = time.monotonic()
+        self._step = 0
+        self._fired_for_beat: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stall_count = 0
+
+    def start(self) -> None:
+        self._last_beat = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, name="step-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def beat(self, step: int) -> None:
+        self._step = step
+        self._last_beat = time.monotonic()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            last = self._last_beat
+            elapsed = time.monotonic() - last
+            if elapsed < self.timeout_s or self._fired_for_beat == last:
+                continue
+            self._fired_for_beat = last  # once per stall, not per poll
+            self.stall_count += 1
+            logger.error(
+                f"step stall: no progress for {elapsed:.1f}s "
+                f"(timeout {self.timeout_s}s) after step {self._step}; "
+                f"thread stacks follow\n{dump_thread_stacks()}"
+            )
+            if self.on_stall is not None:
+                try:
+                    self.on_stall(self._step, elapsed)
+                except Exception as e:
+                    logger.error(f"watchdog on_stall callback failed: {e!r}")

@@ -1,0 +1,196 @@
+"""Checkpoint integrity manifest: write, verify, prune.
+
+``MANIFEST.json`` sits inside every committed ``global_stepN/`` directory
+and records, for each artifact file, its size and crc32 digest, plus the
+step, a config fingerprint and a schema version. It is written LAST
+(inside the staging dir, before the atomic rename), so its presence
+implies every listed file was fully written — and its digests are
+computed from the bytes the writer INTENDED where available, so even
+write-time corruption (torn page, bad DMA) is caught on restore.
+
+Checkpoints without a manifest (pre-manifest layouts, externally
+produced trees, direct ``save_model_checkpoint`` callers) are accepted
+as *legacy*: loadable, integrity unverified — backwards compatibility
+with every existing checkpoint on disk.
+"""
+
+from __future__ import annotations
+
+import json
+import zlib
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..logging import logger
+
+MANIFEST_NAME = "MANIFEST.json"
+SCHEMA_VERSION = 1
+
+_CHUNK = 1 << 20
+
+
+def _is_optimizer_artifact(rel: str) -> bool:
+    rel = Path(rel).as_posix()
+    return rel.startswith("optimizer_state") or rel.startswith("orbax/optimizer/")
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint failed integrity verification (or was unreadable)."""
+
+
+def crc32_file(path: Path) -> Tuple[int, str]:
+    """(size, crc32-hex) of a file, streamed."""
+    crc = 0
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_CHUNK)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+    return size, f"{crc & 0xFFFFFFFF:08x}"
+
+
+def crc32_bytes(data: bytes) -> str:
+    return f"{zlib.crc32(data) & 0xFFFFFFFF:08x}"
+
+
+def _iter_files(root: Path) -> Iterable[Path]:
+    for p in sorted(root.rglob("*")):
+        if p.is_file() and p.name != MANIFEST_NAME:
+            yield p
+
+
+def write_manifest(
+    step_dir: Path,
+    step: int,
+    recorded: Optional[Dict[str, Tuple[int, str]]] = None,
+    config_fingerprint: Optional[str] = None,
+) -> Path:
+    """Scan ``step_dir`` and write its manifest.
+
+    ``recorded`` maps relpath -> (size, crc32) for files whose digests
+    the writer computed from the in-memory bytes (npz writes); files not
+    in it (context.json, config.yml, orbax trees) are digested from
+    disk. Returns the manifest path; the caller fsyncs/renames.
+    """
+    step_dir = Path(step_dir)
+    files: Dict[str, dict] = {}
+    for p in _iter_files(step_dir):
+        rel = p.relative_to(step_dir).as_posix()
+        if recorded is not None and rel in recorded:
+            size, digest = recorded[rel]
+        else:
+            size, digest = crc32_file(p)
+        files[rel] = {"size": size, "crc32": digest}
+    payload = {
+        "schema_version": SCHEMA_VERSION,
+        "step": step,
+        "config_fingerprint": config_fingerprint,
+        "files": files,
+    }
+    out = step_dir / MANIFEST_NAME
+    out.write_text(json.dumps(payload, indent=1, sort_keys=True))
+    return out
+
+
+def read_manifest(step_dir: Path) -> Optional[dict]:
+    """Parsed manifest, or None when absent. Raises
+    CheckpointCorruptionError on an unparseable or future-schema one."""
+    f = Path(step_dir) / MANIFEST_NAME
+    if not f.is_file():
+        return None
+    try:
+        payload = json.loads(f.read_text())
+    except (json.JSONDecodeError, OSError) as e:
+        raise CheckpointCorruptionError(f"{f}: unreadable manifest ({e})") from e
+    if payload.get("schema_version", 0) > SCHEMA_VERSION:
+        raise CheckpointCorruptionError(
+            f"{f}: manifest schema {payload.get('schema_version')} is newer "
+            f"than this build understands ({SCHEMA_VERSION})"
+        )
+    return payload
+
+
+def verify_checkpoint(step_dir: Path, deep: bool = True) -> List[str]:
+    """Integrity problems of ``step_dir`` ([] == loadable).
+
+    With a manifest: every listed file must exist with the recorded size
+    and (``deep``) crc32 digest. Without one (legacy checkpoint): accept
+    when recognizable checkpoint artifacts are present, flag otherwise.
+    """
+    step_dir = Path(step_dir)
+    if not step_dir.is_dir():
+        return [f"{step_dir}: not a directory"]
+    try:
+        manifest = read_manifest(step_dir)
+    except CheckpointCorruptionError as e:
+        return [str(e)]
+    if manifest is None:
+        has_artifacts = (
+            any(step_dir.glob("model_state_layer_*.npz"))
+            or (step_dir / "orbax").is_dir()
+            or (step_dir / "context.json").is_file()
+        )
+        if not has_artifacts:
+            return [f"{step_dir}: no manifest and no recognizable checkpoint files"]
+        logger.warning(
+            f"{step_dir}: no MANIFEST.json (legacy checkpoint); "
+            "integrity not verified"
+        )
+        return []
+    problems: List[str] = []
+    for rel, meta in manifest.get("files", {}).items():
+        p = step_dir / rel
+        if not p.is_file():
+            if _is_optimizer_artifact(rel):
+                # optimizer state is legitimately prunable by hand
+                # (delete_past_optimizer_states rewrites the manifest,
+                # but operators also rmtree it to save disk) — absence
+                # is pruning, not corruption; the loader falls back to
+                # fresh optimizer state as it always has
+                logger.warning(
+                    f"{step_dir}: optimizer artifact {rel} pruned "
+                    "(listed in manifest but absent)"
+                )
+                continue
+            problems.append(f"{rel}: listed in manifest but missing")
+            continue
+        size = p.stat().st_size
+        if size != meta["size"]:
+            problems.append(
+                f"{rel}: size {size} != manifest {meta['size']} (truncated?)"
+            )
+            continue
+        if deep:
+            _, digest = crc32_file(p)
+            if digest != meta["crc32"]:
+                problems.append(
+                    f"{rel}: crc32 {digest} != manifest {meta['crc32']} "
+                    "(bit rot / torn write)"
+                )
+    return problems
+
+
+def prune_manifest_entries(step_dir: Path, removed: Iterable[str]) -> None:
+    """Drop deleted files from an old checkpoint's manifest.
+
+    ``delete_past_optimizer_states`` legitimately removes optimizer
+    files from committed checkpoints; without this the pruned checkpoint
+    would look corrupt to the fallback scanner and be skipped forever.
+    """
+    step_dir = Path(step_dir)
+    manifest = read_manifest(step_dir)
+    if manifest is None:
+        return
+    removed = {Path(r).as_posix() for r in removed}
+    files = manifest.get("files", {})
+    kept = {rel: meta for rel, meta in files.items() if rel not in removed}
+    if len(kept) == len(files):
+        return
+    manifest["files"] = kept
+    manifest["optimizer_pruned"] = True
+    (step_dir / MANIFEST_NAME).write_text(
+        json.dumps(manifest, indent=1, sort_keys=True)
+    )

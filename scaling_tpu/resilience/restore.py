@@ -1,0 +1,110 @@
+"""Verified restore: find the newest checkpoint that passes integrity.
+
+The ``latest`` pointer is a hint, not the truth — after a crash it can
+point at a checkpoint that later rotted on disk, or (legacy layouts,
+pre-atomic-commit writers) at a half-written directory; it can also be
+missing entirely while valid ``global_step*`` dirs sit next to it.
+``select_checkpoint`` honors a *valid* ``latest`` exactly as before
+(tests and tooling deliberately repoint it to replay older steps), and
+otherwise scans newest-first for the most recent checkpoint that passes
+:func:`..resilience.manifest.verify_checkpoint`, reporting exactly what
+was skipped and why.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+from ..logging import logger
+from .manifest import CheckpointCorruptionError, verify_checkpoint
+
+_STEP_RE = re.compile(r"^global_step(\d+)$")
+
+
+def scan_step_dirs(base: Path | str) -> List[Tuple[int, Path]]:
+    """``(step, dir)`` for every ``global_stepN`` child, newest first."""
+    out = []
+    for d in Path(base).iterdir() if Path(base).is_dir() else []:
+        m = _STEP_RE.match(d.name)
+        if m and d.is_dir():
+            out.append((int(m.group(1)), d))
+    return sorted(out, reverse=True)
+
+
+def checkpoint_candidates(base: Path | str) -> List[Path]:
+    """Candidate step dirs under ``base``, in restore-preference order:
+    the ``latest``-pointed dir first (when it exists), then every other
+    ``global_step*`` newest-first; ``base`` itself when it IS a step dir
+    (direct loads: inference, export tooling)."""
+    base = Path(base)
+    cands: List[Path] = []
+    pointed: Optional[Path] = None
+    latest = base / "latest"
+    if latest.is_file():
+        pointed = base / latest.read_text().strip()
+        if pointed.is_dir():
+            cands.append(pointed)
+        else:
+            logger.warning(
+                f"latest pointer names {pointed.name!r} but no such "
+                f"directory exists under {base}; falling back to a scan"
+            )
+            pointed = None
+    newer_than_pointed = []
+    for step, d in scan_step_dirs(base):
+        if pointed is None or d != pointed:
+            cands.append(d)
+            if pointed is not None:
+                m = _STEP_RE.match(pointed.name)
+                if m and step > int(m.group(1)):
+                    newer_than_pointed.append(d.name)
+    if newer_than_pointed:
+        # a crash between a commit's rename and its latest update leaves
+        # the pointer lagging a newer committed checkpoint; latest is
+        # still honored (replay workflows repoint it deliberately), but
+        # the operator should know a newer step exists
+        logger.warning(
+            f"latest points at {pointed.name} but newer committed "
+            f"checkpoint(s) exist: {', '.join(newer_than_pointed)} — "
+            "repoint 'latest' (or remove it) to resume from the newest"
+        )
+    if not cands and (
+        (base / "context.json").is_file()
+        or any(base.glob("model_state_layer_*.npz"))
+        or (base / "orbax").is_dir()
+    ):
+        cands.append(base)
+    return cands
+
+
+def select_checkpoint(
+    base: Path | str, strict: bool = False, deep: bool = True
+) -> Tuple[Optional[Path], List[str]]:
+    """The newest checkpoint under ``base`` that verifies, plus the
+    skip log (one line per rejected candidate, saying why).
+
+    ``strict=True`` raises :class:`CheckpointCorruptionError` on the
+    FIRST invalid candidate instead of falling back — for runs where
+    silently resuming from an older step would invalidate the science.
+    """
+    skipped: List[str] = []
+    for cand in checkpoint_candidates(base):
+        problems = verify_checkpoint(cand, deep=deep)
+        if not problems:
+            if skipped:
+                logger.warning(
+                    f"restored from {cand} after skipping "
+                    f"{len(skipped)} invalid checkpoint(s): "
+                    + " | ".join(skipped)
+                )
+            return cand, skipped
+        line = f"{cand.name}: {'; '.join(problems)}"
+        if strict:
+            raise CheckpointCorruptionError(
+                f"checkpoint verification failed (strict mode): {line}"
+            )
+        logger.warning(f"skipping invalid checkpoint {line}")
+        skipped.append(line)
+    return None, skipped

@@ -1,0 +1,58 @@
+"""Bounded auto-resume: restart from the newest valid checkpoint.
+
+``run_with_resume`` wraps the build-and-train cycle the way a pod
+supervisor would: on a *recoverable* failure it rebuilds the trainer —
+whose ``load_checkpoint`` fallback restores the newest checkpoint that
+passes integrity verification — and continues, up to ``restart_budget``
+restarts. Exactness is preserved by construction: a resumed run replays
+the exact loss trajectory of an uninterrupted one (the data stream is a
+pure function of (seed, consumed_samples) and the RNG of (seed, step);
+pinned by ``test_checkpoint_resume_loss_exactness`` and the crash e2e).
+
+Recoverable by default is transient I/O (``OSError`` — storage blips,
+injected faults). Deliberately NOT recoverable by default:
+``NonFiniteLossError`` (a diverged run restarts into the same
+divergence — an operator decision), assertion/config errors, and OOMs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple, Type
+
+from ..logging import logger
+
+
+def run_with_resume(
+    trainer_factory: Callable[[], "object"],
+    restart_budget: int = 3,
+    recoverable: Tuple[Type[BaseException], ...] = (OSError,),
+    log_metrics_fn: Optional[Callable] = None,
+):
+    """Run training to completion, restarting on recoverable failures.
+
+    ``trainer_factory`` must build a FRESH trainer each call with
+    ``load_dir`` pointing at the run's ``save_dir`` (so every restart
+    resumes from the newest valid checkpoint) and
+    ``assert_checkpoint_loaded=False`` for the cold start. Returns the
+    trainer that finished; re-raises the last failure once the budget
+    is exhausted.
+    """
+    restarts = 0
+    while True:
+        trainer = trainer_factory()
+        try:
+            trainer.run_training(log_metrics_fn=log_metrics_fn)
+            return trainer
+        except recoverable as e:
+            restarts += 1
+            if restarts > restart_budget:
+                logger.error(
+                    f"restart budget exhausted ({restart_budget}); "
+                    f"giving up on {type(e).__name__}: {e}"
+                )
+                raise
+            logger.warning(
+                f"recoverable failure ({type(e).__name__}: {e}); "
+                f"restart {restarts}/{restart_budget} from the newest "
+                "valid checkpoint"
+            )

@@ -10,8 +10,10 @@ working.
 
 from __future__ import annotations
 
+import json
 import shutil
 import time
+import zipfile
 from enum import Enum
 from pathlib import Path
 from typing import Any, Callable, List, Optional
@@ -37,6 +39,26 @@ from ..parallel.parallel_module import (
     EvaluationStepOutput,
     ParallelModule,
     TrainStepOutput,
+)
+from ..resilience import (
+    CheckpointCommit,
+    NonFiniteGuard,
+    NonFiniteLossError,
+    StepStallWatchdog,
+    get_fault_plan,
+    retry_io,
+)
+from ..resilience.manifest import CheckpointCorruptionError, read_manifest
+from ..resilience.restore import checkpoint_candidates, verify_checkpoint
+
+# disk-corruption error types the load fallback may skip past; everything
+# else (shape/config mismatches, OOMs, assertion errors) aborts the resume
+_CORRUPT_LOAD_ERRORS = (
+    zipfile.BadZipFile,
+    EOFError,
+    OSError,
+    CheckpointCorruptionError,
+    json.JSONDecodeError,
 )
 
 
@@ -118,6 +140,50 @@ class TrainerConfig(BaseConfig):
         description="write checkpoint files on a background thread; the train "
         "loop only blocks for the device-to-host gather",
     )
+    strict_checkpoint_load: bool = Field(
+        False,
+        description="fail on the FIRST checkpoint that flunks integrity "
+        "verification instead of falling back to the newest older valid "
+        "one — for runs where silently resuming from an earlier step "
+        "would invalidate the experiment",
+    )
+    max_consecutive_nonfinite: Optional[int] = Field(
+        None,
+        description="non-finite policy budget: tolerate up to this many "
+        "CONSECUTIVE overflow/NaN steps (the loss scaler already turns "
+        "each into a no-op update), then save a checkpoint and abort "
+        "with a diagnosis. None disables. Only fetched steps are "
+        "observed — with log_interval > 1 the streak is counted at "
+        "fetch granularity",
+        ge=0,
+    )
+    step_timeout_seconds: Optional[float] = Field(
+        None,
+        description="step-stall watchdog: if a train-loop iteration "
+        "makes no progress for this long, dump every thread's stack "
+        "(hung collective / wedged storage forensics) and flag "
+        "preemption so the loop saves-and-exits at the next safe "
+        "point. None disables",
+        gt=0,
+    )
+    io_retry_attempts: int = Field(
+        3,
+        description="bounded retry for transient dataloader read "
+        "failures (exponential backoff; checkpoint writes retry with "
+        "the same default independently)",
+        ge=1,
+    )
+    io_retry_backoff_seconds: float = Field(
+        0.05, description="base backoff delay for dataloader read retries",
+        ge=0,
+    )
+    deep_checkpoint_verification: bool = Field(
+        True,
+        description="verify crc32 digests of every manifest-listed file "
+        "before restoring (catches bit rot / torn writes). False checks "
+        "existence+size only — for very large checkpoints on slow "
+        "shared storage where a full read per restore is prohibitive",
+    )
     checkpoint_backend: CheckpointBackend = Field(
         CheckpointBackend.NPZ,
         description="'npz': layout-independent per-layer files, host-gathered "
@@ -197,6 +263,12 @@ class BaseTrainer:
         self.external_preemption: Optional[Callable[[], bool]] = None
         self.metrics_hooks: List[Callable[[dict, int], None]] = []
         self.checkpoint_hooks: List[Callable[[Path, int], None]] = []
+        self._preempted = False
+        self._nonfinite_guard: Optional[NonFiniteGuard] = (
+            NonFiniteGuard(config.max_consecutive_nonfinite)
+            if config.max_consecutive_nonfinite is not None
+            else None
+        )
 
     # ------------------------------------------------------------ lifecycle
     def initialize(
@@ -293,6 +365,8 @@ class BaseTrainer:
                 consumed_samples=self.context.consumed_samples,
                 dataset=self.dataset,
                 topology=self.topology,
+                retry_attempts=self.config.io_retry_attempts,
+                retry_backoff=self.config.io_retry_backoff_seconds,
             )
         if self.dataset_evaluation is not None:
             self.dataloader_evaluation = DataLoader(
@@ -300,6 +374,8 @@ class BaseTrainer:
                 consumed_samples=self.context.consumed_eval_samples,
                 dataset=self.dataset_evaluation,
                 topology=self.topology,
+                retry_attempts=self.config.io_retry_attempts,
+                retry_backoff=self.config.io_retry_backoff_seconds,
             )
 
     # ----------------------------------------------------------- train step
@@ -342,6 +418,11 @@ class BaseTrainer:
         self.params, self.opt_state, loss, metrics, opt_out = self._train_step(
             self.params, self.opt_state, micro_batches, dropout_key
         )
+        if get_fault_plan().fire("step.nan_grads") == "nan":
+            # emulate a transient hardware NaN burst for the non-finite
+            # policy: poison only the OBSERVED loss (params stay clean,
+            # so "skip and continue" semantics hold exactly)
+            loss = jnp.asarray(float("nan"), jnp.float32)
         self.context.step()
         # profiler windows always sync (recorded step times must cover the
         # device work); otherwise log_interval decides whether this step
@@ -430,28 +511,87 @@ class BaseTrainer:
         """Save-and-exit on SIGTERM — the TPU-pod equivalent of the
         reference's Determined preemption hook (reference:
         trainer.py:449-456): GKE spot/preemptible nodes deliver SIGTERM
-        ahead of reclaim; the next run resumes from the saved step."""
+        ahead of reclaim; the next run resumes from the saved step.
+
+        Chains to any previously installed SIGTERM handler (launchers,
+        log flushers, cluster agents) instead of silently discarding it.
+        """
         import signal
+
+        prev = signal.getsignal(signal.SIGTERM)
 
         def handler(signum, frame):
             self._preempted = True
+            if callable(prev):  # SIG_DFL/SIG_IGN are enum ints, skipped
+                prev(signum, frame)
 
         self._preempted = False
         signal.signal(signal.SIGTERM, handler)
 
+    # ----------------------------------------------------------- preemption
+    def _preemption_requested(self) -> bool:
+        return self._preempted or (
+            self.external_preemption is not None and self.external_preemption()
+        )
+
+    def _preemption_exit(self) -> None:
+        if self.config.save_dir is not None:
+            step_dir = self.save_checkpoint()
+            self.finalize_checkpoints()
+            self._run_checkpoint_hooks(step_dir)
+            logger.info("preemption: checkpoint saved, exiting cleanly")
+
+    def _on_step_stall(self, step: int, elapsed: float) -> None:
+        """Watchdog callback: the watchdog thread must not host-gather
+        donated device buffers mid-step, so it requests a save at the
+        next safe point — if the stalled step ever completes, the loop
+        saves-and-exits via the preemption path."""
+        logger.error(
+            f"step stall after step {step} ({elapsed:.1f}s): requesting "
+            "save-and-exit at the next loop boundary"
+        )
+        self._preempted = True
+
     # ----------------------------------------------------------- train loop
     def run_training(self, log_metrics_fn: Optional[Callable] = None) -> None:
         assert self.config.train_iterations is not None
+        watchdog = None
+        if self.config.step_timeout_seconds is not None:
+            # created here, ARMED by the loop after the first step
+            # completes: the cold jit compile (minutes on big models)
+            # must not read as a stall
+            watchdog = StepStallWatchdog(
+                self.config.step_timeout_seconds, on_stall=self._on_step_stall
+            )
+        try:
+            self._run_training_loop(log_metrics_fn, watchdog)
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
+
+    def _run_training_loop(
+        self, log_metrics_fn: Optional[Callable],
+        watchdog: Optional[StepStallWatchdog] = None,
+    ) -> None:
+        watchdog_armed = False
         while self.context.iterations < self.config.train_iterations:
+            if watchdog is not None and watchdog_armed:
+                watchdog.beat(self.context.iterations)
+            get_fault_plan().fire("signal.sigterm")
+            # check the SIGNAL flag before dispatching: a SIGTERM that
+            # arrived during the checkpoint/eval window (or a stall
+            # flag) must exit without burning another full step. The
+            # external predicate is NOT polled here — cluster glue
+            # (Determined) counts one poll per completed step
+            if self._preempted:
+                self._preemption_exit()
+                return
             output = self.train_step()
-            if getattr(self, "_preempted", False) or (
-                self.external_preemption is not None and self.external_preemption()
-            ):
-                if self.config.save_dir is not None:
-                    step_dir = self.save_checkpoint()
-                    self.finalize_checkpoints()
-                    self._run_checkpoint_hooks(step_dir)
-                    logger.info("preemption: checkpoint saved, exiting cleanly")
+            if watchdog is not None and not watchdog_armed:
+                watchdog_armed = True
+                watchdog.start()  # steady-state steps from here on
+            if self._preemption_requested():
+                self._preemption_exit()
                 return
             will_save = (
                 self.config.save_dir is not None
@@ -507,6 +647,27 @@ class BaseTrainer:
                     except Exception as e:
                         # reporting must never abort a training step
                         logger.warning(f"metrics hook failed: {e}")
+            if self._nonfinite_guard is not None and output.fetched:
+                # after logging, so the aborting step's metrics still
+                # reach the sinks. Fetched outputs only: unfetched steps
+                # carry in-flight device arrays whose inspection would
+                # force the sync log_interval exists to remove
+                try:
+                    self._nonfinite_guard.observe(
+                        self.context.iterations, output.loss,
+                        output.overflow, output.current_loss_scale,
+                    )
+                except NonFiniteLossError:
+                    # budget exhausted: leave a resumable checkpoint
+                    # behind, then surface the diagnosis
+                    if self.config.save_dir is not None:
+                        step_dir = self.save_checkpoint()
+                        self.finalize_checkpoints()
+                        self._run_checkpoint_hooks(step_dir)
+                        logger.error(
+                            f"non-finite abort: state saved to {step_dir}"
+                        )
+                    raise
         self.finalize_checkpoints()
 
     def _run_checkpoint_hooks(self, step_dir: Path) -> None:
@@ -531,13 +692,28 @@ class BaseTrainer:
         thread dies with the process."""
         if self._ckpt_writer is not None:
             self._ckpt_writer.wait()
-    def _step_dir(self, base: Path, iterations: int) -> Path:
-        return base / f"global_step{iterations}"
+
+    def _config_fingerprint(self) -> Optional[str]:
+        """Stable digest of the run config, stamped into the checkpoint
+        manifest (restore logs a warning when it changes across a
+        resume — legitimate for finetunes, suspicious otherwise)."""
+        cfg = getattr(self.context, "config", None)
+        if cfg is None or not hasattr(cfg, "model_dump"):
+            return None
+        import hashlib
+        import json as _json
+
+        blob = _json.dumps(cfg.model_dump(mode="json"), sort_keys=True, default=str)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
     def save_checkpoint(self, dir: Optional[Path | str] = None) -> Path:
+        """Atomic commit protocol (docs/RESILIENCE.md): everything is
+        written into a ``.tmp-global_stepN`` staging dir, checksummed
+        into ``MANIFEST.json``, fsynced and atomically renamed onto
+        ``global_stepN`` before ``latest`` moves — a kill at any instant
+        leaves the previous committed checkpoint intact and loadable."""
         base = Path(dir or self.config.save_dir)
-        step_dir = self._step_dir(base, self.context.iterations)
-        step_dir.mkdir(parents=True, exist_ok=True)
+        base.mkdir(parents=True, exist_ok=True)
         writer = None
         if self.config.save_checkpoint_async:
             if self._ckpt_writer is None:
@@ -545,6 +721,14 @@ class BaseTrainer:
             else:
                 self._ckpt_writer.wait()  # never interleave two saves
             writer = self._ckpt_writer
+        # AFTER the writer barrier: creating the commit sweeps stale
+        # .tmp-* staging debris, which must never race a previous async
+        # save's still-pending finalize
+        commit = CheckpointCommit(
+            base, self.context.iterations,
+            config_fingerprint=self._config_fingerprint(),
+        )
+        stage_dir = commit.tmp_dir
         # checkpoint-view trees: stage-stacked pipeline bodies un-stack into
         # per-layer files so checkpoints are pipe-layout independent
         viewed_opt = self.opt_state._replace(
@@ -553,7 +737,7 @@ class BaseTrainer:
             exp_avg_sq=self.module.ckpt_view(self.opt_state.exp_avg_sq),
         )
         if self.config.checkpoint_backend == CheckpointBackend.ORBAX:
-            self._save_orbax(step_dir, viewed_opt)
+            self._save_orbax(stage_dir, viewed_opt)
         else:
             # checked here, not in config validation: jax.process_count()
             # initializes the backend as a side effect, which would break a
@@ -564,30 +748,27 @@ class BaseTrainer:
                     "and cannot run multi-process; set "
                     "trainer.checkpoint_backend: orbax for multi-host runs"
                 )
-            stale_orbax = step_dir / "orbax"
-            if stale_orbax.is_dir():
-                # a crashed orbax run re-reached this step under the npz
-                # backend: load detects the backend by directory presence,
-                # so the stale orbax tree would silently shadow this save
-                logger.warning(f"removing stale orbax checkpoint {stale_orbax}")
-                shutil.rmtree(stale_orbax)
             metas = self.module.ckpt_metas()
             save_model_checkpoint(
-                step_dir, self.module.ckpt_view(self.params), metas,
+                stage_dir, self.module.ckpt_view(self.params), metas,
                 separate_file_for_parameters=getattr(
                     self.module, "separate_file_for_parameters", None
                 ),
                 writer=writer,
+                recorder=commit.record,
             )
-            save_optimizer_checkpoint(step_dir, viewed_opt, metas, writer=writer)
-        self.context.save_checkpoint(step_dir)
+            save_optimizer_checkpoint(
+                stage_dir, viewed_opt, metas, writer=writer,
+                recorder=commit.record,
+            )
+        self.context.save_checkpoint(stage_dir)
         # full config travels with the weights so inference can rebuild the
         # architecture (reference: context.py:113-125 config.yml copy)
         cfg = getattr(self.context, "config", None)
         if cfg is not None and hasattr(cfg, "model_dump"):
             import yaml as _yaml
 
-            (step_dir / "config.yml").write_text(
+            (stage_dir / "config.yml").write_text(
                 _yaml.safe_dump(cfg.model_dump(mode="json"), sort_keys=False)
             )
             # tokenizer travels with the weights so inference needs nothing
@@ -596,25 +777,51 @@ class BaseTrainer:
                 getattr(cfg, "transformer_architecture", None), "vocab_file", None
             )
             if vocab and Path(vocab).is_file():
-                shutil.copyfile(vocab, step_dir / "vocab.json")
-        latest = f"global_step{self.context.iterations}"
+                shutil.copyfile(vocab, stage_dir / "vocab.json")
+        step_dir = commit.final_dir
         if writer is None:
-            (base / "latest").write_text(latest)
+            commit.finalize()
+            commit.update_latest()
         else:
-            # the single writer thread is FIFO: "latest" lands only after
-            # every npz of this save is durable
-            writer.submit((base / "latest").write_text, latest)
+            # the single writer thread is FIFO: the manifest+rename and
+            # then "latest" land only after every npz of this save is
+            # durable
+            writer.submit(commit.finalize)
+            writer.submit(commit.update_latest)
         logger.info(f"saved checkpoint {step_dir}")
         if self.config.delete_past_optimizer_states:
-            for old in sorted(base.glob("global_step*")):
-                if old == step_dir:
-                    continue
-                for f in old.glob("optimizer_state_*"):
-                    f.unlink()
-                old_orbax_opt = old / "orbax" / "optimizer"
-                if old_orbax_opt.is_dir():
-                    shutil.rmtree(old_orbax_opt)
+            if writer is None:
+                self._prune_past_optimizer_states(base, step_dir)
+            else:
+                # AFTER the queued finalize+latest: pruning the previous
+                # checkpoint's optimizer state before the new save is
+                # committed would open a crash window with no optimizer
+                # state anywhere on disk
+                writer.submit(self._prune_past_optimizer_states, base, step_dir)
         return step_dir
+
+    def _prune_past_optimizer_states(self, base: Path, step_dir: Path) -> None:
+        for old in sorted(base.glob("global_step*")):
+            if old == step_dir:
+                continue
+            removed = []
+            for f in old.glob("optimizer_state_*"):
+                f.unlink()
+                removed.append(f.name)
+            old_orbax_opt = old / "orbax" / "optimizer"
+            if old_orbax_opt.is_dir():
+                removed.extend(
+                    p.relative_to(old).as_posix()
+                    for p in old_orbax_opt.rglob("*") if p.is_file()
+                )
+                shutil.rmtree(old_orbax_opt)
+            if removed:
+                # keep the pruned checkpoint valid in the eyes of the
+                # fallback scanner: its manifest must not list files
+                # this deliberate pruning removed
+                from ..resilience import prune_manifest_entries
+
+                prune_manifest_entries(old, removed)
 
     def _save_orbax(self, step_dir: Path, viewed_opt: OptimizerState) -> None:
         """Tensorstore-backed sharded save: every host writes only its own
@@ -681,15 +888,79 @@ class BaseTrainer:
         )
 
     def load_checkpoint(self, dir: Optional[Path | str] = None) -> bool:
+        """Verified restore with fallback: candidates are tried in
+        preference order (a valid ``latest`` pointer first, then every
+        ``global_step*`` newest-first); each must pass manifest
+        verification and actually load — corrupt or torn ones are
+        skipped with an exact reason, so a run resumes from the most
+        recent VALID state instead of crashing on a rotten one.
+        ``trainer.strict_checkpoint_load`` turns any skip into an error.
+        """
         base = Path(dir or self.config.load_dir)
-        latest_file = base / "latest"
-        if latest_file.is_file():
-            step_dir = base / latest_file.read_text().strip()
-        elif (base / "context.json").is_file() or list(base.glob("model_state_layer_*.npz")):
-            step_dir = base
-        else:
+        strict = self.config.strict_checkpoint_load
+        candidates = checkpoint_candidates(base)
+        if not candidates:
             logger.warning(f"no checkpoint found at {base}")
             return False
+        skipped: List[str] = []
+        for step_dir in candidates:
+            problems = verify_checkpoint(
+                step_dir, deep=self.config.deep_checkpoint_verification
+            )
+            if problems:
+                line = f"{step_dir.name}: {'; '.join(problems)}"
+                if strict:
+                    raise CheckpointCorruptionError(
+                        f"checkpoint verification failed (strict mode): {line}"
+                    )
+                logger.warning(f"skipping invalid checkpoint {line}")
+                skipped.append(line)
+                continue
+            try:
+                # a TRANSIENT read error must not demote a checkpoint
+                # that just passed verification — retry the (idempotent)
+                # load before treating the OSError as corruption
+                retry_io(
+                    lambda d=step_dir: self._load_step_dir(d),
+                    attempts=self.config.io_retry_attempts,
+                    base_delay=self.config.io_retry_backoff_seconds,
+                    retry_on=(OSError,),
+                    what=f"checkpoint load {step_dir.name}",
+                )
+            except _CORRUPT_LOAD_ERRORS as e:
+                # disk-level corruption the manifest could not vouch
+                # against (legacy manifest-less checkpoints, torn orbax
+                # trees). Config/shape mismatches, OOMs and assertion
+                # errors are NOT in this tuple — those abort, falling
+                # back would silently load the wrong science.
+                line = f"{step_dir.name}: load failed ({type(e).__name__}: {e})"
+                if strict:
+                    raise
+                logger.warning(f"skipping unreadable checkpoint {line}")
+                skipped.append(line)
+                continue
+            if skipped:
+                logger.warning(
+                    f"resumed from {step_dir.name} after skipping "
+                    f"{len(skipped)} checkpoint(s): " + " | ".join(skipped)
+                )
+            return True
+        logger.warning(
+            f"no valid checkpoint under {base}; skipped: " + " | ".join(skipped)
+        )
+        return False
+
+    def _load_step_dir(self, step_dir: Path) -> None:
+        manifest = read_manifest(step_dir)
+        if manifest is not None and manifest.get("config_fingerprint"):
+            current = self._config_fingerprint()
+            if current is not None and current != manifest["config_fingerprint"]:
+                logger.warning(
+                    f"config fingerprint changed since {step_dir.name} was "
+                    f"saved ({manifest['config_fingerprint']} -> {current}); "
+                    "expected for finetunes/topology changes, suspicious "
+                    "for a plain resume"
+                )
         from ..checkpoint.orbax_backend import orbax_model_valid
 
         orbax_dir_present = (step_dir / "orbax").is_dir()
@@ -698,7 +969,7 @@ class BaseTrainer:
             # a crashed orbax save must not shadow valid npz files in the
             # same step dir (and must fail loudly when nothing else exists)
             if not list(step_dir.glob("model_state_layer_*.npz")):
-                raise RuntimeError(
+                raise CheckpointCorruptionError(
                     f"{step_dir / 'orbax'} exists but holds no committed orbax "
                     "checkpoint (torn save?) and no npz files are present"
                 )
@@ -783,7 +1054,6 @@ class BaseTrainer:
         if self.config.load_context:
             self.context.load_checkpoint(step_dir)
         logger.info(f"loaded checkpoint {step_dir}")
-        return True
 
 
 def _maybe_float(v):

@@ -24,16 +24,24 @@ except Exception:
     pass
 
 # persistent XLA compilation cache: the suite is compile-dominated on a
-# small host, and repeat runs (CI, local loops) hit the cache instead
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.environ.get("SCALING_TPU_TEST_CACHE", "/tmp/scaling_tpu_test_jaxcache"),
+# small host, and repeat runs (CI, local loops) hit the cache instead.
+# SCALING_TPU_TEST_CACHE=off disables it entirely — on some containers
+# (old kernel/glibc + jax 0.4.x CPU) executables DESERIALIZED from this
+# cache mis-execute (NaN losses, heap corruption, hard aborts: the known
+# tier-1 abort in test_checkpoint_resume_loss_exactness is exactly a
+# cache read-back on the resumed trainer's re-jit of the same step).
+# Subprocess-isolated tests (tests/core/subproc.py) run with the cache
+# off: cold compiles, correct executables.
+_cache_dir = os.environ.get(
+    "SCALING_TPU_TEST_CACHE", "/tmp/scaling_tpu_test_jaxcache"
 )
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-try:
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-except Exception:
-    pass
+if _cache_dir.lower() not in ("off", "none", "0", ""):
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,71 @@
+"""``run_in_subprocess`` — isolate a test in a fresh pytest process.
+
+Some full-trainer tests can take the whole pytest process down with a
+hard XLA CPU abort on constrained hosts (ISSUE 3 satellite: the known
+container abort in ``test_checkpoint_resume_loss_exactness`` kills the
+run mid-suite, so nothing after it ever reports). Decorated tests
+re-invoke ONLY themselves in a child pytest; a crash/abort there becomes
+an ordinary failure here, and tier-1 reports the remaining suite instead
+of dying. On healthy hosts the child passes and the wrapper is just
+process overhead.
+
+The decorated test must take ``request`` as a parameter (the wrapper
+needs the node id). Child runs are detected via an env flag, so the
+decorator is inert inside the child.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[2]
+ENV_FLAG = "SCALING_TPU_IN_TEST_SUBPROCESS"
+
+
+def run_in_subprocess(timeout: float = 600):
+    """Decorator factory: run this test alone in a child pytest."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(**kwargs):
+            if os.environ.get(ENV_FLAG) == "1":
+                return fn(**kwargs)
+            nodeid = kwargs["request"].node.nodeid
+            cmd = [
+                sys.executable, "-m", "pytest", "-q", "-x", "--runslow",
+                "-p", "no:cacheprovider", "-p", "no:randomly", nodeid,
+            ]
+            try:
+                # SCALING_TPU_TEST_CACHE=off: the child cold-compiles
+                # instead of reading the persistent XLA cache — cache
+                # read-back is exactly what hard-aborts these tests on
+                # the known-bad container (see tests/conftest.py)
+                p = subprocess.run(
+                    cmd, cwd=REPO,
+                    env={**os.environ, ENV_FLAG: "1",
+                         "SCALING_TPU_TEST_CACHE": "off"},
+                    capture_output=True, text=True, timeout=timeout,
+                )
+            except subprocess.TimeoutExpired:
+                pytest.fail(
+                    f"subprocess-isolated test timed out after {timeout}s: "
+                    f"{nodeid}",
+                    pytrace=False,
+                )
+            if p.returncode != 0:
+                tail = (p.stdout + "\n" + p.stderr)[-4000:]
+                pytest.fail(
+                    f"subprocess-isolated test failed "
+                    f"(rc={p.returncode}): {nodeid}\n{tail}",
+                    pytrace=False,
+                )
+
+        return wrapper
+
+    return deco

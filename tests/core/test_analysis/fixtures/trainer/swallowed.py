@@ -1,0 +1,64 @@
+"""Seeded STA007 violations — swallowed-exception patterns in a
+``trainer/`` path (the rule's directory allowlist). Line numbers are
+asserted by tests/core/test_analysis/test_lint.py; keep edits additive
+at the bottom."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def swallow_pass(fn):
+    try:
+        fn()
+    except Exception:  # STA007: broad catch, nothing surfaces
+        pass
+
+
+def swallow_bare(fn):
+    try:
+        fn()
+    except:  # noqa: E722  # STA007: bare except, nothing surfaces
+        return None
+
+
+def swallow_bound_unused(fn):
+    try:
+        fn()
+    except BaseException as e:  # STA007: bound but never used
+        return -1
+
+
+def ok_logged(fn):
+    try:
+        fn()
+    except Exception as e:
+        logger.warning(f"fn failed: {e}")
+
+
+def ok_reraised(fn):
+    try:
+        fn()
+    except Exception:
+        raise
+
+
+def ok_bound_used(queue, fn):
+    try:
+        fn()
+    except BaseException as e:
+        queue.put(e)  # propagated to a consumer: not swallowed
+
+
+def ok_narrow(fn):
+    try:
+        fn()
+    except FileNotFoundError:  # narrow type: out of STA007 scope
+        pass
+
+
+def suppressed_swallow(fn):
+    try:
+        fn()
+    except Exception:  # sta: disable=STA007
+        pass

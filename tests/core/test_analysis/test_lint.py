@@ -12,8 +12,9 @@ from scaling_tpu.analysis.lint import RULES, lint_paths
 REPO = Path(__file__).resolve().parents[3]
 FIXTURES = Path(__file__).resolve().parent / "fixtures"
 
-# (rule, line) pairs seeded in fixtures/nn/violations.py — line numbers are
-# part of the fixture's contract (edits there stay additive at the bottom)
+# (rule, line) pairs seeded in fixtures/nn/violations.py and
+# fixtures/trainer/swallowed.py — line numbers are part of the fixtures'
+# contract (edits there stay additive at the bottom)
 EXPECTED = [
     ("STA001", 17),   # if jnp.any(...)
     ("STA002", 24),   # np.tanh on traced
@@ -24,8 +25,14 @@ EXPECTED = [
     ("STA005", 49),   # mutable default
     ("STA006", 55),   # astype(jnp.float16)
     ("STA001", 64),   # branch inside lax.scan body
+    ("STA007", 14),   # except Exception: pass
+    ("STA007", 21),   # bare except, nothing surfaces
+    ("STA007", 28),   # except BaseException as e, e unused
 ]
-SUPPRESSED = [("STA003", 60)]  # sta: disable=STA003
+SUPPRESSED = [
+    ("STA003", 60),  # sta: disable=STA003
+    ("STA007", 63),  # sta: disable=STA007
+]
 
 
 @pytest.fixture(scope="module")
@@ -114,10 +121,31 @@ def test_rule_table_is_stable():
     """Rule IDs are a public contract (suppression comments, docs,
     golden reports reference them)."""
     assert set(RULES) == {
-        "STA001", "STA002", "STA003", "STA004", "STA005", "STA006"
+        "STA001", "STA002", "STA003", "STA004", "STA005", "STA006", "STA007"
     }
     for rule, (severity, _) in RULES.items():
         assert severity in ("error", "warning"), rule
+
+
+def test_swallowed_exception_only_flagged_in_scope_dirs(tmp_path):
+    """STA007 is scoped to the fault-surfacing layers (trainer/,
+    checkpoint/, data/, resilience/); the same code outside them is
+    legal (ISSUE 3 satellite)."""
+    from scaling_tpu.analysis.lint import lint_file
+
+    src = (
+        "def f(fn):\n"
+        "    try:\n"
+        "        fn()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    assert _lint_source(tmp_path, src) == []  # not under a scope dir
+    d = tmp_path / "trainer"
+    d.mkdir()
+    f2 = d / "mod.py"
+    f2.write_text(src)
+    assert [f.rule for f in lint_file(f2, root=tmp_path)] == ["STA007"]
 
 
 def test_findings_are_json_serializable(fixture_findings):

@@ -1,0 +1,162 @@
+"""Standalone training subprocess for the resilience e2e tests.
+
+NOT collected by pytest. Runs the MLP example SINGLE-DEVICE (no
+8-virtual-device mesh — the parent controls faults via the
+``SCALING_TPU_FAULTS`` env var and may SIGKILL this process at an exact
+checkpoint write, so the child must be a realistic standalone trainer,
+not a pytest harness).
+
+Usage: ``python tests/core/test_resilience/resilience_script.py SPEC.json``
+
+Spec keys:
+  workdir        run directory (checkpoints under <workdir>/ckpt)
+  steps          train_iterations
+  save_interval  checkpoint every N steps
+  resume         bool: point load_dir at <workdir>/ckpt (auto-resume)
+  restart_budget int: run via run_with_resume with this budget (default
+                 0 = plain run_training)
+  nonfinite_budget  optional int -> trainer.max_consecutive_nonfinite
+  losses_path    jsonl file appended per fetched step (flushed per line,
+                 so a SIGKILL keeps the partial record)
+  result_path    json written on clean exit {iterations, resumed_from}
+
+Exit codes: 0 clean, 42 NonFiniteLossError (after its save), anything
+else is a real failure. A SIGKILL mid-save shows up as -9 to the parent.
+"""
+
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[3]
+
+
+def main() -> int:
+    spec = json.loads(Path(sys.argv[1]).read_text())
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # run SINGLE-device even when launched from the 8-virtual-device
+    # pytest harness: a realistic standalone dp=1 trainer, and the forced
+    # multi-device CPU mesh is unstable on constrained hosts
+    import re as _re
+
+    os.environ["XLA_FLAGS"] = _re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        os.environ.get("XLA_FLAGS", ""),
+    ).strip()
+    sys.path.insert(0, str(REPO))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # deliberately NO persistent compilation cache: on known-bad
+    # containers cache READ-BACK mis-executes (NaN losses, heap
+    # corruption — see tests/conftest.py); every arm cold-compiles the
+    # tiny MLP instead, trading ~15s for correct executables
+
+    from examples.mlp_example.config import MLPConfig
+    from examples.mlp_example.context import MLPContext
+    from examples.mlp_example.data import MNISTDataset
+    from examples.mlp_example.model import init_model, init_optimizer, loss_function
+    from examples.mlp_example.train import batch_to_model_input
+    from scaling_tpu.resilience import NonFiniteLossError, run_with_resume
+    from scaling_tpu.topology import Topology
+    from scaling_tpu.trainer import BaseTrainer
+
+    workdir = Path(spec["workdir"])
+    workdir.mkdir(parents=True, exist_ok=True)
+    ckpt_dir = workdir / "ckpt"
+    losses_path = Path(spec["losses_path"])
+    result_path = Path(spec["result_path"])
+    resumed_from = {"value": None}
+
+    def make_config():
+        return MLPConfig.from_dict({
+            "topology": {
+                "model_parallel_size": 1,
+                "pipe_parallel_size": 1,
+                "data_parallel_size": 1,
+                "micro_batch_size": 32,
+                "gradient_accumulation_steps": 1,
+            },
+            "optimizer": {"gradient_clipping": 1.0},
+            "learning_rate_scheduler": {
+                "learning_rate": 0.01,
+                "learning_rate_decay_iters": 100,
+            },
+            "architecture": {"n_hidden_layers": 2, "hidden_dim": 64},
+            "trainer": {
+                "train_iterations": spec["steps"],
+                "seed": 42,
+                "save_dir": str(ckpt_dir),
+                "save_interval": spec["save_interval"],
+                "load_dir": str(ckpt_dir) if spec.get("resume") else None,
+                "assert_checkpoint_loaded": False,
+                "delete_past_optimizer_states": False,
+                "max_consecutive_nonfinite": spec.get("nonfinite_budget"),
+            },
+            "logger": {"log_dir": None},
+        })
+
+    def build_trainer():
+        config = make_config()
+        topology = Topology(config.topology)
+        context = MLPContext(config=config, topology=topology)
+        module = init_model(config, topology)
+        optimizer = init_optimizer(config, module, topology)
+        dataset = MNISTDataset(train=True, seed=config.trainer.seed)
+        dataset.xs = dataset.xs[:512]
+        dataset.ys = dataset.ys[:512]
+        dataset.set_seed(config.trainer.seed)
+        trainer = BaseTrainer(
+            config=config.trainer,
+            context=context,
+            parallel_module=module,
+            optimizer=optimizer,
+            loss_function=loss_function,
+            dataset=dataset,
+            batch_to_model_input=batch_to_model_input,
+        )
+        # chain check: a pre-existing SIGTERM handler must keep firing
+        import signal
+
+        def mark_chained(signum, frame):
+            (workdir / "CHAINED").write_text("1")
+
+        signal.signal(signal.SIGTERM, mark_chained)
+        trainer.install_preemption_handler()
+        trainer.initialize(load_checkpoint=config.trainer.load_dir is not None)
+        resumed_from["value"] = trainer.context.iterations
+        return trainer
+
+    def record_loss(_trainer, output, metrics):
+        with open(losses_path, "a") as f:
+            f.write(json.dumps({
+                "step": _trainer.context.iterations, "loss": output.loss,
+            }) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        return metrics
+
+    try:
+        trainer = run_with_resume(
+            build_trainer,
+            restart_budget=spec.get("restart_budget", 0),
+            log_metrics_fn=record_loss,
+        )
+    except NonFiniteLossError as e:
+        print(f"NONFINITE_ABORT: {e}")
+        result_path.write_text(json.dumps({
+            "exit": "nonfinite", "resumed_from": resumed_from["value"],
+        }))
+        return 42
+    result_path.write_text(json.dumps({
+        "exit": "ok",
+        "iterations": trainer.context.iterations,
+        "resumed_from": resumed_from["value"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

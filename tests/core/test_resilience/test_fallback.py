@@ -1,0 +1,98 @@
+"""Verified-restore selection logic (ISSUE 3): the ``latest`` pointer is
+honored when valid, corrupt/truncated candidates are skipped newest-first
+with exact reasons, strict mode raises instead of falling back, and the
+scanner ignores staging debris. Pure host I/O — the full trainer-level
+load loop is exercised end-to-end by test_crash_resume.py."""
+
+import pytest
+
+from scaling_tpu.resilience import (
+    scan_step_dirs,
+    select_checkpoint,
+    verify_checkpoint,
+    write_manifest,
+)
+from scaling_tpu.resilience.manifest import CheckpointCorruptionError
+
+
+def _valid_step(base, n):
+    d = base / f"global_step{n}"
+    d.mkdir(parents=True)
+    (d / "model_state_layer_0_L.npz").write_bytes(b"w" * (50 + n))
+    (d / "context.json").write_text('{"iterations": %d}' % n)
+    write_manifest(d, n)
+    return d
+
+
+def test_scan_orders_newest_first_and_ignores_debris(tmp_path):
+    for n in (3, 12, 6):
+        _valid_step(tmp_path, n)
+    (tmp_path / ".tmp-global_step15").mkdir()  # staging debris
+    (tmp_path / "not_a_step").mkdir()
+    assert [s for s, _ in scan_step_dirs(tmp_path)] == [12, 6, 3]
+
+
+def test_select_honors_valid_latest_pointer(tmp_path):
+    """Tooling deliberately repoints ``latest`` at older steps (replay
+    workflows); a VALID pointer target wins over newer valid dirs."""
+    for n in (3, 6):
+        _valid_step(tmp_path, n)
+    (tmp_path / "latest").write_text("global_step3")
+    chosen, skipped = select_checkpoint(tmp_path)
+    assert chosen.name == "global_step3" and skipped == []
+
+
+def test_select_falls_back_from_corrupt_latest(tmp_path):
+    for n in (3, 6, 9):
+        _valid_step(tmp_path, n)
+    (tmp_path / "latest").write_text("global_step9")
+    f = tmp_path / "global_step9" / "model_state_layer_0_L.npz"
+    f.write_bytes(f.read_bytes()[:10])  # truncate the pointed checkpoint
+    chosen, skipped = select_checkpoint(tmp_path)
+    assert chosen.name == "global_step6"
+    assert len(skipped) == 1 and "global_step9" in skipped[0]
+    assert "truncated" in skipped[0]  # the skip log says exactly why
+
+
+def test_select_skips_multiple_invalid_candidates(tmp_path):
+    for n in (3, 6, 9):
+        _valid_step(tmp_path, n)
+    # 9: bad digest under a manifest; 6: listed file missing
+    f9 = tmp_path / "global_step9" / "model_state_layer_0_L.npz"
+    f9.write_bytes(b"x" * f9.stat().st_size)
+    (tmp_path / "global_step6" / "model_state_layer_0_L.npz").unlink()
+    chosen, skipped = select_checkpoint(tmp_path)
+    assert chosen.name == "global_step3"
+    assert len(skipped) == 2
+
+
+def test_select_missing_latest_target_falls_back_to_scan(tmp_path):
+    _valid_step(tmp_path, 3)
+    (tmp_path / "latest").write_text("global_step99")  # crash-lost dir
+    chosen, _ = select_checkpoint(tmp_path)
+    assert chosen.name == "global_step3"
+
+
+def test_select_strict_raises_instead_of_falling_back(tmp_path):
+    for n in (3, 6):
+        _valid_step(tmp_path, n)
+    f = tmp_path / "global_step6" / "model_state_layer_0_L.npz"
+    f.write_bytes(f.read_bytes()[:5])
+    (tmp_path / "latest").write_text("global_step6")
+    with pytest.raises(CheckpointCorruptionError, match="strict"):
+        select_checkpoint(tmp_path, strict=True)
+
+
+def test_select_nothing_valid_returns_none(tmp_path):
+    d = _valid_step(tmp_path, 3)
+    (d / "model_state_layer_0_L.npz").unlink()
+    chosen, skipped = select_checkpoint(tmp_path)
+    assert chosen is None and len(skipped) == 1
+
+
+def test_verify_problems_name_file_and_cause(tmp_path):
+    d = _valid_step(tmp_path, 3)
+    f = d / "model_state_layer_0_L.npz"
+    f.write_bytes(f.read_bytes()[:7])
+    (problem,) = verify_checkpoint(d)
+    assert "model_state_layer_0_L.npz" in problem and "truncated" in problem

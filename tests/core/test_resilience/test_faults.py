@@ -1,0 +1,242 @@
+"""Unit coverage for the fault-injection plan and the in-loop guards
+(ISSUE 3): FaultPlan spec parsing + windows, retry-with-backoff,
+dataloader read retry against injected transient IOErrors, the
+step-stall watchdog, the async-writer backpressure fix, and SIGTERM
+handler chaining. Everything here is host-side — no jitted compute."""
+
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from scaling_tpu.resilience import (
+    FaultPlan,
+    InjectedFault,
+    StepStallWatchdog,
+    dump_thread_stacks,
+    get_fault_plan,
+    retry_io,
+    set_fault_plan,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    yield
+    set_fault_plan(None)
+
+
+# ------------------------------------------------------------- FaultPlan
+def test_fault_plan_spec_windows():
+    plan = FaultPlan("data.read=fail@3x2,ckpt.write=corrupt")
+    # hits 1,2 pass; 3,4 fail; 5 passes again
+    assert plan.fire("data.read") is None
+    assert plan.fire("data.read") is None
+    with pytest.raises(InjectedFault):
+        plan.fire("data.read")
+    with pytest.raises(InjectedFault):
+        plan.fire("data.read")
+    assert plan.fire("data.read") is None
+    # advisory actions return their name; unknown points are counters
+    assert plan.fire("ckpt.write") == "corrupt"
+    assert plan.fire("never.armed") is None
+    assert plan.hits("never.armed") == 1
+
+
+def test_fault_plan_infinite_window_and_nan():
+    plan = FaultPlan("step.nan_grads=nan@2x*")
+    assert plan.fire("step.nan_grads") is None
+    for _ in range(5):
+        assert plan.fire("step.nan_grads") == "nan"
+
+
+def test_fault_plan_rejects_bad_specs():
+    with pytest.raises(ValueError, match="bad fault spec"):
+        FaultPlan("ckpt.write")
+    with pytest.raises(ValueError, match="unknown fault action"):
+        FaultPlan("ckpt.write=explode")
+
+
+def test_empty_plan_is_noop_counter():
+    plan = FaultPlan("")
+    assert plan.fire("ckpt.write") is None
+    assert plan.hits("ckpt.write") == 1
+
+
+def test_corrupt_file_truncates(tmp_path):
+    f = tmp_path / "x.bin"
+    f.write_bytes(b"a" * 100)
+    FaultPlan.corrupt_file(f)
+    assert f.stat().st_size == 50
+
+
+# -------------------------------------------------------------- retry_io
+def test_retry_io_recovers_from_transient_failures():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise IOError("transient")
+        return "ok"
+
+    assert retry_io(flaky, attempts=3, base_delay=0.001) == "ok"
+    assert calls["n"] == 3
+
+
+def test_retry_io_reraises_after_budget():
+    def always():
+        raise IOError("persistent")
+
+    with pytest.raises(IOError, match="persistent"):
+        retry_io(always, attempts=2, base_delay=0.001)
+
+
+def test_retry_io_does_not_catch_unrelated_errors():
+    def boom():
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        retry_io(boom, attempts=3, base_delay=0.001)
+
+
+# ------------------------------------------------------ dataloader retry
+def _tiny_loader(retry_attempts):
+    from scaling_tpu.data import BaseDataset, DataLoader
+    from scaling_tpu.topology import Topology, TopologyConfig
+
+    class Counting(BaseDataset):
+        def ident(self):
+            return "counting"
+
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            return np.asarray([i], dtype=np.int32)
+
+        def set_seed(self, seed, shuffle=True):
+            self.seed = seed
+
+        def collate(self, batch):
+            return np.stack(batch)
+
+    topo = Topology(TopologyConfig.from_dict({
+        "model_parallel_size": 1, "pipe_parallel_size": 1,
+        "data_parallel_size": 1, "micro_batch_size": 4,
+        "gradient_accumulation_steps": 1,
+    }))
+    return DataLoader(
+        seed=7, consumed_samples=0, dataset=Counting(seed=7), topology=topo,
+        shuffle=False, retry_attempts=retry_attempts, retry_backoff=0.001,
+    )
+
+
+def test_dataloader_read_retries_injected_ioerrors(devices):
+    set_fault_plan(FaultPlan("data.read=fail@1x2"))
+    loader = _tiny_loader(retry_attempts=3)
+    batch = next(loader)  # two injected failures, third attempt lands
+    assert batch.shape == (4, 1)
+    assert get_fault_plan().hits("data.read") == 3
+    # the retried read did not skip samples: dp=1, no shuffle -> 0..3
+    assert batch.ravel().tolist() == [0, 1, 2, 3]
+
+
+def test_dataloader_read_raises_when_budget_exhausted(devices):
+    set_fault_plan(FaultPlan("data.read=fail@1x99"))
+    loader = _tiny_loader(retry_attempts=2)
+    with pytest.raises(InjectedFault):
+        next(loader)
+
+
+def test_memory_map_span_read_not_doubly_retried(tmp_path):
+    """Retry + the data.read fault point live at ONE layer (the
+    DataLoader); the raw span read must not consume fault hits or
+    multiply retry budgets underneath it."""
+    from scaling_tpu.data import MemoryMapDataset, MemoryMapDatasetBuilder
+
+    with MemoryMapDatasetBuilder(tmp_path / "ds") as b:
+        b.add(np.arange(10, dtype=np.int32))
+    set_fault_plan(FaultPlan("data.read=fail@1x1"))
+    ds = MemoryMapDataset(tmp_path / "ds")
+    span = ds.read_span(2, 5)  # would raise if read_span fired the point
+    assert span.tolist() == [2, 3, 4, 5, 6]
+    assert get_fault_plan().hits("data.read") == 0
+
+
+# -------------------------------------------------------------- watchdog
+def test_watchdog_fires_once_per_stall_and_resets_on_beat():
+    stalls = []
+    wd = StepStallWatchdog(
+        timeout_s=0.15, on_stall=lambda step, el: stalls.append((step, el)),
+        poll_interval_s=0.02,
+    )
+    wd.start()
+    try:
+        wd.beat(3)
+        time.sleep(0.4)  # one stall, reported once despite many polls
+        assert len(stalls) == 1 and stalls[0][0] == 3
+        wd.beat(4)
+        time.sleep(0.05)  # beat arrived in time: no new stall yet
+        assert len(stalls) == 1
+        time.sleep(0.4)  # a second distinct stall after the new beat
+        assert len(stalls) == 2 and stalls[1][0] == 4
+    finally:
+        wd.stop()
+
+
+def test_dump_thread_stacks_names_threads():
+    out = dump_thread_stacks()
+    assert "MainThread" in out
+    assert "test_dump_thread_stacks_names_threads" in out
+
+
+# ------------------------------------- async writer backpressure (S1 fix)
+def test_async_writer_backpressure_drain_defers_failure():
+    """A writer failure must NOT re-raise on the submitting thread when
+    the backpressure drain touches the failed future; it re-raises from
+    wait(), later tasks of the save are skipped, and the writer is
+    reusable afterwards."""
+    from scaling_tpu.checkpoint import AsyncCheckpointWriter
+
+    ran = []
+
+    def fail():
+        raise IOError("disk gone")
+
+    def ok(tag):
+        ran.append(tag)
+
+    w = AsyncCheckpointWriter(max_queued=1)
+    w.submit(fail)
+    # each submit may drain the (failed) predecessor — none may raise here
+    for i in range(4):
+        w.submit(ok, i)
+    with pytest.raises(IOError, match="disk gone"):
+        w.wait()
+    assert ran == []  # every later task of the failed save was skipped
+    # the failure is consumed: the next save goes through
+    w.submit(ok, "after")
+    w.wait()
+    assert ran == ["after"]
+    w.close()
+
+
+# ------------------------------------------------ SIGTERM handler chain
+def test_preemption_handler_chains_previous_handler():
+    from scaling_tpu.trainer import BaseTrainer
+
+    t = BaseTrainer.__new__(BaseTrainer)  # handler only touches _preempted
+    seen = []
+    original = signal.getsignal(signal.SIGTERM)
+    try:
+        signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+        t.install_preemption_handler()
+        import os
+
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert t._preempted is True
+        assert seen == [signal.SIGTERM]  # previous handler still ran
+    finally:
+        signal.signal(signal.SIGTERM, original)

@@ -1,0 +1,178 @@
+"""Manifest + atomic-commit unit coverage (ISSUE 3): digests round-trip,
+verification catches truncation / bit rot / missing files / future
+schemas, legacy manifest-less checkpoints stay accepted, deliberate
+optimizer pruning keeps the manifest honest, and the commit protocol
+stages-then-renames with stale-debris sweeping. Pure host I/O — no jax."""
+
+import json
+
+import pytest
+
+from scaling_tpu.resilience import (
+    CheckpointCommit,
+    prune_manifest_entries,
+    verify_checkpoint,
+    write_manifest,
+)
+from scaling_tpu.resilience.manifest import (
+    MANIFEST_NAME,
+    CheckpointCorruptionError,
+    crc32_bytes,
+    crc32_file,
+    read_manifest,
+)
+
+
+def _fake_ckpt(dir, files=("model_state_layer_0_L.npz", "context.json")):
+    dir.mkdir(parents=True, exist_ok=True)
+    for i, name in enumerate(files):
+        (dir / name).write_bytes(bytes([i]) * (100 + i))
+    return dir
+
+
+def test_manifest_roundtrip_verifies_clean(tmp_path):
+    step = _fake_ckpt(tmp_path / "global_step3")
+    write_manifest(step, 3, config_fingerprint="abcd")
+    assert verify_checkpoint(step) == []
+    m = read_manifest(step)
+    assert m["step"] == 3 and m["config_fingerprint"] == "abcd"
+    assert set(m["files"]) == {"model_state_layer_0_L.npz", "context.json"}
+
+
+def test_recorded_digests_override_disk_scan(tmp_path):
+    """Digests recorded from the INTENDED bytes win over a disk re-read:
+    corruption introduced during/after the write is caught on verify."""
+    step = _fake_ckpt(tmp_path / "global_step3")
+    f = step / "model_state_layer_0_L.npz"
+    data = f.read_bytes()
+    write_manifest(step, 3, recorded={
+        "model_state_layer_0_L.npz": (len(data), crc32_bytes(data)),
+    })
+    assert verify_checkpoint(step) == []
+    f.write_bytes(data[: len(data) // 2])  # torn after digest was taken
+    problems = verify_checkpoint(step)
+    assert len(problems) == 1 and "truncated" in problems[0]
+
+
+def test_verify_detects_bad_digest_same_size(tmp_path):
+    step = _fake_ckpt(tmp_path / "global_step3")
+    write_manifest(step, 3)
+    f = step / "context.json"
+    flipped = bytearray(f.read_bytes())
+    flipped[0] ^= 0xFF  # same size, different bytes
+    f.write_bytes(bytes(flipped))
+    problems = verify_checkpoint(step)
+    assert len(problems) == 1 and "crc32" in problems[0]
+    # shallow verification (size only) cannot see it — documented tradeoff
+    assert verify_checkpoint(step, deep=False) == []
+
+
+def test_verify_detects_missing_listed_file(tmp_path):
+    step = _fake_ckpt(tmp_path / "global_step3")
+    write_manifest(step, 3)
+    (step / "context.json").unlink()
+    problems = verify_checkpoint(step)
+    assert len(problems) == 1 and "missing" in problems[0]
+
+
+def test_future_schema_rejected(tmp_path):
+    step = _fake_ckpt(tmp_path / "global_step3")
+    write_manifest(step, 3)
+    m = json.loads((step / MANIFEST_NAME).read_text())
+    m["schema_version"] = 99
+    (step / MANIFEST_NAME).write_text(json.dumps(m))
+    assert any("schema" in p for p in verify_checkpoint(step))
+    with pytest.raises(CheckpointCorruptionError, match="schema"):
+        read_manifest(step)
+
+
+def test_legacy_checkpoint_without_manifest_accepted(tmp_path):
+    step = _fake_ckpt(tmp_path / "global_step3")
+    assert verify_checkpoint(step) == []  # loadable, unverified
+    empty = tmp_path / "global_step9"
+    empty.mkdir()
+    assert verify_checkpoint(empty) != []  # nothing recognizable at all
+
+
+def test_prune_keeps_manifest_honest(tmp_path):
+    step = _fake_ckpt(
+        tmp_path / "global_step3",
+        files=("model_state_layer_0_L.npz", "optimizer_state_layer_0.npz",
+               "context.json"),
+    )
+    write_manifest(step, 3)
+    (step / "optimizer_state_layer_0.npz").unlink()
+    # an ABSENT optimizer artifact is pruning, not corruption — operators
+    # legitimately rmtree optimizer state by hand to save disk, so
+    # verification accepts it even before the manifest is rewritten
+    assert verify_checkpoint(step) == []
+    prune_manifest_entries(step, ["optimizer_state_layer_0.npz"])
+    assert verify_checkpoint(step) == []
+    assert "optimizer_state_layer_0.npz" not in read_manifest(step)["files"]
+    assert read_manifest(step)["optimizer_pruned"] is True
+
+
+def test_corrupt_optimizer_artifact_still_detected(tmp_path):
+    """Only ABSENCE of optimizer state is pruning; a present-but-corrupt
+    optimizer file is corruption like any other."""
+    step = _fake_ckpt(
+        tmp_path / "global_step3",
+        files=("model_state_layer_0_L.npz", "optimizer_state_layer_0.npz"),
+    )
+    write_manifest(step, 3)
+    f = step / "optimizer_state_layer_0.npz"
+    f.write_bytes(f.read_bytes()[: f.stat().st_size // 2])
+    problems = verify_checkpoint(step)
+    assert len(problems) == 1 and "optimizer_state_layer_0" in problems[0]
+
+
+def test_crc32_file_matches_bytes(tmp_path):
+    f = tmp_path / "blob"
+    f.write_bytes(b"some checkpoint bytes")
+    size, digest = crc32_file(f)
+    assert size == len(b"some checkpoint bytes")
+    assert digest == crc32_bytes(b"some checkpoint bytes")
+
+
+# ------------------------------------------------------ CheckpointCommit
+def test_commit_stages_then_renames_atomically(tmp_path):
+    base = tmp_path / "ckpt"
+    base.mkdir()
+    commit = CheckpointCommit(base, 6, config_fingerprint="ff00")
+    assert commit.tmp_dir.name.startswith(".tmp-")  # invisible to globs
+    data = b"layer bytes"
+    f = commit.tmp_dir / "model_state_layer_0_L.npz"
+    f.write_bytes(data)
+    commit.record(f, len(data), crc32_bytes(data))
+    assert not commit.final_dir.exists()  # nothing visible before commit
+    commit.finalize()
+    commit.update_latest()
+    assert not commit.tmp_dir.exists()
+    assert verify_checkpoint(commit.final_dir) == []
+    assert (base / "latest").read_text() == "global_step6"
+    assert read_manifest(commit.final_dir)["config_fingerprint"] == "ff00"
+
+
+def test_commit_sweeps_stale_staging_debris(tmp_path):
+    base = tmp_path / "ckpt"
+    base.mkdir()
+    torn = base / ".tmp-global_step4"
+    torn.mkdir()
+    (torn / "partial.npz").write_bytes(b"half")
+    CheckpointCommit(base, 7)  # next save sweeps the crash debris
+    assert not torn.exists()
+
+
+def test_commit_replaces_rereached_step(tmp_path):
+    """Crash recovery re-reaches a step: the recommit must replace the
+    old directory wholesale (no stale-file shadowing)."""
+    base = tmp_path / "ckpt"
+    base.mkdir()
+    old = base / "global_step5"
+    old.mkdir()
+    (old / "stale_orbax_marker").write_bytes(b"old backend debris")
+    commit = CheckpointCommit(base, 5)
+    (commit.tmp_dir / "model_state_layer_0_L.npz").write_bytes(b"new")
+    commit.finalize()
+    assert not (base / "global_step5" / "stale_orbax_marker").exists()
+    assert (base / "global_step5" / "model_state_layer_0_L.npz").read_bytes() == b"new"

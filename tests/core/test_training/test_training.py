@@ -1,12 +1,20 @@
 """Full-lifecycle training tests on the MLP example (the reference's
 ``tests/core/test_training/test_training.py`` pattern): train N steps saving
 mid-run, relaunch from the checkpoint, and assert the losses of the
-remaining steps match EXACTLY."""
+remaining steps match EXACTLY.
+
+Every test here that LOADS a checkpoint runs subprocess-isolated
+(``run_in_subprocess``): on constrained hosts the 8-virtual-device XLA
+CPU restore path can hard-abort the whole pytest process (known
+container abort, ISSUE 3 satellite) — isolation turns that into an
+ordinary failure so the remaining suite still reports."""
 
 import shutil
 
 import numpy as np
 import pytest
+
+from tests.core.subproc import run_in_subprocess
 
 from examples.mlp_example.config import MLPConfig
 from examples.mlp_example.context import MLPContext
@@ -97,7 +105,8 @@ def run_steps(trainer, n):
     (2, 1, True, False),
     (1, 1, False, True),
 ])
-def test_checkpoint_resume_loss_exactness(tmp_path, devices, dp, gas, zero, loss_scaler):
+@run_in_subprocess(timeout=420)
+def test_checkpoint_resume_loss_exactness(request, tmp_path, devices, dp, gas, zero, loss_scaler):
     cfg = make_config(tmp_path, dp=dp, gas=gas, zero=zero, loss_scaler=loss_scaler)
     trainer = build_trainer(cfg)
     losses = run_steps(trainer, 10)
@@ -154,7 +163,8 @@ def test_checkpoint_layout(tmp_path, devices):
 
 
 @pytest.mark.slow
-def test_async_checkpoint_resume_matches_sync(tmp_path, devices):
+@run_in_subprocess(timeout=420)
+def test_async_checkpoint_resume_matches_sync(request, tmp_path, devices):
     """save_checkpoint_async produces byte-equivalent checkpoints: resume
     from an async save reproduces the sync-save training trajectory."""
     cfg_sync = make_config(tmp_path / "sync", train_iterations=6, save_interval=3)
@@ -183,7 +193,8 @@ def test_async_checkpoint_resume_matches_sync(tmp_path, devices):
     )
 
 
-def test_prefetch_matches_synchronous(tmp_path, devices):
+@run_in_subprocess(timeout=420)
+def test_prefetch_matches_synchronous(request, tmp_path, devices):
     """dataloader_prefetch_factor overlaps batch assembly with the device
     step without changing the stream: identical losses, and resume from a
     mid-run checkpoint stays exact (prefetched-but-unconsumed batches are
@@ -216,7 +227,8 @@ def test_prefetch_matches_synchronous(tmp_path, devices):
     )
 
 
-def test_zero3_fsdp_matches_zero1(tmp_path, devices):
+@run_in_subprocess(timeout=420)
+def test_zero3_fsdp_matches_zero1(request, tmp_path, devices):
     """ZeRO stage 3 (FSDP param sharding over the data axis — beyond the
     reference's stage 1): identical training math (GSPMD all-gathers per
     use, reduce-scatters grads), params ACTUALLY sharded (per-device shard
