@@ -22,7 +22,7 @@ STA006    warning   dtype literal that bypasses the configured precision
                     admits bf16/f32 via ``precision`` config only).
 STA007    error     swallowed exception in resilience-critical code
                     (``trainer/``, ``checkpoint/``, ``data/``,
-                    ``resilience/``): a bare ``except:`` /
+                    ``resilience/``, ``runner/``): a bare ``except:`` /
                     ``except Exception`` / ``except BaseException``
                     handler that neither re-raises, logs, nor uses the
                     bound exception — a fault-masking black hole in the
@@ -71,14 +71,16 @@ TRACED_MODULE_DIRS = (
     "models/transformer/layers",
 )
 
-# Directory allowlist for STA007 (ISSUE 3): the layers that stand between
-# a fault and a lost run — an exception silently eaten here is exactly
-# how a torn checkpoint or a dead data mount goes unnoticed for days.
+# Directory allowlist for STA007 (ISSUE 3; runner/ added by ISSUE 4): the
+# layers that stand between a fault and a lost run — an exception silently
+# eaten here is exactly how a torn checkpoint, a dead data mount, or a
+# worker failure the supervisor should have relaunched goes unnoticed.
 SWALLOW_SCOPE_DIRS = (
     "trainer",
     "checkpoint",
     "data",
     "resilience",
+    "runner",
 )
 
 # calls that count as "the handler surfaced the problem"

@@ -29,6 +29,14 @@ _LEVELS = {
 class LoggerConfig(BaseConfig):
     log_level: str = Field("info", description="")
     log_dir: Optional[str] = Field(None, description="directory for per-rank log files")
+    events_path: Optional[str] = Field(
+        None,
+        description="jsonl file for structured lifecycle events "
+        "(supervisor transitions, stall reports, preemption broadcasts) "
+        "— machine-parseable post-mortems instead of stderr scraping. "
+        "The SCALING_TPU_EVENTS_PATH env var overrides/provides this for "
+        "subprocesses",
+    )
     metrics_ranks: Optional[List[int]] = Field(
         None, description="global ranks that record metrics; None -> rank 0 only"
     )
@@ -184,6 +192,39 @@ class _Logger:
 
     def log_config(self, config: BaseConfig) -> None:
         self.info(f"config:\n{config.as_str()}")
+
+    # -------------------------------------------------------------- events
+    def log_event(self, event: str, **fields: Any) -> None:
+        """Structured lifecycle event: one JSON line, append-only.
+
+        Post-mortems of supervised multi-host runs (who died, when the
+        relaunch happened, which host broadcast preemption) must not
+        depend on scraping human-formatted stderr — each event lands as
+        a single flushed JSON object in the events file
+        (the ``SCALING_TPU_EVENTS_PATH`` env var, else
+        ``LoggerConfig.events_path``) and is mirrored to the normal log.
+        Without a configured path only the mirror line is emitted."""
+        import json as _json
+        import os as _os
+        import time as _time
+
+        rec = {"event": event, "ts": _time.time(), **fields}
+        line = _json.dumps(rec, sort_keys=True, default=str)
+        self.info(f"EVENT {line}")
+        # env first: the field doc promises the env var OVERRIDES the
+        # config value (a launcher redirecting a subprocess whose config
+        # already declares a path must win)
+        path = _os.environ.get("SCALING_TPU_EVENTS_PATH") or (
+            self._config.events_path if self._config is not None else None
+        )
+        if path:
+            try:
+                with open(path, "a") as f:
+                    f.write(line + "\n")
+                    f.flush()
+                    _os.fsync(f.fileno())
+            except OSError as e:
+                self.warning(f"could not append event to {path}: {e!r}")
 
 
 def _is_number(v: Any) -> bool:

@@ -169,6 +169,19 @@ def main(config: TransformerConfig) -> TransformerTrainer:
         batch_to_model_input=batch_to_model_input,
         profiler=Profiler(config.profiler),
     )
+    from ...resilience import controlplane_from_env
+
+    # under the multi-host supervisor every worker finds the control
+    # plane in its environment (SCALING_TPU_CONTROL_DIR/_ADDR); joining
+    # it turns on heartbeats (without which the supervisor would declare
+    # a healthy host hung after the startup grace), the coordinated
+    # preemption drain, and the cross-host commit barrier
+    cp = controlplane_from_env()
+    if cp is not None:
+        trainer.attach_control_plane(
+            cp, shared_save_dir=config.trainer.multihost_shared_save_dir
+        )
+        trainer.install_preemption_handler()
     from ...determined import DeterminedGlue
 
     glue = DeterminedGlue.detect()

@@ -23,6 +23,10 @@ pieces, all host-side (nothing here touches the lowered step program):
   for the most recent checkpoint that passes manifest verification.
 - :mod:`.resume` — ``run_with_resume``: bounded auto-restart from the
   newest valid checkpoint after a recoverable failure.
+- :mod:`.controlplane` — the multi-host supervision channel (ISSUE 4):
+  heartbeats, named barriers with timeouts, and broadcast flags over a
+  shared directory or a coordinator TCP server; the out-of-band signal
+  path beside the XLA collectives that a dead peer leaves hanging.
 
 Import cost matters (subprocess restarts pay it on the reclaim critical
 path), so nothing in this package imports jax at module level.
@@ -31,6 +35,16 @@ See docs/RESILIENCE.md for the operator-facing guide.
 """
 
 from .commit import CheckpointCommit
+from .controlplane import (
+    BarrierTimeout,
+    ControlPlane,
+    FileControlPlane,
+    JobAborted,
+    TcpControlPlane,
+    TcpControlPlaneServer,
+    controlplane_from_env,
+    straggler_table,
+)
 from .faults import FaultPlan, InjectedFault, get_fault_plan, set_fault_plan
 from .guards import (
     NonFiniteGuard,
@@ -51,6 +65,14 @@ from .resume import run_with_resume
 
 __all__ = [
     "CheckpointCommit",
+    "BarrierTimeout",
+    "ControlPlane",
+    "FileControlPlane",
+    "JobAborted",
+    "TcpControlPlane",
+    "TcpControlPlaneServer",
+    "controlplane_from_env",
+    "straggler_table",
     "FaultPlan",
     "InjectedFault",
     "get_fault_plan",

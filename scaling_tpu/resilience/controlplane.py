@@ -1,0 +1,560 @@
+"""Multi-host control plane: heartbeats, named barriers, broadcast flags.
+
+The supervision layer (ISSUE 4) needs a tiny out-of-band channel beside
+the XLA collectives: collectives can tell you *nothing* when a peer host
+is gone — they just hang. The control plane is that channel. Two
+backends with one contract:
+
+- :class:`FileControlPlane` — a shared directory (tests, single-machine
+  fake pods, NFS-backed pods). Heartbeats are atomic file replaces,
+  barriers are arrival files, flags are files. No daemon.
+- :class:`TcpControlPlane` — a line-JSON socket server on the
+  coordinator host (run by the supervisor or host 0) for real pods
+  where the hosts share no filesystem.
+
+Contract (both backends):
+
+- ``heartbeat(step)`` publishes this host's liveness + progress; a
+  SIGKILLed host simply stops publishing.
+- ``peer_heartbeats()`` returns every host's newest record — the
+  supervisor's dead/hung detection and the watchdog's straggler table
+  read this.
+- ``barrier(name, timeout_s)`` blocks until all ``num_hosts`` arrive at
+  ``name``. It raises :class:`BarrierTimeout` when peers never show
+  (the caller must NOT proceed — that is the commit-barrier guarantee)
+  and :class:`JobAborted` as soon as the supervisor raises the abort
+  flag, so survivors of a dead host exit in seconds, not after the
+  full barrier timeout.
+- ``set_flag``/``get_flag`` broadcast small strings: coordinated
+  preemption (``preempt``), supervisor teardown (``abort``).
+
+Barrier names are namespaced per coordinator epoch by construction: the
+supervisor hands every epoch a fresh control-plane root, so a relaunch
+can never observe arrivals from the dead epoch.
+
+Fault points (docs in :mod:`.faults`): ``barrier.timeout`` fires on
+every barrier entry — arm ``kill``/``hang`` to make this host die or
+stall exactly between its work and the rendezvous.
+
+Nothing here imports jax (resilience package rule: subprocess restarts
+pay the import cost on the reclaim critical path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import socket
+import threading
+import time
+from pathlib import Path
+from typing import Dict, Optional
+
+from ..logging import logger
+from .faults import get_fault_plan
+from .guards import retry_io
+
+ENV_CONTROL_DIR = "SCALING_TPU_CONTROL_DIR"
+ENV_CONTROL_ADDR = "SCALING_TPU_CONTROL_ADDR"
+ENV_HOST_ID = "SCALING_TPU_HOST_ID"
+ENV_NUM_HOSTS = "SCALING_TPU_NUM_HOSTS"
+ENV_COORD_EPOCH = "SCALING_TPU_COORD_EPOCH"
+
+PREEMPT_FLAG = "preempt"
+ABORT_FLAG = "abort"
+# raised alongside PREEMPT when the drain was triggered by a step-stall
+# watchdog, not an operator: the supervisor must treat the resulting
+# clean exit as a failure to relaunch, not a finished run
+STALL_FLAG = "stall"
+
+DEFAULT_BARRIER_POLL_S = 0.05
+
+
+class BarrierTimeout(RuntimeError):
+    """Peers never arrived: a host is dead/hung, or the net partitioned."""
+
+
+class JobAborted(RuntimeError):
+    """The supervisor raised the abort flag: stop waiting and exit."""
+
+
+@dataclasses.dataclass
+class HostHeartbeat:
+    host: int
+    step: int
+    status: str
+    wall: float  # publisher's time.time() at publish
+
+    def age(self, now: Optional[float] = None) -> float:
+        return (now if now is not None else time.time()) - self.wall
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class ControlPlane:
+    """Backend-independent surface; see module docstring for semantics."""
+
+    def __init__(self, host_id: int, num_hosts: int):
+        assert 0 <= host_id < num_hosts
+        self.host_id = host_id
+        self.num_hosts = num_hosts
+        self._last_step = 0
+
+    # -- backend hooks --------------------------------------------------
+    def _publish_heartbeat(self, record: HostHeartbeat) -> None:
+        raise NotImplementedError
+
+    def _read_heartbeats(self) -> Dict[int, HostHeartbeat]:
+        raise NotImplementedError
+
+    def _arrive(self, name: str) -> None:
+        raise NotImplementedError
+
+    def _arrived_count(self, name: str) -> int:
+        raise NotImplementedError
+
+    def _prune_barrier(self, name: str) -> None:
+        raise NotImplementedError
+
+    def set_flag(self, name: str, value: str = "1") -> None:
+        raise NotImplementedError
+
+    def get_flag(self, name: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    # -- shared logic ---------------------------------------------------
+    def heartbeat(self, step: int, status: str = "running") -> None:
+        self._last_step = step
+        self._publish_heartbeat(
+            HostHeartbeat(self.host_id, step, status, time.time())
+        )
+
+    def peer_heartbeats(self) -> Dict[int, HostHeartbeat]:
+        """Newest record per host (own host included)."""
+        return self._read_heartbeats()
+
+    def arrive(self, name: str) -> None:
+        """Register arrival at ``name`` WITHOUT waiting.
+
+        For exit paths that will never re-enter the loop (preemption at
+        this boundary): peers may already be parked inside this
+        barrier, and a host that exits without registering would leave
+        them waiting out the full timeout."""
+        self._arrive(name)
+
+    def prune_barrier(self, name: str) -> None:
+        """Drop a barrier's arrival state once no host can ever wait on
+        it again (the lockstep protocol guarantees this for barriers two
+        steps behind). Without pruning, a per-step barrier accrues state
+        for the life of the epoch — millions of entries on a long run."""
+        self._prune_barrier(name)
+
+    def barrier(
+        self,
+        name: str,
+        timeout_s: float,
+        poll_s: float = DEFAULT_BARRIER_POLL_S,
+    ) -> None:
+        """Block until all ``num_hosts`` arrive at ``name``.
+
+        Raises :class:`JobAborted` the moment the abort flag appears
+        (supervisor teardown must not wait out the timeout) and
+        :class:`BarrierTimeout` when the deadline passes with hosts
+        missing."""
+        get_fault_plan().fire("barrier.timeout", path=name)
+        self._arrive(name)
+        deadline = time.monotonic() + timeout_s
+        next_hb = time.monotonic() + 1.0
+        # each poll costs two backend round trips (arrivals + abort
+        # flag) — on the TCP backend, two connections. Lockstep peers
+        # arrive near-simultaneously, so the fast path resolves in the
+        # first poll or two at full responsiveness; a LONG wait (a slow
+        # peer's multi-minute checkpoint write ahead of the commit
+        # barrier) backs off toward 1s so N parked hosts don't hammer
+        # the serial coordinator for the whole write
+        sleep_s = poll_s
+        while True:
+            arrived = self._arrived_count(name)
+            if arrived >= self.num_hosts:
+                return
+            if self.get_flag(ABORT_FLAG) is not None:
+                raise JobAborted(
+                    f"abort flag raised while waiting at barrier {name!r} "
+                    f"({arrived}/{self.num_hosts} arrived)"
+                )
+            if time.monotonic() >= deadline:
+                raise BarrierTimeout(
+                    f"barrier {name!r} timed out after {timeout_s}s: "
+                    f"{arrived}/{self.num_hosts} hosts arrived "
+                    "(a peer is dead, hung, or partitioned)"
+                )
+            if time.monotonic() >= next_hb:
+                # waiting at a barrier is ALIVE — keep the supervisor's
+                # staleness detector pointed at truly wedged hosts
+                self._publish_heartbeat(HostHeartbeat(
+                    self.host_id, self._last_step, f"barrier:{name}",
+                    time.time(),
+                ))
+                next_hb = time.monotonic() + 1.0
+            time.sleep(sleep_s)
+            sleep_s = min(sleep_s * 1.5, 1.0)
+
+
+# ---------------------------------------------------------------- file
+class FileControlPlane(ControlPlane):
+    """Shared-directory backend: atomic file replaces carry every record.
+
+    Layout under ``root``::
+
+        heartbeat/host<K>.json   newest heartbeat per host (atomic replace)
+        barrier/<name>/host<K>   arrival marker files
+        flags/<name>             flag value file
+
+    Writers only ever replace whole files via ``os.replace``, so readers
+    never observe torn records. Works on any filesystem with atomic
+    rename (local disk, NFS close-to-open is fine for these tiny files).
+
+    Every backend op rides :func:`retry_io` (same resilience rule the
+    TCP client applies to its requests): on the documented NFS-backed
+    pod use of this backend, one transient ESTALE/EIO during a
+    per-iteration heartbeat must not crash a healthy worker and burn a
+    restart-budget slot. All ops are idempotent whole-file replaces or
+    reads, so a repeat is safe.
+    """
+
+    def __init__(self, root: Path | str, host_id: int, num_hosts: int):
+        super().__init__(host_id, num_hosts)
+        self.root = Path(root)
+        for sub in ("heartbeat", "barrier", "flags"):
+            (self.root / sub).mkdir(parents=True, exist_ok=True)
+
+    def _atomic_write(self, path: Path, text: str) -> None:
+        # pid AND thread id: the async checkpoint writer refreshes the
+        # heartbeat from a barrier wait while the main loop publishes its
+        # own — same process, two threads, must never share a temp path
+        tmp = path.with_name(
+            f".{path.name}.tmp{os.getpid()}.{threading.get_ident()}"
+        )
+        tmp.write_text(text)
+        os.replace(tmp, path)
+
+    def _publish_heartbeat(self, record: HostHeartbeat) -> None:
+        retry_io(
+            lambda: self._atomic_write(
+                self.root / "heartbeat" / f"host{record.host}.json",
+                json.dumps(record.to_dict()),
+            ),
+            what="heartbeat publish",
+        )
+
+    def _read_heartbeats(self) -> Dict[int, HostHeartbeat]:
+        return retry_io(self._read_heartbeats_once, what="heartbeat read")
+
+    def _read_heartbeats_once(self) -> Dict[int, HostHeartbeat]:
+        out: Dict[int, HostHeartbeat] = {}
+        for f in (self.root / "heartbeat").glob("host*.json"):
+            try:
+                rec = json.loads(f.read_text())
+                # staleness must not compare the PUBLISHER's wall clock
+                # against the reader's: the file mtime comes from ONE
+                # clock (the FS server's) for every record, so
+                # per-publisher skew drops out of the age math — only
+                # the single reader<->server offset remains (NTP-sized)
+                rec["wall"] = f.stat().st_mtime
+                out[int(rec["host"])] = HostHeartbeat(**rec)
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                # a reader racing the writer's very first publish; the
+                # atomic replace makes this transient, never torn
+                logger.debug(f"unreadable heartbeat {f}: {e!r}")
+        return out
+
+    def _barrier_dir(self, name: str) -> Path:
+        # flatten: barrier names may carry ':' / '/' (commit:step-6)
+        safe = name.replace("/", "_").replace(":", "_")
+        return self.root / "barrier" / safe
+
+    def _arrive(self, name: str) -> None:
+        def op():
+            d = self._barrier_dir(name)
+            d.mkdir(parents=True, exist_ok=True)
+            self._atomic_write(d / f"host{self.host_id}", "1")
+
+        retry_io(op, what=f"barrier arrival {name!r}")
+
+    def _arrived_count(self, name: str) -> int:
+        def op():
+            d = self._barrier_dir(name)
+            if not d.is_dir():
+                return 0
+            return sum(1 for _ in d.glob("host*"))
+
+        return retry_io(op, what=f"barrier count {name!r}")
+
+    def _prune_barrier(self, name: str) -> None:
+        # concurrent pruners race benignly: whoever loses sees ENOENT
+        shutil.rmtree(self._barrier_dir(name), ignore_errors=True)
+
+    def set_flag(self, name: str, value: str = "1") -> None:
+        retry_io(
+            lambda: self._atomic_write(self.root / "flags" / name, value),
+            what=f"flag set {name!r}",
+        )
+
+    def get_flag(self, name: str) -> Optional[str]:
+        def op():
+            try:
+                return (self.root / "flags" / name).read_text()
+            except FileNotFoundError:
+                return None  # absent flag — the common case, not an error
+
+        return retry_io(op, what=f"flag read {name!r}")
+
+
+# ----------------------------------------------------------------- tcp
+class TcpControlPlaneServer:
+    """Coordinator-side state holder for :class:`TcpControlPlane`.
+
+    One connection per request, newline-delimited JSON in both
+    directions — trivially robust, and the request rate (a heartbeat +
+    a few barrier polls per host per step; long barrier waits back off
+    to ~1s between polls) is far below any socket limit.
+    Run it on the supervisor or host 0; workers connect with the
+    address from ``SCALING_TPU_CONTROL_ADDR``.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._hb: Dict[int, dict] = {}
+        self._barriers: Dict[str, set] = {}
+        self._flags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.address = f"{host}:{self._sock.getsockname()[1]}"
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, name="controlplane-server", daemon=True
+        )
+        self._thread.start()
+
+    # requests are sub-KiB JSON lines; anything bigger is garbage (a
+    # client streaming bytes with no newline must not buffer unboundedly)
+    MAX_REQUEST_BYTES = 64 * 1024
+
+    def _serve(self) -> None:
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # socket closed under us during shutdown
+            # one short-lived thread per connection: an idle prober that
+            # connects and sends nothing otherwise parks the SERIAL
+            # accept loop for its full 5s read timeout, freezing every
+            # host's heartbeat publish — repeated probes could push a
+            # healthy host past heartbeat_timeout. Threads are bounded
+            # by the read timeout, so a flood drains itself.
+            threading.Thread(
+                target=self._handle_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                conn.settimeout(5.0)
+                data = conn.makefile("r").readline(self.MAX_REQUEST_BYTES)
+                if len(data) >= self.MAX_REQUEST_BYTES and not data.endswith("\n"):
+                    raise ValueError(
+                        f"request line exceeds {self.MAX_REQUEST_BYTES} bytes"
+                    )
+                reply = self._handle(json.loads(data))
+                conn.sendall((json.dumps(reply) + "\n").encode())
+        except Exception as e:
+            # every handler must survive ANY malformed request (stray
+            # port scanner, version-skewed worker sending json without
+            # the expected keys): an uncaught error here would kill the
+            # thread silently and drop the client's reply with no
+            # diagnosis
+            logger.warning(f"control-plane request failed: {e!r}")
+
+    def _handle(self, req: dict) -> dict:
+        with self._lock:
+            op = req.get("op")
+            if op == "hb":
+                rec = dict(req["record"])
+                # receipt-stamp with the SERVER clock: staleness math
+                # must never compare a worker's wall clock against the
+                # supervisor's (skew > heartbeat_timeout would make a
+                # healthy host read as hung forever)
+                rec["wall"] = time.time()
+                self._hb[int(req["host"])] = rec
+                return {"ok": True}
+            if op == "peers":
+                # `now` (server clock) lets the client translate record
+                # walls into its own clock before computing ages
+                return {"ok": True, "peers": list(self._hb.values()),
+                        "now": time.time()}
+            if op == "arrive":
+                self._barriers.setdefault(req["name"], set()).add(
+                    int(req["host"])
+                )
+                return {"ok": True}
+            if op == "count":
+                return {
+                    "ok": True,
+                    "count": len(self._barriers.get(req["name"], ())),
+                }
+            if op == "prune":
+                self._barriers.pop(req["name"], None)
+                return {"ok": True}
+            if op == "set_flag":
+                self._flags[req["name"]] = req["value"]
+                return {"ok": True}
+            if op == "get_flag":
+                return {"ok": True, "value": self._flags.get(req["name"])}
+            return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError as e:
+            logger.debug(f"control-plane server close: {e!r}")
+        self._thread.join(timeout=5)
+
+
+class TcpControlPlane(ControlPlane):
+    """Client for :class:`TcpControlPlaneServer` (``address`` =
+    ``host:port``)."""
+
+    def __init__(self, address: str, host_id: int, num_hosts: int,
+                 connect_timeout_s: float = 5.0):
+        super().__init__(host_id, num_hosts)
+        host, port = address.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._timeout = connect_timeout_s
+
+    def _request_once(self, req: dict) -> dict:
+        with socket.create_connection(self._addr, self._timeout) as conn:
+            conn.sendall((json.dumps(req) + "\n").encode())
+            return json.loads(conn.makefile("r").readline())
+
+    def _request(self, req: dict) -> dict:
+        # every heartbeat/flag check/barrier poll is a fresh connection
+        # against a serial coordinator — a momentary accept-backlog
+        # overflow or reset during a rendezvous burst must not kill a
+        # healthy host (resilience rule: transient I/O gets a bounded
+        # retry). Protocol errors (ok=false) are NOT transient and are
+        # never retried.
+        reply = retry_io(
+            lambda: self._request_once(req),
+            retry_on=(OSError, ValueError),
+            what=f"control-plane request {req.get('op')!r}",
+        )
+        if not reply.get("ok"):
+            raise RuntimeError(f"control-plane request {req} failed: {reply}")
+        return reply
+
+    def _publish_heartbeat(self, record: HostHeartbeat) -> None:
+        self._request(
+            {"op": "hb", "host": record.host, "record": record.to_dict()}
+        )
+
+    def _read_heartbeats(self) -> Dict[int, HostHeartbeat]:
+        reply = self._request({"op": "peers"})
+        # record walls are server-clock receipt stamps; shift them into
+        # THIS clock so HostHeartbeat.age() against local time is sane
+        offset = time.time() - float(reply.get("now") or time.time())
+        out: Dict[int, HostHeartbeat] = {}
+        for r in reply["peers"]:
+            rec = HostHeartbeat(**r)
+            rec.wall += offset
+            out[int(rec.host)] = rec
+        return out
+
+    def _arrive(self, name: str) -> None:
+        self._request({"op": "arrive", "name": name, "host": self.host_id})
+
+    def _arrived_count(self, name: str) -> int:
+        return int(self._request({"op": "count", "name": name})["count"])
+
+    def _prune_barrier(self, name: str) -> None:
+        self._request({"op": "prune", "name": name})
+
+    def set_flag(self, name: str, value: str = "1") -> None:
+        self._request({"op": "set_flag", "name": name, "value": value})
+
+    def get_flag(self, name: str) -> Optional[str]:
+        return self._request({"op": "get_flag", "name": name})["value"]
+
+
+# ------------------------------------------------------------- helpers
+def controlplane_from_env() -> Optional[ControlPlane]:
+    """Build the control plane a launcher described in the environment.
+
+    ``SCALING_TPU_CONTROL_DIR`` selects the file backend,
+    ``SCALING_TPU_CONTROL_ADDR`` (``host:port``) the TCP backend; both
+    need ``SCALING_TPU_HOST_ID`` + ``SCALING_TPU_NUM_HOSTS``. Returns
+    None when nothing is configured (single-host runs pay nothing)."""
+    control_dir = os.environ.get(ENV_CONTROL_DIR)
+    control_addr = os.environ.get(ENV_CONTROL_ADDR)
+    if not control_dir and not control_addr:
+        return None
+    host_id = int(os.environ.get(ENV_HOST_ID, "0"))
+    num_hosts = int(os.environ.get(ENV_NUM_HOSTS, "1"))
+    if control_dir:
+        return FileControlPlane(control_dir, host_id, num_hosts)
+    return TcpControlPlane(control_addr, host_id, num_hosts)
+
+
+def straggler_table(
+    heartbeats: Dict[int, HostHeartbeat],
+    num_hosts: int,
+    stale_after_s: float,
+    now: Optional[float] = None,
+) -> "StragglerReport":
+    """Classify every expected host from its newest heartbeat.
+
+    A host with no heartbeat at all or one older than ``stale_after_s``
+    is *dead* (SIGKILLed processes stop publishing; hung ones stop
+    progressing); the rest are ranked by staleness so the watchdog can
+    tell "peer host 2 is dead" apart from "we are the straggler"."""
+    now = now if now is not None else time.time()
+    rows = []
+    dead = []
+    for host in range(num_hosts):
+        hb = heartbeats.get(host)
+        if hb is None:
+            rows.append((host, None, None, "never-heartbeat"))
+            dead.append(host)
+            continue
+        age = hb.age(now)
+        state = "dead" if age > stale_after_s else hb.status
+        if age > stale_after_s:
+            dead.append(host)
+        rows.append((host, hb.step, age, state))
+    return StragglerReport(rows=rows, dead_hosts=dead)
+
+
+@dataclasses.dataclass
+class StragglerReport:
+    rows: list  # (host, step|None, age_s|None, state)
+    dead_hosts: list
+
+    def render(self) -> str:
+        lines = [f"{'host':>4} {'step':>6} {'hb_age_s':>9} state"]
+        for host, step, age, state in self.rows:
+            lines.append(
+                f"{host:>4} {step if step is not None else '-':>6} "
+                f"{f'{age:.1f}' if age is not None else '-':>9} {state}"
+            )
+        return "\n".join(lines)

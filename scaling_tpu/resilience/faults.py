@@ -30,16 +30,38 @@ point               fired
 ``signal.sigterm``  at the top of every ``run_training`` loop iteration;
                     the ``sigterm`` action delivers a real SIGTERM to
                     this process (exercises the preemption path)
+``host.kill``       at the top of every ``run_training`` loop iteration
+                    (next to ``signal.sigterm``); arm ``kill`` with an
+                    ``@host=K`` selector to crash exactly one host of a
+                    supervised pod at an exact step boundary
+``host.hang``       same site; arm ``hang`` to wedge one host's loop
+                    forever (the supervisor's stale-heartbeat detection
+                    is the only thing that notices)
+``barrier.timeout`` on every control-plane ``barrier()`` entry, BEFORE
+                    this host registers its arrival — ``kill`` here dies
+                    between the host's work and the rendezvous (the
+                    commit-barrier crash window)
+``ckpt.commit_barrier``  in ``save_checkpoint`` after this host's shard
+                    commit (manifest + rename done), before entering the
+                    ``commit:step-N`` barrier — the precise "committed
+                    my shard, never told the others" window
 ==================  =====================================================
 
-Spec grammar (comma list): ``point=action[@N][xM]`` — fire ``action`` on
-hits ``N .. N+M-1`` of ``point`` (1-based; ``N`` defaults to 1, ``M`` to
-1, ``x*`` means every hit from ``N`` on). Actions:
+Spec grammar (comma list): ``point=action[@N][xM][@host=K]`` — fire
+``action`` on hits ``N .. N+M-1`` of ``point`` (1-based; ``N`` defaults
+to 1, ``M`` to 1, ``x*`` means every hit from ``N`` on). ``@host=K``
+scopes the rule to the host whose ``SCALING_TPU_HOST_ID`` environment
+variable equals ``K`` (supervised multi-host runs export it per worker);
+on other hosts — or outside a supervised launch — the rule never fires,
+though hits are still counted. Actions:
 
 - ``kill``    SIGKILL this process (no cleanup runs — a real crash)
 - ``fail``    raise :class:`InjectedFault` (an ``IOError``, so the
               bounded-retry guards treat it as transient)
 - ``sigterm`` deliver SIGTERM to this process
+- ``hang``    block this thread forever (emulates a wedged host: a hung
+              collective, a dead storage mount — only heartbeat
+              staleness can detect it)
 - ``corrupt`` advisory: returned to the call site, which truncates the
               file it just wrote (write-time corruption; manifest
               digests are computed from the intended bytes, so restore
@@ -47,7 +69,8 @@ hits ``N .. N+M-1`` of ``point`` (1-based; ``N`` defaults to 1, ``M`` to
 - ``nan``     advisory: returned to the call site, which poisons the
               observed loss
 
-Example: ``SCALING_TPU_FAULTS="ckpt.write=kill@13,data.read=fail@1x2"``.
+Example: ``SCALING_TPU_FAULTS="ckpt.write=kill@13,data.read=fail@1x2"``;
+host-scoped: ``SCALING_TPU_FAULTS="host.kill=kill@5@host=1"``.
 """
 
 from __future__ import annotations
@@ -61,14 +84,17 @@ from ..logging import logger
 
 ENV_VAR = "SCALING_TPU_FAULTS"
 
-ACTIONS = ("kill", "fail", "sigterm", "corrupt", "nan")
+ACTIONS = ("kill", "fail", "sigterm", "hang", "corrupt", "nan")
 
 # actions fire() executes itself; "corrupt"/"nan" are advisory returns
-_EXECUTED = ("kill", "fail", "sigterm")
+_EXECUTED = ("kill", "fail", "sigterm", "hang")
+
+HOST_ID_ENV = "SCALING_TPU_HOST_ID"
 
 _SPEC_RE = re.compile(
     r"^(?P<point>[a-z_.]+)=(?P<action>[a-z]+)"
-    r"(?:@(?P<first>\d+))?(?:x(?P<count>\d+|\*))?$"
+    r"(?:@(?P<first>\d+))?(?:x(?P<count>\d+|\*))?"
+    r"(?:@host=(?P<host>\d+))?$"
 )
 
 
@@ -77,14 +103,22 @@ class InjectedFault(IOError):
 
 
 class _Rule:
-    __slots__ = ("action", "first", "count")
+    __slots__ = ("action", "first", "count", "host")
 
-    def __init__(self, action: str, first: int, count: Optional[int]):
+    def __init__(self, action: str, first: int, count: Optional[int],
+                 host: Optional[int] = None):
         self.action = action
         self.first = first
         self.count = count  # None -> every hit from `first` on
+        self.host = host  # None -> any host
 
     def matches(self, hit: int) -> bool:
+        if self.host is not None:
+            # read at fire time, not parse time: tests flip host identity
+            # without rebuilding the plan
+            here = os.environ.get(HOST_ID_ENV)
+            if here is None or int(here) != self.host:
+                return False
         if hit < self.first:
             return False
         return self.count is None or hit < self.first + self.count
@@ -111,10 +145,12 @@ class FaultPlan:
                     f"one of {ACTIONS}"
                 )
             count = m.group("count")
+            host = m.group("host")
             self._rules[m.group("point")] = _Rule(
                 action,
                 int(m.group("first") or 1),
                 None if count == "*" else int(count or 1),
+                int(host) if host is not None else None,
             )
 
     def hits(self, point: str) -> int:
@@ -139,6 +175,13 @@ class FaultPlan:
             )
         if rule.action == "kill":
             os.kill(os.getpid(), signal.SIGKILL)
+        if rule.action == "hang":
+            # a wedged host: no exception, no exit — only the missing
+            # heartbeats give it away to the supervisor
+            import time
+
+            while True:
+                time.sleep(60)
         if rule.action == "sigterm":
             os.kill(os.getpid(), signal.SIGTERM)
             return None

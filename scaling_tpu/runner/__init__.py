@@ -1,5 +1,6 @@
 from .config import LaunchConfig, RunnerConfig, RunnerType
 from .runner import get_resource_pool, initialize_distributed, runner_main
+from .supervise import supervise_main
 
 __all__ = [
     "LaunchConfig",
@@ -8,4 +9,5 @@ __all__ = [
     "get_resource_pool",
     "initialize_distributed",
     "runner_main",
+    "supervise_main",
 ]

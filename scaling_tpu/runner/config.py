@@ -63,6 +63,58 @@ class RunnerConfig(BaseConfig):
         None, description="container settings for runner_type=pdsh_docker"
     )
     use_determined: bool = Field(False, description="kept for config parity")
+    supervise: bool = Field(
+        False,
+        description="run the workers under the multi-host supervisor "
+        "(scaling_tpu.runner.supervise): per-host heartbeats over a "
+        "control plane, dead/hung-host detection, clean teardown of "
+        "survivors, bounded relaunch with a fresh coordinator epoch",
+    )
+    control_dir: Optional[Path] = Field(
+        None,
+        description="root directory for the file-backed control plane "
+        "(required when supervise=true; each coordinator epoch gets a "
+        "fresh subdirectory). Must be on storage every host can reach — "
+        "shared FS for real pods, any local dir for single-machine runs",
+    )
+    heartbeat_timeout_seconds: float = Field(
+        60.0,
+        description="a host whose newest heartbeat is older than this is "
+        "declared hung and the epoch is torn down (heartbeats are "
+        "published once per train-loop iteration and at the head of "
+        "each checkpoint/eval window; set this several multiples of "
+        "the LONGEST silent stretch — the slowest step, a full eval "
+        "pass, or a checkpoint write, whichever is largest)",
+        gt=0,
+    )
+    startup_grace_seconds: float = Field(
+        600.0,
+        description="grace before the FIRST heartbeat of an epoch is due "
+        "(covers process start + imports + cold jit compile, which can "
+        "run minutes on big models)",
+        gt=0,
+    )
+    restart_budget: int = Field(
+        3,
+        description="maximum supervisor relaunches (new coordinator "
+        "epochs) after host failures before giving up",
+        ge=0,
+    )
+    restart_backoff_seconds: float = Field(
+        1.0,
+        description="base relaunch delay; doubles with each consecutive "
+        "restart (bounded exponential backoff)",
+        ge=0,
+    )
+    worker_grace_seconds: float = Field(
+        15.0,
+        description="teardown grace: after the abort flag + SIGTERM, "
+        "surviving workers get this long to exit before SIGKILL",
+        gt=0,
+    )
+    supervisor_poll_seconds: float = Field(
+        0.2, description="supervisor monitoring loop period", gt=0
+    )
 
 
 class LaunchConfig(BaseConfig):

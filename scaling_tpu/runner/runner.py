@@ -24,15 +24,28 @@ from .config import LaunchConfig, RunnerConfig, RunnerType
 
 def get_resource_pool(config: RunnerConfig) -> Dict[str, int]:
     """hostsfile/hosts -> ordered {hostname: device_slots}
-    (reference: runner.py:118-196)."""
+    (reference: runner.py:118-196).
+
+    Hostsfile hygiene: blank lines and ``#`` comments (whole-line or
+    trailing) are ignored; a duplicate hostname is a hard error — the
+    silent last-entry-wins alternative launches the wrong world size
+    and strands the rendezvous."""
     pool: Dict[str, int] = {}
     if config.hostsfile is not None:
-        for line in open(config.hostsfile).read().splitlines():
-            line = line.split("#")[0].strip()
+        for lineno, raw in enumerate(
+            open(config.hostsfile).read().splitlines(), start=1
+        ):
+            line = raw.split("#")[0].strip()
             if not line:
                 continue
             parts = line.split()
             host = parts[0]
+            if host in pool:
+                raise ValueError(
+                    f"duplicate hostname {host!r} at line {lineno} of "
+                    f"hostsfile {config.hostsfile}: each host must appear "
+                    "once (merge its slots= onto the first entry)"
+                )
             slots = config.default_gpu_count
             for p in parts[1:]:
                 if p.startswith("slots="):
@@ -40,6 +53,11 @@ def get_resource_pool(config: RunnerConfig) -> Dict[str, int]:
             pool[host] = slots
     elif config.hosts:
         for host in config.hosts:
+            if host in pool:
+                raise ValueError(
+                    f"duplicate hostname {host!r} in hosts list: each "
+                    "host must appear once"
+                )
             pool[host] = config.default_gpu_count
     else:
         pool["localhost"] = config.default_gpu_count
@@ -84,60 +102,94 @@ def build_worker_command(
     return [sys.executable, "-u", "-m", script, f"--payload={encoded_payload}"]
 
 
-def runner_main(config: RunnerConfig, payload: Any) -> int:
-    """Launch ``config.script`` across the resource pool.
-
-    All-localhost pools expand slots into local worker processes (each
-    claiming its own device slot via LOCAL_SLOT/local_device_ids); remote
-    hosts get one ssh-launched process each, owning all local devices."""
-    pool = get_resource_pool(config)
+def plan_workers(pool: Dict[str, int]) -> List[tuple]:
+    """``(host, slot)`` per worker process. All-localhost pools expand
+    slots into local worker processes (each claiming its own device slot
+    via LOCAL_SLOT/local_device_ids); remote hosts get one process each,
+    owning all local devices."""
     all_local = all(h in ("localhost", "127.0.0.1") for h in pool)
     if all_local:
-        # expand slots into local worker processes — the reference's
-        # pdsh-on-localhost mode (tests/core/test_runner exercises a real
-        # multi-process rendezvous this way)
-        workers = [
+        # the reference's pdsh-on-localhost mode (tests/core/test_runner
+        # exercises a real multi-process rendezvous this way)
+        return [
             (host, slot)
             for host, slots in pool.items()
             for slot in range(max(slots, 1))
         ]
-    else:
-        # one process per host; jax owns all of that host's devices
-        workers = [(host, 0) for host in pool]
-    hosts = list(pool)
-    master_addr = config.master_addr or hosts[0]
-    num_processes = len(workers)
+    return [(host, 0) for host in pool]
+
+
+def worker_env(
+    pool: Dict[str, int],
+    workers: List[tuple],
+    process_id: int,
+    master_addr: str,
+    master_port: int,
+) -> Dict[str, str]:
+    """The launch-contract env one worker receives (LaunchConfig reads
+    these back on the other side)."""
+    host, slot = workers[process_id]
+    local_workers = sum(1 for hh, _ in workers if hh == host)
+    return {
+        "MASTER_ADDR": master_addr,
+        "MASTER_PORT": str(master_port),
+        # total device slots, NOT process count (LaunchConfig contract)
+        "WORLD_SIZE": str(sum(max(s, 1) for s in pool.values())),
+        "RANK": str(process_id),
+        "LOCAL_SLOT": str(slot),
+        "LOCAL_WORLD_SIZE": str(local_workers),
+        "JAX_NUM_PROCESSES": str(len(workers)),
+        "JAX_PROCESS_ID": str(process_id),
+    }
+
+
+def spawn_worker(
+    config: RunnerConfig,
+    host: str,
+    env_exports: Dict[str, str],
+    encoded_payload: str,
+) -> subprocess.Popen:
+    """Start one worker process (local exec or ssh-wrapped)."""
+    cmd = build_worker_command(config, env_exports, encoded_payload)
+    docker = config.runner_type == RunnerType.PDSH_DOCKER
+    quoted = " ".join(shlex.quote(a) for a in cmd)
+    if host in ("localhost", "127.0.0.1"):
+        return subprocess.Popen(cmd, env={**os.environ, **env_exports})
+    if docker:
+        # env already rides inside the docker argv; no cd — the
+        # container's workdir/mounts define the code location
+        return subprocess.Popen(["ssh", host, quoted])
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in env_exports.items()
+    )
+    return subprocess.Popen(
+        ["ssh", host, f"cd {shlex.quote(os.getcwd())} && {exports} {quoted}"]
+    )
+
+
+def runner_main(config: RunnerConfig, payload: Any) -> int:
+    """Launch ``config.script`` across the resource pool.
+
+    With ``config.supervise`` the workers run under the heartbeat
+    supervisor (:mod:`.supervise`): dead/hung-host detection, clean
+    teardown of survivors, bounded relaunch with a fresh coordinator
+    epoch. Without it, the classic babysit loop below: if any worker
+    dies non-zero, kill the rest."""
+    if config.supervise:
+        from .supervise import supervise_main
+
+        return supervise_main(config, payload)
+    pool = get_resource_pool(config)
+    workers = plan_workers(pool)
+    master_addr = config.master_addr or list(pool)[0]
     encoded = encode_payload(payload)
 
-    local_workers = {h: sum(1 for hh, _ in workers if hh == h) for h in pool}
     procs: List[subprocess.Popen] = []
-    for process_id, (host, slot) in enumerate(workers):
-        env_exports = {
-            "MASTER_ADDR": master_addr,
-            "MASTER_PORT": str(config.master_port),
-            # total device slots, NOT process count (LaunchConfig contract)
-            "WORLD_SIZE": str(sum(max(s, 1) for s in pool.values())),
-            "RANK": str(process_id),
-            "LOCAL_SLOT": str(slot),
-            "LOCAL_WORLD_SIZE": str(local_workers[host]),
-            "JAX_NUM_PROCESSES": str(num_processes),
-            "JAX_PROCESS_ID": str(process_id),
-        }
-        cmd = build_worker_command(config, env_exports, encoded)
-        docker = config.runner_type == RunnerType.PDSH_DOCKER
-        quoted = " ".join(shlex.quote(a) for a in cmd)
-        if host in ("localhost", "127.0.0.1"):
-            procs.append(subprocess.Popen(cmd, env={**os.environ, **env_exports}))
-        elif docker:
-            # env already rides inside the docker argv; no cd — the
-            # container's workdir/mounts define the code location
-            procs.append(subprocess.Popen(["ssh", host, quoted]))
-        else:
-            exports = " ".join(
-                f"{k}={shlex.quote(v)}" for k, v in env_exports.items()
-            )
-            ssh_cmd = ["ssh", host, f"cd {shlex.quote(os.getcwd())} && {exports} {quoted}"]
-            procs.append(subprocess.Popen(ssh_cmd))
+    for process_id, (host, _slot) in enumerate(workers):
+        env_exports = worker_env(
+            pool, workers, process_id, master_addr, config.master_port
+        )
+        procs.append(spawn_worker(config, host, env_exports, encoded))
 
     # babysit: if any worker dies non-zero, kill the rest
     # (reference: launch.py:125-161)

@@ -1,0 +1,424 @@
+"""Multi-host supervisor: heartbeat monitoring, teardown, bounded relaunch.
+
+Mesh-style SPMD makes every host a single point of failure: one
+reclaimed machine leaves the other N-1 blocked inside a collective that
+will never complete, burning pod-hours until a human notices. The
+supervisor is the per-pod half of the resilience gate (the per-process
+half is :mod:`scaling_tpu.resilience`): it launches the workers of one
+*coordinator epoch*, watches their exit codes and control-plane
+heartbeats, and on a dead or hung host
+
+1. raises the ``abort`` broadcast flag so survivors waiting at any
+   barrier exit within seconds instead of the full barrier timeout,
+2. SIGTERMs the survivors, escalating to SIGKILL after a grace period
+   (a host truly wedged inside an XLA collective ignores SIGTERM),
+3. relaunches the whole rendezvous as a fresh epoch — new control-plane
+   directory (no stale arrivals), new coordinator port (the dead
+   coordinator's socket may linger in TIME_WAIT) — under a bounded
+   exponential-backoff restart budget.
+
+Relaunched workers resume exactly like ``run_with_resume`` does: the
+training script points ``load_dir`` at its ``save_dir`` and restores the
+newest checkpoint that passes integrity verification, so the resumed
+loss trajectory is the uninterrupted one (the cross-host commit barrier
+guarantees no mixed-step ``latest`` exists to restore from).
+
+SIGTERM to the supervisor is relayed as SIGTERM to every worker (not a
+direct flag write — see :func:`_relay_sigterm`): the workers' handlers
+run the coordinated-preemption protocol, every host saves at the same
+step boundary, exits 0, and the epoch counts as clean — no relaunch.
+
+Every transition lands as a structured event (``logger.log_event``):
+``epoch-start``, ``host-dead``, ``teardown-complete``, ``relaunch``,
+``preempt-relay``, ``epoch-clean-exit``, ``epoch-stalled``,
+``give-up``.
+"""
+
+from __future__ import annotations
+
+import shutil
+import signal
+import subprocess
+import time
+from pathlib import Path
+from typing import Any, Dict, List
+
+from ..logging import logger
+from ..resilience.controlplane import (
+    ABORT_FLAG,
+    ENV_CONTROL_DIR,
+    ENV_COORD_EPOCH,
+    ENV_HOST_ID,
+    ENV_NUM_HOSTS,
+    PREEMPT_FLAG,
+    STALL_FLAG,
+    FileControlPlane,
+    straggler_table,
+)
+from .config import RunnerConfig
+from .runner import (
+    encode_payload,
+    get_resource_pool,
+    plan_workers,
+    spawn_worker,
+    worker_env,
+)
+
+
+def classify_workers(
+    exit_codes: List,
+    heartbeats: Dict,
+    *,
+    heartbeat_timeout_s: float,
+    startup_grace_s: float,
+    epoch_elapsed_s: float,
+    now: float,
+) -> Dict[str, List[int]]:
+    """Split one epoch's workers into dead / hung / alive.
+
+    *dead*: exited non-zero (a SIGKILL shows as a negative code).
+    *hung*: still running but the newest heartbeat is stale (or absent,
+    or still ``starting``) AND the startup grace has passed. The grace
+    suppresses ALL staleness verdicts, not just missing first
+    heartbeats: a host can legitimately go silent for minutes inside
+    the cold jit compile of its first step — after it already published
+    ``starting`` and a ``barrier:step-0`` refresh — and that window is
+    exactly what ``startup_grace_s`` budgets for. A worker whose last
+    heartbeat says ``done`` or ``preempted`` is winding down, never
+    hung. Pure function so the detection policy is unit-testable
+    without spawning anything."""
+    dead: List[int] = []
+    hung: List[int] = []
+    alive: List[int] = []
+    for host, rc in enumerate(exit_codes):
+        if rc is not None:
+            if rc != 0:
+                dead.append(host)
+            continue  # exited 0: finished/preempted, not alive, not dead
+        hb = heartbeats.get(host)
+        # no special case for 'starting': a FRESH 'starting' heartbeat
+        # past the grace is a host demonstrably alive (e.g. a restore
+        # that outlasts the grace, still checking in) — only age makes
+        # it stale, same as any other non-terminal status
+        stale = (
+            hb is None
+            or (
+                hb.status not in ("done", "preempted")
+                and hb.age(now) > heartbeat_timeout_s
+            )
+        )
+        if stale:
+            (hung if epoch_elapsed_s > startup_grace_s else alive).append(host)
+        else:
+            alive.append(host)
+    return {"dead": dead, "hung": hung, "alive": alive}
+
+
+def _signal_local(p: subprocess.Popen, sig: str) -> None:
+    """SIGTERM/SIGKILL a local worker Popen, logging instead of raising
+    (signal delivery races process exit benignly)."""
+    try:
+        (p.terminate if sig == "TERM" else p.kill)()
+    except OSError as e:
+        logger.warning(f"SIG{sig} to worker pid {p.pid} failed: {e!r}")
+
+
+def _remote_pkill(host: str, encoded: str, sig: str) -> None:
+    """Signal a remote host's workers of THIS launch via ssh pkill.
+
+    The local Popen for an ssh-launched worker is only the ssh client —
+    signalling it does not reach the remote process. The pkill pattern
+    is this launch's unique payload marker: the base64 payload is
+    shell- and regex-safe by construction, and 48 chars keeps clear of
+    base64 padding while staying unique per job."""
+    try:
+        r = subprocess.run(
+            ["ssh", host, f"pkill -{sig} -f -- --payload={encoded[:48]}"],
+            timeout=30, capture_output=True,
+        )
+        # pkill 1 = pattern matched nothing (workers already gone) —
+        # fine; anything else (pkill 2/3, ssh 255 transport failure)
+        # means the remote workers may still be alive
+        if r.returncode not in (0, 1):
+            logger.warning(
+                f"remote SIG{sig} on {host} failed rc={r.returncode}: "
+                f"{getattr(r, 'stderr', b'')!r}"
+            )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.warning(f"remote SIG{sig} on {host} failed: {e!r}")
+
+
+def _relay_sigterm(
+    procs: List[subprocess.Popen], workers: List[tuple], encoded: str
+) -> None:
+    """Supervisor-initiated drain: SIGTERM every worker instead of
+    setting the preempt flag directly. A flag with no barrier arrival
+    attached can be observed by two lockstep hosts on opposite sides
+    of a barrier release, splitting their exit boundaries (mismatched
+    commit barriers, failed drain). The workers' own SIGTERM handlers
+    enter the broadcast protocol at one of its decision points, which
+    IS race-free — flag-before-arrival plus the in-barrier deferral."""
+    for (host, _slot), p in zip(workers, procs):
+        if p.poll() is not None:
+            continue
+        if host in ("localhost", "127.0.0.1"):
+            _signal_local(p, "TERM")
+        else:
+            # never terminate the ssh client here: the session dying
+            # would reach the remote worker as a HUP (if at all), not
+            # the SIGTERM its preemption handler is installed for
+            _remote_pkill(host, encoded, "TERM")
+
+
+def _teardown(
+    cp: FileControlPlane,
+    procs: List[subprocess.Popen],
+    workers: List[tuple],
+    encoded: str,
+    config: RunnerConfig,
+) -> None:
+    """Stop the survivors of a failed epoch without an indefinite hang:
+    abort flag (barrier waits raise within one poll), SIGTERM, then
+    SIGKILL for anything that rode out the grace period.
+
+    For ssh-launched workers the local Popen is only the ssh client —
+    killing it does NOT kill the remote worker, and a host wedged
+    inside a collective keeps holding its TPU devices into the next
+    epoch. A best-effort remote ``pkill`` against the unique payload
+    marker cleans those up; the base64 payload is shell- and
+    regex-safe by construction."""
+    try:
+        cp.set_flag(ABORT_FLAG, "host-dead")
+    except (OSError, RuntimeError, ValueError) as e:
+        # best-effort: if the control-plane storage is what failed, the
+        # signal escalation below is still the real teardown — dying
+        # here would leave every survivor wedged in its collective
+        logger.warning(f"abort flag write failed (continuing): {e!r}")
+    remote_hosts = sorted(
+        {h for h, _ in workers if h not in ("localhost", "127.0.0.1")}
+    )
+    for p in procs:
+        if p.poll() is None:
+            _signal_local(p, "TERM")
+    for host in remote_hosts:
+        # the local Popen is only the ssh client: it exits immediately on
+        # TERM, which would otherwise collapse the grace window to ~0 and
+        # send the still-running remote workers straight to pkill -KILL
+        _remote_pkill(host, encoded, "TERM")
+    deadline = time.monotonic() + config.worker_grace_seconds
+    # remote liveness is not observable through the ssh-client procs, so
+    # with remote hosts the grace is a plain wall-clock wait
+    while time.monotonic() < deadline and (
+        remote_hosts or any(p.poll() is None for p in procs)
+    ):
+        time.sleep(0.05)
+    killed = []
+    for p in procs:
+        if p.poll() is None:
+            killed.append(p.pid)
+            _signal_local(p, "KILL")
+    for p in procs:
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            logger.error(f"worker pid {p.pid} unreaped after SIGKILL")
+    if killed:
+        logger.warning(
+            f"worker pid(s) {killed} survived the {config.worker_grace_seconds}s "
+            "SIGTERM grace (wedged collective?); SIGKILLed"
+        )
+    for host in remote_hosts:
+        _remote_pkill(host, encoded, "KILL")
+    logger.log_event(
+        "teardown-complete", killed_pids=killed, remote_hosts=remote_hosts
+    )
+
+
+def _run_epoch(
+    config: RunnerConfig,
+    pool: Dict[str, int],
+    workers: List[tuple],
+    encoded: str,
+    master_addr: str,
+    control_root: Path,
+    epoch: int,
+    state: Dict[str, bool],
+) -> int:
+    """One coordinator epoch: spawn, monitor, and (on failure) tear down.
+
+    Returns 0 on a clean epoch (training finished or coordinated
+    preemption), non-zero when a host died/hung and the epoch was torn
+    down."""
+    epoch_dir = control_root / f"epoch-{epoch}"
+    if epoch_dir.exists():
+        # ephemeral coordination state from a PREVIOUS supervisor run
+        # over the same control root (never checkpoint data): a stale
+        # abort flag or barrier arrival here would instantly poison the
+        # new epoch's workers
+        shutil.rmtree(epoch_dir)
+    epoch_dir.mkdir(parents=True)
+    num_hosts = len(workers)
+    # monitor view of the epoch's control plane: heartbeat reads + flag
+    # writes only (the supervisor never enters barriers)
+    cp = FileControlPlane(epoch_dir, host_id=0, num_hosts=num_hosts)
+    # a fresh port per epoch: the dead epoch's coordinator socket may
+    # linger in TIME_WAIT and refuse the new rendezvous
+    master_port = config.master_port + epoch
+    procs: List[subprocess.Popen] = []
+    for process_id, (host, _slot) in enumerate(workers):
+        env = worker_env(
+            pool, workers, process_id, master_addr, master_port
+        )
+        env.update({
+            ENV_CONTROL_DIR: str(epoch_dir),
+            ENV_HOST_ID: str(process_id),
+            ENV_NUM_HOSTS: str(num_hosts),
+            ENV_COORD_EPOCH: str(epoch),
+        })
+        procs.append(spawn_worker(config, host, env, encoded))
+    logger.log_event(
+        "epoch-start", epoch=epoch, num_hosts=num_hosts,
+        master_port=master_port, pids=[p.pid for p in procs],
+    )
+    started = time.monotonic()
+    preempt_broadcast = False
+    while True:
+        time.sleep(config.supervisor_poll_seconds)
+        if state["preempted"] and not preempt_broadcast:
+            _relay_sigterm(procs, workers, encoded)
+            preempt_broadcast = True
+            logger.log_event("preempt-relay", host="supervisor",
+                             epoch=epoch)
+        rcs = [p.poll() for p in procs]
+        if all(rc is not None for rc in rcs):
+            if all(rc == 0 for rc in rcs):
+                stall = cp.get_flag(STALL_FLAG)
+                if stall is not None:
+                    # a step-stall watchdog drained the pod: every host
+                    # saved and exited 0, but training is NOT done —
+                    # count it as a failed epoch so the budgeted
+                    # relaunch resumes it instead of reporting success
+                    # mid-run
+                    logger.log_event(
+                        "epoch-stalled", epoch=epoch, stall_step=stall
+                    )
+                    logger.error(
+                        f"epoch {epoch}: clean exit but the stall flag is "
+                        f"set (step {stall}); relaunching to resume"
+                    )
+                    return 1
+                logger.log_event(
+                    "epoch-clean-exit", epoch=epoch,
+                    preempted=preempt_broadcast or bool(
+                        cp.get_flag(PREEMPT_FLAG)
+                    ),
+                )
+                return 0
+            bad = {h: rcs[h] for h in range(num_hosts) if rcs[h] != 0}
+            logger.log_event(
+                "host-dead", epoch=epoch, hosts=sorted(bad), reason="exit",
+                exit_codes=bad,
+            )
+            # every LOCAL proc has exited, but for ssh-launched workers
+            # those are only the ssh clients — a network blip can kill
+            # all of them at once while the remote workers keep running,
+            # and skipping teardown here would leave the orphans fighting
+            # the relaunched epoch for devices and checkpoint dirs
+            _teardown(cp, procs, workers, encoded, config)
+            return 1
+        now = time.time()
+        heartbeats = cp.peer_heartbeats()
+        verdict = classify_workers(
+            rcs, heartbeats,
+            heartbeat_timeout_s=config.heartbeat_timeout_seconds,
+            startup_grace_s=config.startup_grace_seconds,
+            epoch_elapsed_s=time.monotonic() - started,
+            now=now,
+        )
+        if not verdict["dead"] and not verdict["hung"]:
+            continue
+        gone = verdict["dead"] or verdict["hung"]
+        reason = "exit" if verdict["dead"] else "heartbeat-stale"
+        # the SAME snapshot that produced the verdict: a host whose
+        # heartbeat refreshes between two reads would otherwise render a
+        # "heartbeat-stale" teardown next to an all-fresh straggler table
+        report = straggler_table(
+            heartbeats, num_hosts,
+            config.heartbeat_timeout_seconds, now=now,
+        )
+        logger.error(
+            f"epoch {epoch}: host(s) {gone} {reason}; tearing down "
+            f"survivors\n{report.render()}"
+        )
+        logger.log_event(
+            "host-dead", epoch=epoch, hosts=gone, reason=reason,
+            exit_codes={h: rcs[h] for h in verdict["dead"]},
+        )
+        _teardown(cp, procs, workers, encoded, config)
+        return 1
+
+
+def supervise_main(config: RunnerConfig, payload: Any) -> int:
+    """Run the pool under supervision until training completes, a
+    coordinated preemption drains it, or the restart budget runs out."""
+    if config.control_dir is None:
+        raise ValueError(
+            "runner.supervise=true needs runner.control_dir (a directory "
+            "every host can reach, for the heartbeat control plane)"
+        )
+    pool = get_resource_pool(config)
+    workers = plan_workers(pool)
+    master_addr = config.master_addr or list(pool)[0]
+    encoded = encode_payload(payload)
+    control_root = Path(config.control_dir)
+    control_root.mkdir(parents=True, exist_ok=True)
+
+    # SIGTERM to the supervisor = coordinated preemption of the pod
+    # (chained to any previously installed handler, like the trainer's)
+    state = {"preempted": False}
+    prev = signal.getsignal(signal.SIGTERM)
+
+    def on_sigterm(signum, frame):
+        state["preempted"] = True
+        if callable(prev):  # SIG_DFL/SIG_IGN are enum ints, skipped
+            prev(signum, frame)
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+
+    restarts = 0
+    epoch = 0
+    while True:
+        rc = _run_epoch(
+            config, pool, workers, encoded, master_addr, control_root,
+            epoch, state,
+        )
+        if rc == 0:
+            return 0
+        if state["preempted"]:
+            # an operator-initiated shutdown that still lost a host is
+            # not a reason to spin the pod back up
+            logger.error("epoch failed during preemption drain; not relaunching")
+            return rc
+        restarts += 1
+        if restarts > config.restart_budget:
+            logger.log_event(
+                "give-up", epoch=epoch, restarts=restarts - 1,
+                budget=config.restart_budget,
+            )
+            logger.error(
+                f"supervisor restart budget exhausted "
+                f"({config.restart_budget}); giving up"
+            )
+            return rc
+        delay = config.restart_backoff_seconds * (2 ** (restarts - 1))
+        epoch += 1
+        logger.log_event(
+            "relaunch", epoch=epoch, restarts=restarts,
+            budget=config.restart_budget, backoff_s=delay,
+        )
+        logger.warning(
+            f"relaunching as coordinator epoch {epoch} in {delay:.1f}s "
+            f"(restart {restarts}/{config.restart_budget}); workers will "
+            "resume from the newest valid checkpoint"
+        )
+        time.sleep(delay)

@@ -48,6 +48,14 @@ from ..resilience import (
     get_fault_plan,
     retry_io,
 )
+from ..resilience.controlplane import (
+    ABORT_FLAG,
+    PREEMPT_FLAG,
+    STALL_FLAG,
+    ControlPlane,
+    JobAborted,
+    straggler_table,
+)
 from ..resilience.manifest import CheckpointCorruptionError, read_manifest
 from ..resilience.restore import checkpoint_candidates, verify_checkpoint
 
@@ -146,6 +154,14 @@ class TrainerConfig(BaseConfig):
         "verification instead of falling back to the newest older valid "
         "one — for runs where silently resuming from an earlier step "
         "would invalidate the experiment",
+    )
+    multihost_shared_save_dir: bool = Field(
+        False,
+        description="multi-host supervision: save_dir is ONE tree shared "
+        "by every host (orbax on shared storage) — only host 0 advances "
+        "`latest`, after the cross-host commit barrier. False means "
+        "per-host shard dirs where every host owns its own pointer. "
+        "Only read when a control plane is attached",
     )
     max_consecutive_nonfinite: Optional[int] = Field(
         None,
@@ -264,6 +280,16 @@ class BaseTrainer:
         self.metrics_hooks: List[Callable[[dict, int], None]] = []
         self.checkpoint_hooks: List[Callable[[Path, int], None]] = []
         self._preempted = False
+        # multi-host supervision (attach_control_plane): out-of-band
+        # heartbeats/barriers/flags beside the XLA collectives
+        self._control_plane: Optional[ControlPlane] = None
+        self._cp_first_checkin = True
+        self._cp_step_barrier = True
+        self._cp_barrier_timeout = 300.0
+        self._cp_peer_stale = 60.0
+        self._cp_latest_leader = True
+        self._cp_prev_commit_step: Optional[int] = None
+        self._last_saved_step: Optional[int] = None
         self._nonfinite_guard: Optional[NonFiniteGuard] = (
             NonFiniteGuard(config.max_consecutive_nonfinite)
             if config.max_consecutive_nonfinite is not None
@@ -506,6 +532,147 @@ class BaseTrainer:
             step_duration=time.time() - start,
         )
 
+    # ------------------------------------------------------- control plane
+    def attach_control_plane(
+        self,
+        cp: ControlPlane,
+        *,
+        step_barrier: bool = True,
+        barrier_timeout_s: float = 300.0,
+        peer_stale_s: float = 60.0,
+        shared_save_dir: bool = False,
+    ) -> None:
+        """Join a multi-host supervision control plane (docs/RESILIENCE.md).
+
+        Per loop iteration this host then: publishes a heartbeat, obeys
+        the supervisor's ``abort`` flag (exit fast instead of hanging in
+        a collective whose peer is gone), broadcasts/observes the
+        ``preempt`` flag (one host's SIGTERM becomes everyone's
+        save-and-exit at the SAME step boundary), and — with
+        ``step_barrier`` — rendezvouses at ``step-N`` so the preemption
+        decision is taken in lockstep even when the step program itself
+        would tolerate skew. ``save_checkpoint`` additionally enters the
+        ``commit:step-N`` barrier between shard commit and the ``latest``
+        advance. ``shared_save_dir=True`` means all hosts write one
+        shared checkpoint tree (orbax on shared storage): only host 0
+        advances ``latest``; with per-host shard dirs every host owns
+        its own pointer, still gated on the same barrier."""
+        if not step_barrier and cp.num_hosts > 1:
+            # without the lockstep rendezvous nothing bounds step skew,
+            # so a drain can end with hosts saving at different steps
+            # and parking in commit barriers that never fill
+            logger.warning(
+                "attach_control_plane(step_barrier=False) on a "
+                f"{cp.num_hosts}-host plane: coordinated preemption "
+                "cannot guarantee a same-step boundary and commit "
+                "barriers may time out during a drain"
+            )
+        self._control_plane = cp
+        self._cp_step_barrier = step_barrier
+        self._cp_barrier_timeout = barrier_timeout_s
+        self._cp_peer_stale = peer_stale_s
+        self._cp_latest_leader = (not shared_save_dir) or cp.host_id == 0
+
+    def _control_plane_checkin(self) -> bool:
+        """Top-of-iteration supervision protocol (see attach_control_plane).
+
+        Returns True when this host must exit at the CURRENT boundary
+        (its own preemption decided before arriving at the step barrier,
+        or a peer's broadcast observed pre- or post-barrier). The
+        boundary decision is only ever taken at those points: a local
+        SIGTERM that lands while we are INSIDE the barrier wait comes
+        too late — we already rendezvoused for the next step, and
+        peers may already be parked at ITS barrier — so that host runs
+        one more step and exits through the post-step path instead,
+        where the broadcast-plus-arrival releases peers at the matching
+        boundary. Flag-before-arrival ordering makes the released
+        peer's post-barrier flag check reliable."""
+        cp = self._control_plane
+        if cp is None:
+            return self._preempted
+        step = self.context.iterations
+        # the first iteration's step still pays the cold jit compile —
+        # report "starting" so the supervisor applies the startup grace,
+        # not the steady-state heartbeat timeout
+        cp.heartbeat(step, status="starting" if self._cp_first_checkin
+                     else "running")
+        self._cp_first_checkin = False
+        if cp.get_flag(ABORT_FLAG) is not None:
+            logger.log_event("abort-observed", host=cp.host_id, step=step)
+            raise JobAborted(
+                "supervisor raised the abort flag: a peer host is gone, "
+                "so barriers/collectives can never complete — exiting "
+                "without a save (the last committed checkpoint stands)"
+            )
+        if not self._preempted and cp.get_flag(PREEMPT_FLAG) is not None:
+            self._preempted = True
+        if self._preempted:
+            # exiting at THIS boundary: flag + arrival (idempotent, via
+            # _broadcast_preempt) release any peer already parked inside
+            # this step's barrier; skipping the wait ourselves is safe —
+            # the save's commit barrier is the real rendezvous
+            self._broadcast_preempt(step)
+            return True
+        if self._cp_step_barrier and cp.num_hosts > 1:
+            cp.barrier(f"step-{step}", self._cp_barrier_timeout)
+            if step >= 2 and cp.host_id == 0:
+                # every host arrived at step-{step} for us to be here, so
+                # none can ever wait on step-{step-2} again — unbounded
+                # arrival state on long runs otherwise. One prune suffices;
+                # all N hosts issuing it is N-1 wasted coordinator round
+                # trips per step on the TCP backend
+                cp.prune_barrier(f"step-{step - 2}")
+            if cp.get_flag(PREEMPT_FLAG) is not None:
+                # the broadcaster arrived at THIS barrier, so its exit
+                # boundary is this one — join it
+                self._preempted = True
+                return True
+        # a local signal that landed during the barrier wait is handled
+        # post-step (see docstring), never here
+        return False
+
+    def _broadcast_preempt(self, step: int) -> None:
+        """Make this host's preemption everyone's, without stranding a
+        peer: set the preempt flag (once), then register arrival at this
+        boundary's step barrier. Exit paths never re-enter the loop top,
+        so a peer already parked inside ``step-N`` would otherwise wait
+        out the full barrier timeout for an arrival that never comes.
+        Flag-before-arrival ordering means a peer released by our
+        arrival always observes the flag on its post-barrier check."""
+        cp = self._control_plane
+        if cp is None:
+            return
+        if cp.get_flag(PREEMPT_FLAG) is None:
+            cp.set_flag(PREEMPT_FLAG, str(step))
+            logger.log_event("preempt-broadcast", host=cp.host_id, step=step)
+        if self._cp_step_barrier and cp.num_hosts > 1:
+            cp.arrive(f"step-{step}")
+
+    def _commit_barrier_and_latest(self, commit: CheckpointCommit) -> None:
+        """Cross-host commit barrier: this host's shard is committed
+        (manifest + rename done); ``latest`` may only advance once EVERY
+        host has committed its shard for this step. A host killed in
+        this window leaves peers timing out at the barrier — ``latest``
+        stays at the previous step on every host, so restore can never
+        assemble a mixed-step checkpoint."""
+        cp = self._control_plane
+        if cp is not None and cp.num_hosts > 1:
+            get_fault_plan().fire("ckpt.commit_barrier", path=commit.final_dir)
+            cp.barrier(
+                f"commit:step-{commit.step}", self._cp_barrier_timeout
+            )
+            prev = self._cp_prev_commit_step
+            if prev is not None and prev != commit.step and cp.host_id == 0:
+                # every host passed THIS commit barrier, so none can ever
+                # wait on the previous step's again; keep the current
+                # one's arrivals sticky (a preemption re-save of the same
+                # step must re-enter it instantly). Host 0 only — one
+                # prune suffices
+                cp.prune_barrier(f"commit:step-{prev}")
+            self._cp_prev_commit_step = commit.step
+        if self._cp_latest_leader:
+            commit.update_latest()
+
     # ----------------------------------------------------------- preemption
     def install_preemption_handler(self) -> None:
         """Save-and-exit on SIGTERM — the TPU-pod equivalent of the
@@ -530,26 +697,106 @@ class BaseTrainer:
 
     # ----------------------------------------------------------- preemption
     def _preemption_requested(self) -> bool:
+        if not self._preempted and self._control_plane is not None:
+            # another host broadcast preemption since our last check
+            if self._control_plane.get_flag(PREEMPT_FLAG) is not None:
+                self._preempted = True
         return self._preempted or (
             self.external_preemption is not None and self.external_preemption()
         )
 
     def _preemption_exit(self) -> None:
-        if self.config.save_dir is not None:
+        # a mid-step SIGTERM lands here WITHOUT passing another checkin:
+        # broadcast (and release any peer parked at this boundary's step
+        # barrier) before saving, or the commit barrier below would wait
+        # on peers that never learned they must save
+        self._broadcast_preempt(self.context.iterations)
+        if (
+            self.config.save_dir is not None
+            and self._last_saved_step == self.context.iterations
+        ):
+            # the will_save path just saved this exact boundary (lockstep
+            # peers all did the same, so no commit-barrier mismatch);
+            # re-staging an identical checkpoint on the preemption
+            # critical path can overrun a tight reclaim grace. Still
+            # drain the async writer so that save is durably committed.
+            self.finalize_checkpoints()
+            logger.info(
+                "preemption: boundary already checkpointed, exiting cleanly"
+            )
+        elif self.config.save_dir is not None:
+            if self._control_plane is not None:
+                # same head-of-window refresh as the regular will_save
+                # path: the last heartbeat was at the loop-top checkin,
+                # a whole step ago — without this, heartbeat_timeout
+                # must budget step+save and the supervisor can declare
+                # us hung (and SIGKILL us) mid-final-save
+                self._control_plane.heartbeat(
+                    self.context.iterations, status="running"
+                )
             step_dir = self.save_checkpoint()
             self.finalize_checkpoints()
             self._run_checkpoint_hooks(step_dir)
             logger.info("preemption: checkpoint saved, exiting cleanly")
+        if self._control_plane is not None:
+            self._control_plane.heartbeat(
+                self.context.iterations, status="preempted"
+            )
 
     def _on_step_stall(self, step: int, elapsed: float) -> None:
         """Watchdog callback: the watchdog thread must not host-gather
         donated device buffers mid-step, so it requests a save at the
         next safe point — if the stalled step ever completes, the loop
-        saves-and-exits via the preemption path."""
-        logger.error(
-            f"step stall after step {step} ({elapsed:.1f}s): requesting "
-            "save-and-exit at the next loop boundary"
+        saves-and-exits via the preemption path.
+
+        With a control plane attached, peer heartbeats turn the blind
+        "no progress for Ns" into a verdict: a peer that stopped
+        publishing is dead (the collective will never complete — the
+        supervisor is about to tear us down), otherwise the stall is
+        local (wedged storage, stuck data worker)."""
+        verdict = "local-stall"
+        dead: List[int] = []
+        cp = self._control_plane
+        if cp is not None:
+            try:
+                report = straggler_table(
+                    cp.peer_heartbeats(), cp.num_hosts, self._cp_peer_stale
+                )
+            # ValueError included: a truncated TCP reply surfaces as
+            # json.JSONDecodeError, and this watchdog-thread callback
+            # must reach the save-and-exit request below no matter what
+            except (OSError, RuntimeError, ValueError) as e:
+                logger.warning(f"peer heartbeat read failed mid-stall: {e!r}")
+            else:
+                # our own heartbeat is necessarily stale mid-stall (the
+                # main thread is stuck inside the step, not publishing),
+                # so counting ourselves would turn every local stall
+                # into a false "peer-host-dead"
+                dead = [h for h in report.dead_hosts if h != cp.host_id]
+                if dead:
+                    verdict = "peer-host-dead"
+                logger.error(
+                    f"stall straggler table (stale after "
+                    f"{self._cp_peer_stale}s):\n{report.render()}"
+                )
+        logger.log_event(
+            "step-stall", step=step, elapsed_s=round(elapsed, 1),
+            verdict=verdict, dead_hosts=dead,
+            host=cp.host_id if cp is not None else 0,
         )
+        logger.error(
+            f"step stall after step {step} ({elapsed:.1f}s, {verdict}): "
+            "requesting save-and-exit at the next loop boundary"
+        )
+        if cp is not None:
+            try:
+                # the drain below exits every host with code 0 — the
+                # stall flag is what tells the supervisor this was NOT a
+                # finished run, so it relaunches instead of reporting
+                # success mid-training
+                cp.set_flag(STALL_FLAG, str(step))
+            except (OSError, RuntimeError, ValueError) as e:
+                logger.warning(f"stall flag broadcast failed: {e!r}")
         self._preempted = True
 
     # ----------------------------------------------------------- train loop
@@ -569,6 +816,34 @@ class BaseTrainer:
             if watchdog is not None:
                 watchdog.stop()
 
+    def _emit_step_metrics(
+        self, output: TrainStepOutput, log_metrics_fn: Optional[Callable]
+    ) -> None:
+        if not output.fetched:
+            # unfetched steps (log_interval > 1) carry in-flight device
+            # arrays; touching them here would reintroduce the per-step
+            # sync the knob exists to remove
+            return
+        metrics = {
+            "loss": output.loss,
+            **output.metrics,
+            **(output.learning_rates or {}),
+        }
+        if output.global_grad_norm is not None:
+            metrics["global_grad_norm"] = output.global_grad_norm
+        if output.current_loss_scale is not None:
+            metrics["loss_scale"] = output.current_loss_scale
+        metrics["step_duration"] = output.step_duration
+        if log_metrics_fn is not None:
+            metrics = log_metrics_fn(self, output, metrics)
+        logger.log_metrics(metrics, self.context.iterations)
+        for hook in self.metrics_hooks:
+            try:
+                hook(metrics, self.context.iterations)
+            except Exception as e:
+                # reporting must never abort a training step
+                logger.warning(f"metrics hook failed: {e}")
+
     def _run_training_loop(
         self, log_metrics_fn: Optional[Callable],
         watchdog: Optional[StepStallWatchdog] = None,
@@ -578,19 +853,35 @@ class BaseTrainer:
             if watchdog is not None and watchdog_armed:
                 watchdog.beat(self.context.iterations)
             get_fault_plan().fire("signal.sigterm")
-            # check the SIGNAL flag before dispatching: a SIGTERM that
-            # arrived during the checkpoint/eval window (or a stall
-            # flag) must exit without burning another full step. The
-            # external predicate is NOT polled here — cluster glue
-            # (Determined) counts one poll per completed step
-            if self._preempted:
+            get_fault_plan().fire("host.kill")
+            get_fault_plan().fire("host.hang")
+            # heartbeat + abort/preempt flags + lockstep barrier; raises
+            # JobAborted when the supervisor is tearing this epoch down.
+            # True = exit at this boundary: a SIGTERM that arrived during
+            # the checkpoint/eval window (or a stall flag) must exit
+            # without burning another full step. The external predicate
+            # is NOT polled here — cluster glue (Determined) counts one
+            # poll per completed step
+            if self._control_plane_checkin():
                 self._preemption_exit()
                 return
             output = self.train_step()
             if watchdog is not None and not watchdog_armed:
                 watchdog_armed = True
                 watchdog.start()  # steady-state steps from here on
-            if self._preemption_requested():
+            if (
+                self._preemption_requested()
+                and self.context.iterations < self.config.train_iterations
+            ):
+                # the step that just completed is about to be saved by
+                # the preemption exit — its metrics must reach the sinks
+                # too (same contract as the non-finite abort below).
+                # NOT at the final boundary: the run is complete, and a
+                # drain here would save + enter a commit barrier that
+                # peers who missed the flag (they exit 'done' without
+                # another checkin) never arrive at — every host must
+                # take the identical normal exit path instead
+                self._emit_step_metrics(output, log_metrics_fn)
                 self._preemption_exit()
                 return
             will_save = (
@@ -609,6 +900,14 @@ class BaseTrainer:
                 # window, so the aux-time exclusion below can't swallow
                 # real step time that would have drained during the aux work
                 jax.block_until_ready(self.opt_state.step)
+            if (will_save or will_eval) and self._control_plane is not None:
+                # the save/eval window publishes no step heartbeats (a
+                # long eval can exceed heartbeat_timeout on its own);
+                # restart the staleness clock here so the timeout only
+                # has to budget for the window itself, not step+window
+                self._control_plane.heartbeat(
+                    self.context.iterations, status="running"
+                )
             aux_start = time.time()
             if will_save:
                 step_dir = self.save_checkpoint()
@@ -624,29 +923,7 @@ class BaseTrainer:
                 # fetch) by the backlog; checkpoint/eval wall time between
                 # fetches is not train-step work and would inflate it
                 self._last_fetch_wall += time.time() - aux_start
-            if output.fetched:
-                # unfetched steps (log_interval > 1) carry in-flight device
-                # arrays; touching them here would reintroduce the per-step
-                # sync the knob exists to remove
-                metrics = {
-                    "loss": output.loss,
-                    **output.metrics,
-                    **(output.learning_rates or {}),
-                }
-                if output.global_grad_norm is not None:
-                    metrics["global_grad_norm"] = output.global_grad_norm
-                if output.current_loss_scale is not None:
-                    metrics["loss_scale"] = output.current_loss_scale
-                metrics["step_duration"] = output.step_duration
-                if log_metrics_fn is not None:
-                    metrics = log_metrics_fn(self, output, metrics)
-                logger.log_metrics(metrics, self.context.iterations)
-                for hook in self.metrics_hooks:
-                    try:
-                        hook(metrics, self.context.iterations)
-                    except Exception as e:
-                        # reporting must never abort a training step
-                        logger.warning(f"metrics hook failed: {e}")
+            self._emit_step_metrics(output, log_metrics_fn)
             if self._nonfinite_guard is not None and output.fetched:
                 # after logging, so the aborting step's metrics still
                 # reach the sinks. Fetched outputs only: unfetched steps
@@ -669,6 +946,10 @@ class BaseTrainer:
                         )
                     raise
         self.finalize_checkpoints()
+        if self._control_plane is not None:
+            # the supervisor's straggler table should read "done", not a
+            # stale "running" that looks like a hang at shutdown
+            self._control_plane.heartbeat(self.context.iterations, status="done")
 
     def _run_checkpoint_hooks(self, step_dir: Path) -> None:
         if not self.checkpoint_hooks:
@@ -781,13 +1062,13 @@ class BaseTrainer:
         step_dir = commit.final_dir
         if writer is None:
             commit.finalize()
-            commit.update_latest()
+            self._commit_barrier_and_latest(commit)
         else:
-            # the single writer thread is FIFO: the manifest+rename and
-            # then "latest" land only after every npz of this save is
-            # durable
+            # the single writer thread is FIFO: the manifest+rename, the
+            # cross-host commit barrier, and then "latest" land only
+            # after every npz of this save is durable
             writer.submit(commit.finalize)
-            writer.submit(commit.update_latest)
+            writer.submit(self._commit_barrier_and_latest, commit)
         logger.info(f"saved checkpoint {step_dir}")
         if self.config.delete_past_optimizer_states:
             if writer is None:
@@ -798,6 +1079,7 @@ class BaseTrainer:
                 # committed would open a crash window with no optimizer
                 # state anywhere on disk
                 writer.submit(self._prune_past_optimizer_states, base, step_dir)
+        self._last_saved_step = self.context.iterations
         return step_dir
 
     def _prune_past_optimizer_states(self, base: Path, step_dir: Path) -> None:

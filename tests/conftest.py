@@ -31,11 +31,13 @@ except Exception:
 # tier-1 abort in test_checkpoint_resume_loss_exactness is exactly a
 # cache read-back on the resumed trainer's re-jit of the same step).
 # Subprocess-isolated tests (tests/core/subproc.py) run with the cache
-# off: cold compiles, correct executables.
-_cache_dir = os.environ.get(
-    "SCALING_TPU_TEST_CACHE", "/tmp/scaling_tpu_test_jaxcache"
-)
-if _cache_dir.lower() not in ("off", "none", "0", ""):
+# off: cold compiles, correct executables. (scaling_tpu.analysis is
+# import-light — pulling the shared sentinel parser in here does NOT
+# import jax before the config above.)
+from scaling_tpu.analysis import resolve_test_cache_dir  # noqa: E402
+
+_cache_dir = resolve_test_cache_dir()
+if _cache_dir is not None:
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     try:

@@ -1,0 +1,39 @@
+"""Seeded STA007 violations in a ``runner/`` path (the scope dir ISSUE 4
+added: a supervisor that silently eats a worker failure never relaunches
+it). Line numbers are asserted by tests/core/test_analysis/test_lint.py
+and chosen NOT to collide with the trainer fixture's; keep edits
+additive at the bottom."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+# the next def begins line 11 so its handler lands on a line the trainer
+# fixture does not use
+
+
+def swallow_worker_exit(proc):
+    try:
+        proc.wait()
+    except Exception:  # STA007: a lost worker failure, line 17
+        pass
+
+
+def swallow_spawn_error(spawn):
+    try:
+        return spawn()
+    except:  # noqa: E722  # STA007: bare except, line 24
+        return None
+
+
+def ok_logged_teardown(proc):
+    try:
+        proc.terminate()
+    except Exception as e:
+        logger.warning(f"teardown failed: {e}")
+
+
+def suppressed_poll(proc):
+    try:
+        return proc.poll()
+    except Exception:  # sta: disable=STA007
+        return None

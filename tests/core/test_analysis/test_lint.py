@@ -12,9 +12,11 @@ from scaling_tpu.analysis.lint import RULES, lint_paths
 REPO = Path(__file__).resolve().parents[3]
 FIXTURES = Path(__file__).resolve().parent / "fixtures"
 
-# (rule, line) pairs seeded in fixtures/nn/violations.py and
-# fixtures/trainer/swallowed.py — line numbers are part of the fixtures'
-# contract (edits there stay additive at the bottom)
+# (rule, line) pairs seeded in fixtures/nn/violations.py,
+# fixtures/trainer/swallowed.py and fixtures/runner/swallowed.py — line
+# numbers are part of the fixtures' contract (edits there stay additive
+# at the bottom; the runner fixture's lines deliberately avoid the
+# trainer fixture's so each (rule, line) pair stays unique)
 EXPECTED = [
     ("STA001", 17),   # if jnp.any(...)
     ("STA002", 24),   # np.tanh on traced
@@ -25,13 +27,16 @@ EXPECTED = [
     ("STA005", 49),   # mutable default
     ("STA006", 55),   # astype(jnp.float16)
     ("STA001", 64),   # branch inside lax.scan body
-    ("STA007", 14),   # except Exception: pass
-    ("STA007", 21),   # bare except, nothing surfaces
-    ("STA007", 28),   # except BaseException as e, e unused
+    ("STA007", 14),   # trainer: except Exception: pass
+    ("STA007", 21),   # trainer: bare except, nothing surfaces
+    ("STA007", 28),   # trainer: except BaseException as e, e unused
+    ("STA007", 17),   # runner: swallowed worker failure
+    ("STA007", 24),   # runner: bare except around spawn
 ]
 SUPPRESSED = [
     ("STA003", 60),  # sta: disable=STA003
-    ("STA007", 63),  # sta: disable=STA007
+    ("STA007", 63),  # trainer: sta: disable=STA007
+    ("STA007", 38),  # runner: sta: disable=STA007
 ]
 
 
@@ -129,8 +134,9 @@ def test_rule_table_is_stable():
 
 def test_swallowed_exception_only_flagged_in_scope_dirs(tmp_path):
     """STA007 is scoped to the fault-surfacing layers (trainer/,
-    checkpoint/, data/, resilience/); the same code outside them is
-    legal (ISSUE 3 satellite)."""
+    checkpoint/, data/, resilience/, and — since ISSUE 4 — runner/, so
+    supervisor error paths can't silently eat worker failures); the
+    same code outside them is legal."""
     from scaling_tpu.analysis.lint import lint_file
 
     src = (
@@ -141,11 +147,12 @@ def test_swallowed_exception_only_flagged_in_scope_dirs(tmp_path):
         "        pass\n"
     )
     assert _lint_source(tmp_path, src) == []  # not under a scope dir
-    d = tmp_path / "trainer"
-    d.mkdir()
-    f2 = d / "mod.py"
-    f2.write_text(src)
-    assert [f.rule for f in lint_file(f2, root=tmp_path)] == ["STA007"]
+    for scope in ("trainer", "runner"):
+        d = tmp_path / scope
+        d.mkdir()
+        f2 = d / "mod.py"
+        f2.write_text(src)
+        assert [f.rule for f in lint_file(f2, root=tmp_path)] == ["STA007"], scope
 
 
 def test_findings_are_json_serializable(fixture_findings):

@@ -23,11 +23,14 @@ def _bench_env(**overrides):
     # this is set, overriding JAX_PLATFORMS — strip it so the subprocess
     # really runs the CPU fallback
     env.pop("PALLAS_AXON_POOL_IPS", None)
-    # share the suite's persistent compile cache so repeats are cheap
-    env.setdefault(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.environ.get("SCALING_TPU_TEST_CACHE", "/tmp/scaling_tpu_test_jaxcache"),
-    )
+    # share the suite's persistent compile cache so repeats are cheap;
+    # SCALING_TPU_TEST_CACHE=off leaves the cache disabled ("off" must
+    # not become a literal cache dir)
+    from scaling_tpu.analysis import resolve_test_cache_dir
+
+    cache_dir = resolve_test_cache_dir()
+    if cache_dir is not None:
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
     env.update(overrides)
     return env
 

@@ -1,0 +1,60 @@
+"""Structured lifecycle events (ISSUE 4 satellite): ``logger.log_event``
+must land machine-parseable JSON lines in the configured events file —
+post-mortems of supervised runs cannot depend on scraping stderr."""
+
+import io
+import json
+import logging as pylogging
+
+import pytest
+
+from scaling_tpu.logging import LoggerConfig, logger
+
+
+def _read(path):
+    return [json.loads(l) for l in path.read_text().splitlines()]
+
+
+@pytest.fixture()
+def mirror():
+    """Tap the logger's own pipeline (its console handler holds a stream
+    bound before pytest's capture fixtures layer in, so capsys/capfd
+    can't see it)."""
+    buf = io.StringIO()
+    handler = pylogging.StreamHandler(buf)
+    logger._log.addHandler(handler)
+    yield buf
+    logger._log.removeHandler(handler)
+
+
+def test_log_event_appends_jsonl_via_env(tmp_path, monkeypatch, mirror):
+    events = tmp_path / "events.jsonl"
+    monkeypatch.setenv("SCALING_TPU_EVENTS_PATH", str(events))
+    logger.log_event("host-dead", epoch=0, hosts=[1], reason="exit")
+    logger.log_event("relaunch", epoch=1, restarts=1)
+    recs = _read(events)
+    assert [r["event"] for r in recs] == ["host-dead", "relaunch"]
+    assert recs[0]["hosts"] == [1] and recs[0]["reason"] == "exit"
+    assert all("ts" in r for r in recs)
+    # mirrored to the human log too
+    assert "EVENT" in mirror.getvalue()
+
+
+def test_log_event_config_path_and_nonserializable(tmp_path, monkeypatch):
+    monkeypatch.delenv("SCALING_TPU_EVENTS_PATH", raising=False)
+    events = tmp_path / "ev.jsonl"
+    logger.configure(LoggerConfig.from_dict({"events_path": str(events)}))
+    try:
+        # non-JSON values must degrade via str(), never raise mid-teardown
+        logger.log_event("teardown-complete", path=tmp_path)
+        recs = _read(events)
+        assert recs[0]["event"] == "teardown-complete"
+        assert recs[0]["path"] == str(tmp_path)
+    finally:
+        logger.configure(LoggerConfig())
+
+
+def test_log_event_without_sink_only_mirrors(monkeypatch, mirror):
+    monkeypatch.delenv("SCALING_TPU_EVENTS_PATH", raising=False)
+    logger.log_event("epoch-start", epoch=0)  # must not raise
+    assert "epoch-start" in mirror.getvalue()

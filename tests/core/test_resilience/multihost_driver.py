@@ -1,0 +1,49 @@
+"""Supervisor driver for the multi-host e2e tests.
+
+NOT collected by pytest. Runs ``runner_main`` in supervised mode exactly
+as an operator would, in its own process so the parent test can enforce
+a hard wall-clock timeout around the WHOLE supervision tree (epochs,
+teardowns, relaunches included). The supervisor itself never imports
+jax — only the fake hosts it spawns do.
+
+Usage: ``python tests/core/test_resilience/multihost_driver.py SPEC.json``
+
+Spec keys: ``master_port``, ``num_hosts``, ``control_dir``, ``payload``
+(forwarded to multihost_script), plus optional supervisor knobs
+``heartbeat_timeout`` / ``startup_grace`` / ``restart_budget`` /
+``restart_backoff`` / ``worker_grace``.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[3]
+
+
+def main() -> int:
+    spec = json.loads(Path(sys.argv[1]).read_text())
+    sys.path.insert(0, str(REPO))
+
+    from scaling_tpu.runner import RunnerConfig, runner_main
+
+    config = RunnerConfig.from_dict({
+        "runner_type": "pdsh",
+        "hosts": ["localhost"],
+        "master_addr": "127.0.0.1",
+        "master_port": spec["master_port"],
+        "script": "tests.core.test_resilience.multihost_script",
+        "default_gpu_count": spec.get("num_hosts", 2),
+        "supervise": True,
+        "control_dir": spec["control_dir"],
+        "heartbeat_timeout_seconds": spec.get("heartbeat_timeout", 60.0),
+        "startup_grace_seconds": spec.get("startup_grace", 240.0),
+        "restart_budget": spec.get("restart_budget", 1),
+        "restart_backoff_seconds": spec.get("restart_backoff", 0.1),
+        "worker_grace_seconds": spec.get("worker_grace", 5.0),
+    })
+    return runner_main(config, payload=spec["payload"])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

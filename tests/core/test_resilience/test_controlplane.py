@@ -1,0 +1,334 @@
+"""Unit coverage for the multi-host control plane (ISSUE 4): heartbeat
+publish/read, named barriers (completion, timeout, abort interruption,
+liveness refresh while waiting), broadcast flags, the env factory, and
+the straggler classification — for both the file-backed and the TCP
+backend. Host-side only, no jax."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from scaling_tpu.resilience import (
+    BarrierTimeout,
+    FileControlPlane,
+    JobAborted,
+    TcpControlPlane,
+    TcpControlPlaneServer,
+    straggler_table,
+)
+from scaling_tpu.resilience.controlplane import (
+    ABORT_FLAG,
+    ENV_CONTROL_DIR,
+    ENV_HOST_ID,
+    ENV_NUM_HOSTS,
+    controlplane_from_env,
+)
+
+
+@pytest.fixture(params=["file", "tcp"])
+def plane_pair(request, tmp_path):
+    """Two hosts on one control plane, either backend."""
+    if request.param == "file":
+        yield (FileControlPlane(tmp_path, 0, 2), FileControlPlane(tmp_path, 1, 2))
+    else:
+        srv = TcpControlPlaneServer()
+        yield (
+            TcpControlPlane(srv.address, 0, 2),
+            TcpControlPlane(srv.address, 1, 2),
+        )
+        srv.close()
+
+
+def test_heartbeats_roundtrip(plane_pair):
+    a, b = plane_pair
+    a.heartbeat(3)
+    b.heartbeat(7, status="starting")
+    for reader in (a, b):
+        hb = reader.peer_heartbeats()
+        assert hb[0].step == 3 and hb[0].status == "running"
+        assert hb[1].step == 7 and hb[1].status == "starting"
+        assert hb[0].age() < 5.0
+
+
+def test_heartbeat_newest_wins(plane_pair):
+    a, b = plane_pair
+    a.heartbeat(1)
+    a.heartbeat(2)
+    a.heartbeat(5, status="done")
+    hb = b.peer_heartbeats()[0]
+    assert (hb.step, hb.status) == (5, "done")
+
+
+def test_flags_broadcast(plane_pair):
+    a, b = plane_pair
+    assert a.get_flag("preempt") is None
+    b.set_flag("preempt", "3")
+    assert a.get_flag("preempt") == "3"
+    assert b.get_flag("preempt") == "3"
+
+
+def test_barrier_completes_when_all_arrive(plane_pair):
+    a, b = plane_pair
+    done = []
+
+    def other():
+        b.barrier("step-1", timeout_s=10)
+        done.append("b")
+
+    t = threading.Thread(target=other)
+    t.start()
+    a.barrier("step-1", timeout_s=10)
+    t.join(timeout=10)
+    assert done == ["b"]
+    # re-entering a completed barrier returns immediately (arrivals are
+    # sticky within one epoch's namespace — re-reached saves rely on it)
+    a.barrier("step-1", timeout_s=0.5)
+
+
+def test_arrive_registers_without_waiting(plane_pair):
+    """`arrive` is the exit-path half of the barrier protocol: a host
+    that will never re-enter the loop registers its arrival so a peer
+    already parked inside the barrier releases instead of waiting out
+    the timeout."""
+    a, b = plane_pair
+    released = []
+
+    def parked():
+        a.barrier("step-5", timeout_s=10)
+        released.append(1)
+
+    t = threading.Thread(target=parked)
+    t.start()
+    time.sleep(0.2)
+    start = time.monotonic()
+    b.arrive("step-5")  # returns immediately, no wait
+    assert time.monotonic() - start < 2.0
+    t.join(timeout=10)
+    assert released == [1]
+
+
+def test_prune_barrier_drops_arrival_state(plane_pair):
+    """Pruned barriers forget their arrivals (long-run state bound);
+    until pruned, completed barriers stay sticky for re-entry."""
+    a, b = plane_pair
+    a.arrive("step-0")
+    b.arrive("step-0")
+    a.barrier("step-0", timeout_s=2)  # complete: re-entry is instant
+    a.prune_barrier("step-0")
+    with pytest.raises(BarrierTimeout):
+        # only our own (re-)arrival exists now
+        a.barrier("step-0", timeout_s=0.3)
+
+
+def test_heartbeat_age_ignores_publisher_clock_skew(plane_pair):
+    """Staleness must never compare the publisher's wall clock against
+    the reader's: a worker 9999s 'behind' would otherwise read as hung
+    forever. File backend trusts mtime, TCP backend receipt-stamps with
+    the server clock."""
+    from scaling_tpu.resilience.controlplane import HostHeartbeat
+
+    a, b = plane_pair
+    a._publish_heartbeat(HostHeartbeat(0, 3, "running", time.time() - 9999.0))
+    assert b.peer_heartbeats()[0].age() < 30.0
+
+
+def test_checkin_exit_path_releases_parked_peer(plane_pair):
+    """The preemption race (docs/RESILIENCE.md): host 1 decides to exit
+    at boundary 3 while host 0 is ALREADY parked inside the step-3
+    barrier. Host 1's checkin must broadcast the flag and register its
+    arrival (without waiting), so host 0 releases and its post-barrier
+    flag check joins the same-boundary save."""
+    from types import SimpleNamespace
+
+    from scaling_tpu.trainer.trainer import BaseTrainer
+
+    a, b = plane_pair
+    trainer = object.__new__(BaseTrainer)
+    trainer._control_plane = b
+    trainer._cp_first_checkin = False
+    trainer._cp_step_barrier = True
+    trainer._cp_barrier_timeout = 10.0
+    trainer._preempted = True  # SIGTERM landed on host 1
+    trainer.context = SimpleNamespace(iterations=3)
+    released = []
+
+    def parked():
+        a.barrier("step-3", timeout_s=10)
+        released.append(1)
+
+    t = threading.Thread(target=parked)
+    t.start()
+    time.sleep(0.2)
+    start = time.monotonic()
+    # pre-barrier decision: exit at THIS boundary, without waiting
+    assert trainer._control_plane_checkin() is True
+    assert time.monotonic() - start < 2.0
+    t.join(timeout=10)
+    assert released == [1]
+    assert trainer._preempted
+    # flag was set BEFORE the arrival, so the released peer's post-
+    # barrier check cannot miss it
+    assert a.get_flag("preempt") == "3"
+
+
+def test_barrier_times_out_when_peer_missing(plane_pair):
+    a, _ = plane_pair
+    start = time.monotonic()
+    with pytest.raises(BarrierTimeout, match="1/2 hosts arrived"):
+        a.barrier("lonely", timeout_s=0.3)
+    assert time.monotonic() - start < 5.0
+
+
+def test_barrier_aborts_fast_on_abort_flag(plane_pair):
+    """Teardown latency: a survivor parked at a barrier must exit within
+    polls of the abort flag, NOT after the full barrier timeout."""
+    a, b = plane_pair
+    errs = []
+
+    def waiter():
+        try:
+            a.barrier("never", timeout_s=60)
+        except JobAborted as e:
+            errs.append(e)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.2)
+    b.set_flag(ABORT_FLAG, "host-dead")
+    t.join(timeout=5)
+    assert not t.is_alive() and len(errs) == 1
+
+
+def test_barrier_wait_refreshes_heartbeat(plane_pair):
+    """A host waiting at a barrier is ALIVE: its heartbeat must keep
+    refreshing so the supervisor's staleness detector only catches truly
+    wedged hosts."""
+    a, b = plane_pair
+    a.heartbeat(4)
+    first = b.peer_heartbeats()[0]
+    with pytest.raises(BarrierTimeout):
+        a.barrier("parked", timeout_s=1.6)
+    hb = b.peer_heartbeats()[0]
+    assert hb.wall > first.wall
+    assert hb.status.startswith("barrier:")
+    assert hb.step == 4  # progress marker survives the refresh
+
+
+def test_controlplane_from_env(tmp_path, monkeypatch):
+    monkeypatch.delenv(ENV_CONTROL_DIR, raising=False)
+    monkeypatch.delenv("SCALING_TPU_CONTROL_ADDR", raising=False)
+    assert controlplane_from_env() is None  # unconfigured: no-op
+    monkeypatch.setenv(ENV_CONTROL_DIR, str(tmp_path))
+    monkeypatch.setenv(ENV_HOST_ID, "1")
+    monkeypatch.setenv(ENV_NUM_HOSTS, "3")
+    cp = controlplane_from_env()
+    assert isinstance(cp, FileControlPlane)
+    assert (cp.host_id, cp.num_hosts) == (1, 3)
+    cp.heartbeat(2)
+    assert FileControlPlane(tmp_path, 0, 3).peer_heartbeats()[1].step == 2
+
+
+def test_tcp_from_env(monkeypatch):
+    srv = TcpControlPlaneServer()
+    try:
+        monkeypatch.delenv(ENV_CONTROL_DIR, raising=False)
+        monkeypatch.setenv("SCALING_TPU_CONTROL_ADDR", srv.address)
+        monkeypatch.setenv(ENV_HOST_ID, "0")
+        monkeypatch.setenv(ENV_NUM_HOSTS, "2")
+        cp = controlplane_from_env()
+        assert isinstance(cp, TcpControlPlane)
+        cp.set_flag("x", "y")
+        assert cp.get_flag("x") == "y"
+    finally:
+        srv.close()
+
+
+def test_on_step_stall_verdict_and_event(tmp_path, monkeypatch):
+    """The watchdog callback (ISSUE 4 satellite): with a control plane
+    attached it consults peer heartbeats, renders the straggler table,
+    and emits a structured ``step-stall`` event whose verdict separates
+    "peer host dead" from "local stall"."""
+    import json
+
+    from scaling_tpu.trainer.trainer import BaseTrainer
+
+    events = tmp_path / "events.jsonl"
+    monkeypatch.setenv("SCALING_TPU_EVENTS_PATH", str(events))
+    trainer = object.__new__(BaseTrainer)  # only the stall path is poked
+    cp = FileControlPlane(tmp_path / "cp", 0, 2)
+    cp.heartbeat(5)  # we are alive; peer host 1 never published
+    trainer._control_plane = cp
+    trainer._cp_peer_stale = 1.0
+    trainer._preempted = False
+    trainer._on_step_stall(5, 33.0)
+    assert trainer._preempted  # save-and-exit requested at the next boundary
+    # the stall flag tells the supervisor the coming clean drain is NOT
+    # a finished run (it must relaunch, not report success)
+    assert cp.get_flag("stall") == "5"
+    recs = [json.loads(l) for l in events.read_text().splitlines()]
+    stall = [r for r in recs if r["event"] == "step-stall"]
+    assert len(stall) == 1
+    assert stall[0]["verdict"] == "peer-host-dead"
+    assert stall[0]["dead_hosts"] == [1]
+    assert stall[0]["step"] == 5 and stall[0]["host"] == 0
+
+    # no control plane: the stall can only be local
+    solo = object.__new__(BaseTrainer)
+    solo._control_plane = None
+    solo._preempted = False
+    solo._on_step_stall(3, 10.0)
+    assert solo._preempted
+    recs = [json.loads(l) for l in events.read_text().splitlines()]
+    assert recs[-1]["verdict"] == "local-stall" and recs[-1]["dead_hosts"] == []
+
+
+def test_on_step_stall_own_stale_heartbeat_is_not_a_dead_peer(
+    tmp_path, monkeypatch
+):
+    """During a LOCAL stall this host's own heartbeat is necessarily
+    stale (the main thread is stuck inside the step, not publishing) —
+    the verdict must not count ourselves as a dead peer and invert the
+    local-vs-peer diagnosis the straggler table exists to provide."""
+    import json
+
+    from scaling_tpu.trainer.trainer import BaseTrainer
+
+    events = tmp_path / "events.jsonl"
+    monkeypatch.setenv("SCALING_TPU_EVENTS_PATH", str(events))
+    trainer = object.__new__(BaseTrainer)
+    cp = FileControlPlane(tmp_path / "cp", 0, 2)
+    # our own last heartbeat predates the stall window; peer 1 is fresh
+    cp.heartbeat(5)
+    own = tmp_path / "cp" / "heartbeat" / "host0.json"
+    old = time.time() - 120.0
+    os.utime(own, (old, old))
+    peer = FileControlPlane(tmp_path / "cp", 1, 2)
+    peer.heartbeat(5)
+    trainer._control_plane = cp
+    trainer._cp_peer_stale = 1.0
+    trainer._preempted = False
+    trainer._on_step_stall(5, 33.0)
+    recs = [json.loads(l) for l in events.read_text().splitlines()]
+    stall = [r for r in recs if r["event"] == "step-stall"][-1]
+    assert stall["verdict"] == "local-stall"
+    assert stall["dead_hosts"] == []
+
+
+def test_straggler_table_classification():
+    from scaling_tpu.resilience.controlplane import HostHeartbeat
+
+    now = time.time()
+    hbs = {
+        0: HostHeartbeat(0, 10, "running", now - 1.0),
+        1: HostHeartbeat(1, 9, "running", now - 120.0),  # stale -> dead
+        # host 2 never published
+    }
+    report = straggler_table(hbs, num_hosts=3, stale_after_s=30.0, now=now)
+    assert report.dead_hosts == [1, 2]
+    states = {h: s for h, _, _, s in report.rows}
+    assert states == {0: "running", 1: "dead", 2: "never-heartbeat"}
+    rendered = report.render()
+    assert "never-heartbeat" in rendered and "dead" in rendered
+    assert rendered.splitlines()[0].split() == ["host", "step", "hb_age_s", "state"]

@@ -71,6 +71,40 @@ def test_corrupt_file_truncates(tmp_path):
     assert f.stat().st_size == 50
 
 
+def test_host_selector_scopes_rule_to_one_host(monkeypatch):
+    """``@host=K`` (ISSUE 4): the rule fires only in the process whose
+    SCALING_TPU_HOST_ID is K; other hosts — and unsupervised processes
+    with no host identity at all — count hits but never fire."""
+    spec = "data.read=fail@2@host=1"
+    # no host identity: never fires
+    monkeypatch.delenv("SCALING_TPU_HOST_ID", raising=False)
+    plan = FaultPlan(spec)
+    for _ in range(4):
+        assert plan.fire("data.read") is None
+    # wrong host: never fires
+    monkeypatch.setenv("SCALING_TPU_HOST_ID", "0")
+    plan = FaultPlan(spec)
+    for _ in range(4):
+        assert plan.fire("data.read") is None
+    # matching host: fires on its window exactly
+    monkeypatch.setenv("SCALING_TPU_HOST_ID", "1")
+    plan = FaultPlan(spec)
+    assert plan.fire("data.read") is None
+    with pytest.raises(InjectedFault):
+        plan.fire("data.read")
+    assert plan.fire("data.read") is None
+
+
+def test_host_selector_composes_with_windows():
+    plan = FaultPlan("host.kill=kill@5@host=1,ckpt.write=corrupt@3x2@host=0")
+    r1 = plan._rules["host.kill"]
+    assert (r1.action, r1.first, r1.count, r1.host) == ("kill", 5, 1, 1)
+    r2 = plan._rules["ckpt.write"]
+    assert (r2.action, r2.first, r2.count, r2.host) == ("corrupt", 3, 2, 0)
+    # hang parses as an executed action
+    assert FaultPlan("host.hang=hang@4")._rules["host.hang"].action == "hang"
+
+
 # -------------------------------------------------------------- retry_io
 def test_retry_io_recovers_from_transient_failures():
     calls = {"n": 0}

@@ -86,6 +86,79 @@ def test_resource_pool_parsing(tmp_path: Path):
     assert pool == {"worker-0": 4, "worker-1": 2}
 
 
+def test_resource_pool_ignores_blanks_and_trailing_comments(tmp_path: Path):
+    """Hostsfile hygiene (ISSUE 4 satellite): blank lines, whole-line
+    comments, and trailing comments must all be inert — and a line that
+    is only whitespace after comment stripping is skipped too."""
+    hostsfile = tmp_path / "hostsfile"
+    hostsfile.write_text(
+        "\n"
+        "# leading comment\n"
+        "worker-0 slots=4  # trailing comment\n"
+        "   \n"
+        "   # indented comment-only line\n"
+        "worker-1\n"
+        "\n"
+    )
+    pool = get_resource_pool(
+        RunnerConfig.from_dict({"hostsfile": str(hostsfile),
+                                "default_gpu_count": 8})
+    )
+    assert pool == {"worker-0": 4, "worker-1": 8}
+
+
+def test_resource_pool_rejects_duplicate_hostnames(tmp_path: Path):
+    """A duplicate host silently overwriting the first entry launches the
+    wrong world size and strands the rendezvous — it must be a hard,
+    located error instead."""
+    hostsfile = tmp_path / "hostsfile"
+    hostsfile.write_text("worker-0 slots=4\nworker-1 slots=2\nworker-0 slots=8\n")
+    with pytest.raises(ValueError, match=r"duplicate hostname 'worker-0' at line 3"):
+        get_resource_pool(RunnerConfig.from_dict({"hostsfile": str(hostsfile)}))
+    with pytest.raises(ValueError, match="duplicate hostname 'h1'"):
+        get_resource_pool(RunnerConfig.from_dict({"hosts": ["h1", "h2", "h1"]}))
+
+
+@pytest.mark.parametrize("runner_type", ["pdsh", "pdsh_docker"])
+def test_payload_survives_shell_quoting_roundtrip(runner_type: str):
+    """encode_payload -> build_worker_command -> the ssh-style requote ->
+    shlex.split must hand the worker the exact payload back, including
+    spaces, quotes, and unicode in paths (ISSUE 4 satellite: the payload
+    rides as an argv token through ssh/docker wrapping)."""
+    import base64
+    import shlex
+
+    from scaling_tpu.runner.runner import build_worker_command, encode_payload
+
+    payload = {
+        "workdir": "/data/runs/my run (v2)/ünïcodé—路径",
+        "note": 'quotes \' " and $VARS and `ticks` survive',
+        "steps": 8,
+        "nested": {"hosts": ["a b", "c\td"]},
+    }
+    encoded = encode_payload(payload)
+    cfg = RunnerConfig.from_dict({
+        "runner_type": runner_type,
+        "hosts": ["worker-0"],
+        "script": "scaling_tpu.models.transformer.train",
+        "docker_config": (
+            {"docker_container": "img:1"} if runner_type == "pdsh_docker"
+            else None
+        ),
+    })
+    cmd = build_worker_command(cfg, {"RANK": "0"}, encoded)
+    # the ssh path re-quotes the argv into one shell line; a worker's
+    # shell then re-splits it — the payload token must survive unchanged
+    quoted = " ".join(shlex.quote(a) for a in cmd)
+    resplit = shlex.split(quoted)
+    assert resplit == cmd
+    (payload_arg,) = [a for a in resplit if a.startswith("--payload=")]
+    decoded = json.loads(
+        base64.urlsafe_b64decode(payload_arg[len("--payload="):]).decode()
+    )
+    assert decoded == payload
+
+
 def test_docker_worker_command_assembly():
     """runner_type=pdsh_docker wraps the worker in docker run with env
     passthrough (PYTHON* skipped), bind mounts, privileged + host network

@@ -1,0 +1,264 @@
+"""Unit coverage for the supervisor's detection policy and teardown
+(ISSUE 4): dead/hung classification from exit codes + heartbeats, the
+startup-grace regime for cold-compiling workers, supervised-mode config
+validation, and teardown's SIGTERM→SIGKILL escalation against real
+(trivial) subprocesses. The full spawn→kill→relaunch→resume cycle rides
+tests/core/test_resilience/test_multihost.py."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from scaling_tpu.resilience.controlplane import FileControlPlane, HostHeartbeat
+from scaling_tpu.runner import RunnerConfig
+from scaling_tpu.runner.supervise import classify_workers, _teardown
+
+
+def _hb(host, step, status, age, now):
+    return HostHeartbeat(host, step, status, now - age)
+
+
+def test_classify_dead_by_exit_code():
+    now = time.time()
+    verdict = classify_workers(
+        [None, -9, 0, 1], {0: _hb(0, 5, "running", 0.1, now)},
+        heartbeat_timeout_s=30, startup_grace_s=300,
+        epoch_elapsed_s=50, now=now,
+    )
+    # -9 (SIGKILL) and 1 are dead; 0 exited clean; host 0 lives
+    assert verdict == {"dead": [1, 3], "hung": [], "alive": [0]}
+
+
+def test_classify_hung_by_stale_heartbeat():
+    now = time.time()
+    verdict = classify_workers(
+        [None, None],
+        {0: _hb(0, 5, "running", 0.5, now), 1: _hb(1, 5, "running", 99.0, now)},
+        heartbeat_timeout_s=30, startup_grace_s=100,
+        epoch_elapsed_s=200, now=now,
+    )
+    assert verdict == {"dead": [], "hung": [1], "alive": [0]}
+
+
+def test_classify_grace_covers_post_barrier_compile_silence():
+    """The first checkin publishes 'starting', the step-0 barrier wait
+    refreshes it to 'barrier:step-0', and then the cold jit compile of
+    step 1 goes silent for minutes. That staleness must ride the
+    startup grace — NOT the steady-state heartbeat timeout — or every
+    epoch with a slow compile is torn down mid-startup."""
+    now = time.time()
+    kw = dict(heartbeat_timeout_s=30, startup_grace_s=300, now=now)
+    verdict = classify_workers(
+        [None], {0: _hb(0, 0, "barrier:step-0", 120.0, now)},
+        epoch_elapsed_s=150, **kw,
+    )
+    assert verdict == {"dead": [], "hung": [], "alive": [0]}
+    # same silence after the grace: genuinely hung
+    verdict = classify_workers(
+        [None], {0: _hb(0, 0, "barrier:step-0", 120.0, now)},
+        epoch_elapsed_s=400, **kw,
+    )
+    assert verdict == {"dead": [], "hung": [0], "alive": []}
+
+
+def test_classify_startup_grace_covers_compile():
+    """No heartbeat yet — or an explicit 'starting' one — answers to the
+    startup grace (imports + cold jit compile), not the steady-state
+    heartbeat timeout."""
+    now = time.time()
+    kw = dict(heartbeat_timeout_s=5, startup_grace_s=120, now=now)
+    # 60s in, nothing published / 50s-old 'starting': still within grace
+    verdict = classify_workers(
+        [None, None], {1: _hb(1, 0, "starting", 50.0, now)},
+        epoch_elapsed_s=60, **kw,
+    )
+    assert verdict == {"dead": [], "hung": [], "alive": [0, 1]}
+    # grace expired: both hung
+    verdict = classify_workers(
+        [None, None], {1: _hb(1, 0, "starting", 200.0, now)},
+        epoch_elapsed_s=200, **kw,
+    )
+    assert verdict == {"dead": [], "hung": [0, 1], "alive": []}
+
+
+def test_classify_winding_down_statuses_never_hang():
+    """'done'/'preempted' heartbeats mean the worker is finalizing
+    (async checkpoint drain can be slow) — staleness there is not a
+    hang."""
+    now = time.time()
+    verdict = classify_workers(
+        [None, None],
+        {0: _hb(0, 8, "done", 500.0, now), 1: _hb(1, 3, "preempted", 500.0, now)},
+        heartbeat_timeout_s=5, startup_grace_s=60,
+        epoch_elapsed_s=600, now=now,
+    )
+    assert verdict == {"dead": [], "hung": [], "alive": [0, 1]}
+
+
+def test_classify_barrier_wait_is_alive():
+    now = time.time()
+    verdict = classify_workers(
+        [None], {0: _hb(0, 6, "barrier:commit:step-6", 1.0, now)},
+        heartbeat_timeout_s=10, startup_grace_s=60,
+        epoch_elapsed_s=100, now=now,
+    )
+    assert verdict == {"dead": [], "hung": [], "alive": [0]}
+
+
+def test_supervise_requires_control_dir():
+    from scaling_tpu.runner.supervise import supervise_main
+
+    config = RunnerConfig.from_dict({"hosts": ["localhost"], "supervise": True})
+    with pytest.raises(ValueError, match="control_dir"):
+        supervise_main(config, payload={})
+
+
+def test_teardown_remote_hosts_get_best_effort_pkill(tmp_path, monkeypatch):
+    """Killing the local ssh client Popen does not kill the remote
+    worker: teardown must pkill every remote host, scoped to this
+    launch's unique payload marker — TERM first (the ssh clients exit
+    instantly, so only a remote TERM gives the workers a real grace
+    window), KILL after the grace."""
+    from scaling_tpu.runner import supervise
+
+    calls = []
+    monkeypatch.setattr(
+        supervise.subprocess, "run",
+        lambda cmd, **kw: (
+            calls.append(cmd),
+            subprocess.CompletedProcess(cmd, 0, b"", b""),
+        )[1],
+    )
+    cp = FileControlPlane(tmp_path, 0, 3)
+    config = RunnerConfig.from_dict({
+        "hosts": ["tpu-a", "tpu-b"], "supervise": True,
+        "control_dir": str(tmp_path), "worker_grace_seconds": 0.1,
+    })
+    encoded = "x" * 100
+    _teardown(
+        cp, [], [("tpu-a", 0), ("tpu-b", 0), ("localhost", 0)],
+        encoded, config,
+    )
+    # localhost skipped; TERM round, then KILL round after the grace
+    assert [c[1] for c in calls] == ["tpu-a", "tpu-b", "tpu-a", "tpu-b"]
+    assert [c[2].split()[1] for c in calls] == [
+        "-TERM", "-TERM", "-KILL", "-KILL"
+    ]
+    for c in calls:
+        assert c[0] == "ssh" and f"--payload={'x' * 48}" in c[2]
+
+
+def test_relay_sigterm_signals_workers_not_flag(monkeypatch):
+    """Supervisor preemption must arrive as SIGTERM to each worker (the
+    race-free protocol entry), local via Popen.terminate and remote via
+    ssh pkill -TERM; already-exited workers are skipped."""
+    from scaling_tpu.runner import supervise
+    from scaling_tpu.runner.supervise import _relay_sigterm
+
+    ssh_calls = []
+    monkeypatch.setattr(
+        supervise.subprocess, "run",
+        lambda cmd, **kw: (
+            ssh_calls.append(cmd),
+            subprocess.CompletedProcess(cmd, 0, b"", b""),
+        )[1],
+    )
+
+    class FakeProc:
+        def __init__(self, rc=None):
+            self.rc, self.terminated = rc, False
+
+        def poll(self):
+            return self.rc
+
+        def terminate(self):
+            self.terminated = True
+
+    local, done, remote = FakeProc(), FakeProc(rc=0), FakeProc()
+    _relay_sigterm(
+        [local, done, remote],
+        [("localhost", 0), ("localhost", 1), ("tpu-b", 0)],
+        "y" * 100,
+    )
+    assert local.terminated and not done.terminated
+    assert not remote.terminated  # ssh client NOT killed — remote pkill'd
+    assert len(ssh_calls) == 1 and ssh_calls[0][1] == "tpu-b"
+    assert "pkill -TERM" in ssh_calls[0][2]
+
+
+def test_epoch_stall_drain_is_not_success(tmp_path, monkeypatch):
+    """All workers exiting 0 normally ends the run — but not when the
+    stall flag is up: a watchdog-initiated drain saved and exited
+    cleanly MID-training, and reporting success would silently drop the
+    rest of the run. The supervisor must count that epoch failed so the
+    budgeted relaunch resumes it."""
+    import json
+
+    from scaling_tpu.runner import supervise
+
+    events = tmp_path / "events.jsonl"
+    monkeypatch.setenv("SCALING_TPU_EVENTS_PATH", str(events))
+    control_root = tmp_path / "cp"
+
+    class DoneProc:
+        pid = 4242
+
+        def poll(self):
+            return 0
+
+    def stalled_spawn(config, host, env, encoded):
+        # a worker that hit the step-stall watchdog: raised the stall
+        # flag, saved, drained, exited 0
+        FileControlPlane(control_root / "epoch-0", 0, 1).set_flag("stall", "7")
+        return DoneProc()
+
+    monkeypatch.setattr(supervise, "spawn_worker", stalled_spawn)
+    config = RunnerConfig.from_dict({
+        "hosts": ["localhost"], "supervise": True,
+        "control_dir": str(control_root), "supervisor_poll_seconds": 0.01,
+    })
+    args = (config, {"localhost": 1}, [("localhost", 0)], "payload",
+            "localhost", control_root)
+    assert supervise._run_epoch(*args, 0, {"preempted": False}) == 1
+    recs = [json.loads(l) for l in events.read_text().splitlines()]
+    stalled = [r for r in recs if r["event"] == "epoch-stalled"]
+    assert len(stalled) == 1 and stalled[0]["stall_step"] == "7"
+
+    # without the flag the same all-zero exit is a clean finish
+    monkeypatch.setattr(
+        supervise, "spawn_worker", lambda *a, **k: DoneProc()
+    )
+    assert supervise._run_epoch(*args, 1, {"preempted": False}) == 0
+
+
+def test_teardown_escalates_sigterm_to_sigkill(tmp_path):
+    """A worker that ignores SIGTERM (wedged collective) must be
+    SIGKILLed after the grace period; a cooperative worker dies on
+    SIGTERM alone. Both are reaped, and the abort flag is raised first
+    so barrier-parked survivors bail out on their own."""
+    cp = FileControlPlane(tmp_path, 0, 2)
+    config = RunnerConfig.from_dict({
+        "hosts": ["localhost"], "supervise": True,
+        "control_dir": str(tmp_path), "worker_grace_seconds": 1.0,
+    })
+    stubborn = subprocess.Popen([
+        sys.executable, "-c",
+        "import signal, time\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        "print('armed', flush=True)\n"
+        "time.sleep(600)\n"
+    ], stdout=subprocess.PIPE, text=True)
+    meek = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(600)"])
+    assert stubborn.stdout.readline().strip() == "armed"  # SIG_IGN installed
+    start = time.monotonic()
+    _teardown(
+        cp, [stubborn, meek], [("localhost", 0), ("localhost", 1)],
+        "PAYLOADB64", config,
+    )
+    elapsed = time.monotonic() - start
+    assert stubborn.poll() == -9  # escalated
+    assert meek.poll() == -15  # SIGTERM sufficed
+    assert cp.get_flag("abort") is not None
+    assert elapsed < 30
