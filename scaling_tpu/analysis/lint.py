@@ -22,7 +22,7 @@ STA006    warning   dtype literal that bypasses the configured precision
                     admits bf16/f32 via ``precision`` config only).
 STA007    error     swallowed exception in resilience-critical code
                     (``trainer/``, ``checkpoint/``, ``data/``,
-                    ``resilience/``, ``runner/``): a bare ``except:`` /
+                    ``resilience/``, ``runner/``, ``obs/``): a bare ``except:`` /
                     ``except Exception`` / ``except BaseException``
                     handler that neither re-raises, logs, nor uses the
                     bound exception — a fault-masking black hole in the
@@ -81,6 +81,9 @@ SWALLOW_SCOPE_DIRS = (
     "data",
     "resilience",
     "runner",
+    # ISSUE 5: telemetry that silently eats its own failures is telemetry
+    # you cannot trust during the post-mortem that needed it
+    "obs",
 )
 
 # calls that count as "the handler surfaced the problem"

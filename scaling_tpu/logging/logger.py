@@ -37,6 +37,20 @@ class LoggerConfig(BaseConfig):
         "The SCALING_TPU_EVENTS_PATH env var overrides/provides this for "
         "subprocesses",
     )
+    metrics_path: Optional[str] = Field(
+        None,
+        description="jsonl file for per-step metric records (the run-dir "
+        "analyzer's input, see docs/OBSERVABILITY.md). Defaults to "
+        "<log_dir>/metrics_rank_<rank>.jsonl whenever log_dir is set, so "
+        "telemetry is on by default for any run that logs at all; the "
+        "SCALING_TPU_METRICS_PATH env var overrides both",
+    )
+    metrics_jsonl: bool = Field(
+        True,
+        description="explicit off switch for the metrics jsonl sink "
+        "(false disables it even when log_dir/metrics_path is set; the "
+        "env var still wins)",
+    )
     metrics_ranks: Optional[List[int]] = Field(
         None, description="global ranks that record metrics; None -> rank 0 only"
     )
@@ -90,6 +104,7 @@ class _Logger:
         self._config: Optional[LoggerConfig] = None
         self._tb_writer: Any = None
         self._wandb: Any = None
+        self._warned_nonnumeric: set = set()
         self._ensure_console()
 
     def _ensure_console(self) -> None:
@@ -173,6 +188,47 @@ class _Logger:
         self._log.critical(msg)
 
     # ------------------------------------------------------------- metrics
+    def metrics_path(self) -> Optional[str]:
+        """Resolved per-step metrics JSONL path, or None when the sink is
+        off. ``metrics_ranks`` gates this resolution exactly like it
+        gates ``log_metrics`` — the registry's ``flush_step`` rides the
+        same decision, so a rank configured not to record metrics never
+        writes snapshots either. For an enabled rank: env override first
+        (a launcher redirecting a subprocess must win, same contract as
+        the events path), then the explicit config path, then the
+        log-dir default."""
+        import os
+
+        if self._config is not None and not _rank_enabled(
+            self._config.metrics_ranks, self._rank
+        ):
+            return None
+        env = os.environ.get("SCALING_TPU_METRICS_PATH")
+        if env:
+            return env
+        c = self._config
+        if c is None or not c.metrics_jsonl:
+            return None
+        if c.metrics_path:
+            return c.metrics_path
+        if c.log_dir:
+            return str(Path(c.log_dir) / f"metrics_rank_{self._rank}.jsonl")
+        return None
+
+    def _warn_dropped_metrics(self, keys: List[str]) -> None:
+        """One-time (per key) warning for non-numeric metric values the
+        structured sinks (jsonl/tensorboard) cannot record — silent drops
+        hide typos like logging a whole array object under 'loss'."""
+        fresh = [k for k in keys if k not in self._warned_nonnumeric]
+        if not fresh:
+            return
+        self._warned_nonnumeric.update(fresh)
+        self.warning(
+            "non-numeric metric value(s) dropped from structured sinks "
+            f"(console still shows them): {sorted(fresh)} — logged once "
+            "per key"
+        )
+
     def log_metrics(self, metrics: dict, step: int) -> None:
         if self._config is not None and not _rank_enabled(
             self._config.metrics_ranks, self._rank
@@ -183,10 +239,40 @@ class _Logger:
             for k, v in metrics.items()
         )
         self.info(f"step {step} | {rendered}")
+        numeric = {k: float(v) for k, v in metrics.items()
+                   if _is_number(v) and v is not None}
+        dropped = [k for k in metrics if k not in numeric]
+        if dropped:
+            self._warn_dropped_metrics(dropped)
+        path = self.metrics_path()
+        if path:
+            import json as _json
+            import math as _math
+            import time as _time
+
+            rec = {
+                "kind": "step", "step": step, "ts": _time.time(),
+                "host": _host_id(self._rank),
+                # NaN/Inf serialize as invalid-JSON bare tokens, which
+                # would corrupt the file exactly during the non-finite
+                # incidents this telemetry exists to diagnose; null keeps
+                # the line parseable everywhere (jq, Go/JS parsers) and
+                # the analyzer skips nulls
+                "metrics": {
+                    k: (v if _math.isfinite(v) else None)
+                    for k, v in numeric.items()
+                },
+            }
+            # single-syscall append (multi-writer-safe), no fsync: metric
+            # lines are per-step and advisory, unlike lifecycle events
+            try:
+                Path(path).parent.mkdir(parents=True, exist_ok=True)
+                append_jsonl_line(path, _json.dumps(rec, sort_keys=True))
+            except OSError as e:
+                self.warning(f"could not append metrics to {path}: {e!r}")
         if self._tb_writer is not None:
-            for k, v in metrics.items():
-                if _is_number(v):
-                    self._tb_writer.add_scalar(k, float(v), step)
+            for k, v in numeric.items():
+                self._tb_writer.add_scalar(k, v, step)
         if self._wandb is not None:  # pragma: no cover
             self._wandb.log(metrics, step=step)
 
@@ -194,7 +280,8 @@ class _Logger:
         self.info(f"config:\n{config.as_str()}")
 
     # -------------------------------------------------------------- events
-    def log_event(self, event: str, **fields: Any) -> None:
+    def log_event(self, event: str, _level: str = "info",
+                  _fsync: bool = True, **fields: Any) -> None:
         """Structured lifecycle event: one JSON line, append-only.
 
         Post-mortems of supervised multi-host runs (who died, when the
@@ -203,14 +290,21 @@ class _Logger:
         a single flushed JSON object in the events file
         (the ``SCALING_TPU_EVENTS_PATH`` env var, else
         ``LoggerConfig.events_path``) and is mirrored to the normal log.
-        Without a configured path only the mirror line is emitted."""
+        Without a configured path only the mirror line is emitted.
+        ``_level`` tunes only the mirror: high-frequency span events
+        mirror at debug so steady-state training stays readable, while
+        the events file receives every record either way. ``_fsync``
+        defaults on for lifecycle events (a crashed supervisor must not
+        lose its last transition); per-step span records pass False —
+        an fsync per span on the step path is exactly the overhead the
+        metrics sink already declines."""
         import json as _json
         import os as _os
         import time as _time
 
         rec = {"event": event, "ts": _time.time(), **fields}
         line = _json.dumps(rec, sort_keys=True, default=str)
-        self.info(f"EVENT {line}")
+        getattr(self, _level, self.info)(f"EVENT {line}")
         # env first: the field doc promises the env var OVERRIDES the
         # config value (a launcher redirecting a subprocess whose config
         # already declares a path must win)
@@ -222,9 +316,30 @@ class _Logger:
                 with open(path, "a") as f:
                     f.write(line + "\n")
                     f.flush()
-                    _os.fsync(f.fileno())
+                    if _fsync:
+                        _os.fsync(f.fileno())
             except OSError as e:
                 self.warning(f"could not append event to {path}: {e!r}")
+
+
+def append_jsonl_line(path: Any, line: str) -> None:
+    """Append one line in a SINGLE ``write(2)`` on an O_APPEND fd.
+
+    Multiple host processes may share one metrics file (the supervised
+    pod wires every worker's ``SCALING_TPU_METRICS_PATH`` at the same
+    place); Python's buffered file object splits writes above its 8 KiB
+    buffer into several syscalls, and a registry snapshot with many
+    labelled histograms can cross that — two hosts' partial writes would
+    interleave into torn lines. One syscall keeps the append atomic.
+    Lives here (stdlib-only, below both packages) so ``obs`` depends on
+    ``logging`` and never the reverse."""
+    import os
+
+    fd = os.open(str(path), os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+    try:
+        os.write(fd, (line + "\n").encode())
+    finally:
+        os.close(fd)
 
 
 def _is_number(v: Any) -> bool:
@@ -233,6 +348,17 @@ def _is_number(v: Any) -> bool:
         return True
     except (TypeError, ValueError):
         return False
+
+
+def _host_id(rank: int) -> int:
+    """Pod host id for metric records: the supervisor's env var when
+    present (fake pods and real ones both set it), else the rank."""
+    import os
+
+    try:
+        return int(os.environ.get("SCALING_TPU_HOST_ID", rank))
+    except ValueError:
+        return rank
 
 
 logger = _Logger()

@@ -30,6 +30,7 @@ from .data.text_dataset import LegacyBlendedDataset, TextBlendedDataset, TextDat
 from .model import init_model, init_optimizer, loss_function
 from .utils.get_tflops import (
     HardwareType,
+    get_flops_per_token,
     get_model_parameter_count,
     get_palm_mfu,
     get_tflops_aleph_alpha,
@@ -168,6 +169,24 @@ def main(config: TransformerConfig) -> TransformerTrainer:
         dataset_evaluation=dataset_evaluation,
         batch_to_model_input=batch_to_model_input,
         profiler=Profiler(config.profiler),
+    )
+    # declare the model's FLOPs-per-token once so the trainer's telemetry
+    # emits per-step achieved-TFLOPs/MFU gauges (docs/OBSERVABILITY.md)
+    # alongside the per-step estimator metrics log_metrics_fn computes
+    arch = config.transformer_architecture
+    topo = config.topology
+    param_count = get_model_parameter_count(
+        arch.hidden_size, arch.num_layers, arch.vocab_size, arch.mlp_factor,
+        glu=arch.mlp_type.value == "swiglu",
+    )
+    trainer.telemetry.configure(
+        flops_per_token=get_flops_per_token(
+            param_count, arch.num_layers, arch.hidden_size,
+            arch.sequence_length,
+        ),
+        tokens_per_step=topo.global_batch_size * arch.sequence_length,
+        world_size=topo.world_size,
+        peak_tflops=HardwareType.TPU_V5P.max_tflops,
     )
     from ...resilience import controlplane_from_env
 

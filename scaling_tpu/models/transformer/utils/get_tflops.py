@@ -122,6 +122,22 @@ def get_tflops_aleph_alpha(
     return flops / iter_time_s / 1e12
 
 
+def get_flops_per_token(
+    parameter_count: int,
+    num_layers: int,
+    hidden_size: int,
+    sequence_length: int,
+) -> float:
+    """PaLM appendix-B train FLOPs per token: ``6N`` matmul plus the
+    ``12 L H S`` attention quadratic term. This is the single number the
+    obs telemetry layer needs from a model to turn step time into
+    achieved-TFLOPs/MFU gauges (docs/OBSERVABILITY.md)."""
+    return (
+        6.0 * parameter_count
+        + 12.0 * num_layers * hidden_size * sequence_length
+    )
+
+
 def get_palm_mfu(
     parameter_count: int,
     num_layers: int,
@@ -133,6 +149,8 @@ def get_palm_mfu(
 ) -> float:
     """PaLM appendix-B MFU: observed tokens/s over peak-flop token rate
     (reference: get_tflops.py:337-401)."""
-    flops_per_token = 6.0 * parameter_count + 12.0 * num_layers * hidden_size * sequence_length
+    flops_per_token = get_flops_per_token(
+        parameter_count, num_layers, hidden_size, sequence_length
+    )
     peak_tokens_per_second = hardware.max_tflops * 1e12 * world_size / flops_per_token
     return tokens_per_second / peak_tokens_per_second

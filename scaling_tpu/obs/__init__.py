@@ -1,0 +1,52 @@
+"""Unified telemetry (ISSUE 5, docs/OBSERVABILITY.md).
+
+- :mod:`.registry` — process-wide metrics registry (counters, gauges,
+  histograms with labels), per-step JSONL flush + Prometheus textfile.
+- :mod:`.spans` — ``with obs.span("ckpt.commit", step=N)`` phase
+  tracing, emitted through ``logger.log_event`` into the same stream as
+  the supervision events.
+- :mod:`.hardware` — device memory / live-array gauges, step-time EMA,
+  achieved-TFLOPs and MFU math.
+- :mod:`.telemetry` — the per-step driver the trainer owns.
+- :mod:`.report` / ``python -m scaling_tpu.obs`` — run-dir analyzer
+  turning events + metrics JSONL into a health report.
+
+jax-free at import time (functions import it lazily): the analyzer CLI
+and the supervisor's relaunch path must not pay backend init.
+"""
+
+from .hardware import (
+    StepTimeEMA,
+    achieved_tflops,
+    device_memory_snapshot,
+    mfu,
+    update_hardware_gauges,
+)
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    host_id,
+)
+from .spans import Span, current_span, span
+from .telemetry import StepTelemetry
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Span",
+    "StepTelemetry",
+    "StepTimeEMA",
+    "achieved_tflops",
+    "current_span",
+    "device_memory_snapshot",
+    "get_registry",
+    "host_id",
+    "mfu",
+    "span",
+    "update_hardware_gauges",
+]

@@ -1,0 +1,9 @@
+"""Entry point for ``python -m scaling_tpu.obs`` — pure stdlib, no jax:
+the analyzer runs on login nodes and in CI where backend init is dead
+weight."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,79 @@
+"""CLI: ``python -m scaling_tpu.obs report <run_dir>``.
+
+Renders the health report on stdout; ``--json`` additionally writes the
+machine-readable payload. Exit codes: 0 clean, 1 a ``--assert-*`` gate
+fired, 2 the run dir held no parseable telemetry at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .report import check_gates, load_run_dir, mfu_section, render_report
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m scaling_tpu.obs",
+        description="run-dir telemetry analyzer (docs/OBSERVABILITY.md)",
+    )
+    parser.add_argument("command", choices=["report"])
+    parser.add_argument("run_dir", help="directory holding the run's "
+                        "events/metrics JSONL files (searched recursively)")
+    parser.add_argument("--json", metavar="FILE",
+                        help="also write a machine-readable report")
+    parser.add_argument("--assert-mfu", type=float, metavar="FLOOR",
+                        help="fail (exit 1) when mean MFU is below FLOOR")
+    parser.add_argument("--assert-step-time", type=float, metavar="CEIL",
+                        help="fail (exit 1) when p50 step time exceeds "
+                        "CEIL seconds")
+    args = parser.parse_args(argv)
+
+    run_dir = Path(args.run_dir)
+    if not run_dir.is_dir():
+        print(f"error: {run_dir} is not a directory", file=sys.stderr)
+        return 2
+    data = load_run_dir(run_dir)
+    if not data.events and not data.steps and not data.registry:
+        print(
+            f"error: no telemetry records under {run_dir} "
+            f"({data.files} jsonl file(s), {data.bad_lines} unparseable "
+            "line(s)) — was the run launched with a log_dir / "
+            "SCALING_TPU_EVENTS_PATH?",
+            file=sys.stderr,
+        )
+        return 2
+    print(render_report(data, run_dir), end="")
+
+    failures = check_gates(
+        data, assert_mfu=args.assert_mfu,
+        assert_step_time=args.assert_step_time,
+    )
+    if args.assert_mfu is not None or args.assert_step_time is not None:
+        print("== gates ==")
+        if failures:
+            for f in failures:
+                print(f"  FAIL {f}")
+        else:
+            print("  PASS")
+
+    if args.json:
+        _, stats = mfu_section(data)
+        payload = {
+            "files": data.files,
+            "bad_lines": data.bad_lines,
+            "events": len(data.events),
+            "step_records": len(data.steps),
+            "registry_records": len(data.registry),
+            "stats": stats,
+            "gate_failures": failures,
+        }
+        Path(args.json).write_text(json.dumps(payload, indent=1) + "\n")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

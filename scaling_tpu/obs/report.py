@@ -1,0 +1,365 @@
+"""Run-dir analyzer: events + metrics JSONL -> health report.
+
+``python -m scaling_tpu.obs report <run_dir>`` walks every ``*.jsonl``
+under the run directory (however the launcher named them — per-host
+``host0_events.jsonl``, ``metrics_rank_0.jsonl``, one shared file —
+records classify themselves), and renders:
+
+- step-time percentiles per host + straggler verdict;
+- MFU / achieved-TFLOPs / throughput summary;
+- barrier-wait attribution per barrier and per host (the host that
+  waits ~0 arrived last — it made everyone else wait), the offline
+  echo of the live ``_on_step_stall`` straggler table;
+- checkpoint commit latency breakdown per step
+  (stage / manifest / rename / commit-barrier / latest);
+- the restart / preemption timeline from the supervision events;
+- optional CI-style gates (``--assert-mfu``, ``--assert-step-time``).
+
+Pure stdlib + deterministic rendering: the golden-report test pins the
+exact output for a canned run dir, so keep formatting changes deliberate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from collections import defaultdict
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+# lifecycle events the timeline renders (everything except the
+# high-frequency span records); unknown event names still render — a new
+# subsystem's events must not be invisible to post-mortems
+SPAN_EVENT = "span"
+
+CKPT_PHASES = (
+    "trainer.save", "ckpt.stage", "ckpt.manifest", "ckpt.rename",
+    "ckpt.commit_barrier", "ckpt.latest",
+)
+
+
+@dataclasses.dataclass
+class RunData:
+    events: List[dict]
+    steps: List[dict]
+    registry: List[dict]
+    files: int
+    bad_lines: int
+
+    @property
+    def spans(self) -> List[dict]:
+        return [e for e in self.events if e.get("event") == SPAN_EVENT]
+
+    @property
+    def lifecycle(self) -> List[dict]:
+        return [e for e in self.events if e.get("event") != SPAN_EVENT]
+
+
+def load_run_dir(run_dir: Path | str) -> RunData:
+    """Parse every JSONL under ``run_dir``; tolerant of torn tails (a
+    SIGKILLed host's last line) and foreign files — unparseable lines
+    are counted, never fatal."""
+    run_dir = Path(run_dir)
+    events: List[dict] = []
+    steps: List[dict] = []
+    registry: List[dict] = []
+    files = 0
+    bad = 0
+    for path in sorted(run_dir.rglob("*.jsonl")):
+        files += 1
+        try:
+            text = path.read_text()
+        except OSError:
+            bad += 1
+            continue
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if not isinstance(rec, dict):
+                bad += 1
+                continue
+            if "event" in rec:
+                events.append(rec)
+            elif rec.get("kind") == "step":
+                steps.append(rec)
+            elif rec.get("kind") == "registry":
+                registry.append(rec)
+            else:
+                bad += 1
+    events.sort(key=lambda r: r.get("ts", 0.0))
+    steps.sort(key=lambda r: (r.get("step", 0), r.get("host", 0)))
+    return RunData(events=events, steps=steps, registry=registry,
+                   files=files, bad_lines=bad)
+
+
+# ------------------------------------------------------------------ math
+def percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile (deterministic, no interpolation)."""
+    assert values
+    s = sorted(values)
+    idx = max(0, min(len(s) - 1, math.ceil(q / 100.0 * len(s)) - 1))
+    return s[idx]
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v:.3f}s"
+
+
+# -------------------------------------------------------------- sections
+def step_time_section(data: RunData) -> List[str]:
+    by_host: Dict[int, List[float]] = defaultdict(list)
+    for rec in data.steps:
+        dur = rec.get("metrics", {}).get("step_duration")
+        if dur is not None:
+            by_host[int(rec.get("host", 0))].append(float(dur))
+    lines = ["== step time =="]
+    if not by_host:
+        lines.append("  (no step records)")
+        return lines
+    p50s: Dict[int, float] = {}
+    for host in sorted(by_host):
+        vals = by_host[host]
+        p50s[host] = percentile(vals, 50)
+        lines.append(
+            f"  host {host}: n={len(vals)} p50={_fmt_s(percentile(vals, 50))} "
+            f"p90={_fmt_s(percentile(vals, 90))} "
+            f"p99={_fmt_s(percentile(vals, 99))} max={_fmt_s(max(vals))}"
+        )
+    if len(p50s) > 1:
+        fastest = min(p50s.values())
+        slowest_host = max(p50s, key=lambda h: p50s[h])
+        ratio = p50s[slowest_host] / fastest if fastest > 0 else float("inf")
+        if ratio > 1.2:
+            lines.append(
+                f"  straggler: host {slowest_host} "
+                f"(p50 {ratio:.2f}x the fastest host)"
+            )
+        else:
+            lines.append(f"  stragglers: none (p50 spread {ratio:.2f}x)")
+    return lines
+
+
+def mfu_section(data: RunData) -> Tuple[List[str], Dict[str, float]]:
+    """Render + return the summary stats the gates check."""
+    mfus: List[float] = []
+    tflops: List[float] = []
+    tokens: List[float] = []
+    step_times: List[float] = []
+    for rec in data.steps:
+        m = rec.get("metrics", {})
+        v = m.get("mfu", m.get("palm_mfu"))
+        if v is not None:
+            mfus.append(float(v))
+        if m.get("achieved_tflops") is not None:
+            tflops.append(float(m["achieved_tflops"]))
+        if m.get("tokens_per_second") is not None:
+            tokens.append(float(m["tokens_per_second"]))
+        if m.get("step_duration") is not None:
+            step_times.append(float(m["step_duration"]))
+    lines = ["== mfu / throughput =="]
+    stats: Dict[str, float] = {}
+    if step_times:
+        stats["step_time_p50"] = percentile(step_times, 50)
+    if mfus:
+        stats["mfu_mean"] = sum(mfus) / len(mfus)
+        lines.append(
+            f"  mfu: mean={stats['mfu_mean']:.4f} "
+            f"p50={percentile(mfus, 50):.4f} min={min(mfus):.4f} "
+            f"max={max(mfus):.4f}"
+        )
+    else:
+        lines.append("  mfu: (not recorded — configure trainer.telemetry)")
+    if tflops:
+        lines.append(
+            f"  achieved_tflops: mean={sum(tflops) / len(tflops):.1f} "
+            f"max={max(tflops):.1f}"
+        )
+    if tokens:
+        lines.append(
+            f"  tokens_per_second: mean={sum(tokens) / len(tokens):.0f} "
+            f"max={max(tokens):.0f}"
+        )
+    return lines, stats
+
+
+def _epoch_key(rec: dict) -> Tuple:
+    """Attribution key prefix: a relaunched pod re-waits the same barrier
+    and re-saves the same step in a later supervisor epoch, and merging
+    those incidents would corrupt the arrived-last verdict. Spans without
+    an epoch (single-epoch runs, old files) sort first unchanged."""
+    epoch = rec.get("epoch")
+    return (epoch is not None, epoch if epoch is not None else 0)
+
+
+def _epoch_label(key: Tuple) -> str:
+    has_epoch, epoch = key
+    return f"epoch {epoch} " if has_epoch else ""
+
+
+def barrier_section(data: RunData) -> List[str]:
+    """Per-barrier wait attribution (per supervisor epoch). The LAST
+    host to arrive waits ~0 and is the one every peer waited on;
+    per-host blame aggregates the time it cost its peers."""
+    waits: Dict[Tuple, Dict[int, float]] = defaultdict(lambda: defaultdict(float))
+    ok_waits: Dict[Tuple, Dict[int, float]] = defaultdict(lambda: defaultdict(float))
+    failed: Dict[Tuple, str] = {}
+    for sp in data.spans:
+        if sp.get("span") != "barrier.wait":
+            continue
+        key = _epoch_key(sp) + (str(sp.get("barrier", "?")),)
+        host = int(sp.get("host", 0))
+        waits[key][host] += float(sp.get("dur_s", 0.0))
+        if sp.get("ok", True):
+            ok_waits[key][host] += float(sp.get("dur_s", 0.0))
+        else:
+            failed[key] = str(sp.get("error", "error"))
+    lines = ["== barrier wait attribution =="]
+    if not waits:
+        lines.append("  (no barrier spans)")
+        return lines
+    blame: Dict[int, float] = defaultdict(float)
+    blame_barriers: Dict[int, int] = defaultdict(int)
+    for key in sorted(waits):
+        per_host = waits[key]
+        label = _epoch_label(key[:2]) + key[2]
+        rendered = " ".join(
+            f"host{h}={_fmt_s(per_host[h])}" for h in sorted(per_host)
+        )
+        suffix = ""
+        # the arrived-last verdict only makes sense over SUCCESSFUL
+        # waits: when the barrier failed, the culprit is whoever never
+        # produced a span (the dead/hung host) — blaming the survivor
+        # whose timeout was marginally shorter misattributes the cost
+        succeeded = ok_waits.get(key, {})
+        if len(succeeded) > 1:
+            last = min(succeeded, key=lambda h: succeeded[h])
+            cost = sum(v for h, v in succeeded.items() if h != last)
+            blame[last] += cost
+            blame_barriers[last] += 1
+            suffix = f" -> host {last} arrived last"
+        if key in failed:
+            suffix += f" [FAILED: {failed[key]}]"
+        lines.append(f"  {label}: {rendered}{suffix}")
+    for host in sorted(blame):
+        lines.append(
+            f"  blame: host {host} kept peers waiting "
+            f"{_fmt_s(blame[host])} across {blame_barriers[host]} barrier(s)"
+        )
+    return lines
+
+
+def checkpoint_section(data: RunData) -> List[str]:
+    by_step: Dict[Tuple, Dict[str, float]] = defaultdict(dict)
+    for sp in data.spans:
+        name = sp.get("span")
+        if name not in CKPT_PHASES or "step" not in sp:
+            continue
+        # per (epoch, step): a relaunched pod re-saves the same step
+        key = _epoch_key(sp) + (int(sp["step"]),)
+        # multihost: keep the slowest host's phase time (the pod-wide cost)
+        prev = by_step[key].get(name, 0.0)
+        by_step[key][name] = max(prev, float(sp.get("dur_s", 0.0)))
+    lines = ["== checkpoint commits =="]
+    if not by_step:
+        lines.append("  (no checkpoint spans)")
+        return lines
+    for key in sorted(by_step):
+        phases = by_step[key]
+        parts = [
+            f"{phase.split('.', 1)[-1]}={_fmt_s(phases[phase])}"
+            for phase in CKPT_PHASES if phase in phases
+        ]
+        lines.append(
+            f"  {_epoch_label(key[:2])}step {key[2]}: " + " ".join(parts)
+        )
+    return lines
+
+
+def timeline_section(data: RunData) -> List[str]:
+    lines = ["== restart / preemption timeline =="]
+    lifecycle = data.lifecycle
+    if not lifecycle:
+        lines.append("  (no lifecycle events)")
+        return lines
+    t0 = lifecycle[0].get("ts", 0.0)
+    for e in lifecycle:
+        fields = {
+            k: v for k, v in sorted(e.items()) if k not in ("event", "ts")
+        }
+        rendered = " ".join(f"{k}={v}" for k, v in fields.items())
+        offset = e.get("ts", t0) - t0
+        lines.append(f"  +{offset:8.1f}s {e['event']}" +
+                     (f" {rendered}" if rendered else ""))
+    restarts = sum(1 for e in lifecycle if e["event"] == "relaunch")
+    preempts = sum(
+        1 for e in lifecycle
+        if e["event"] in ("preempt-broadcast", "preempt-relay")
+    )
+    stalls = sum(1 for e in lifecycle if e["event"] == "step-stall")
+    lines.append(
+        f"  totals: restarts={restarts} preemptions={preempts} "
+        f"stalls={stalls}"
+    )
+    return lines
+
+
+def render_report(data: RunData, run_dir: Path | str = "") -> str:
+    hosts = sorted(
+        {int(r.get("host", 0)) for r in data.steps}
+        | {int(e["host"]) for e in data.events if isinstance(e.get("host"), int)}
+    )
+    steps = [r.get("step", 0) for r in data.steps]
+    header = [
+        "== run summary ==",
+        f"  dir: {run_dir}",
+        f"  files={data.files} events={len(data.events)} "
+        f"step_records={len(data.steps)} registry_records={len(data.registry)} "
+        f"unparseable_lines={data.bad_lines}",
+        f"  hosts: {', '.join(map(str, hosts)) if hosts else '(none)'}",
+        f"  steps: {min(steps)}..{max(steps)}" if steps else "  steps: (none)",
+    ]
+    mfu_lines, _ = mfu_section(data)
+    sections = [
+        header,
+        step_time_section(data),
+        mfu_lines,
+        barrier_section(data),
+        checkpoint_section(data),
+        timeline_section(data),
+    ]
+    return "\n".join("\n".join(s) for s in sections) + "\n"
+
+
+def check_gates(data: RunData, assert_mfu: Optional[float] = None,
+                assert_step_time: Optional[float] = None) -> List[str]:
+    """CI-style regression gates; returns failure messages (empty ==
+    pass). Missing data FAILS a requested gate — a run that recorded no
+    MFU must not pass an MFU floor by silence."""
+    _, stats = mfu_section(data)
+    failures: List[str] = []
+    if assert_mfu is not None:
+        mean = stats.get("mfu_mean")
+        if mean is None:
+            failures.append("assert-mfu: no MFU samples in the run dir")
+        elif mean < assert_mfu:
+            failures.append(
+                f"assert-mfu: mean MFU {mean:.4f} < floor {assert_mfu:.4f}"
+            )
+    if assert_step_time is not None:
+        p50 = stats.get("step_time_p50")
+        if p50 is None:
+            failures.append(
+                "assert-step-time: no step_duration samples in the run dir"
+            )
+        elif p50 > assert_step_time:
+            failures.append(
+                f"assert-step-time: p50 step time {p50:.3f}s > ceiling "
+                f"{assert_step_time:.3f}s"
+            )
+    return failures

@@ -1,0 +1,138 @@
+"""Phase tracing: ``with obs.span("ckpt.commit", step=N): ...``.
+
+Every span lands twice:
+
+- as an observation in the default registry's ``span_seconds`` histogram
+  (labelled by span name) — cheap, in-memory, flushed with the per-step
+  registry snapshot;
+- as a structured ``span`` event through :meth:`logger.log_event`, so
+  the PR 4 supervision events and the new telemetry share ONE stream and
+  the run-dir analyzer (``python -m scaling_tpu.obs report``) can
+  attribute barrier waits and checkpoint commits per host without a
+  second file format.
+
+Spans nest (thread-local stack; the parent's name is recorded on the
+child) and are exception-safe: a body that raises still emits the span,
+marked ``ok=false`` with the exception type, and the exception
+propagates untouched.
+
+Device-drain semantics reuse :class:`SynchronizedTimer`'s contract
+without forcing a sync: a span measures host wall time unless the caller
+hands it device work via ``sp.wait_for(x)``, in which case the exit
+drains ``x`` first so the measured time covers the device work. The
+default is drain-free — the step path must not gain device syncs outside
+profiler windows (unit-asserted).
+
+No jax at module level; the drain imports it lazily.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Iterator, Optional
+
+from ..logging import logger
+from .registry import get_registry
+
+_local = threading.local()
+
+
+def _stack() -> list:
+    stack = getattr(_local, "stack", None)
+    if stack is None:
+        stack = []
+        _local.stack = stack
+    return stack
+
+
+class Span:
+    """Handle yielded by :func:`span`; mutate it to enrich the record."""
+
+    __slots__ = ("name", "fields", "_wait_for", "duration_s")
+
+    def __init__(self, name: str, fields: dict):
+        self.name = name
+        self.fields = fields
+        self._wait_for: Any = None
+        self.duration_s: Optional[float] = None
+
+    def wait_for(self, x: Any) -> Any:
+        """Drain ``x`` (``jax.block_until_ready``) before the span closes,
+        so the measured time covers its device work. Returns ``x``."""
+        self._wait_for = x
+        return x
+
+    def annotate(self, **fields: Any) -> None:
+        """Attach extra fields to the emitted span event."""
+        self.fields.update(fields)
+
+
+def current_span() -> Optional[Span]:
+    stack = _stack()
+    return stack[-1] if stack else None
+
+
+@contextmanager
+def span(name: str, *, step: Optional[int] = None, level: str = "debug",
+         registry=None, **fields: Any) -> Iterator[Span]:
+    """Trace one phase. ``level`` controls only the console mirror of the
+    event (per-step phases default to ``debug`` so steady-state training
+    does not quadruple its console output); the events file — when
+    configured — receives every span regardless."""
+    sp = Span(name, dict(fields))
+    stack = _stack()
+    parent = stack[-1].name if stack else None
+    stack.append(sp)
+    ok = True
+    error: Optional[str] = None
+    start = time.perf_counter()
+    try:
+        yield sp
+        if sp._wait_for is not None:
+            # drain INSIDE the measured window: the caller explicitly
+            # asked for SynchronizedTimer semantics on this span
+            import jax
+
+            jax.block_until_ready(sp._wait_for)
+    except BaseException as e:
+        ok = False
+        error = type(e).__name__
+        raise
+    finally:
+        duration = time.perf_counter() - start
+        sp.duration_s = duration
+        stack.pop()
+        _emit(sp, parent, duration, ok, error, step, level, registry)
+
+
+def _emit(sp: Span, parent: Optional[str], duration: float, ok: bool,
+          error: Optional[str], step: Optional[int], level: str,
+          registry) -> None:
+    reg = registry if registry is not None else get_registry()
+    reg.histogram("span_seconds", labels={"span": sp.name}).observe(duration)
+    event_fields = dict(sp.fields)
+    event_fields.update(span=sp.name, dur_s=round(duration, 6), ok=ok)
+    if parent is not None:
+        event_fields["parent"] = parent
+    if step is not None:
+        event_fields["step"] = step
+    if error is not None:
+        event_fields["error"] = error
+    # host + relaunch epoch ride every span so the analyzer can attribute
+    # per host AND per supervisor epoch — the same step gets re-saved and
+    # the same barrier re-waited after a relaunch, and merging those
+    # incidents would corrupt the arrived-last verdict
+    for env_var, field in (("SCALING_TPU_HOST_ID", "host"),
+                           ("SCALING_TPU_COORD_EPOCH", "epoch")):
+        raw = os.environ.get(env_var)
+        if raw is not None and field not in event_fields:
+            try:
+                event_fields[field] = int(raw)
+            except ValueError:
+                logger.warning(f"non-integer {env_var} {raw!r} ignored")
+    # spans skip the per-record fsync: 3-4 of them land per training
+    # step, and the durability contract belongs to lifecycle events
+    logger.log_event("span", _level=level, _fsync=False, **event_fields)

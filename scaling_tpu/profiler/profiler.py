@@ -116,6 +116,21 @@ class Profiler:
                 self._tracing = False
             self.flush()
 
+    def close(self) -> None:
+        """Abort-safe drain: stop an active XLA trace and flush whatever
+        the window collected so far. A run that dies mid-window
+        (NonFiniteLossError, SIGTERM drain, watchdog stall) previously
+        lost EVERY observation and left the trace running; the trainer
+        calls this from its ``finally`` so partial observations land.
+        Idempotent — flush rewrites the same JSON on a clean exit."""
+        if self._tracing:
+            try:
+                jax.profiler.stop_trace()
+            except RuntimeError as e:
+                logger.warning(f"could not stop in-flight XLA trace: {e!r}")
+            self._tracing = False
+        self.flush()
+
     def flush(self) -> None:
         if self.config.profiler_output is None or not self.observations:
             return

@@ -30,6 +30,7 @@ from pathlib import Path
 from typing import Dict, Optional, Tuple
 
 from ..logging import logger
+from ..obs.spans import span
 from .faults import get_fault_plan
 from .manifest import write_manifest
 
@@ -81,28 +82,35 @@ class CheckpointCommit:
         self._recorded[rel] = (size, crc32_hex)
 
     def finalize(self) -> Path:
-        """Manifest -> fsync -> atomic rename. Returns the final dir."""
+        """Manifest -> fsync -> atomic rename. Returns the final dir.
+
+        Traced as ``ckpt.manifest`` (digest + manifest write) and
+        ``ckpt.rename`` (the fsync walk + atomic rename — on slow shared
+        storage the fsync walk IS the commit cost, so it belongs to the
+        rename phase the analyzer breaks out)."""
         plan = get_fault_plan()
         plan.fire("ckpt.manifest", path=self.tmp_dir)
-        write_manifest(
-            self.tmp_dir, self.step, recorded=self._recorded,
-            config_fingerprint=self.config_fingerprint,
-        )
-        # npz writes fsync themselves; sync the rest (manifest, context,
-        # config, orbax tree) plus every directory so the rename never
-        # commits names whose contents are still in flight
-        for p in sorted(self.tmp_dir.rglob("*")):
-            if p.is_file() and p.suffix != ".npz":
-                _fsync_path(p)
-            elif p.is_dir():
-                _fsync_path(p)
-        _fsync_path(self.tmp_dir)
-        plan.fire("ckpt.rename", path=self.final_dir)
-        if self.final_dir.exists():
-            # crash recovery re-reached this step; replace the old save
-            shutil.rmtree(self.final_dir)
-        os.replace(self.tmp_dir, self.final_dir)
-        _fsync_path(self.base)
+        with span("ckpt.manifest", step=self.step):
+            write_manifest(
+                self.tmp_dir, self.step, recorded=self._recorded,
+                config_fingerprint=self.config_fingerprint,
+            )
+        with span("ckpt.rename", step=self.step):
+            # npz writes fsync themselves; sync the rest (manifest, context,
+            # config, orbax tree) plus every directory so the rename never
+            # commits names whose contents are still in flight
+            for p in sorted(self.tmp_dir.rglob("*")):
+                if p.is_file() and p.suffix != ".npz":
+                    _fsync_path(p)
+                elif p.is_dir():
+                    _fsync_path(p)
+            _fsync_path(self.tmp_dir)
+            plan.fire("ckpt.rename", path=self.final_dir)
+            if self.final_dir.exists():
+                # crash recovery re-reached this step; replace the old save
+                shutil.rmtree(self.final_dir)
+            os.replace(self.tmp_dir, self.final_dir)
+            _fsync_path(self.base)
         return self.final_dir
 
     def update_latest(self) -> None:

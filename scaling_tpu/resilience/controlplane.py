@@ -53,6 +53,8 @@ from pathlib import Path
 from typing import Dict, Optional
 
 from ..logging import logger
+from ..obs.registry import get_registry
+from ..obs.spans import span
 from .faults import get_fault_plan
 from .guards import retry_io
 
@@ -131,9 +133,18 @@ class ControlPlane:
     # -- shared logic ---------------------------------------------------
     def heartbeat(self, step: int, status: str = "running") -> None:
         self._last_step = step
+        t0 = time.perf_counter()
         self._publish_heartbeat(
             HostHeartbeat(self.host_id, step, status, time.time())
         )
+        # send lag is the leading indicator for control-plane storage
+        # trouble (NFS degradation, coordinator overload) — a heartbeat
+        # that takes seconds to publish will read as a stale host soon
+        lag = time.perf_counter() - t0
+        reg = get_registry()
+        labels = {"host": str(self.host_id)}
+        reg.gauge("controlplane_heartbeat_send_seconds", labels).set(lag)
+        reg.histogram("controlplane_heartbeat_send", labels).observe(lag)
 
     def peer_heartbeats(self) -> Dict[int, HostHeartbeat]:
         """Newest record per host (own host included)."""
@@ -166,8 +177,19 @@ class ControlPlane:
         Raises :class:`JobAborted` the moment the abort flag appears
         (supervisor teardown must not wait out the timeout) and
         :class:`BarrierTimeout` when the deadline passes with hosts
-        missing."""
+        missing.
+
+        Traced as a ``barrier.wait`` span per host: the wait time is the
+        straggler signal the run-dir analyzer attributes offline (the
+        host that waits ~0 arrived last — it made everyone else wait),
+        the SPMD analogue of per-mesh-axis communication-time accounting
+        (arxiv 1811.02084). A timeout/abort lands as ``ok=false`` with
+        the exception type."""
         get_fault_plan().fire("barrier.timeout", path=name)
+        with span("barrier.wait", barrier=name, host=self.host_id):
+            self._barrier_wait(name, timeout_s, poll_s)
+
+    def _barrier_wait(self, name: str, timeout_s: float, poll_s: float) -> None:
         self._arrive(name)
         deadline = time.monotonic() + timeout_s
         next_hb = time.monotonic() + 1.0

@@ -44,6 +44,7 @@ from pathlib import Path
 from typing import Any, Dict, List
 
 from ..logging import logger
+from ..obs import span
 from ..resilience.controlplane import (
     ABORT_FLAG,
     ENV_CONTROL_DIR,
@@ -187,6 +188,17 @@ def _teardown(
     epoch. A best-effort remote ``pkill`` against the unique payload
     marker cleans those up; the base64 payload is shell- and
     regex-safe by construction."""
+    with span("supervisor.teardown", level="info"):
+        _teardown_inner(cp, procs, workers, encoded, config)
+
+
+def _teardown_inner(
+    cp: FileControlPlane,
+    procs: List[subprocess.Popen],
+    workers: List[tuple],
+    encoded: str,
+    config: RunnerConfig,
+) -> None:
     try:
         cp.set_flag(ABORT_FLAG, "host-dead")
     except (OSError, RuntimeError, ValueError) as e:
@@ -388,10 +400,12 @@ def supervise_main(config: RunnerConfig, payload: Any) -> int:
     restarts = 0
     epoch = 0
     while True:
-        rc = _run_epoch(
-            config, pool, workers, encoded, master_addr, control_root,
-            epoch, state,
-        )
+        with span("supervisor.epoch", level="info", epoch=epoch) as ep:
+            rc = _run_epoch(
+                config, pool, workers, encoded, master_addr, control_root,
+                epoch, state,
+            )
+            ep.annotate(rc=rc)
         if rc == 0:
             return 0
         if state["preempted"]:
@@ -421,4 +435,7 @@ def supervise_main(config: RunnerConfig, payload: Any) -> int:
             f"(restart {restarts}/{config.restart_budget}); workers will "
             "resume from the newest valid checkpoint"
         )
-        time.sleep(delay)
+        # traced so the analyzer's restart timeline shows backoff cost
+        # (time the pod sat idle between epochs) next to the epochs
+        with span("supervisor.backoff", level="info", epoch=epoch):
+            time.sleep(delay)

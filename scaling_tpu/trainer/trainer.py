@@ -34,6 +34,7 @@ from ..config import BaseConfig
 from ..context import BaseContext
 from ..data import DataLoader
 from ..logging import logger
+from ..obs import StepTelemetry, span
 from ..optimizer.optimizer import Optimizer, OptimizerState
 from ..parallel.parallel_module import (
     EvaluationStepOutput,
@@ -280,6 +281,11 @@ class BaseTrainer:
         self.metrics_hooks: List[Callable[[dict, int], None]] = []
         self.checkpoint_hooks: List[Callable[[Path, int], None]] = []
         self._preempted = False
+        # per-step telemetry (docs/OBSERVABILITY.md): hardware gauges,
+        # step-time EMA, and — once configure() declared the model's
+        # FLOPs-per-token — achieved-TFLOPs/MFU; flushed to the metrics
+        # JSONL sink on every fetched step. Host-side only by contract.
+        self.telemetry = StepTelemetry()
         # multi-host supervision (attach_control_plane): out-of-band
         # heartbeats/barriers/flags beside the XLA collectives
         self._control_plane: Optional[ControlPlane] = None
@@ -438,12 +444,18 @@ class BaseTrainer:
         if self.profiler is not None:
             self.profiler.begin_step(step_idx)
         start = time.time()
-        micro_batches = self._next_micro_batches()
+        with span("step.data", step=step_idx):
+            micro_batches = self._next_micro_batches()
         t_data = time.time() - start
         dropout_key = self.context.rng.key("dropout", self.context.iterations)
-        self.params, self.opt_state, loss, metrics, opt_out = self._train_step(
-            self.params, self.opt_state, micro_batches, dropout_key
-        )
+        # dispatch-only span: without a drain it measures how long the
+        # host took to hand XLA the fused step, the device work itself
+        # shows up in step.sync — adding a drain here is exactly the
+        # per-step sync log_interval exists to remove
+        with span("step.fwdbwd", step=step_idx):
+            self.params, self.opt_state, loss, metrics, opt_out = self._train_step(
+                self.params, self.opt_state, micro_batches, dropout_key
+            )
         if get_fault_plan().fire("step.nan_grads") == "nan":
             # emulate a transient hardware NaN burst for the non-finite
             # policy: poison only the OBSERVED loss (params stay clean,
@@ -479,7 +491,8 @@ class BaseTrainer:
                 step_duration=None,  # dispatch time would masquerade as step time
                 fetched=False,
             )
-        loss = float(loss)  # host sync: the step's device work is drained
+        with span("step.sync", step=step_idx):
+            loss = float(loss)  # host sync: the step's device work is drained
         # a fetch after unfetched steps drains their whole device backlog,
         # so this step's wall time covers several steps of device work;
         # report the amortized per-step time (what tokens/s and the TFLOPs
@@ -510,6 +523,10 @@ class BaseTrainer:
         )
 
     def eval_step(self) -> EvaluationStepOutput:
+        with span("trainer.eval", step=self.context.iterations):
+            return self._eval_step_inner()
+
+    def _eval_step_inner(self) -> EvaluationStepOutput:
         start = time.time()
         assert self.dataloader_evaluation is not None, "no evaluation dataset"
         losses, metric_list = [], []
@@ -658,9 +675,15 @@ class BaseTrainer:
         cp = self._control_plane
         if cp is not None and cp.num_hosts > 1:
             get_fault_plan().fire("ckpt.commit_barrier", path=commit.final_dir)
-            cp.barrier(
-                f"commit:step-{commit.step}", self._cp_barrier_timeout
-            )
+            # the commit-barrier wait IS the per-host straggler signal:
+            # the host that waits longest committed first, the one that
+            # waits ~0 made everyone else wait (analyzer attributes this
+            # offline from the span stream)
+            with span("ckpt.commit_barrier", step=commit.step,
+                      host=cp.host_id):
+                cp.barrier(
+                    f"commit:step-{commit.step}", self._cp_barrier_timeout
+                )
             prev = self._cp_prev_commit_step
             if prev is not None and prev != commit.step and cp.host_id == 0:
                 # every host passed THIS commit barrier, so none can ever
@@ -671,7 +694,8 @@ class BaseTrainer:
                 cp.prune_barrier(f"commit:step-{prev}")
             self._cp_prev_commit_step = commit.step
         if self._cp_latest_leader:
-            commit.update_latest()
+            with span("ckpt.latest", step=commit.step):
+                commit.update_latest()
 
     # ----------------------------------------------------------- preemption
     def install_preemption_handler(self) -> None:
@@ -815,6 +839,11 @@ class BaseTrainer:
         finally:
             if watchdog is not None:
                 watchdog.stop()
+            if self.profiler is not None:
+                # abort paths (NonFiniteLossError, SIGTERM drain, stall)
+                # must not lose a partially collected window or leave an
+                # XLA trace running
+                self.profiler.close()
 
     def _emit_step_metrics(
         self, output: TrainStepOutput, log_metrics_fn: Optional[Callable]
@@ -836,7 +865,17 @@ class BaseTrainer:
         metrics["step_duration"] = output.step_duration
         if log_metrics_fn is not None:
             metrics = log_metrics_fn(self, output, metrics)
+        try:
+            # host-side gauges only (memory stats, EMA, MFU): adds no
+            # device syncs — see tests/core/test_obs/test_step_path.py
+            metrics.update(self.telemetry.on_step(
+                self.context.iterations, output.step_duration
+            ))
+        except Exception as e:
+            # telemetry must never abort a training step
+            logger.warning(f"step telemetry update failed: {e!r}")
         logger.log_metrics(metrics, self.context.iterations)
+        self.telemetry.flush(self.context.iterations)
         for hook in self.metrics_hooks:
             try:
                 hook(metrics, self.context.iterations)
@@ -992,7 +1031,15 @@ class BaseTrainer:
         written into a ``.tmp-global_stepN`` staging dir, checksummed
         into ``MANIFEST.json``, fsynced and atomically renamed onto
         ``global_stepN`` before ``latest`` moves — a kill at any instant
-        leaves the previous committed checkpoint intact and loadable."""
+        leaves the previous committed checkpoint intact and loadable.
+
+        Traced as ``trainer.save`` (on the async path this covers only
+        the host gather + submit; the writer thread's own ``ckpt.*``
+        spans carry the durable-write cost)."""
+        with span("trainer.save", step=self.context.iterations):
+            return self._save_checkpoint_inner(dir)
+
+    def _save_checkpoint_inner(self, dir: Optional[Path | str] = None) -> Path:
         base = Path(dir or self.config.save_dir)
         base.mkdir(parents=True, exist_ok=True)
         writer = None
@@ -1017,48 +1064,50 @@ class BaseTrainer:
             exp_avg=self.module.ckpt_view(self.opt_state.exp_avg),
             exp_avg_sq=self.module.ckpt_view(self.opt_state.exp_avg_sq),
         )
-        if self.config.checkpoint_backend == CheckpointBackend.ORBAX:
-            self._save_orbax(stage_dir, viewed_opt)
-        else:
-            # checked here, not in config validation: jax.process_count()
-            # initializes the backend as a side effect, which would break a
-            # later jax.distributed.initialize() for configs built early
-            if jax.process_count() > 1:
-                raise RuntimeError(
-                    "the npz checkpoint backend host-gathers every array "
-                    "and cannot run multi-process; set "
-                    "trainer.checkpoint_backend: orbax for multi-host runs"
+        with span("ckpt.stage", step=self.context.iterations,
+                  backend=self.config.checkpoint_backend.value):
+            if self.config.checkpoint_backend == CheckpointBackend.ORBAX:
+                self._save_orbax(stage_dir, viewed_opt)
+            else:
+                # checked here, not in config validation: jax.process_count()
+                # initializes the backend as a side effect, which would break a
+                # later jax.distributed.initialize() for configs built early
+                if jax.process_count() > 1:
+                    raise RuntimeError(
+                        "the npz checkpoint backend host-gathers every array "
+                        "and cannot run multi-process; set "
+                        "trainer.checkpoint_backend: orbax for multi-host runs"
+                    )
+                metas = self.module.ckpt_metas()
+                save_model_checkpoint(
+                    stage_dir, self.module.ckpt_view(self.params), metas,
+                    separate_file_for_parameters=getattr(
+                        self.module, "separate_file_for_parameters", None
+                    ),
+                    writer=writer,
+                    recorder=commit.record,
                 )
-            metas = self.module.ckpt_metas()
-            save_model_checkpoint(
-                stage_dir, self.module.ckpt_view(self.params), metas,
-                separate_file_for_parameters=getattr(
-                    self.module, "separate_file_for_parameters", None
-                ),
-                writer=writer,
-                recorder=commit.record,
-            )
-            save_optimizer_checkpoint(
-                stage_dir, viewed_opt, metas, writer=writer,
-                recorder=commit.record,
-            )
-        self.context.save_checkpoint(stage_dir)
-        # full config travels with the weights so inference can rebuild the
-        # architecture (reference: context.py:113-125 config.yml copy)
-        cfg = getattr(self.context, "config", None)
-        if cfg is not None and hasattr(cfg, "model_dump"):
-            import yaml as _yaml
+                save_optimizer_checkpoint(
+                    stage_dir, viewed_opt, metas, writer=writer,
+                    recorder=commit.record,
+                )
+            self.context.save_checkpoint(stage_dir)
+            # full config travels with the weights so inference can rebuild
+            # the architecture (reference: context.py:113-125 config.yml copy)
+            cfg = getattr(self.context, "config", None)
+            if cfg is not None and hasattr(cfg, "model_dump"):
+                import yaml as _yaml
 
-            (stage_dir / "config.yml").write_text(
-                _yaml.safe_dump(cfg.model_dump(mode="json"), sort_keys=False)
-            )
-            # tokenizer travels with the weights so inference needs nothing
-            # else (reference: inference_model.py:70 expects vocab.json)
-            vocab = getattr(
-                getattr(cfg, "transformer_architecture", None), "vocab_file", None
-            )
-            if vocab and Path(vocab).is_file():
-                shutil.copyfile(vocab, stage_dir / "vocab.json")
+                (stage_dir / "config.yml").write_text(
+                    _yaml.safe_dump(cfg.model_dump(mode="json"), sort_keys=False)
+                )
+                # tokenizer travels with the weights so inference needs
+                # nothing else (reference: inference_model.py:70 vocab.json)
+                vocab = getattr(
+                    getattr(cfg, "transformer_architecture", None), "vocab_file", None
+                )
+                if vocab and Path(vocab).is_file():
+                    shutil.copyfile(vocab, stage_dir / "vocab.json")
         step_dir = commit.final_dir
         if writer is None:
             commit.finalize()

@@ -1,0 +1,55 @@
+"""Seeded STA007 violations in an ``obs/`` path (the scope dir ISSUE 5
+added: telemetry that silently eats its own failures is telemetry you
+cannot trust during a post-mortem). Line numbers are asserted by
+tests/core/test_analysis/test_lint.py and chosen NOT to collide with the
+trainer or runner fixtures' lines; keep edits additive at the bottom."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+# padding so the first handler lands on line 33, a line number no other
+# STA007 fixture uses (trainer: 14/21/28/63, runner: 17/24/38) — the
+# test's (rule, line) pairs must stay unique across fixture files.
+#
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+
+
+def swallow_flush_error(registry, step):
+    try:
+        registry.flush_step(step)
+    except Exception:  # STA007: a lost metrics flush, line 33
+        pass
+
+
+def swallow_span_emit(emit):
+    try:
+        return emit()
+    except:  # noqa: E722  # STA007: bare except, line 40
+        return None
+
+
+def ok_logged_gauge_failure(gauge, value):
+    try:
+        gauge.set(value)
+    except Exception as e:
+        logger.warning(f"gauge update failed: {e}")
+
+
+def suppressed_snapshot(registry):
+    try:
+        return registry.snapshot()
+    except Exception:  # sta: disable=STA007
+        return None

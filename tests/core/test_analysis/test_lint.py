@@ -13,10 +13,11 @@ REPO = Path(__file__).resolve().parents[3]
 FIXTURES = Path(__file__).resolve().parent / "fixtures"
 
 # (rule, line) pairs seeded in fixtures/nn/violations.py,
-# fixtures/trainer/swallowed.py and fixtures/runner/swallowed.py — line
-# numbers are part of the fixtures' contract (edits there stay additive
-# at the bottom; the runner fixture's lines deliberately avoid the
-# trainer fixture's so each (rule, line) pair stays unique)
+# fixtures/trainer/swallowed.py, fixtures/runner/swallowed.py and
+# fixtures/obs/swallowed.py — line numbers are part of the fixtures'
+# contract (edits there stay additive at the bottom; each fixture's
+# lines deliberately avoid the others' so every (rule, line) pair
+# stays unique)
 EXPECTED = [
     ("STA001", 17),   # if jnp.any(...)
     ("STA002", 24),   # np.tanh on traced
@@ -32,11 +33,14 @@ EXPECTED = [
     ("STA007", 28),   # trainer: except BaseException as e, e unused
     ("STA007", 17),   # runner: swallowed worker failure
     ("STA007", 24),   # runner: bare except around spawn
+    ("STA007", 33),   # obs: swallowed metrics flush
+    ("STA007", 40),   # obs: bare except around span emit
 ]
 SUPPRESSED = [
     ("STA003", 60),  # sta: disable=STA003
     ("STA007", 63),  # trainer: sta: disable=STA007
     ("STA007", 38),  # runner: sta: disable=STA007
+    ("STA007", 54),  # obs: sta: disable=STA007
 ]
 
 

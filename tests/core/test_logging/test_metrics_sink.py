@@ -1,0 +1,197 @@
+"""Per-step metrics JSONL sink (ISSUE 5 satellites): on by default
+whenever the logger has a log dir, explicit off switch, env override —
+and non-numeric metric values warn once per key instead of vanishing."""
+
+import io
+import json
+import logging as pylogging
+
+import pytest
+
+from scaling_tpu.logging import LoggerConfig, logger
+
+
+def _read(path):
+    return [json.loads(l) for l in path.read_text().splitlines()]
+
+
+@pytest.fixture()
+def mirror():
+    """Tap the logger's own pipeline (reference: test_events.py — the
+    console handler's stream predates pytest's capture fixtures). Call
+    AFTER ``logger.configure``: configure rebuilds the handler list, so
+    a handler attached earlier is silently dropped."""
+    handlers = []
+
+    def attach():
+        buf = io.StringIO()
+        handler = pylogging.StreamHandler(buf)
+        logger._log.addHandler(handler)
+        handlers.append(handler)
+        return buf
+
+    yield attach
+    for handler in handlers:
+        logger._log.removeHandler(handler)
+
+
+@pytest.fixture()
+def clean_logger(monkeypatch):
+    monkeypatch.delenv("SCALING_TPU_METRICS_PATH", raising=False)
+    monkeypatch.delenv("SCALING_TPU_HOST_ID", raising=False)
+    yield
+    logger.configure(LoggerConfig())
+    logger._warned_nonnumeric.clear()
+
+
+def test_log_dir_enables_metrics_jsonl_by_default(tmp_path, clean_logger):
+    logger.configure(LoggerConfig.from_dict({"log_dir": str(tmp_path)}))
+    assert logger.metrics_path() == str(tmp_path / "metrics_rank_0.jsonl")
+    logger.log_metrics({"loss": 2.5, "step_duration": 0.5}, step=3)
+    (rec,) = _read(tmp_path / "metrics_rank_0.jsonl")
+    assert rec["kind"] == "step" and rec["step"] == 3
+    assert rec["metrics"] == {"loss": 2.5, "step_duration": 0.5}
+    assert rec["host"] == 0 and "ts" in rec
+
+
+def test_metrics_jsonl_off_switch(tmp_path, clean_logger):
+    logger.configure(LoggerConfig.from_dict(
+        {"log_dir": str(tmp_path), "metrics_jsonl": False}
+    ))
+    assert logger.metrics_path() is None
+    logger.log_metrics({"loss": 1.0}, step=1)
+    assert not (tmp_path / "metrics_rank_0.jsonl").exists()
+
+
+def test_env_var_overrides_config_and_off_switch(tmp_path, monkeypatch,
+                                                 clean_logger):
+    override = tmp_path / "redirected.jsonl"
+    monkeypatch.setenv("SCALING_TPU_METRICS_PATH", str(override))
+    # env wins even against the off switch: a launcher redirecting a
+    # subprocess must win, same contract as SCALING_TPU_EVENTS_PATH
+    logger.configure(LoggerConfig.from_dict(
+        {"log_dir": str(tmp_path), "metrics_jsonl": False}
+    ))
+    assert logger.metrics_path() == str(override)
+    logger.log_metrics({"loss": 1.0}, step=1)
+    assert _read(override)[0]["metrics"] == {"loss": 1.0}
+
+
+def test_metrics_ranks_gate_the_sink_and_registry_flush(tmp_path,
+                                                        monkeypatch,
+                                                        clean_logger):
+    """metrics_ranks (default: rank 0 only) must gate the JSONL sink —
+    including the env override and the registry's flush_step, which
+    resolves its path through metrics_path(): a rank configured not to
+    record metrics writes NO snapshots either."""
+    from scaling_tpu.obs.registry import MetricsRegistry
+
+    override = tmp_path / "shared_metrics.jsonl"
+    monkeypatch.setenv("SCALING_TPU_METRICS_PATH", str(override))
+    logger.configure(
+        LoggerConfig.from_dict({"log_dir": str(tmp_path)}), global_rank=1
+    )
+    assert logger.metrics_path() is None
+    logger.log_metrics({"loss": 1.0}, step=1)
+    reg = MetricsRegistry()  # unconfigured: resolves via the logger
+    reg.counter("steps").inc()
+    reg.flush_step(1)
+    assert not override.exists()
+    # an explicitly enabled rank 1 writes
+    logger.configure(
+        LoggerConfig.from_dict(
+            {"log_dir": str(tmp_path), "metrics_ranks": [0, 1]}
+        ),
+        global_rank=1,
+    )
+    logger.log_metrics({"loss": 1.0}, step=2)
+    reg.flush_step(2)
+    kinds = [r["kind"] for r in _read(override)]
+    assert kinds == ["step", "registry"]
+
+
+def test_registry_host_falls_back_to_rank_like_step_records(
+        tmp_path, monkeypatch, clean_logger):
+    """Without SCALING_TPU_HOST_ID both record kinds stamp the logger's
+    rank — the analyzer must never see one file disagree with itself
+    about who wrote it."""
+    from scaling_tpu.obs.registry import MetricsRegistry
+
+    path = tmp_path / "metrics.jsonl"
+    monkeypatch.setenv("SCALING_TPU_METRICS_PATH", str(path))
+    logger.configure(
+        LoggerConfig.from_dict({"metrics_ranks": [2]}), global_rank=2
+    )
+    logger.log_metrics({"loss": 1.0}, step=1)
+    reg = MetricsRegistry()
+    reg.counter("steps").inc()
+    reg.flush_step(1)
+    hosts = {r["host"] for r in _read(path)}
+    assert hosts == {2}
+
+
+def test_no_log_dir_no_sink(clean_logger):
+    logger.configure(LoggerConfig())
+    assert logger.metrics_path() is None
+    logger.log_metrics({"loss": 1.0}, step=1)  # must not raise
+
+
+def test_host_id_env_stamps_metric_records(tmp_path, monkeypatch,
+                                           clean_logger):
+    monkeypatch.setenv("SCALING_TPU_HOST_ID", "2")
+    logger.configure(LoggerConfig.from_dict({"log_dir": str(tmp_path)}))
+    logger.log_metrics({"loss": 1.0}, step=1)
+    assert _read(tmp_path / "metrics_rank_0.jsonl")[0]["host"] == 2
+
+
+def test_nonnumeric_values_warn_once_per_key(tmp_path, mirror, clean_logger):
+    logger.configure(LoggerConfig.from_dict({"log_dir": str(tmp_path)}))
+    buf = mirror()
+    logger.log_metrics({"loss": 1.0, "note": "warmup", "shape": (2, 3)}, 1)
+    logger.log_metrics({"loss": 0.9, "note": "still here"}, 2)
+    logger.log_metrics({"loss": 0.8, "extra": object()}, 3)
+    out = buf.getvalue()
+    # both offenders named, the repeat did not warn again
+    assert out.count("non-numeric metric value(s) dropped") == 2
+    assert "'note'" in out and "'shape'" in out and "'extra'" in out
+    # the jsonl kept every numeric value and only the numeric values
+    recs = _read(tmp_path / "metrics_rank_0.jsonl")
+    assert [r["metrics"] for r in recs] == [
+        {"loss": 1.0}, {"loss": 0.9}, {"loss": 0.8}
+    ]
+
+
+def test_nonfinite_values_serialize_as_null(tmp_path, clean_logger):
+    """A NaN loss (the exact incident the telemetry exists to diagnose)
+    must not corrupt the metrics file: bare ``NaN`` tokens are invalid
+    JSON for every non-Python parser, so non-finite lands as null."""
+    logger.configure(LoggerConfig.from_dict({"log_dir": str(tmp_path)}))
+    logger.log_metrics(
+        {"loss": float("nan"), "grad_norm": float("inf"), "ok": 1.0}, 1
+    )
+    raw = (tmp_path / "metrics_rank_0.jsonl").read_text()
+    assert "NaN" not in raw and "Infinity" not in raw
+    (rec,) = _read(tmp_path / "metrics_rank_0.jsonl")
+    assert rec["metrics"] == {"loss": None, "grad_norm": None, "ok": 1.0}
+
+
+def test_bool_is_numeric_and_none_is_dropped(tmp_path, mirror, clean_logger):
+    logger.configure(LoggerConfig.from_dict({"log_dir": str(tmp_path)}))
+    buf = mirror()
+    logger.log_metrics({"flag": True, "missing": None}, 1)
+    (rec,) = _read(tmp_path / "metrics_rank_0.jsonl")
+    assert rec["metrics"] == {"flag": 1.0}
+    assert "'missing'" in buf.getvalue()
+
+
+def test_sink_write_failure_warns_not_raises(tmp_path, monkeypatch, mirror,
+                                             clean_logger):
+    blocked = tmp_path / "not_a_dir"
+    blocked.write_text("file, not a directory")
+    monkeypatch.setenv(
+        "SCALING_TPU_METRICS_PATH", str(blocked / "metrics.jsonl")
+    )
+    logger.configure(LoggerConfig())
+    buf = mirror()
+    logger.log_metrics({"loss": 1.0}, 1)  # must not raise
+    assert "could not append metrics" in buf.getvalue()

@@ -1,0 +1,192 @@
+"""Run-dir analyzer: golden health reports over canned run dirs (single
+host and a 2-host pod exercising straggler attribution), gate exit
+codes, the --json payload, and the real ``python -m scaling_tpu.obs``
+entrypoint (ISSUE 5 acceptance criterion).
+
+The goldens pin the EXACT rendering — formatting changes are deliberate:
+regenerate with
+``python -c "from scaling_tpu.obs.report import *; ..."`` (see
+docs/OBSERVABILITY.md) and re-review the diff by eye."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from scaling_tpu.obs.cli import main
+from scaling_tpu.obs.report import check_gates, load_run_dir, render_report
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+
+def _golden(name: str) -> str:
+    return (FIXTURES / f"golden_{name}.txt").read_text()
+
+
+# ---------------------------------------------------------------- golden
+def test_single_host_golden_report():
+    data = load_run_dir(FIXTURES / "rundir_single")
+    # the torn tail line (SIGKILLed writer) is counted, never fatal
+    assert data.bad_lines == 1
+    assert render_report(data, "RUNDIR") == _golden("single")
+
+
+def test_pod_golden_report_with_straggler_attribution():
+    data = load_run_dir(FIXTURES / "rundir_pod")
+    report = render_report(data, "RUNDIR")
+    assert report == _golden("pod")
+    # the load-bearing verdicts, asserted independently of formatting:
+    # host 1 is slow (0.75s vs 0.5s p50), so host 0 waits at every
+    # barrier and host 1 "arrived last" — the offline echo of the live
+    # _on_step_stall straggler table
+    assert "straggler: host 1 (p50 1.50x the fastest host)" in report
+    assert "blame: host 1 kept peers waiting 2.530s across 4 barrier(s)" in report
+    assert "[FAILED: BarrierTimeout]" in report
+    assert "totals: restarts=1 preemptions=1 stalls=0" in report
+    assert "commit_barrier=0.320s" in report
+
+
+def test_epoch_keyed_attribution_separates_relaunch_incidents():
+    """A relaunched pod re-waits the same barrier name and re-saves the
+    same step; attribution must keep the epochs apart — host 0 straggles
+    in epoch 0, host 1 in epoch 1, and neither verdict may blend."""
+    from scaling_tpu.obs.report import (
+        RunData, barrier_section, checkpoint_section,
+    )
+
+    def bw(epoch, host, dur):
+        return {"event": "span", "span": "barrier.wait", "ts": 1.0,
+                "barrier": "commit:step-3", "epoch": epoch, "host": host,
+                "dur_s": dur, "ok": True}
+
+    def stage(epoch, dur):
+        return {"event": "span", "span": "ckpt.stage", "ts": 1.0,
+                "step": 3, "epoch": epoch, "dur_s": dur, "ok": True}
+
+    data = RunData(
+        events=[bw(0, 0, 0.01), bw(0, 1, 5.0),   # epoch 0: host 0 last
+                bw(1, 0, 5.0), bw(1, 1, 0.01),   # epoch 1: host 1 last
+                stage(0, 1.0), stage(1, 2.0)],
+        steps=[], registry=[], files=1, bad_lines=0,
+    )
+    barriers = "\n".join(barrier_section(data))
+    assert "epoch 0 commit:step-3" in barriers
+    assert "epoch 1 commit:step-3" in barriers
+    assert "-> host 0 arrived last" in barriers
+    assert "-> host 1 arrived last" in barriers
+    assert "blame: host 0 kept peers waiting 5.000s across 1 barrier(s)" in barriers
+    assert "blame: host 1 kept peers waiting 5.000s across 1 barrier(s)" in barriers
+    ckpt = "\n".join(checkpoint_section(data))
+    assert "epoch 0 step 3: stage=1.000s" in ckpt
+    assert "epoch 1 step 3: stage=2.000s" in ckpt
+
+
+def test_failed_barrier_excluded_from_blame():
+    """Host 2 dies before the barrier: the survivors both time out with
+    ok=false. The arrived-last/blame accounting must not pick whichever
+    survivor's timeout was marginally shorter — the culprit never wrote
+    a span at all."""
+    from scaling_tpu.obs.report import RunData, barrier_section
+
+    data = RunData(
+        events=[
+            {"event": "span", "span": "barrier.wait", "ts": 1.0,
+             "barrier": "commit:step-9", "host": 0, "dur_s": 30.0,
+             "ok": False, "error": "BarrierTimeout"},
+            {"event": "span", "span": "barrier.wait", "ts": 1.0,
+             "barrier": "commit:step-9", "host": 1, "dur_s": 29.8,
+             "ok": False, "error": "BarrierTimeout"},
+        ],
+        steps=[], registry=[], files=1, bad_lines=0,
+    )
+    section = "\n".join(barrier_section(data))
+    assert "[FAILED: BarrierTimeout]" in section
+    assert "arrived last" not in section
+    assert "blame:" not in section
+
+
+# ----------------------------------------------------------------- gates
+def test_gates_pass_and_fail_thresholds():
+    data = load_run_dir(FIXTURES / "rundir_single")
+    assert check_gates(data, assert_mfu=0.30, assert_step_time=0.6) == []
+    failures = check_gates(data, assert_mfu=0.5, assert_step_time=0.1)
+    assert len(failures) == 2
+    assert "mean MFU 0.3300 < floor 0.5000" in failures[0]
+    assert "p50 step time 0.500s > ceiling 0.100s" in failures[1]
+
+
+def test_gates_fail_on_missing_data():
+    """A run that recorded no MFU must not pass an MFU floor by silence."""
+    data = load_run_dir(FIXTURES / "rundir_single")
+    data = type(data)(events=data.events, steps=[], registry=data.registry,
+                      files=data.files, bad_lines=data.bad_lines)
+    failures = check_gates(data, assert_mfu=0.1, assert_step_time=1.0)
+    assert any("no MFU samples" in f for f in failures)
+    assert any("no step_duration samples" in f for f in failures)
+
+
+# ------------------------------------------------------------------- CLI
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    rc = main(["report", str(FIXTURES / "rundir_single")])
+    assert rc == 0
+    assert "== run summary ==" in capsys.readouterr().out
+
+    out_json = tmp_path / "report.json"
+    rc = main([
+        "report", str(FIXTURES / "rundir_single"),
+        "--assert-mfu", "0.5", "--json", str(out_json),
+    ])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "== gates ==" in out and "FAIL assert-mfu" in out
+    payload = json.loads(out_json.read_text())
+    assert payload["step_records"] == 5 and payload["bad_lines"] == 1
+    assert payload["stats"]["mfu_mean"] == pytest.approx(0.33)
+    assert len(payload["gate_failures"]) == 1
+
+
+def test_cli_gates_pass_prints_pass(capsys):
+    rc = main([
+        "report", str(FIXTURES / "rundir_pod"),
+        "--assert-mfu", "0.2", "--assert-step-time", "1.0",
+    ])
+    assert rc == 0
+    assert "  PASS" in capsys.readouterr().out
+
+
+def test_cli_empty_and_missing_dir_exit_2(tmp_path, capsys):
+    assert main(["report", str(tmp_path)]) == 2
+    assert "no telemetry records" in capsys.readouterr().err
+    assert main(["report", str(tmp_path / "nope")]) == 2
+    assert "not a directory" in capsys.readouterr().err
+
+
+def test_module_entrypoint_subprocess():
+    """The documented invocation, end to end — and it must stay fast:
+    the obs package imports no jax at module level, so the analyzer
+    never pays backend init."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "scaling_tpu.obs", "report",
+         str(FIXTURES / "rundir_pod")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout == _golden("pod").replace(
+        "RUNDIR", str(FIXTURES / "rundir_pod")
+    )
+
+
+def test_obs_package_imports_without_jax():
+    """Contract pinned: importing scaling_tpu.obs must not import jax
+    (the supervisor's relaunch path and the CLI both rely on this)."""
+    code = (
+        "import sys; import scaling_tpu.obs; import scaling_tpu.obs.report; "
+        "import scaling_tpu.obs.cli; sys.exit(1 if 'jax' in sys.modules else 0)"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, "scaling_tpu.obs pulled in jax at import time"

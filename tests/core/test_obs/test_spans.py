@@ -1,0 +1,96 @@
+"""Span tracing units: nesting, exception safety, event emission, the
+registry histogram, and the explicit drain hook (ISSUE 5 satellite)."""
+
+import json
+
+import pytest
+
+from scaling_tpu.obs import span
+from scaling_tpu.obs.registry import MetricsRegistry
+
+
+def _read(path):
+    return [json.loads(l) for l in path.read_text().splitlines()]
+
+
+@pytest.fixture()
+def events(tmp_path, monkeypatch):
+    path = tmp_path / "events.jsonl"
+    monkeypatch.setenv("SCALING_TPU_EVENTS_PATH", str(path))
+    return path
+
+
+def test_span_emits_event_and_histogram(events):
+    reg = MetricsRegistry()
+    with span("ckpt.stage", step=7, registry=reg, backend="npz"):
+        pass
+    recs = _read(events)
+    assert len(recs) == 1
+    (rec,) = recs
+    assert rec["event"] == "span" and rec["span"] == "ckpt.stage"
+    assert rec["step"] == 7 and rec["ok"] is True
+    assert rec["backend"] == "npz"
+    assert rec["dur_s"] >= 0
+    hist = reg.snapshot()["histograms"]["span_seconds{span=ckpt.stage}"]
+    assert hist["count"] == 1
+
+
+def test_span_nesting_records_parent(events):
+    reg = MetricsRegistry()
+    with span("outer", registry=reg):
+        with span("inner", registry=reg):
+            pass
+    recs = {r["span"]: r for r in _read(events)}
+    assert recs["inner"]["parent"] == "outer"
+    assert "parent" not in recs["outer"]
+    # the stack drained: a later span has no stale parent
+    with span("after", registry=reg):
+        pass
+    assert "parent" not in _read(events)[-1]
+
+
+def test_span_exception_safety(events):
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError, match="boom"):
+        with span("risky", registry=reg):
+            raise ValueError("boom")
+    (rec,) = _read(events)
+    assert rec["ok"] is False and rec["error"] == "ValueError"
+    # the duration still observed, and the stack is clean after the raise
+    assert reg.snapshot()["histograms"]["span_seconds{span=risky}"]["count"] == 1
+    with span("after", registry=reg):
+        pass
+    assert "parent" not in _read(events)[-1]
+
+
+def test_span_exception_in_nested_pops_both(events):
+    reg = MetricsRegistry()
+    with pytest.raises(RuntimeError):
+        with span("outer", registry=reg):
+            with span("inner", registry=reg):
+                raise RuntimeError("x")
+    for rec in _read(events):
+        assert rec["ok"] is False and rec["error"] == "RuntimeError"
+    with span("clean", registry=reg):
+        pass
+    assert "parent" not in _read(events)[-1]
+
+
+def test_span_annotate_and_host(events, monkeypatch):
+    monkeypatch.setenv("SCALING_TPU_HOST_ID", "3")
+    reg = MetricsRegistry()
+    with span("phase", registry=reg) as sp:
+        sp.annotate(bytes_written=42)
+    (rec,) = _read(events)
+    assert rec["host"] == 3 and rec["bytes_written"] == 42
+
+
+def test_span_wait_for_drains_device_work(events):
+    import jax.numpy as jnp
+
+    reg = MetricsRegistry()
+    with span("synced", registry=reg) as sp:
+        x = jnp.ones((32, 32)) @ jnp.ones((32, 32))
+        sp.wait_for(x)
+    (rec,) = _read(events)
+    assert rec["ok"] is True and rec["dur_s"] > 0

@@ -62,10 +62,17 @@ def run_supervised(tmp_dir: Path, name: str, faults: str = "",
     }
     spec_file = tmp_dir / f"{name}_spec.json"
     spec_file.write_text(json.dumps(spec))
+    # one telemetry dir per scenario: supervisor + every worker (all
+    # epochs) append events here, and each worker's log_metrics appends
+    # step records — exactly the run dir `python -m scaling_tpu.obs
+    # report` is pointed at after a real incident (ISSUE 5)
+    telemetry_dir = tmp_dir / f"{name}_telemetry"
+    telemetry_dir.mkdir(exist_ok=True)
     env = {
         **os.environ,
         "JAX_PLATFORMS": "cpu",
-        "SCALING_TPU_EVENTS_PATH": str(tmp_dir / f"{name}_events.jsonl"),
+        "SCALING_TPU_EVENTS_PATH": str(telemetry_dir / "events.jsonl"),
+        "SCALING_TPU_METRICS_PATH": str(telemetry_dir / "metrics.jsonl"),
         "SCALING_TPU_TEST_CACHE": "off",
     }
     env.pop("XLA_FLAGS", None)  # fake hosts are single-device by design
@@ -110,7 +117,7 @@ def read_result(workdir: Path, host: int) -> dict:
 
 
 def read_events(tmp_dir: Path, name: str) -> list:
-    f = tmp_dir / f"{name}_events.jsonl"
+    f = tmp_dir / f"{name}_telemetry" / "events.jsonl"
     if not f.is_file():
         return []
     return [json.loads(l) for l in f.read_text().splitlines()]
@@ -163,6 +170,21 @@ def test_kill_one_host_supervisor_relaunches_loss_exact(baseline):
     relaunches = [e for e in events if e["event"] == "relaunch"]
     assert [e["epoch"] for e in relaunches] == [1, 2]
     assert any(e["event"] == "epoch-clean-exit" for e in events)
+
+    # ISSUE 5 acceptance: the run's telemetry dir (events + metrics
+    # JSONL from the supervisor and every worker across all 3 epochs)
+    # parses cleanly through the run-dir analyzer
+    from scaling_tpu.obs.cli import main as obs_main
+    from scaling_tpu.obs.report import load_run_dir, render_report
+
+    telemetry = tmp / "kill_telemetry"
+    data = load_run_dir(telemetry)
+    assert data.bad_lines == 0, f"unparseable telemetry: {data.bad_lines}"
+    assert {r["host"] for r in data.steps} == {0, 1}
+    report = render_report(data, telemetry)
+    assert "restarts=2" in report
+    assert "step 3:" in report and "step 6:" in report  # ckpt breakdown
+    assert obs_main(["report", str(telemetry)]) == 0
 
 
 def test_kill_between_commit_and_barrier_latest_never_advances(baseline):
@@ -270,10 +292,13 @@ def test_sigterm_to_supervisor_drains_all_hosts_same_boundary(baseline):
     }
     spec_file = tmp / "supterm_spec.json"
     spec_file.write_text(json.dumps(spec))
+    telemetry_dir = tmp / "supterm_telemetry"
+    telemetry_dir.mkdir(exist_ok=True)
     env = {
         **os.environ,
         "JAX_PLATFORMS": "cpu",
-        "SCALING_TPU_EVENTS_PATH": str(tmp / "supterm_events.jsonl"),
+        "SCALING_TPU_EVENTS_PATH": str(telemetry_dir / "events.jsonl"),
+        "SCALING_TPU_METRICS_PATH": str(telemetry_dir / "metrics.jsonl"),
         "SCALING_TPU_TEST_CACHE": "off",
     }
     env.pop("XLA_FLAGS", None)
