@@ -214,6 +214,32 @@ class TransformerLayer(BaseLayer):
         params["attention"] = self.attention.merge_lora_weights(params["attention"])
         return params
 
+    # ----------------------------------------------------- token slicing
+    def init_token_slice_cache(self, params: dict, x: dict,
+                               ctx: ForwardContext, capacity: int):
+        """Zeroed per-layer KV(+segment-id) cache for TeraPipe token
+        slicing (parallel/pipeline.py): k/v buffers at full-sequence
+        ``capacity`` on the slot axis, plus the cached slots' segment ids
+        so the sliced attention keeps packed-document masking. The shapes
+        come from an abstract probe of this layer on one slice, so GQA /
+        head-dim / dtype choices never drift from the real attention."""
+        import dataclasses as _dc
+
+        probe_ctx = _dc.replace(ctx, dropout_key=None, deterministic=True)
+
+        def probe(p, xx):
+            return self(p, xx, probe_ctx, return_kv=True)[1]
+
+        k, v = jax.eval_shape(probe, params, x)
+
+        def grow(aval):
+            return jnp.zeros(
+                (aval.shape[0], capacity) + aval.shape[2:], aval.dtype
+            )
+
+        seg = jnp.zeros((k.shape[0], capacity), jnp.int32)
+        return (grow(k), grow(v), seg)
+
     # --------------------------------------------------------------- forward
     def __call__(self, params: dict, x: dict, ctx: ForwardContext,
                  kv_cache=None, cache_offset=None, return_kv: bool = False):

@@ -313,8 +313,16 @@ class ParallelSelfAttention(BaseLayer):
         new_kv = (k, v) if return_kv else None
 
         if kv_cache is not None:
-            # incremental decode: append new k/v at cache_offset
-            ck, cv = kv_cache
+            # incremental decode / token-slice pipelining: append new k/v at
+            # cache_offset. A 3-tuple cache carries the cached slots'
+            # segment ids too, so packed-document masking survives sequence
+            # slicing (TeraPipe); the decode paths keep their 2-tuples and
+            # the slots-only mask.
+            cseg = None
+            if len(kv_cache) == 3:
+                ck, cv, cseg = kv_cache
+            else:
+                ck, cv = kv_cache
             assert cache_offset is not None
             ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), cache_offset, axis=1)
             cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), cache_offset, axis=1)
@@ -333,6 +341,17 @@ class ParallelSelfAttention(BaseLayer):
             # mask out unwritten cache slots + causal vs slot order
             valid_k = slots_k < (cache_offset + s)
             allowed = valid_k[:, None, :] & (slots_k[:, None, :] <= slots_q[:, :, None])
+            if cseg is not None:
+                seg_q = (
+                    segment_ids
+                    if segment_ids is not None
+                    else jnp.zeros((b, s), jnp.int32)
+                )
+                cseg = jax.lax.dynamic_update_slice_in_dim(
+                    cseg, seg_q.astype(cseg.dtype), cache_offset, axis=1
+                )
+                allowed = allowed & (cseg[:, None, :] == seg_q[:, :, None])
+                new_kv = (ck, cv, cseg)
             mask = ~allowed[:, None, :, :]
         else:
             if segment_ids is None:

@@ -281,6 +281,112 @@ def checkpoint_section(data: RunData) -> List[str]:
     return lines
 
 
+def step_span_sums(spans: List[dict], names: Tuple[str, ...],
+                   drop_earliest_step: bool = True
+                   ) -> Dict[int, Dict[int, Dict[str, float]]]:
+    """Per-host, per-step summed durations of the given span names —
+    the ONE aggregation both the report's pipeline section and the
+    schedule simulator's profile calibration
+    (``parallel.pipeline_schedule._durations_from_run_dir``) read, so
+    the compile-step-drop policy cannot diverge between them. With
+    ``drop_earliest_step`` (default), each host's earliest step is
+    removed when later steps exist — it carries the jit compile."""
+    by_host: Dict[int, Dict[int, Dict[str, float]]] = defaultdict(dict)
+    for sp in spans:
+        name = sp.get("span")
+        if name not in names or "step" not in sp:
+            continue
+        rec = by_host[int(sp.get("host", 0))].setdefault(int(sp["step"]), {})
+        rec[name] = rec.get(name, 0.0) + float(sp.get("dur_s", 0.0))
+    if drop_earliest_step:
+        for host, steps in by_host.items():
+            if len(steps) > 1:
+                del steps[min(steps)]
+    return dict(by_host)
+
+
+def step_compute_samples(
+    by_host: Dict[int, Dict[int, Dict[str, float]]]
+) -> List[float]:
+    """Per-host AMORTIZED per-step compute seconds from fwdbwd/sync sums.
+
+    Under ``log_interval > 1`` the trainer skips the device sync on most
+    steps: their records carry only the ~ms ``step.fwdbwd`` dispatch,
+    and the next synced step's ``step.sync`` drains the whole backlog.
+    A per-step percentile would read dispatch latency as compute, so the
+    sample is per host: (sum of all kept fwdbwd + sync) / kept steps —
+    the same amortization the trainer's own ``step_duration`` uses."""
+    samples: List[float] = []
+    for steps in by_host.values():
+        if not steps:
+            continue
+        total = sum(sum(rec.get(n, 0.0) for n in ("step.fwdbwd", "step.sync"))
+                    for rec in steps.values())
+        samples.append(total / len(steps))
+    return samples
+
+
+def _pipeline_tick_counts(pp: int, virtual: int, slices: int,
+                          gas: int) -> Tuple[str, int, int]:
+    """(schedule label, work ticks, total ticks) of the spatial executor
+    (parallel/pipeline.py) — closed-form, mirroring the schedule DSL's
+    simulator without importing jax-bearing packages here."""
+    if virtual > 1:
+        return f"interleaved(v={virtual})", gas * virtual, gas * virtual + pp - 1
+    if slices > 1:
+        return f"token-slice(S={slices})", gas * slices, gas * slices + pp - 1
+    return "fill-drain", gas, gas + pp - 1
+
+
+def pipeline_section(data: RunData) -> List[str]:
+    """Pipeline bubble attribution: the schedule shape comes from the
+    trainer's ``pipeline-config`` event; the measured step compute from
+    the ``step.fwdbwd`` (dispatch) + ``step.sync`` (drain) spans. The
+    schedule's tick counts attribute that measured time into busy vs
+    fill/drain-idle seconds, next to the same attribution for the naive
+    fill-drain schedule on the same shape. Rendered only for pipelined
+    runs (no event -> no section, so single-path run dirs are
+    unchanged)."""
+    cfgs = [e for e in data.lifecycle if e.get("event") == "pipeline-config"]
+    if not cfgs:
+        return []
+    cfg = cfgs[-1]
+    pp = int(cfg.get("pp", 1))
+    virtual = int(cfg.get("virtual", 1))
+    slices = int(cfg.get("token_slices", 1))
+    gas = int(cfg.get("gas", 1))
+    label, work, total = _pipeline_tick_counts(pp, virtual, slices, gas)
+    bubble = (total - work) / total if total else 0.0
+    _, fd_work, fd_total = _pipeline_tick_counts(pp, 1, 1, gas)
+    fd_bubble = (fd_total - fd_work) / fd_total if fd_total else 0.0
+    lines = ["== pipeline =="]
+    lines.append(
+        f"  schedule: {label} pp={pp} gas={gas} "
+        f"({work} work ticks / {total} total per pass)"
+    )
+    lines.append(
+        f"  predicted bubble: {bubble:.1%} "
+        f"(fill-drain on this shape: {fd_bubble:.1%})"
+    )
+    by_host = step_span_sums(data.spans, ("step.fwdbwd", "step.sync"))
+    samples = step_compute_samples(by_host)
+    if not samples:
+        lines.append("  measured: (no step.fwdbwd/step.sync spans)")
+        return lines
+    p50 = percentile(samples, 50)
+    n_steps = sum(len(steps) for steps in by_host.values())
+    idle_s = p50 * bubble
+    lines.append(
+        f"  measured step compute (fwdbwd+sync amortized over {n_steps} "
+        f"steps): {_fmt_s(p50)}"
+    )
+    lines.append(
+        f"  attributed: per-tick {_fmt_s(p50 / total)}, "
+        f"fill/drain idle {_fmt_s(idle_s)}/step ({bubble:.1%} of compute)"
+    )
+    return lines
+
+
 def timeline_section(data: RunData) -> List[str]:
     lines = ["== restart / preemption timeline =="]
     lifecycle = data.lifecycle
@@ -329,11 +435,12 @@ def render_report(data: RunData, run_dir: Path | str = "") -> str:
         header,
         step_time_section(data),
         mfu_lines,
+        pipeline_section(data),  # empty (omitted) for non-pipelined runs
         barrier_section(data),
         checkpoint_section(data),
         timeline_section(data),
     ]
-    return "\n".join("\n".join(s) for s in sections) + "\n"
+    return "\n".join("\n".join(s) for s in sections if s) + "\n"
 
 
 def check_gates(data: RunData, assert_mfu: Optional[float] = None,

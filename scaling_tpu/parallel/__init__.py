@@ -6,7 +6,10 @@ from .pipeline import (
     pipe_partition_uniform,
 )
 from .pipeline_schedule import (
+    PipelineScheduleFillDrain,
     PipelineScheduleInference,
+    PipelineScheduleInterleaved,
+    PipelineScheduleTokenSlice,
     PipelineScheduleTrain,
     SimulationEngine,
     visualize,
@@ -28,7 +31,10 @@ __all__ = [
     "pipe_partition_balanced",
     "pipe_partition_from_indices",
     "pipe_partition_uniform",
+    "PipelineScheduleFillDrain",
     "PipelineScheduleInference",
+    "PipelineScheduleInterleaved",
+    "PipelineScheduleTokenSlice",
     "PipelineScheduleTrain",
     "SimulationEngine",
     "visualize",

@@ -241,11 +241,21 @@ class ParallelModule:
             if isinstance(layer, PipelinedBody):
                 start = self._logical_start[i]
                 L = layer.num_layers
-                # empty (0,) leaves are frozen-param placeholders in
-                # optimizer-state trees: not stacked, pass through per layer
-                flat = jax.tree.map(
-                    lambda x: x.reshape(L, *x.shape[2:]) if x.size else x, sub
-                )
+
+                def to_layer_major(x, _layer=layer, _L=L):
+                    # empty (0,) leaves are frozen-param placeholders in
+                    # optimizer-state trees: not stacked, pass through
+                    if not x.size:
+                        return x
+                    if _layer.vpp > 1:
+                        # (pp, v, lpv, ...): stage s's virtual index r is
+                        # the round-robin chunk r*pp + s — undo via
+                        # (v, pp, lpv) flattening
+                        x = jnp.moveaxis(x, 0, 1)
+                        return x.reshape(_L, *x.shape[3:])
+                    return x.reshape(_L, *x.shape[2:])
+
+                flat = jax.tree.map(to_layer_major, sub)
                 for j in range(L):
                     view[f"layer_{start + j}"] = jax.tree.map(
                         lambda x, _j=j: x[_j] if x.size else x, flat
@@ -262,12 +272,23 @@ class ParallelModule:
             if isinstance(layer, PipelinedBody):
                 start = self._logical_start[i]
                 L, pp = layer.num_layers, max(layer.pp, 1)
+                vpp = max(layer.vpp, 1)
                 per_layer = [view[f"layer_{start + j}"] for j in range(L)]
 
-                def restack(old, *xs):
+                def restack(old, *xs, _vpp=vpp):
                     if old.size == 0:  # frozen-param placeholder
                         return old
-                    new = jnp.stack(xs, axis=0).reshape(pp, L // pp, *xs[0].shape)
+                    new = jnp.stack(xs, axis=0)
+                    if _vpp > 1:
+                        # layer-major -> (v, pp, lpv, ...) -> interleaved
+                        # (pp, v, lpv, ...) chunk layout (chunk r*pp + s
+                        # lives at stage s, virtual index r)
+                        new = jnp.moveaxis(
+                            new.reshape(_vpp, pp, L // (pp * _vpp), *xs[0].shape),
+                            0, 1,
+                        )
+                    else:
+                        new = new.reshape(pp, L // pp, *xs[0].shape)
                     return (
                         jax.device_put(new, old.sharding)
                         if hasattr(old, "sharding")
@@ -327,9 +348,10 @@ class ParallelModule:
             if isinstance(layer, PipelinedBody):
                 template = layer.template
                 if hasattr(template, "merge_lora_weights"):
-                    params[name] = jax.vmap(jax.vmap(template.merge_lora_weights))(
-                        params[name]
-                    )
+                    merge = jax.vmap(jax.vmap(template.merge_lora_weights))
+                    if layer.vpp > 1:  # extra (v) leading dim to map over
+                        merge = jax.vmap(merge)
+                    params[name] = merge(params[name])
             elif hasattr(layer, "merge_lora_weights"):
                 params[name] = layer.merge_lora_weights(params[name])
         return params

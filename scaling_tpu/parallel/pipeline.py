@@ -19,8 +19,30 @@ formulation is *spatial* pipelining:
   its pp in-flight micro-batches; an unchunked scan would hold all
   n_micro).
 
-The 1F1B instruction DSL and its simulator survive as the pure-Python
-planning/visualisation tool in ``pipeline_schedule.py``.
+Two schedule refinements shrink the fill/drain bubble (docs/PIPELINE.md):
+
+- **Interleaved virtual stages** (``pipe_virtual_size`` = v, Megatron-LM
+  arxiv 2104.04473): params stack ``(pp, v, layers_per_virtual, ...)``,
+  the ``pp * v`` layer chunks are assigned round-robin over the stages
+  (stage s holds chunks ``{r*pp + s}``), and micro-batches circulate v
+  times through the stage ring — the per-tick shift becomes a CIRCULAR
+  permute (``jnp.roll`` on the pipe-sharded dim, still one ICI
+  collective-permute). Fill/drain shrinks from ``(pp-1)`` full-stage
+  ticks to ``(pp-1)`` thin virtual-stage ticks (~v x less garbage
+  compute) at the cost of v x more permutes per step.
+- **Token slicing** (``pipe_token_slices`` = S, TeraPipe arxiv
+  2102.07988): each micro-batch's sequence splits into S causal chunks
+  which pipeline through the stages as independent work items; each
+  stage keeps a per-layer KV(+segment) cache of the chunks it already
+  saw, so causal attention over the prefix is exact (packed-document
+  masks included). For long sequences at low grad-accum this recovers
+  the parallelism micro-batch pipelining runs out of.
+
+The instruction DSL and its simulator survive as the pure-Python
+planning/visualisation tool in ``pipeline_schedule.py`` — including
+``PipelineScheduleInterleaved`` / ``PipelineScheduleTokenSlice``, whose
+predicted bubble fractions the ``obs report`` pipeline section checks
+against span-measured step time.
 
 Heterogeneous edges (embedding, final norm, lm head) run outside the
 pipelined region: their FLOPs are negligible next to the body. Their big
@@ -125,25 +147,45 @@ class PipelinedBody:
         self.num_layers = num_layers
         self.topology = topology
         self.pp = topology.pipe_parallel_size if topology else 1
-        assert num_layers % max(self.pp, 1) == 0, (
+        self.vpp = topology.pipe_virtual_size if topology else 1
+        self.token_slices = topology.pipe_token_slices if topology else 1
+        assert num_layers % max(self.pp * self.vpp, 1) == 0, (
             f"spatial pipelining needs num_layers ({num_layers}) divisible by "
-            f"pipe_parallel_size ({self.pp})"
+            f"pipe_parallel_size ({self.pp}) * pipe_virtual_size ({self.vpp})"
         )
         self.layers_per_stage = num_layers // max(self.pp, 1)
+        self.layers_per_virtual = num_layers // max(self.pp * self.vpp, 1)
 
-    # params: every leaf gains leading dims (pp, layers_per_stage)
+    # params: every leaf gains leading dims (pp, layers_per_stage) — or
+    # (pp, vpp, layers_per_virtual) under interleaving, where stage s's
+    # virtual index r holds the round-robin chunk r*pp + s
+    def _stack_layer_major(self, stacked: Any) -> Any:
+        if self.vpp > 1:
+            return jax.tree.map(
+                lambda x: jnp.moveaxis(
+                    x.reshape(
+                        self.vpp, self.pp, self.layers_per_virtual, *x.shape[1:]
+                    ),
+                    0, 1,
+                ),
+                stacked,
+            )
+        return jax.tree.map(
+            lambda x: x.reshape(self.pp, self.layers_per_stage, *x.shape[1:]), stacked
+        )
+
     def init(self, key: jax.Array) -> Any:
         per_layer = [
             self.template.init(jax.random.fold_in(key, i)) for i in range(self.num_layers)
         ]
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *per_layer)
-        return jax.tree.map(
-            lambda x: x.reshape(self.pp, self.layers_per_stage, *x.shape[1:]), stacked
-        )
+        return self._stack_layer_major(stacked)
 
     def param_metas(self) -> Any:
+        lead = (PIPE_AXIS, None, None) if self.vpp > 1 else (PIPE_AXIS, None)
+
         def lift(m: ParamMeta) -> ParamMeta:
-            spec = (PIPE_AXIS, None) + tuple(m.partition_spec)
+            spec = lead + tuple(m.partition_spec)
             return ParamMeta(**{**m.__dict__, "partition_spec": spec})
 
         return jax.tree.map(
@@ -221,6 +263,30 @@ class PipelinedBody:
                 s,
             )
 
+        # ONE dropout base key and shard count for every pp>1 schedule:
+        # independently-edited copies could silently decorrelate them
+        base_key = (
+            ctx.dropout_key
+            if ctx.dropout_key is not None
+            else jax.random.PRNGKey(0)
+        )
+        state_shards = pp * (
+            self.topology.data_parallel_size
+            * self.topology.context_parallel_size
+            if self.topology is not None
+            else 1
+        )
+        if self.vpp > 1:
+            return self._interleaved(
+                params, x_microbatches, ctx, call, remat, remat_policy,
+                constrain_state, base_key, state_shards, n_micro,
+            )
+        if self.token_slices > 1:
+            return self._token_sliced(
+                params, x_microbatches, ctx, call, layer_call, remat,
+                remat_policy, constrain_state, base_key, state_shards, n_micro,
+            )
+
         stage_indices = jnp.arange(pp)
 
         def stage_fn(stage_params, x, stage_idx, tick_key):
@@ -239,12 +305,6 @@ class PipelinedBody:
         if remat:
             stage_fn = jax.checkpoint(stage_fn, static_argnums=(), policy=remat_policy)
 
-        base_key = (
-            ctx.dropout_key
-            if ctx.dropout_key is not None
-            else jax.random.PRNGKey(0)
-        )
-
         def tick(state, t):
             tick_key = jax.random.fold_in(base_key, t)
             inp = jax.tree.map(
@@ -253,8 +313,17 @@ class PipelinedBody:
                 ),
                 x_microbatches,
             )
+            # roll-then-overwrite, NOT concatenate([inp[None], s[:-1]]):
+            # with model-parallel params in the stage vmap, XLA SPMD
+            # miscompiles the concatenate form of the shift on the
+            # pipe-sharded dim (wrong activations, reproduced down to a
+            # 60-line pure-matmul case on jax 0.4.37 CPU: max err ~11 vs
+            # the roll form's 5e-7 against the sequential reference —
+            # tests/core/test_nn/test_pipeline.py guards this). The rolled
+            # row 0 (old last stage's output) is discarded by the
+            # overwrite, so semantics are identical.
             shifted = jax.tree.map(
-                lambda i, s: jnp.concatenate([i[None], s[:-1]], axis=0), inp, state
+                lambda i, s: jnp.roll(s, 1, axis=0).at[0].set(i), inp, state
             )
             shifted = constrain_state(shifted)
             tick_keys = jax.vmap(lambda s: jax.random.fold_in(tick_key, s))(stage_indices)
@@ -268,45 +337,328 @@ class PipelinedBody:
         )
         zero_state = constrain_state(zero_state)
         n_ticks = n_micro + pp - 1
-        state_shards = pp * (
-            self.topology.data_parallel_size
-            * self.topology.context_parallel_size
-            if self.topology is not None
-            else 1
+        outs = _scan_ticks(
+            tick, zero_state, n_ticks, remat, remat_policy, state_shards
         )
-        if remat and n_ticks >= 4 and _tick_carries_exceed_budget(
-            zero_state, n_ticks, state_shards
-        ):
-            # sqrt(T)-chunked remat over the tick scan: a plain scan saves
-            # every tick's carry for backward — O(n_micro * pp) boundary
-            # activations, where the reference's 1F1B holds only its pp
-            # in-flight micro-batches (pipeline_schedule/train.py:109-117).
-            # Checkpointing chunks of ~sqrt(T) ticks stores only chunk-edge
-            # carries + one chunk's internal carries during its backward:
-            # O(sqrt(n_micro) * pp) memory for one extra body forward.
-            #
-            # That extra forward is ~+25% step time (b = 2f: (3f+b)/(2f+b))
-            # — real wall-clock, unlike the fill/drain garbage ticks which
-            # overlap 1F1B's bubble — so it is paid ONLY when the carries
-            # would actually strain HBM (at BASELINE #4's pp=2 gas=8 the
-            # carries are ~144 MB/device: the plain scan matches a 1F1B
-            # executor's wall-clock there; see PERF.md "Spatial pipeline
-            # vs a 1F1B executor").
-            chunk, n_chunks = _remat_chunking(n_ticks)
-            padded = n_chunks * chunk  # excess ticks produce discarded outputs
-            tick_ids = jnp.arange(padded).reshape(n_chunks, chunk)
-
-            @partial(jax.checkpoint, policy=remat_policy)
-            def chunk_body(state, ts):
-                return jax.lax.scan(tick, state, ts)
-
-            _, outs = jax.lax.scan(chunk_body, zero_state, tick_ids)
-            outs = jax.tree.map(
-                lambda o: o.reshape((padded,) + o.shape[2:])[pp - 1 : n_ticks], outs
-            )
-            return outs
-        _, outs = jax.lax.scan(tick, zero_state, jnp.arange(n_ticks))
         return jax.tree.map(lambda o: o[pp - 1 :], outs)
+
+    # ------------------------------------------------- interleaved (vpp > 1)
+    def _interleaved(self, params, x_microbatches, ctx, call, remat,
+                     remat_policy, constrain_state, base_key, state_shards,
+                     n_micro):
+        """Interleaved virtual stages: micro-batches circulate ``vpp``
+        rounds through the stage ring, one thin ``layers_per_virtual``
+        chunk per tick; stage s applies chunk ``r*pp + s`` on round r.
+
+        Injection runs in groups of pp micro-batches: group g's round-r
+        items enter stage 0 at ticks ``g*pp*vpp + r*pp + p`` (round 0 by
+        fresh injection, later rounds via the circular wrap of stage
+        pp-1's output — ``jnp.roll`` on the pipe-sharded dim lowers to one
+        ICI collective-permute per tick). Fill/drain is ``pp - 1`` THIN
+        ticks instead of the naive schedule's ``pp - 1`` full ticks: ~vpp
+        x less bubble, vpp x more permutes. When n_micro is not a multiple
+        of pp (eval's single micro-batch), the empty injection slots carry
+        clipped duplicates whose outputs are never gathered."""
+        pp, v, lpv = self.pp, self.vpp, self.layers_per_virtual
+        stage_indices = jnp.arange(pp)
+        period = pp * v
+
+        def stage_fn(stage_params, x, stage_idx, round_idx, tick_key):
+            chunk = jax.tree.map(
+                lambda p: jax.lax.dynamic_index_in_dim(
+                    p, round_idx, axis=0, keepdims=False
+                ),
+                stage_params,
+            )
+
+            def body(h, wj):
+                w, j = wj
+                layer_index = (round_idx * pp + stage_idx) * lpv + j
+                return call(w, h, _fold_key(ctx, tick_key, layer_index), layer_index), None
+
+            h, _ = jax.lax.scan(body, x, (chunk, jnp.arange(lpv)))
+            return h
+
+        if remat:
+            stage_fn = jax.checkpoint(stage_fn, policy=remat_policy)
+
+        def tick(state, t):
+            tick_key = jax.random.fold_in(base_key, t)
+            within = t % period
+            inject = within < pp
+            mb_idx = jnp.clip((t // period) * pp + within, 0, n_micro - 1)
+            inp = jax.tree.map(
+                lambda xs: jax.lax.dynamic_index_in_dim(xs, mb_idx, keepdims=False),
+                x_microbatches,
+            )
+            # circular shift: stage 0 receives stage pp-1's wrap unless this
+            # tick injects a fresh round-0 micro-batch over it
+            rolled = jax.tree.map(lambda s: jnp.roll(s, 1, axis=0), state)
+            shifted = jax.tree.map(
+                lambda i, r: r.at[0].set(jnp.where(inject, i, r[0])), inp, rolled
+            )
+            shifted = constrain_state(shifted)
+            rounds = ((t - stage_indices) % period) // pp
+            tick_keys = jax.vmap(lambda s: jax.random.fold_in(tick_key, s))(stage_indices)
+            new_state = jax.vmap(stage_fn)(
+                params, shifted, stage_indices, rounds, tick_keys
+            )
+            new_state = constrain_state(new_state)
+            out = jax.tree.map(lambda s: s[-1], new_state)
+            return new_state, out
+
+        zero_state = jax.tree.map(
+            lambda xs: jnp.zeros((pp,) + xs.shape[1:], dtype=xs.dtype), x_microbatches
+        )
+        zero_state = constrain_state(zero_state)
+        # micro-batch m (group g, position p) makes its last-round exit from
+        # stage pp-1 at tick g*pp*v + v*pp + p - 1
+        out_ticks = [
+            (m // pp) * period + v * pp + (m % pp) - 1 for m in range(n_micro)
+        ]
+        n_ticks = out_ticks[-1] + 1
+        outs = _scan_ticks(
+            tick, zero_state, n_ticks, remat, remat_policy, state_shards
+        )
+        idx = jnp.asarray(out_ticks)
+        return jax.tree.map(lambda o: jnp.take(o, idx, axis=0), outs)
+
+    # ---------------------------------------------- token slicing (TeraPipe)
+    def _token_sliced(self, params, x_microbatches, ctx, call, layer_call,
+                      remat, remat_policy, constrain_state, base_key,
+                      state_shards, n_micro):
+        """TeraPipe token slicing: each micro-batch's sequence splits into
+        ``token_slices`` causal chunks that pipeline through the stages as
+        independent work items (injection order m-major, so a micro-batch's
+        chunks hit each stage consecutively and in causal order).
+
+        Exactness across chunks comes from a per-stage, per-layer
+        KV(+segment-id) cache carried across ticks: chunk k runs with
+        ``cache_offset = k * slice_len`` against the cache its
+        predecessors wrote, reproducing full causal (and packed-document)
+        attention over the prefix. Templates advertise the cache protocol
+        via ``init_token_slice_cache``; templates whose math is
+        position-local (no cross-token mixing) run cache-free. Slots
+        beyond the current chunk are masked by the attention's
+        ``valid_k`` gate, so caches never need resetting between
+        micro-batches — every valid slot was freshly written by the
+        current one."""
+        pp, S = self.pp, self.token_slices
+        per_stage = self.layers_per_stage
+        stage_indices = jnp.arange(pp)
+
+        s_total = None
+        for leaf in jax.tree.leaves(x_microbatches):
+            if leaf.ndim < 3:
+                raise ValueError(
+                    "token slicing needs every state leaf shaped "
+                    f"(n_micro, mbs, seq, ...); got {leaf.shape}"
+                )
+            if s_total is None:
+                s_total = leaf.shape[2]
+            if leaf.shape[2] != s_total:
+                raise ValueError(
+                    "token slicing needs one shared sequence dim; got "
+                    f"{leaf.shape[2]} vs {s_total}"
+                )
+        if s_total % S != 0:
+            raise ValueError(
+                f"pipe_token_slices ({S}) must divide the sequence length "
+                f"({s_total})"
+            )
+        slice_len = s_total // S
+        n_work = n_micro * S
+
+        def split(leaf):
+            x = leaf.reshape(
+                n_micro, leaf.shape[1], S, slice_len, *leaf.shape[3:]
+            )
+            x = jnp.moveaxis(x, 2, 1)
+            return x.reshape(n_work, leaf.shape[1], slice_len, *leaf.shape[3:])
+
+        work_items = jax.tree.map(split, x_microbatches)
+
+        cached = hasattr(self.template, "init_token_slice_cache")
+        if cached and layer_call is not None:
+            # the cached stage loop calls the template's cache-protocol
+            # signature directly; silently dropping a caller's wrapper
+            # would be wrong behavior with zero signal
+            raise NotImplementedError(
+                "token slicing with a KV-cache template does not support "
+                "layer_call overrides (the cache protocol bypasses them)"
+            )
+        if not cached:
+            import inspect
+
+            try:
+                takes_cache = "kv_cache" in inspect.signature(
+                    type(self.template).__call__
+                ).parameters
+            except (TypeError, ValueError):
+                takes_cache = False
+            if takes_cache:
+                raise NotImplementedError(
+                    f"{type(self.template).__name__} takes kv_cache but does "
+                    "not implement init_token_slice_cache; token slicing "
+                    "cannot run its attention exactly without the cache "
+                    "protocol"
+                )
+
+        zero_caches = None
+        if cached:
+            probe_ctx = dataclasses.replace(
+                ctx, dropout_key=None, deterministic=True
+            )
+            w0 = jax.tree.map(lambda p: p[0, 0], params)
+            x0 = jax.tree.map(lambda l: l[0], work_items)
+            layer_cache = self.template.init_token_slice_cache(
+                w0, x0, probe_ctx, capacity=s_total
+            )
+            zero_caches = jax.tree.map(
+                lambda l: jnp.zeros((pp, per_stage) + l.shape, l.dtype),
+                layer_cache,
+            )
+
+        def constrain_caches(c):
+            if ctx.mesh is None or c is None:
+                return c
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def spec_for(x):
+                # (pp, per_stage, mbs, seq, ...): stage over pipe, the
+                # cached batch dim over data
+                axes = [PIPE_AXIS, None, DATA_AXIS][: min(x.ndim, 3)]
+                return P(*axes, *([None] * (x.ndim - len(axes))))
+
+            return jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, NamedSharding(ctx.mesh, spec_for(x))
+                ),
+                c,
+            )
+
+        zero_caches = constrain_caches(zero_caches)
+
+        if cached:
+            def stage_fn(stage_params, stage_cache, x, stage_idx, offset, tick_key):
+                def body(h, wjc):
+                    w, j, cache_j = wjc
+                    layer_index = stage_idx * per_stage + j
+                    out, new_cache = self.template(
+                        w, h, _fold_key(ctx, tick_key, layer_index),
+                        kv_cache=cache_j, cache_offset=offset,
+                    )
+                    return out, new_cache
+
+                h, new_caches = jax.lax.scan(
+                    body, x, (stage_params, jnp.arange(per_stage), stage_cache)
+                )
+                return h, new_caches
+        else:
+            def stage_fn(stage_params, stage_cache, x, stage_idx, offset, tick_key):
+                del stage_cache, offset
+
+                def body(h, wj):
+                    w, j = wj
+                    layer_index = stage_idx * per_stage + j
+                    return call(
+                        w, h, _fold_key(ctx, tick_key, layer_index), layer_index
+                    ), None
+
+                h, _ = jax.lax.scan(body, x, (stage_params, jnp.arange(per_stage)))
+                return h, None
+
+        if remat:
+            stage_fn = jax.checkpoint(stage_fn, policy=remat_policy)
+
+        def tick(carry, t):
+            state, caches = carry
+            tick_key = jax.random.fold_in(base_key, t)
+            inp = jax.tree.map(
+                lambda xs: jax.lax.dynamic_index_in_dim(
+                    xs, jnp.clip(t, 0, n_work - 1), keepdims=False
+                ),
+                work_items,
+            )
+            # roll-then-overwrite shift — same SPMD-miscompile guard as the
+            # naive path (see the comment there)
+            shifted = jax.tree.map(
+                lambda i, s: jnp.roll(s, 1, axis=0).at[0].set(i), inp, state
+            )
+            shifted = constrain_state(shifted)
+            # the chunk index of the work item at each stage sets where its
+            # K/V land in the cache (garbage fill/drain writes are masked or
+            # overwritten before any valid read)
+            w_at = jnp.clip(t - stage_indices, 0, None)
+            offsets = (w_at % S) * slice_len
+            tick_keys = jax.vmap(lambda s: jax.random.fold_in(tick_key, s))(stage_indices)
+            new_state, new_caches = jax.vmap(stage_fn)(
+                params, caches, shifted, stage_indices, offsets, tick_keys
+            )
+            new_state = constrain_state(new_state)
+            new_caches = constrain_caches(new_caches)
+            out = jax.tree.map(lambda s: s[-1], new_state)
+            return (new_state, new_caches), out
+
+        zero_state = jax.tree.map(
+            lambda xs: jnp.zeros((pp,) + xs.shape[1:], dtype=xs.dtype), work_items
+        )
+        zero_state = constrain_state(zero_state)
+        n_ticks = n_work + pp - 1
+        outs = _scan_ticks(
+            tick, (zero_state, zero_caches), n_ticks, remat, remat_policy,
+            state_shards,
+        )
+        outs = jax.tree.map(lambda o: o[pp - 1 :], outs)
+
+        def join(leaf):
+            rest = leaf.shape[3:]
+            x = leaf.reshape(n_micro, S, leaf.shape[1], slice_len, *rest)
+            x = jnp.moveaxis(x, 1, 2)
+            return x.reshape(n_micro, leaf.shape[1], s_total, *rest)
+
+        return jax.tree.map(join, outs)
+
+
+def _scan_ticks(tick, zero_carry, n_ticks, remat, remat_policy,
+                state_shards) -> Any:
+    """The ONE tick scan behind every pp>1 schedule, with the budgeted
+    sqrt(T)-chunked remat trade.
+
+    A plain scan saves every tick's carry for backward — O(n_ticks)
+    boundary activations, where the reference's 1F1B holds only its pp
+    in-flight micro-batches (pipeline_schedule/train.py:109-117).
+    Checkpointing chunks of ~sqrt(T) ticks stores only chunk-edge
+    carries + one chunk's internal carries during its backward:
+    O(sqrt(T)) memory for one extra body forward. That extra forward is
+    ~+25% step time (b = 2f: (3f+b)/(2f+b)) — real wall-clock, unlike
+    the fill/drain garbage ticks which overlap 1F1B's bubble — so it is
+    paid ONLY when the carries would actually strain HBM (at BASELINE
+    #4's pp=2 gas=8 the carries are ~144 MB/device: the plain scan
+    matches a 1F1B executor's wall-clock there; see PERF.md "Spatial
+    pipeline vs a 1F1B executor").
+
+    The budget gate sees the WHOLE carry (KV caches included under token
+    slicing) and the schedule's true ``n_ticks`` (v x more, thinner
+    ticks under interleaving; S x under token slicing), so chunking
+    engages on real carry volume — not v x too early."""
+    if remat and n_ticks >= 4 and _tick_carries_exceed_budget(
+        zero_carry, n_ticks, state_shards
+    ):
+        chunk, n_chunks = _remat_chunking(n_ticks)
+        padded = n_chunks * chunk  # excess ticks produce discarded outputs
+
+        @partial(jax.checkpoint, policy=remat_policy)
+        def chunk_body(carry, ts):
+            return jax.lax.scan(tick, carry, ts)
+
+        tick_ids = jnp.arange(padded).reshape(n_chunks, chunk)
+        _, outs = jax.lax.scan(chunk_body, zero_carry, tick_ids)
+        return jax.tree.map(
+            lambda o: o.reshape((padded,) + o.shape[2:])[:n_ticks], outs
+        )
+    _, outs = jax.lax.scan(tick, zero_carry, jnp.arange(n_ticks))
+    return outs
 
 
 def _tick_carries_exceed_budget(state: Any, n_ticks: int,

@@ -21,18 +21,25 @@ class Instruction(NamedTuple):
     name: str
     micro_batch_id: Optional[int] = None
     buffer_id: Optional[int] = None
+    # explicit comm peer (interleaved wrap: stage pp-1 sends to stage 0);
+    # None keeps the classic linear neighbour convention
+    peer: Optional[int] = None
+    # disambiguates repeated crossings of the same (kind, micro_batch)
+    # between the same rank pair (a chunk/round id); None for the classic
+    # schedules, whose crossings are unique
+    tag: Optional[int] = None
 
 
 def InstructionLoadMicroBatch(micro_batch_id, buffer_id):
     return Instruction("load_micro_batch", micro_batch_id, buffer_id)
 
 
-def InstructionRecvActivation(micro_batch_id, buffer_id):
-    return Instruction("recv_activation", micro_batch_id, buffer_id)
+def InstructionRecvActivation(micro_batch_id, buffer_id, peer=None, tag=None):
+    return Instruction("recv_activation", micro_batch_id, buffer_id, peer, tag)
 
 
-def InstructionSendActivation(micro_batch_id, buffer_id):
-    return Instruction("send_activation", micro_batch_id, buffer_id)
+def InstructionSendActivation(micro_batch_id, buffer_id, peer=None, tag=None):
+    return Instruction("send_activation", micro_batch_id, buffer_id, peer, tag)
 
 
 def InstructionForwardPass(micro_batch_id, buffer_id):
@@ -47,12 +54,12 @@ def InstructionBackwardPass(micro_batch_id, buffer_id):
     return Instruction("backward_pass", micro_batch_id, buffer_id)
 
 
-def InstructionSendGrad(micro_batch_id, buffer_id):
-    return Instruction("send_grad", micro_batch_id, buffer_id)
+def InstructionSendGrad(micro_batch_id, buffer_id, peer=None, tag=None):
+    return Instruction("send_grad", micro_batch_id, buffer_id, peer, tag)
 
 
-def InstructionRecvGrad(micro_batch_id, buffer_id):
-    return Instruction("recv_grad", micro_batch_id, buffer_id)
+def InstructionRecvGrad(micro_batch_id, buffer_id, peer=None, tag=None):
+    return Instruction("recv_grad", micro_batch_id, buffer_id, peer, tag)
 
 
 def InstructionReduceTiedGrads():
@@ -86,6 +93,13 @@ class PipelineScheduleBase:
 
     def buffer_for(self, micro_batch_id: int) -> int:
         return micro_batch_id % self.num_buffers
+
+    def duration_scale(self, name: str) -> float:
+        """Per-instruction duration multiplier: schedules whose work items
+        are fractions of a micro-batch (virtual-stage chunks, token
+        slices) scale their compute (and, where the payload shrinks,
+        comm) below the profile's full-micro-batch durations."""
+        return 1.0
 
     def instructions(self) -> List[Instruction]:
         raise NotImplementedError
@@ -171,6 +185,142 @@ class PipelineScheduleInference(PipelineScheduleBase):
         return instructions
 
 
+def _interleaved_work_items(gas: int, pp: int, virtual_size: int):
+    """Injection order of the spatial interleaved executor
+    (pipeline.py): micro-batches in groups of pp, each group cycling
+    ``virtual_size`` rounds through the stage ring before the next group
+    starts."""
+    items = []
+    for g0 in range(0, gas, pp):
+        group = range(g0, min(g0 + pp, gas))
+        for rnd in range(virtual_size):
+            for m in group:
+                items.append((m, rnd))
+    return items
+
+
+@dataclass
+class PipelineScheduleInterleaved(PipelineScheduleBase):
+    """Spatial interleaved virtual stages (Megatron-LM, arxiv
+    2104.04473; executor: pipeline.py ``PipelinedBody._interleaved``).
+
+    Each rank runs one ``1/virtual_size``-thick layer chunk per work
+    item; stage pp-1's output wraps back to stage 0 between rounds (the
+    explicit ``peer`` on the comm instructions). Forward streams all
+    work items, backward mirrors them in reverse — ``jax.grad`` through
+    the tick scan, not 1F1B. At ``virtual_size=1`` this degenerates to
+    the naive spatial fill-drain schedule and is the bubble baseline the
+    interleaved/token-slice variants are judged against."""
+
+    virtual_size: int = 2
+
+    def duration_scale(self, name: str) -> float:
+        # chunks carry the full micro-batch's activations (comm unscaled,
+        # and v x more of it) but 1/v of its layers (compute scaled)
+        if name in ("forward_pass", "backward_pass"):
+            return 1.0 / self.virtual_size
+        return 1.0
+
+    def instructions(self) -> List[Instruction]:
+        pp = self.pipe_parallel_size
+        r = self.pipe_parallel_rank
+        gas = self.gradient_accumulation_steps
+        v = self.virtual_size
+        items = _interleaved_work_items(gas, pp, v)
+        ins: List[Instruction] = []
+        # each forward edge is tagged by its receiving chunk id
+        # (rnd*pp + rank), unique per crossing — at pp=2 the linear hop and
+        # the wrap cross the SAME rank pair, and an untagged match would
+        # pair a send with the wrong round's recv
+        for m, rnd in items:
+            buf = self.buffer_for(m)
+            chunk = rnd * pp + r
+            if r == 0 and rnd == 0:
+                ins.append(InstructionLoadMicroBatch(m, buf))
+            else:
+                ins.append(InstructionRecvActivation(
+                    m, buf, peer=(pp - 1 if r == 0 else r - 1), tag=chunk))
+            ins.append(InstructionForwardPass(m, buf))
+            if r == pp - 1 and rnd == v - 1:
+                ins.append(InstructionLoss(m, buf))
+            else:
+                ins.append(InstructionSendActivation(
+                    m, buf, peer=(0 if r == pp - 1 else r + 1), tag=chunk + 1))
+        for m, rnd in reversed(items):
+            buf = self.buffer_for(m)
+            chunk = rnd * pp + r
+            if not (r == pp - 1 and rnd == v - 1):
+                ins.append(InstructionRecvGrad(
+                    m, buf, peer=(0 if r == pp - 1 else r + 1), tag=chunk + 1))
+            ins.append(InstructionBackwardPass(m, buf))
+            if not (r == 0 and rnd == 0):
+                ins.append(InstructionSendGrad(
+                    m, buf, peer=(pp - 1 if r == 0 else r - 1), tag=chunk))
+        ins.append(InstructionReduceTiedGrads())
+        ins.append(InstructionOptimizerStep())
+        return ins
+
+
+@dataclass
+class PipelineScheduleFillDrain(PipelineScheduleInterleaved):
+    """Naive spatial fill-drain (GPipe): the ``virtual_size=1``
+    degenerate of the interleaved schedule — the baseline the simulator
+    compares bubble fractions against."""
+
+    virtual_size: int = 1
+
+
+@dataclass
+class PipelineScheduleTokenSlice(PipelineScheduleBase):
+    """TeraPipe token slicing (arxiv 2102.07988; executor:
+    ``PipelinedBody._token_sliced``): each micro-batch splits into
+    ``token_slices`` causal sequence chunks pipelined as independent
+    work items (m-major order keeps a micro-batch's chunks causal at
+    every stage). First-order cost model: compute AND comm scale 1/S
+    (the payload is 1/S of the sequence; the attention prefix term is
+    folded into the same scale)."""
+
+    token_slices: int = 2
+
+    _SCALED = (
+        "forward_pass", "backward_pass", "loss", "load_micro_batch",
+        "store_micro_batch", "send_activation", "recv_activation",
+        "send_grad", "recv_grad",
+    )
+
+    def duration_scale(self, name: str) -> float:
+        return 1.0 / self.token_slices if name in self._SCALED else 1.0
+
+    def instructions(self) -> List[Instruction]:
+        pp = self.pipe_parallel_size
+        r = self.pipe_parallel_rank
+        gas = self.gradient_accumulation_steps
+        S = self.token_slices
+        items = [(m, k) for m in range(gas) for k in range(S)]
+        ins: List[Instruction] = []
+        for m, k in items:
+            buf = self.buffer_for(m)
+            if r == 0:
+                ins.append(InstructionLoadMicroBatch(m, buf))
+            else:
+                ins.append(InstructionRecvActivation(m, buf, peer=r - 1, tag=k))
+            ins.append(InstructionForwardPass(m, buf))
+            if r == pp - 1:
+                ins.append(InstructionLoss(m, buf))
+            else:
+                ins.append(InstructionSendActivation(m, buf, peer=r + 1, tag=k))
+        for m, k in reversed(items):
+            buf = self.buffer_for(m)
+            if r != pp - 1:
+                ins.append(InstructionRecvGrad(m, buf, peer=r + 1, tag=k))
+            ins.append(InstructionBackwardPass(m, buf))
+            if r != 0:
+                ins.append(InstructionSendGrad(m, buf, peer=r - 1, tag=k))
+        ins.append(InstructionReduceTiedGrads())
+        ins.append(InstructionOptimizerStep())
+        return ins
+
+
 # ----------------------------------------------------------------- simulator
 @dataclass
 class SimulationEngine:
@@ -206,19 +356,22 @@ class SimulationEngine:
 
     def simulate(self, schedule_cls=PipelineScheduleTrain) -> dict:
         pp = self.pipe_parallel_size
-        schedules = [
+        scheds = [
             schedule_cls(
                 pipe_parallel_size=pp,
                 pipe_parallel_rank=r,
                 gradient_accumulation_steps=self.gradient_accumulation_steps,
-            ).instructions()
+            )
             for r in range(pp)
         ]
+        schedules = [s.instructions() for s in scheds]
         cursors = [0] * pp
         times = [0.0] * pp
         busy = [0.0] * pp
         timeline: List[dict] = []
-        # comm matching: sends/recvs of (kind, mb) pair between neighbours
+        # comm matching: sends/recvs of (kind, mb[, tag]) pair between
+        # peers — the tag separates repeated crossings of the same pair
+        # (interleaved rounds at pp=2 wrap over the same two ranks)
         pending: Dict[tuple, float] = {}
 
         def comm_peer(name: str, rank: int) -> Optional[int]:
@@ -228,16 +381,22 @@ class SimulationEngine:
                 return rank - 1
             return None
 
+        def dur(rank: int, name: str) -> float:
+            return self.duration(name) * scheds[rank].duration_scale(name)
+
         progressed = True
         while progressed:
             progressed = False
             for r in range(pp):
                 while cursors[r] < len(schedules[r]):
                     ins = schedules[r][cursors[r]]
-                    peer = comm_peer(ins.name, r)
+                    peer = (
+                        ins.peer if ins.peer is not None
+                        else comm_peer(ins.name, r)
+                    )
                     if peer is None:
                         start = times[r]
-                        end = start + self.duration(ins.name)
+                        end = start + dur(r, ins.name)
                         timeline.append(
                             {"rank": r, "name": ins.name, "micro_batch": ins.micro_batch_id,
                              "start": start, "end": end}
@@ -250,12 +409,12 @@ class SimulationEngine:
                     mb = ins.micro_batch_id
                     kind = "act" if "activation" in ins.name else "grad"
                     lo, hi = min(r, peer), max(r, peer)
-                    key = (kind, mb, lo, hi)
+                    key = (kind, mb, ins.tag, lo, hi)
                     if ins.name.startswith("send"):
                         # sends are async: post completion time and continue
-                        end = times[r] + self.duration(ins.name)
+                        end = times[r] + dur(r, ins.name)
                         pending[key] = end
-                        busy[r] += self.duration(ins.name)
+                        busy[r] += dur(r, ins.name)
                         timeline.append(
                             {"rank": r, "name": ins.name, "micro_batch": mb,
                              "start": times[r], "end": end}
@@ -270,8 +429,8 @@ class SimulationEngine:
                     if key in pending:
                         data_ready = pending.pop(key)
                         start = max(times[r], data_ready)
-                        end = start + self.duration(ins.name)
-                        busy[r] += self.duration(ins.name)
+                        end = start + dur(r, ins.name)
+                        busy[r] += dur(r, ins.name)
                         times[r] = end
                         timeline.append(
                             {"rank": r, "name": ins.name, "micro_batch": mb,
@@ -293,28 +452,39 @@ class SimulationEngine:
 
 
 def durations_from_profile(
-    observations: list,
+    observations: Optional[list],
     gradient_accumulation_steps: int,
+    run_dir=None,
 ) -> Dict[str, float]:
-    """Calibrate simulator instruction durations from the trainer's
-    recorded profile (``profiler_output`` JSON: one ``step_time`` per
-    step, the whole fused program).
+    """Calibrate simulator instruction durations from a real measurement.
 
-    The fused XLA step has no per-instruction timers — the instructions
-    don't exist at runtime — so the measured step time is split across
-    the schedule's compute instructions at the simulator's own 1:2
-    forward:backward ratio, one (forward + loss + backward) triple per
-    micro-batch. Communication instructions keep their defaults (they are
-    overlapped collective-permutes here). The result feeds
-    ``SimulationEngine``/``illustrate`` to ask layout questions — "what
-    does idle % look like at twice the micro-batches?" — anchored to a
-    real measurement (reference: profile JSON -> SimulationEngine,
-    pipeline_schedule/base.py:568-595)."""
-    steps = [o["step_time"] for o in observations if "step_time" in o]
+    Preferred source (``run_dir``): an obs run directory whose
+    ``step.fwdbwd`` / ``step.sync`` span records bound the fused step's
+    actual device-compute window (dispatch + drain — excludes data
+    loading, logging and eval, which the old ``step_time / 3.2`` fudge
+    silently smeared into compute), and whose ``step.data`` spans
+    calibrate ``load_micro_batch`` directly. The fused XLA program still
+    has no internal fwd/bwd boundary, so the forward:backward split keeps
+    the simulator's 1:2 prior over the measured compute — that prior is
+    the documented fallback, the TOTAL and the data-load cost are
+    measured. When the run dir has no usable spans, or ``run_dir`` is
+    None, the legacy path splits the profile's mean ``step_time`` with
+    the 3.2 fudge factor as before.
+
+    The result feeds ``SimulationEngine``/``illustrate`` to ask layout
+    questions — "what does idle % look like at twice the micro-batches?"
+    — anchored to a real measurement (reference: profile JSON ->
+    SimulationEngine, pipeline_schedule/base.py:568-595)."""
+    gas = gradient_accumulation_steps
+    if run_dir is not None:
+        calibrated = _durations_from_run_dir(run_dir, gas)
+        if calibrated is not None:
+            return calibrated
+    steps = [o["step_time"] for o in (observations or []) if "step_time" in o]
     if not steps:
         raise ValueError("profile has no step_time observations")
     mean_step = sum(steps) / len(steps)
-    unit = mean_step / (gradient_accumulation_steps * 3.2)
+    unit = mean_step / (gas * 3.2)
     return {
         "forward_pass": unit,
         "backward_pass": 2.0 * unit,
@@ -324,6 +494,58 @@ def durations_from_profile(
         # computed unit so the ABSOLUTE defaults (tuned for the default
         # 1.0/2.0 compute times) can't swamp a calibrated fast step
         "load_micro_batch": 0.05 * unit,
+        "store_micro_batch": 0.05 * unit,
+        "recv_activation": 0.05 * unit,
+        "send_activation": 0.05 * unit,
+        "send_grad": 0.05 * unit,
+        "recv_grad": 0.05 * unit,
+    }
+
+
+def _durations_from_run_dir(run_dir, gas: int) -> Optional[Dict[str, float]]:
+    """Span-calibrated instruction durations, or None when the run dir has
+    no ``step.fwdbwd`` spans to calibrate from. Aggregation (incl. the
+    compile-step drop) is shared with the obs report's pipeline section
+    via ``step_span_sums``."""
+    from ..obs.report import (  # stdlib-only
+        load_run_dir,
+        step_compute_samples,
+        step_span_sums,
+    )
+
+    data = load_run_dir(run_dir)
+    by_host = step_span_sums(
+        data.spans, ("step.fwdbwd", "step.sync", "step.data")
+    )
+    recs = [
+        rec
+        for steps in by_host.values()
+        for rec in steps.values()
+        if "step.fwdbwd" in rec
+    ]
+    if not recs:
+        return None
+    # per-host amortized compute (log_interval > 1 leaves most steps with
+    # a dispatch-only fwdbwd record; the sync drains the backlog — the
+    # shared amortization handles both regimes). Aggregated over the
+    # compute spans alone so a data-only record can't dilute the mean.
+    compute = sorted(step_compute_samples(
+        step_span_sums(data.spans, ("step.fwdbwd", "step.sync"))
+    ))
+    compute_p50 = compute[len(compute) // 2]
+    # fwd(1) + bwd(2) per micro-batch over the MEASURED compute window —
+    # loss/optimizer ride the same window, folded in as small multiples
+    unit = compute_p50 / (gas * 3.0)
+    datas = sorted(r["step.data"] for r in recs if "step.data" in r)
+    load = (
+        datas[len(datas) // 2] / gas if datas else 0.05 * unit
+    )
+    return {
+        "forward_pass": unit,
+        "backward_pass": 2.0 * unit,
+        "loss": 0.1 * unit,
+        "optimizer_step": 0.1 * unit,
+        "load_micro_batch": load,
         "store_micro_batch": 0.05 * unit,
         "recv_activation": 0.05 * unit,
         "send_activation": 0.05 * unit,
@@ -363,6 +585,15 @@ def illustrate(
     lines = [f"rank {r}: |{''.join(row)}|" for r, row in enumerate(rows)]
     idle = ", ".join(f"{i:.0%}" for i in result["idle_fraction"])
     lines.append(f"total {result['total_time']:.2f}s  idle per rank: {idle}")
+    if result["deadlocked"]:
+        # a partial Gantt with no warning reads as a (great-looking)
+        # schedule; make the failure impossible to miss
+        banner = (
+            "!! DEADLOCK: schedule never completed — unmatched sends/recvs; "
+            "the timeline above is PARTIAL and its idle numbers meaningless"
+        )
+        lines.insert(0, banner)
+        lines.append(banner)
     return "\n".join(lines)
 
 
@@ -389,6 +620,12 @@ def visualize(
         durations=durations or {},
     )
     result = sim.simulate(schedule_cls)
+    if result["deadlocked"]:
+        raise RuntimeError(
+            "schedule deadlocked (unmatched sends/recvs — simulate() "
+            "reports deadlocked=true); refusing to render a partial, "
+            "misleading Gantt timeline"
+        )
 
     colors = {
         "forward_pass": "#4878cf",

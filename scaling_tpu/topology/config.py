@@ -87,6 +87,35 @@ class TopologyConfig(BaseConfig):
         gt=0,
     )
 
+    pipe_virtual_size: int = Field(
+        1,
+        description="interleaved virtual pipeline stages per physical stage "
+        "(Megatron-LM, arxiv 2104.04473): the layer stack is split into "
+        "pipe_parallel_size * pipe_virtual_size chunks assigned round-robin "
+        "over the stages, and micro-batches circulate v times through the "
+        "stage ring. Fill/drain shrinks from (pp-1) full-stage ticks to "
+        "(pp-1) thin virtual-stage ticks (~v x less bubble) at the cost of "
+        "v x more stage-shift collective-permutes. Requires "
+        "pipe_parallel_size > 1, num_layers divisible by pp * v, and "
+        "gradient_accumulation_steps divisible by pp (micro-batches are "
+        "injected in full groups of pp).",
+        gt=0,
+    )
+
+    pipe_token_slices: int = Field(
+        1,
+        description="TeraPipe-style token slicing (arxiv 2102.07988): each "
+        "micro-batch's sequence is split into this many causal chunks and "
+        "the chunks are pipelined through the stages, for the "
+        "long-sequence / low-gradient-accumulation regime where micro-batch "
+        "parallelism alone cannot fill the pipeline. Exact math: attention "
+        "runs against a per-stage KV cache of the earlier chunks "
+        "(segment-aware, so packed-document masking is preserved). Requires "
+        "pipe_parallel_size > 1 and sequence_length divisible by the slice "
+        "count; mutually exclusive with pipe_virtual_size > 1.",
+        gt=0,
+    )
+
     pipe_partition_method: PipePartitionMethod = Field(
         PipePartitionMethod.UNIFORM,
         description="Method to assign layers to pipeline stages",
@@ -166,6 +195,31 @@ class TopologyConfig(BaseConfig):
                 f"global_batch_size {gbs} does not equal the product of "
                 f"micro_batch_size ({mbs}) and gradient_accumulation_steps ({gas}) "
                 f"and data_parallel_size ({dp})."
+            )
+
+        vpp = values.get("pipe_virtual_size") or 1
+        slices = values.get("pipe_token_slices") or 1
+        if vpp > 1 and pp < 2:
+            raise AssertionError(
+                "pipe_virtual_size > 1 requires pipe_parallel_size > 1 "
+                "(virtual stages interleave over the physical stage ring)"
+            )
+        if slices > 1 and pp < 2:
+            raise AssertionError(
+                "pipe_token_slices > 1 requires pipe_parallel_size > 1 "
+                "(token slices pipeline through the physical stages)"
+            )
+        if vpp > 1 and slices > 1:
+            raise AssertionError(
+                "pipe_virtual_size and pipe_token_slices are mutually "
+                "exclusive (the executor interleaves micro-batches OR "
+                "token slices, not both)"
+            )
+        if vpp > 1 and gas % pp != 0:
+            raise AssertionError(
+                f"interleaved virtual stages need gradient_accumulation_steps "
+                f"({gas}) divisible by pipe_parallel_size ({pp}): micro-"
+                f"batches are injected in full groups of pp"
             )
 
         values.update(

@@ -74,6 +74,14 @@ class Topology:
         return self.config.context_parallel_size
 
     @property
+    def pipe_virtual_size(self) -> int:
+        return self.config.pipe_virtual_size
+
+    @property
+    def pipe_token_slices(self) -> int:
+        return self.config.pipe_token_slices
+
+    @property
     def context_parallel_variant(self) -> str:
         return self.config.context_parallel_variant.value
 

@@ -826,6 +826,18 @@ class BaseTrainer:
     # ----------------------------------------------------------- train loop
     def run_training(self, log_metrics_fn: Optional[Callable] = None) -> None:
         assert self.config.train_iterations is not None
+        topo = self.topology
+        if topo is not None and topo.pipe_parallel_size > 1:
+            # the obs report's pipeline section needs the schedule shape to
+            # attribute span-measured step time against the predicted
+            # bubble (docs/PIPELINE.md); one lifecycle event carries it
+            logger.log_event(
+                "pipeline-config",
+                pp=topo.pipe_parallel_size,
+                virtual=topo.pipe_virtual_size,
+                token_slices=topo.pipe_token_slices,
+                gas=topo.gradient_accumulation_steps,
+            )
         watchdog = None
         if self.config.step_timeout_seconds is not None:
             # created here, ARMED by the loop after the first step

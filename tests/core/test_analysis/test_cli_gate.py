@@ -51,10 +51,11 @@ def test_lint_gate_seeded_violations_exit_nonzero(tmp_path):
 def test_audit_gate_matches_golden(tmp_path):
     """The enforced baseline: today's clean tree reproduces the committed
     goldens (collective inventory, precision audit, recompile keys) for
-    the single-device AND the pp=2/mp=2 mesh train steps."""
+    the single-device, the pp=2/mp=2 mesh, and the interleaved
+    virtual-stage train steps."""
     out = tmp_path / "audit.json"
     p = run_cli(
-        "audit", "--sections", "train_single,train_pp2_mp2",
+        "audit", "--sections", "train_single,train_pp2_mp2,train_pp2_vpp2",
         "--json", str(out),
     )
     assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-2000:]
@@ -67,6 +68,17 @@ def test_audit_gate_matches_golden(tmp_path):
     axes = {r["axis"] for r in pp2["collectives"]}
     # the layout's signature collectives, attributed to their mesh axes
     assert "model" in axes and any("pipe" in a for a in axes), axes
+
+    # the interleaved step's stage shift still lowers to pipe-axis
+    # collective-permutes (the circular roll did not silently degrade to
+    # an all-gather); the v x per-STEP multiplicity lives in the tick
+    # scan's trip count, so the static op count pins the program shape
+    # and the golden pins its drift
+    vpp2 = payload["audit"]["sections"]["train_pp2_vpp2"]
+    assert any(
+        r["op"] == "collective-permute" and r["axis"] == "pipe"
+        for r in vpp2["collectives"]
+    ), vpp2["collectives"]
 
 
 def test_audit_gate_detects_seeded_drift(tmp_path):
@@ -99,7 +111,8 @@ def test_full_cli_all_clean(tmp_path):
     payload = json.loads(out.read_text())
     assert payload["exit_code"] == 0
     assert set(payload["audit"]["sections"]) == {
-        "train_single", "train_pp2_mp2", "decode_fused"
+        "train_single", "train_pp2_mp2", "train_pp2_vpp2",
+        "train_pp2_tokenslice", "decode_fused"
     }
     pp2 = payload["audit"]["sections"]["train_pp2_mp2"]
     axes = {(r["op"], r["axis"]) for r in pp2["collectives"]}

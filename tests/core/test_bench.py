@@ -85,6 +85,20 @@ def test_bench_emits_stale_line_when_backend_unreachable():
     assert "unreachable" in rec["stale_reason"]
     # the payload carries the committed LAST_GOOD capture, not zeros
     assert rec["value"] > 0 and rec["stale_captured"]
+    # ROADMAP "bench capture health": the dead round also leaves a
+    # structured artifact — {"stale": true, "last_good": ...} pointing at
+    # the obs --assert-mfu gate — so downstream tooling never greps an
+    # rc-0 log tail to learn the capture was stale
+    stale_path = REPO_ROOT / "benchmarks" / "artifacts" / "STALE.json"
+    try:
+        art = json.loads(stale_path.read_text())
+        assert art["stale"] is True
+        assert "unreachable" in art["stale_reason"]
+        assert art["emitted"]["value"] == rec["value"]
+        assert art["last_good"]["result"]["value"] > 0
+        assert "--assert-mfu" in art["fallback_judge"]
+    finally:
+        stale_path.unlink(missing_ok=True)
 
 
 def test_bench_sigterm_flushes_stale_line():

@@ -190,3 +190,61 @@ def test_obs_package_imports_without_jax():
         timeout=60,
     )
     assert proc.returncode == 0, "scaling_tpu.obs pulled in jax at import time"
+
+
+def _pipeline_run_dir(tmp_path, virtual=1, token_slices=1, steps=4,
+                      fwdbwd=0.01, sync=0.99):
+    lines = [json.dumps({"event": "pipeline-config", "ts": 0.0, "pp": 2,
+                         "virtual": virtual, "token_slices": token_slices,
+                         "gas": 8})]
+    for s in range(10, 10 + steps):
+        # first step is the compile outlier the section must drop
+        scale = 30.0 if s == 10 else 1.0
+        lines.append(json.dumps({"event": "span", "span": "step.fwdbwd",
+                                 "step": s, "dur_s": fwdbwd * scale,
+                                 "ts": float(s)}))
+        lines.append(json.dumps({"event": "span", "span": "step.sync",
+                                 "step": s, "dur_s": sync * scale,
+                                 "ts": float(s) + 0.5}))
+    (tmp_path / "events.jsonl").write_text("\n".join(lines) + "\n")
+    return tmp_path
+
+
+def test_pipeline_section_attributes_measured_idle(tmp_path):
+    """Deterministic spans -> exact attribution: interleaved pp=2 v=2
+    gas=8 is 16 work / 17 total ticks (5.9% bubble vs fill-drain's
+    11.1%), and the measured p50 (1.0s, compile step dropped) attributes
+    0.059s/step of fill/drain idle."""
+    from scaling_tpu.obs.report import load_run_dir, pipeline_section
+
+    data = load_run_dir(_pipeline_run_dir(tmp_path, virtual=2))
+    lines = pipeline_section(data)
+    text = "\n".join(lines)
+    assert "schedule: interleaved(v=2) pp=2 gas=8 (16 work ticks / 17 total" in text
+    assert "predicted bubble: 5.9% (fill-drain on this shape: 11.1%)" in text
+    assert "fwdbwd+sync amortized over 3 steps): 1.000s" in text
+    assert "idle 0.059s/step (5.9% of compute)" in text
+
+
+def test_pipeline_section_token_slice_and_fill_drain(tmp_path):
+    from scaling_tpu.obs.report import load_run_dir, pipeline_section
+
+    d1 = tmp_path / "ts"; d1.mkdir()
+    text = "\n".join(pipeline_section(load_run_dir(
+        _pipeline_run_dir(d1, token_slices=4))))
+    assert "token-slice(S=4)" in text and "predicted bubble: 3.0%" in text
+    d2 = tmp_path / "fd"; d2.mkdir()
+    text = "\n".join(pipeline_section(load_run_dir(_pipeline_run_dir(d2))))
+    assert "fill-drain" in text and "predicted bubble: 11.1%" in text
+
+
+def test_pipeline_section_absent_without_config_event(tmp_path):
+    """Non-pipelined run dirs keep their exact report layout — the
+    committed golden reports must not grow an empty pipeline section."""
+    from scaling_tpu.obs.report import load_run_dir, pipeline_section
+
+    (tmp_path / "events.jsonl").write_text(
+        json.dumps({"event": "span", "span": "step.fwdbwd", "step": 1,
+                    "dur_s": 0.5, "ts": 1.0}) + "\n")
+    assert pipeline_section(load_run_dir(tmp_path)) == []
+    assert "== pipeline ==" not in render_report(load_run_dir(tmp_path))

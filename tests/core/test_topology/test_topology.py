@@ -129,3 +129,36 @@ def test_context_parallel_excludes_pipeline():
             micro_batch_size=1,
             gradient_accumulation_steps=1,
         )
+
+
+def test_pipe_virtual_size_validation():
+    """Interleaved virtual stages: v>1 needs pp>1 and gas % pp == 0 (full
+    injection groups); token slicing needs pp>1; the two modes are
+    mutually exclusive in the executor."""
+    def cfg(**kw):
+        base = dict(model_parallel_size=1, pipe_parallel_size=2,
+                    data_parallel_size=1, micro_batch_size=1,
+                    gradient_accumulation_steps=4)
+        base.update(kw)
+        return TopologyConfig(**base)
+
+    assert cfg(pipe_virtual_size=2).pipe_virtual_size == 2
+    assert cfg(pipe_token_slices=4).pipe_token_slices == 4
+    with pytest.raises(Exception, match="pipe_virtual_size > 1 requires"):
+        cfg(pipe_parallel_size=1, pipe_virtual_size=2)
+    with pytest.raises(Exception, match="pipe_token_slices > 1 requires"):
+        cfg(pipe_parallel_size=1, pipe_token_slices=2)
+    with pytest.raises(Exception, match="mutually"):
+        cfg(pipe_virtual_size=2, pipe_token_slices=2)
+    with pytest.raises(Exception, match="divisible by pipe_parallel_size"):
+        cfg(pipe_virtual_size=2, gradient_accumulation_steps=3)
+
+
+def test_topology_exposes_pipe_schedule_knobs(devices):
+    topo = Topology(TopologyConfig(
+        model_parallel_size=1, pipe_parallel_size=2, data_parallel_size=1,
+        micro_batch_size=1, gradient_accumulation_steps=4,
+        pipe_virtual_size=2,
+    ))
+    assert topo.pipe_virtual_size == 2
+    assert topo.pipe_token_slices == 1

@@ -24,11 +24,14 @@ def data_prefix(tmp_path_factory):
     return prefix
 
 
-def make_pp_config(tmp_path, data_prefix, pp=2, mp=1, dp=1, gas=4, **kwargs):
+def make_pp_config(tmp_path, data_prefix, pp=2, mp=1, dp=1, gas=4, vpp=1,
+                   token_slices=1, **kwargs):
     config = make_config(tmp_path, data_prefix, mp=mp, dp=dp, gas=gas, **kwargs)
     d = config.model_dump(mode="json")
     d["topology"]["pipe_parallel_size"] = pp
     d["topology"]["world_size"] = pp * mp * dp
+    d["topology"]["pipe_virtual_size"] = vpp
+    d["topology"]["pipe_token_slices"] = token_slices
     type_ = type(config)
     return type_.from_dict(d)
 
@@ -141,6 +144,169 @@ def _meta_leaves(metas):
     from scaling_tpu.nn.param import ParamMeta
 
     return jax.tree.leaves(metas, is_leaf=lambda x: isinstance(x, ParamMeta))
+
+
+@pytest.mark.parametrize("vpp,num_layers", [(2, 4), pytest.param(4, 8, marks=pytest.mark.slow)])
+def test_interleaved_loss_close_to_pp1(tmp_path, data_prefix, vpp, num_layers):
+    """Interleaved virtual stages vs the pp=1 golden under the same
+    checkpoint-transfer + rng/dropout decorrelation contract as the
+    fill-drain parity test above: same instruction stream per layer, only
+    the chunk circulation reassociates a handful of reductions, so rtol
+    1e-5 holds while any schedule bug (wrong chunk at a round, a wrap
+    mis-phase, garbage injected over a live slot) lands at >=1e-2 on
+    step 1."""
+    cfg0 = make_config(tmp_path / "seed", data_prefix, gas=4,
+                       train_iterations=1, save_interval=100,
+                       num_layers=num_layers)
+    t0 = build_capturing_trainer(cfg0)
+    t0.save_checkpoint()
+
+    losses = {}
+    for arm, kw in (("pp1", {}), ("vpp", {"pp": 2, "vpp": vpp})):
+        cfg = make_pp_config(tmp_path / arm, data_prefix, gas=4,
+                             train_iterations=5, save_interval=100,
+                             num_layers=num_layers,
+                             load_dir=Path(cfg0.trainer.save_dir),
+                             **({"pp": 1} if arm == "pp1" else kw))
+        t = build_capturing_trainer(cfg, load=True)
+        losses[arm] = train_capture(t, 5)
+
+    np.testing.assert_allclose(
+        np.asarray(losses["pp1"], np.float32),
+        np.asarray(losses["vpp"], np.float32),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_token_slice_loss_close_to_pp1(tmp_path, data_prefix):
+    """TeraPipe token slicing vs the pp=1 golden, on REAL packed-document
+    data: each stage's attention runs against the per-stage KV cache with
+    the cached slots' segment ids, so a slice must see exactly the causal
+    prefix of its own documents — a cache offset bug, a missing segment
+    mask (cross-document attention), or rotary positions drifting per
+    slice all break the 1e-5 parity immediately."""
+    cfg0 = make_config(tmp_path / "seed", data_prefix, gas=4,
+                       train_iterations=1, save_interval=100)
+    t0 = build_capturing_trainer(cfg0)
+    t0.save_checkpoint()
+
+    losses = {}
+    for arm, kw in (("pp1", {"pp": 1}), ("slice", {"pp": 2, "token_slices": 2})):
+        cfg = make_pp_config(tmp_path / arm, data_prefix, gas=4,
+                             train_iterations=5, save_interval=100,
+                             load_dir=Path(cfg0.trainer.save_dir), **kw)
+        t = build_capturing_trainer(cfg, load=True)
+        losses[arm] = train_capture(t, 5)
+
+    np.testing.assert_allclose(
+        np.asarray(losses["pp1"], np.float32),
+        np.asarray(losses["slice"], np.float32),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_interleaved_checkpoint_interchanges_with_other_layouts(
+    tmp_path, data_prefix
+):
+    """A checkpoint written under the interleaved (pp, v, lpv) stacking
+    unstacks into the same per-layer files as any other layout: the
+    round-robin chunk order must be inverted exactly, or layer j's
+    weights land in layer k's file."""
+    cfg = make_pp_config(tmp_path, data_prefix, pp=2, vpp=2, gas=4,
+                         train_iterations=3, save_interval=3, num_layers=4)
+    t = build_capturing_trainer(cfg)
+    train_capture(t, 3)
+
+    cfg_load = make_pp_config(
+        tmp_path / "reload", data_prefix, pp=1, gas=4,
+        train_iterations=6, save_interval=100, num_layers=4,
+        load_dir=Path(cfg.trainer.save_dir),
+    )
+    t2 = build_capturing_trainer(cfg_load, load=True)
+    assert t2.context.iterations == 3
+    view_saved = t.module.ckpt_view(t.params)
+    view_loaded = t2.module.ckpt_view(t2.params)
+    for (ka, a), (kb, b) in zip(
+        sorted(view_saved.items()), sorted(view_loaded.items())
+    ):
+        assert ka == kb
+        for la, lb in zip(_leaves(a), _leaves(b)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb),
+                                          err_msg=ka)
+    out = t2.train_step()
+    assert np.isfinite(float(out.loss))
+
+
+def test_interleaved_flops_shrink_fill_drain_garbage():
+    """The bubble shrink, measured on compiled HLO FLOPs at fixed global
+    batch (remat off): fill-drain runs (gas + pp - 1)/gas of the body's
+    useful FLOPs, interleaved (gas*v + pp - 1)/(gas*v) — strictly less
+    garbage. Measured at seq=512 (a realistic tokens-per-micro-batch),
+    where the schedule's only counted overhead — the per-tick
+    dynamic-index chunk select whose backward is a param-sized
+    scatter-add — is O(v/tokens) noise; at the 48-token toy dataset
+    shape it would swamp the ~1% diluted garbage win."""
+    from scaling_tpu.analysis.hlo_audit import lower_train_step, make_train_config
+
+    flops = {}
+    for label, vpp in (("naive", 1), ("vpp2", 2)):
+        cfg = make_train_config(pp=2, gas=8, vpp=vpp, layers=4, hidden=64,
+                                seq=512, vocab=128)
+        lowered, _, _ = lower_train_step(cfg)
+        analysis = lowered.compile().cost_analysis()
+        analysis = analysis[0] if isinstance(analysis, list) else analysis
+        flops[label] = float(analysis["flops"])
+    assert flops["vpp2"] < flops["naive"], flops
+
+
+def test_pipeline_obs_report_measures_interleaved_bubble(
+    tmp_path, data_prefix, monkeypatch
+):
+    """The ISSUE 7 acceptance: simulated AND obs-span-measured bubble for
+    interleaved (pp=2, v=2, gas=8) strictly below fill-drain's on the
+    same shape. Two real runs on the virtual mesh write span telemetry;
+    the analyzer's pipeline section must (a) appear with the right
+    schedule label, (b) predict the smaller bubble, and (c) attribute
+    strictly less measured idle — both the fraction (1/17 vs 1/9 of a
+    pass) and the idle seconds derived from each run's own measured
+    fwdbwd+sync spans."""
+    from scaling_tpu.obs.report import load_run_dir, pipeline_section, render_report
+
+    measured = {}
+    for label, vpp in (("naive", 1), ("vpp2", 2)):
+        run_dir = tmp_path / f"run_{label}"
+        run_dir.mkdir(parents=True)
+        monkeypatch.setenv("SCALING_TPU_EVENTS_PATH",
+                           str(run_dir / "events.jsonl"))
+        monkeypatch.setenv("SCALING_TPU_METRICS_PATH",
+                           str(run_dir / "metrics.jsonl"))
+        cfg = make_pp_config(tmp_path / label, data_prefix, pp=2, gas=8,
+                             vpp=vpp, num_layers=4,
+                             train_iterations=6, save_interval=100)
+        t = build_capturing_trainer(cfg)
+        t.run_training()
+        monkeypatch.delenv("SCALING_TPU_EVENTS_PATH")
+        monkeypatch.delenv("SCALING_TPU_METRICS_PATH")
+
+        data = load_run_dir(run_dir)
+        lines = pipeline_section(data)
+        assert lines, "pipeline section missing for a pp>1 run"
+        text = "\n".join(lines)
+        assert ("interleaved(v=2)" in text) == (vpp == 2)
+        assert "predicted bubble" in text
+        # full report renders cleanly too
+        assert "== pipeline ==" in render_report(data, run_dir)
+        import re
+
+        pred = float(re.search(r"predicted bubble: ([0-9.]+)%", text).group(1))
+        m = re.search(r"fill/drain idle ([0-9.]+)s/step", text)
+        assert m, text
+        measured[label] = {"pred": pred, "idle_s": float(m.group(1))}
+
+    # simulated bubble strictly below fill-drain's...
+    assert measured["vpp2"]["pred"] < measured["naive"]["pred"], measured
+    # ...and so is the span-measured idle attribution
+    assert measured["vpp2"]["idle_s"] < measured["naive"]["idle_s"], measured
 
 
 def test_edge_layers_sharded_over_pipe(tmp_path, data_prefix, devices):
@@ -306,12 +472,17 @@ def test_pipeline_carry_budget_gates_chunked_remat(tmp_path, data_prefix,
     assert not _tick_carries_exceed_budget(b4, n_ticks=9, n_state_shards=16)
     assert _tick_carries_exceed_budget(b4, n_ticks=9, n_state_shards=2)
 
-    # gas high enough that the T saved carries dominate the temp budget
-    # (at tiny gas the chunked build's padding buffers mask the difference)
-    temp = {}
+    # the observable build signature: the chunked path nests a tick scan
+    # inside the chunk scan, so its compiled program carries strictly more
+    # while-loops than the plain build of the identical config. (The old
+    # signature — plain temp memory > chunked — died with the
+    # roll-then-overwrite shift fix: the concatenate form had been
+    # double-materializing the state into the saved carries, which was
+    # most of what that comparison measured.)
+    whiles = {}
     for label, budget in (("plain", "100000"), ("chunked", "0")):
         monkeypatch.setenv("SCALING_TPU_PIPE_CARRY_BUDGET_MB", budget)
         compiled = _compile_train_step(tmp_path / label, data_prefix,
                                        pp=2, gas=48, remat=True)
-        temp[label] = compiled.memory_analysis().temp_size_in_bytes
-    assert temp["plain"] > temp["chunked"], temp
+        whiles[label] = compiled.as_text().count(" while(")
+    assert whiles["chunked"] > whiles["plain"], whiles
