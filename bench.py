@@ -158,6 +158,17 @@ def _write_stale_artifact(payload: dict, reason: str) -> None:
                 "<floor>  # judge perf changes from obs run-dir MFU gates "
                 "while the bench capture is stale"
             ),
+            # the auto-sharding tuner must not calibrate its cost model
+            # from a stale capture (nor from the legacy step-time/3.2
+            # fudge): `python -m scaling_tpu.tune --obs-root <dir>` reads
+            # this marker, calibrates from the newest obs run dir instead,
+            # and records the source it used under `tuner_calibration`
+            "tuner_calibration": None,
+            "tuner_fallback": (
+                "python -m scaling_tpu.tune --obs-root <telemetry_root>  "
+                "# calibrate the layout cost model from the newest obs run "
+                "dir while this capture is stale (docs/TUNING.md)"
+            ),
         }
         tmp = STALE_PATH + ".tmp"
         os.makedirs(os.path.dirname(STALE_PATH), exist_ok=True)

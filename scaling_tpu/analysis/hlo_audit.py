@@ -481,6 +481,52 @@ def run_audit(sections: Optional[Sequence[str]] = None) -> dict:
     return {name: SECTIONS[name]() for name in names}
 
 
+# ----------------------------------------------------- layout cost summary
+def cost_summary_from_report(report: dict) -> dict:
+    """Reusable per-layout cost summary from an audit section report (or
+    a committed golden's JSON — same schema): per-axis and per-op totals
+    of the collective inventory plus the compiled FLOPs and mesh. The
+    exported surface the auto-sharding tuner (``scaling_tpu.tune``)
+    consumes, so downstream cost models never reach into the
+    audit-internal record lists."""
+    per_axis: Dict[str, dict] = {}
+    per_op: Dict[str, dict] = {}
+    for rec in report.get("collectives") or []:
+        for key, table in ((rec["axis"], per_axis), (rec["op"], per_op)):
+            slot = table.setdefault(key, {"bytes": 0, "count": 0})
+            slot["bytes"] += int(rec["bytes"])
+            slot["count"] += int(rec["count"])
+    return {
+        "per_axis": per_axis,
+        "per_op": per_op,
+        "collectives": list(report.get("collectives") or []),
+        "flops": report.get("flops"),
+        "mesh": dict(report.get("mesh") or {}),
+    }
+
+
+def layout_cost_summary(pp=1, dp=1, mp=1, gas=1, zero=False, vpp=1,
+                        slices=1, layers=2) -> dict:
+    """Lower the real jitted train step for this layout (tiny audit
+    shapes) and summarize its collective traffic per mesh axis — the
+    artifact-fed ingredient of the tuner's cost model (docs/TUNING.md).
+    Needs enough devices for the mesh (the 8-device virtual CPU mesh in
+    CI)."""
+    return cost_summary_from_report(
+        audit_train_section(pp=pp, dp=dp, mp=mp, gas=gas, zero=zero,
+                            vpp=vpp, slices=slices, layers=layers)
+    )
+
+
+def golden_cost_summary(name: str,
+                        golden_dir: Optional[Path] = None) -> dict:
+    """The committed golden's cost summary — per-axis collective bytes
+    from a REAL lowered program, readable without jax or a mesh (the
+    goldens are artifacts of past audits)."""
+    path = golden_path(name, golden_dir)
+    return cost_summary_from_report(json.loads(path.read_text()))
+
+
 # ------------------------------------------------------------- golden pin
 def golden_path(name: str, golden_dir: Optional[Path] = None) -> Path:
     return (golden_dir or GOLDEN_DIR) / f"{name}.json"

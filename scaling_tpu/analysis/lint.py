@@ -27,6 +27,17 @@ STA007    error     swallowed exception in resilience-critical code
                     handler that neither re-raises, logs, nor uses the
                     bound exception — a fault-masking black hole in the
                     exact layer whose job is surfacing faults.
+STA008    error     stage-shift ``jnp.concatenate`` in a traced context:
+                    one operand expanded (``x[None]`` /
+                    ``jnp.expand_dims``) concatenated with a partial
+                    slice (``s[:-1]`` / ``s[1:]``) of another array —
+                    the exact idiom jax 0.4.37's XLA SPMD partitioner
+                    MISCOMPILED under model-parallel params riding a
+                    vmapped stage dimension (PR 7: every pp x mp
+                    MULTICHIP arm computed wrong activations, max error
+                    ~11 vs sequential). Use roll-then-overwrite
+                    (``jnp.roll(s, 1, 0).at[0].set(inp)``) instead —
+                    exact, and partitions correctly.
 ========  ========  ==========================================================
 
 Suppress a finding on its line with ``# sta: disable=STA003`` (comma list)
@@ -60,6 +71,8 @@ RULES = {
     "STA006": ("warning", "dtype literal bypasses the precision policy"),
     "STA007": ("error", "swallowed exception (broad except without "
                         "re-raise/logging/use)"),
+    "STA008": ("error", "stage-shift concatenate (expand + partial slice) "
+                        "in a traced context — XLA SPMD miscompile hazard"),
 }
 
 # Module allowlist for traced-context rules (ISSUE 2: nn/, parallel/, ops/;
@@ -538,6 +551,58 @@ class _ModuleLint:
                         "STA003", node,
                         ".item() inside a traced context is a host sync",
                     )
+                # STA008: stage-shift concatenate (the PR 7 SPMD
+                # miscompile idiom: concatenate([inp[None], s[:-1]]))
+                elif (
+                    fname in ("jax.numpy.concatenate", "jax.lax.concatenate")
+                    and node.args
+                    and isinstance(node.args[0], (ast.List, ast.Tuple))
+                    and self._is_stage_shift_concat(node.args[0].elts)
+                ):
+                    self._emit(
+                        "STA008", node,
+                        "concatenate of an expanded operand with a partial "
+                        "slice builds a shifted array; XLA SPMD miscompiles "
+                        "this under model-parallel params on a vmapped "
+                        "stage dim (PR 7) — use roll-then-overwrite "
+                        "(jnp.roll(...).at[0].set(...))",
+                    )
+
+    # ------------------------------------------------------ STA008 helpers
+    def _is_stage_shift_concat(self, elts) -> bool:
+        """True when the operand list pairs an EXPANDED array (``x[None]``
+        / ``x[None, ...]`` / ``jnp.expand_dims(x, 0)``) with a PARTIAL
+        slice of another (``s[:-1]`` / ``s[1:]``) — together they build a
+        shifted copy, the shape XLA SPMD mis-partitions when a stage
+        vmap carries model-parallel params."""
+
+        def is_expand(e: ast.AST) -> bool:
+            if isinstance(e, ast.Subscript):
+                idx = e.slice
+                parts = idx.elts if isinstance(idx, ast.Tuple) else [idx]
+                return any(
+                    isinstance(p, ast.Constant) and p.value is None
+                    for p in parts
+                )
+            if isinstance(e, ast.Call):
+                name = self.aliases.resolve(e.func)
+                return bool(name) and name.rsplit(".", 1)[-1] == "expand_dims"
+            return False
+
+        def is_partial_slice(e: ast.AST) -> bool:
+            if not isinstance(e, ast.Subscript):
+                return False
+            idx = e.slice
+            parts = idx.elts if isinstance(idx, ast.Tuple) else [idx]
+            return any(
+                isinstance(p, ast.Slice)
+                and (p.lower is not None or p.upper is not None)
+                for p in parts
+            )
+
+        return any(is_expand(e) for e in elts) and any(
+            is_partial_slice(e) and not is_expand(e) for e in elts
+        )
 
     def _test_computes_on_device(self, test: ast.AST, traced_names) -> bool:
         """A branch test is device-valued when it CALLS into jax (jnp.any,

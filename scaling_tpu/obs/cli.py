@@ -12,7 +12,13 @@ import json
 import sys
 from pathlib import Path
 
-from .report import check_gates, load_run_dir, mfu_section, render_report
+from .report import (
+    check_gates,
+    load_run_dir,
+    mfu_section,
+    render_report,
+    tuner_section,
+)
 
 
 def main(argv=None) -> int:
@@ -30,6 +36,11 @@ def main(argv=None) -> int:
     parser.add_argument("--assert-step-time", type=float, metavar="CEIL",
                         help="fail (exit 1) when p50 step time exceeds "
                         "CEIL seconds")
+    parser.add_argument("--assert-tuner-calibration", type=float,
+                        metavar="CEIL",
+                        help="fail (exit 1) when the tuner's relative "
+                        "prediction error vs measured step time exceeds "
+                        "CEIL (docs/TUNING.md calibration loop)")
     args = parser.parse_args(argv)
 
     run_dir = Path(args.run_dir)
@@ -48,11 +59,15 @@ def main(argv=None) -> int:
         return 2
     print(render_report(data, run_dir), end="")
 
+    _, tuner_stats = tuner_section(data)
     failures = check_gates(
         data, assert_mfu=args.assert_mfu,
         assert_step_time=args.assert_step_time,
+        assert_tuner_calibration=args.assert_tuner_calibration,
+        tuner_stats=tuner_stats,
     )
-    if args.assert_mfu is not None or args.assert_step_time is not None:
+    if (args.assert_mfu is not None or args.assert_step_time is not None
+            or args.assert_tuner_calibration is not None):
         print("== gates ==")
         if failures:
             for f in failures:
@@ -62,6 +77,7 @@ def main(argv=None) -> int:
 
     if args.json:
         _, stats = mfu_section(data)
+        stats = {**stats, **tuner_stats}
         payload = {
             "files": data.files,
             "bad_lines": data.bad_lines,

@@ -387,6 +387,65 @@ def pipeline_section(data: RunData) -> List[str]:
     return lines
 
 
+def tuner_section(data: RunData) -> Tuple[List[str], Dict[str, float]]:
+    """Prediction-vs-measured for the auto-sharding tuner (docs/TUNING.md
+    "calibration loop"): the ``tuner-prediction`` event carries the cost
+    model's predicted step seconds for the layout this run executes; the
+    measured side is the span-measured step compute (fwdbwd+sync — the
+    window the cost model actually prices), falling back to the
+    ``step_duration`` metric when the run recorded no spans. The relative
+    calibration error is returned for the ``--assert-tuner-calibration``
+    gate. Rendered only when a prediction event exists, so run dirs from
+    untuned launches (and the committed golden reports) are unchanged."""
+    preds = [
+        e for e in data.lifecycle if e.get("event") == "tuner-prediction"
+    ]
+    if not preds:
+        return [], {}
+    pred = preds[-1]
+    lines = ["== tuner =="]
+    stats: Dict[str, float] = {}
+    label = pred.get("label", "?")
+    source = pred.get("source", "?")
+    try:
+        predicted = float(pred["predicted_step_s"])
+    except (KeyError, TypeError, ValueError):
+        lines.append(
+            f"  prediction event for {label} carries no predicted_step_s"
+        )
+        return lines, stats
+    stats["tuner_predicted_step_s"] = predicted
+    lines.append(
+        f"  layout {label}: predicted {_fmt_s(predicted)}/step "
+        f"(calibration: {source})"
+    )
+    samples = step_compute_samples(
+        step_span_sums(data.spans, ("step.fwdbwd", "step.sync"))
+    )
+    if samples:
+        measured = percentile(samples, 50)
+        measured_how = "span-measured compute (fwdbwd+sync p50)"
+    else:
+        durs = [
+            float(r["metrics"]["step_duration"]) for r in data.steps
+            if r.get("metrics", {}).get("step_duration") is not None
+        ]
+        if not durs:
+            lines.append("  measured: (no spans or step_duration records)")
+            return lines, stats
+        measured = percentile(durs, 50)
+        measured_how = "step_duration p50 (no spans in this run dir)"
+    stats["tuner_measured_step_s"] = measured
+    err = (predicted - measured) / measured if measured > 0 else math.inf
+    stats["tuner_calibration_error"] = err
+    lines.append(f"  measured: {_fmt_s(measured)}/step [{measured_how}]")
+    lines.append(
+        f"  calibration error: {err:+.1%} (predicted vs measured; the cost "
+        f"model {'over' if err > 0 else 'under'}-prices this layout)"
+    )
+    return lines, stats
+
+
 def timeline_section(data: RunData) -> List[str]:
     lines = ["== restart / preemption timeline =="]
     lifecycle = data.lifecycle
@@ -431,11 +490,13 @@ def render_report(data: RunData, run_dir: Path | str = "") -> str:
         f"  steps: {min(steps)}..{max(steps)}" if steps else "  steps: (none)",
     ]
     mfu_lines, _ = mfu_section(data)
+    tuner_lines, _ = tuner_section(data)
     sections = [
         header,
         step_time_section(data),
         mfu_lines,
         pipeline_section(data),  # empty (omitted) for non-pipelined runs
+        tuner_lines,  # empty (omitted) for untuned runs
         barrier_section(data),
         checkpoint_section(data),
         timeline_section(data),
@@ -444,12 +505,34 @@ def render_report(data: RunData, run_dir: Path | str = "") -> str:
 
 
 def check_gates(data: RunData, assert_mfu: Optional[float] = None,
-                assert_step_time: Optional[float] = None) -> List[str]:
+                assert_step_time: Optional[float] = None,
+                assert_tuner_calibration: Optional[float] = None,
+                tuner_stats: Optional[Dict[str, float]] = None) -> List[str]:
     """CI-style regression gates; returns failure messages (empty ==
     pass). Missing data FAILS a requested gate — a run that recorded no
-    MFU must not pass an MFU floor by silence."""
+    MFU must not pass an MFU floor by silence. ``tuner_stats`` lets a
+    caller that already rendered the tuner section pass its stats in
+    instead of re-aggregating the spans."""
     _, stats = mfu_section(data)
     failures: List[str] = []
+    if assert_tuner_calibration is not None:
+        tstats = (
+            tuner_stats if tuner_stats is not None
+            else tuner_section(data)[1]
+        )
+        err = tstats.get("tuner_calibration_error")
+        if err is None or not math.isfinite(err):
+            failures.append(
+                "assert-tuner-calibration: no tuner prediction + measured "
+                "step time pair in the run dir"
+            )
+        elif abs(err) > assert_tuner_calibration:
+            failures.append(
+                f"assert-tuner-calibration: |calibration error| "
+                f"{abs(err):.3f} > ceiling {assert_tuner_calibration:.3f} "
+                f"(predicted {tstats['tuner_predicted_step_s']:.3f}s vs "
+                f"measured {tstats['tuner_measured_step_s']:.3f}s)"
+            )
     if assert_mfu is not None:
         mean = stats.get("mfu_mean")
         if mean is None:

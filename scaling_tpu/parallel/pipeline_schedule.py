@@ -451,6 +451,61 @@ class SimulationEngine:
         }
 
 
+def schedule_class_for(virtual_size: int = 1, token_slices: int = 1):
+    """The spatial executor's schedule for a layout's knobs: interleaved
+    virtual stages, TeraPipe token slices, or the fill-drain baseline.
+    Returns a factory ``SimulationEngine.simulate`` accepts."""
+    import functools
+
+    if virtual_size > 1 and token_slices > 1:
+        raise ValueError("virtual stages and token slices are mutually "
+                         "exclusive (TopologyConfig enforces this)")
+    if virtual_size > 1:
+        return functools.partial(
+            PipelineScheduleInterleaved, virtual_size=virtual_size
+        )
+    if token_slices > 1:
+        return functools.partial(
+            PipelineScheduleTokenSlice, token_slices=token_slices
+        )
+    return PipelineScheduleFillDrain
+
+
+def simulate_layout(
+    pipe_parallel_size: int,
+    gradient_accumulation_steps: int,
+    durations: Optional[Dict[str, float]] = None,
+    virtual_size: int = 1,
+    token_slices: int = 1,
+) -> dict:
+    """One layout's schedule replayed through the simulator — the surface
+    the auto-sharding tuner (``scaling_tpu.tune``, docs/TUNING.md) prices
+    pipeline bubbles with. Returns the engine's result plus the schedule
+    label and the mean idle fraction as ``bubble_fraction``."""
+    if virtual_size > 1:
+        label = f"interleaved(v={virtual_size})"
+    elif token_slices > 1:
+        label = f"token-slice(S={token_slices})"
+    else:
+        label = "fill-drain"
+    engine = SimulationEngine(
+        pipe_parallel_size=pipe_parallel_size,
+        gradient_accumulation_steps=gradient_accumulation_steps,
+        durations=durations or {},
+    )
+    result = engine.simulate(schedule_class_for(virtual_size, token_slices))
+    if result["deadlocked"]:
+        raise RuntimeError(
+            f"schedule {label} (pp={pipe_parallel_size}, "
+            f"gas={gradient_accumulation_steps}) deadlocked in simulation; "
+            "a layout the tuner prices must replay cleanly"
+        )
+    result["schedule"] = label
+    idle = result["idle_fraction"]
+    result["bubble_fraction"] = sum(idle) / len(idle) if idle else 0.0
+    return result
+
+
 def durations_from_profile(
     observations: Optional[list],
     gradient_accumulation_steps: int,

@@ -838,6 +838,16 @@ class BaseTrainer:
                 token_slices=topo.pipe_token_slices,
                 gas=topo.gradient_accumulation_steps,
             )
+        # the auto-sharding tuner's predicted step time for this run's
+        # layout (exported by `python -m scaling_tpu.tune` as
+        # SCALING_TPU_TUNER_PREDICTION): logged into the SAME events
+        # stream so `obs report` can score prediction vs span-measured
+        # step time — the tuner's calibration loop (docs/TUNING.md)
+        from ..tune import prediction_from_env
+
+        prediction = prediction_from_env()
+        if prediction is not None:
+            logger.log_event("tuner-prediction", **prediction)
         watchdog = None
         if self.config.step_timeout_seconds is not None:
             # created here, ARMED by the loop after the first step

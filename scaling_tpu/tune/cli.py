@@ -1,0 +1,386 @@
+"""CLI: ``python -m scaling_tpu.tune`` — rank layouts, emit a config.
+
+Exit codes: 0 clean, 1 golden drift (``--check-golden``), 2 usage error.
+
+Calibration resolution (printed with the report — the tuner NEVER uses
+the legacy step-time/3.2 fudge):
+
+1. ``--run-dir DIR``: mean MFU of that obs run dir's step records.
+2. A fresh bench capture: ``benchmarks/artifacts/LAST_GOOD.json``'s MFU
+   — but ONLY while ``STALE.json`` is absent.
+3. While the bench capture is stale, the newest obs run dir under
+   ``--obs-root`` (ROADMAP "bench capture health"); the source used is
+   recorded INTO ``STALE.json`` under ``tuner_calibration`` so the
+   fallback is auditable.
+4. An explicit default (efficiency 0.5) that says it is uncalibrated.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+from typing import Optional, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+LAST_GOOD_PATH = REPO_ROOT / "benchmarks" / "artifacts" / "LAST_GOOD.json"
+STALE_PATH = REPO_ROOT / "benchmarks" / "artifacts" / "STALE.json"
+GOLDEN_DIR = Path(__file__).resolve().parent / "goldens"
+
+# golden scores compare within this band (pure-python floats are
+# deterministic; the band absorbs deliberate small constant tweaks
+# without re-pinning the world)
+GOLDEN_RTOL = 0.02
+
+
+def _newest_run_dir(obs_root: Path) -> Optional[Path]:
+    """The run dir under ``obs_root`` whose telemetry is newest: the
+    directory holding the most recently modified ``*.jsonl``."""
+    newest: Tuple[float, Optional[Path]] = (-1.0, None)
+    try:
+        for p in obs_root.rglob("*.jsonl"):
+            try:
+                mtime = p.stat().st_mtime
+            except OSError:
+                continue
+            if mtime > newest[0]:
+                newest = (mtime, p.parent)
+    except OSError:
+        return None
+    return newest[1]
+
+
+def _note_stale_calibration(source: str) -> None:
+    """Record into STALE.json which calibration source replaced the stale
+    bench capture — best effort, the marker is an audit trail."""
+    try:
+        rec = json.loads(STALE_PATH.read_text())
+    except (OSError, ValueError):
+        return
+    rec["tuner_calibration"] = {
+        "source": source,
+        "written": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "note": "bench capture stale: the tuner calibrated its cost model "
+                "from this source instead of LAST_GOOD (never the 3.2-fudge "
+                "profile path)",
+    }
+    try:
+        tmp = STALE_PATH.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(rec, indent=1) + "\n")
+        os.replace(tmp, STALE_PATH)
+    except OSError as e:
+        print(f"# tune: STALE.json note failed ({e})", file=sys.stderr)
+
+
+def resolve_calibration(run_dir: Optional[str], obs_root: Optional[str]):
+    from .costmodel import Calibration
+
+    if run_dir:
+        cal = Calibration.from_run_dir(run_dir)
+        if cal is None:
+            print(
+                f"# tune: {run_dir} has no MFU step records; falling back",
+                file=sys.stderr,
+            )
+        else:
+            return cal
+    stale = STALE_PATH.is_file()
+    if not stale and LAST_GOOD_PATH.is_file():
+        try:
+            rec = json.loads(LAST_GOOD_PATH.read_text())
+            mfu = float(rec["result"]["mfu"])
+            return Calibration.from_mfu(
+                mfu, f"bench:LAST_GOOD@{rec.get('captured')}"
+            )
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(f"# tune: LAST_GOOD unreadable ({e})", file=sys.stderr)
+    if stale:
+        root = Path(obs_root) if obs_root else None
+        newest = _newest_run_dir(root) if root else None
+        if newest is not None:
+            cal = Calibration.from_run_dir(newest)
+            if cal is not None:
+                _note_stale_calibration(cal.source)
+                return cal
+        cal = Calibration.default()
+        _note_stale_calibration(
+            cal.source if newest is None else f"{cal.source}; newest run dir "
+            f"{newest} had no MFU records"
+        )
+        print(
+            "# tune: bench capture is STALE and no obs run dir offered MFU "
+            "records; scoring with the uncalibrated default efficiency "
+            "(pass --run-dir or --obs-root)",
+            file=sys.stderr,
+        )
+        return cal
+    return None  # plain default, no stale marker to annotate
+
+
+def golden_path(devices: int, model_name: str) -> Path:
+    return GOLDEN_DIR / f"tune_{devices}dev_{model_name}.json"
+
+
+def check_golden(payload: dict, path: Path) -> list:
+    if not path.is_file():
+        return [f"no golden at {path} (run --repin-golden)"]
+    golden = json.loads(path.read_text())
+    drift = []
+    g_rank = [(r["label"], r["predicted_step_s"]) for r in golden["ranked"]]
+    c_rank = [
+        (r["label"], r["predicted_step_s"]) for r in payload["ranked"]
+    ]
+    if [l for l, _ in g_rank] != [l for l, _ in c_rank]:
+        drift.append(
+            f"ranking order changed: golden {[l for l, _ in g_rank][:5]}... "
+            f"!= current {[l for l, _ in c_rank][:5]}..."
+        )
+    for (gl, gs), (cl, cs) in zip(g_rank, c_rank):
+        if gl == cl and gs and abs(cs - gs) > GOLDEN_RTOL * gs:
+            drift.append(
+                f"{gl}: predicted {gs:.6f}s -> {cs:.6f}s "
+                f"(> {GOLDEN_RTOL:.0%} band)"
+            )
+    return drift
+
+
+def _lowered_crosscheck(scores, top: int) -> list:
+    """Lower the real train step (tiny audit shapes) for the top layouts
+    and return their per-axis inventories next to the analytic estimate
+    at the SAME tiny shape — a structural check that the analytic model
+    puts traffic on the right axes. cp>1 layouts are skipped (the audit
+    section builder has no context-parallel arm)."""
+    import dataclasses
+
+    from ..analysis.hlo_audit import layout_cost_summary
+    from .costmodel import analytic_collectives
+    from .layouts import ModelSpec
+
+    out = []
+    for s in scores[:top]:
+        L = s.layout
+        if L.cp > 1:
+            out.append({"label": L.label, "skipped": "cp>1 not lowerable "
+                        "via the audit section builder"})
+            continue
+        layers = 2 * L.pp * L.vpp  # audit convention: 2 layers per chunk
+        tiny = ModelSpec(hidden_size=128, num_layers=layers,
+                         num_attention_heads=2, num_kv_heads=2,
+                         sequence_length=64, vocab_size=512,
+                         mlp_factor=2.0, glu=True)
+        summary = layout_cost_summary(
+            pp=L.pp, dp=L.dp, mp=L.mp,
+            gas=L.gradient_accumulation_steps, zero=True,
+            vpp=L.vpp, slices=L.token_slices, layers=layers,
+        )
+        # the audit section builder only expresses ZeRO-1 (zero=True);
+        # pin the analytic side to the same stage so the two inventories
+        # describe the SAME program, whatever stage the ranked layout ran
+        tiny_layout = dataclasses.replace(
+            L, micro_batch_size=2, zero_stage=1
+        )
+        analytic_axis: dict = {}
+        for r in analytic_collectives(tiny, tiny_layout):
+            # sum same-axis records (zero-3 layouts emit several per axis)
+            analytic_axis[r["axis"]] = (
+                analytic_axis.get(r["axis"], 0) + r["bytes"]
+            )
+        out.append({
+            "label": L.label,
+            "lowered_per_axis": summary["per_axis"],
+            "analytic_per_axis": analytic_axis,
+            "flops": summary["flops"],
+        })
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m scaling_tpu.tune",
+        description="topology-aware auto-sharding tuner (docs/TUNING.md)",
+    )
+    parser.add_argument("--devices", type=int, default=8)
+    parser.add_argument("--model", default="0.5b",
+                        help="bench model name (0.5b|1b) or "
+                        "hidden,layers,heads,kv,seq,vocab[,mlp_factor]")
+    parser.add_argument("--global-batch", type=int, default=64,
+                        help="global batch size in sequences")
+    parser.add_argument("--mbs", type=int, default=8,
+                        help="micro batch size (bench self-tunes this "
+                        "per chip; the tuner searches layouts at a fixed "
+                        "one)")
+    parser.add_argument("--generation", default="tpu_v5e",
+                        choices=["tpu_v4", "tpu_v5e", "tpu_v5p", "tpu_v6e"])
+    parser.add_argument("--ici-domain", type=int, default=None,
+                        help="chips per ICI domain (default: all chips on "
+                        "one slice; smaller values model DCN crossings)")
+    parser.add_argument("--top", type=int, default=10,
+                        help="rows to print (the JSON always carries all)")
+    parser.add_argument("--json", metavar="FILE",
+                        help="write the machine-readable report")
+    parser.add_argument("--run-dir", help="obs run dir to calibrate "
+                        "compute efficiency from (mean MFU)")
+    parser.add_argument("--obs-root",
+                        help="root to search for the newest obs run dir "
+                        "when the bench capture is stale")
+    parser.add_argument("--emit-config", metavar="FILE",
+                        help="write the best layout's TopologyConfig dict")
+    parser.add_argument("--record-events", metavar="FILE",
+                        help="append a tuner-prediction event for the best "
+                        "layout to this events JSONL (an obs run dir file)")
+    parser.add_argument("--lower", type=int, metavar="K", default=0,
+                        help="cross-check the top K layouts' analytic axis "
+                        "attribution against the really-lowered step "
+                        "(tiny shapes; needs the 8-device CPU mesh)")
+    parser.add_argument("--check-golden", action="store_true",
+                        help="compare against the pinned ranking (forces "
+                        "the default calibration)")
+    parser.add_argument("--repin-golden", action="store_true",
+                        help="rewrite the pinned ranking from this run "
+                        "(forces the default calibration)")
+    args = parser.parse_args(argv)
+
+    from .costmodel import Calibration, SliceTopology, rank_layouts
+    from .layouts import BENCH_MODELS, ModelSpec, enumerate_layouts
+
+    if args.model in BENCH_MODELS:
+        model = BENCH_MODELS[args.model]
+        model_name = args.model
+    else:
+        try:
+            parts = [float(x) for x in args.model.split(",")]
+            model = ModelSpec(
+                hidden_size=int(parts[0]), num_layers=int(parts[1]),
+                num_attention_heads=int(parts[2]), num_kv_heads=int(parts[3]),
+                sequence_length=int(parts[4]), vocab_size=int(parts[5]),
+                mlp_factor=parts[6] if len(parts) > 6 else 2.75,
+            )
+            model_name = "custom"
+        except (ValueError, IndexError):
+            print(f"error: unknown --model {args.model!r} "
+                  f"(names: {sorted(BENCH_MODELS)})", file=sys.stderr)
+            return 2
+
+    topo = SliceTopology(
+        chips=args.devices, ici_domain=args.ici_domain,
+        generation=args.generation,
+    )
+    pinning = args.check_golden or args.repin_golden
+    calibration = (
+        Calibration.default() if pinning
+        else resolve_calibration(args.run_dir, args.obs_root)
+    )
+    layouts = enumerate_layouts(
+        args.devices, model, global_batch_size=args.global_batch,
+        micro_batch_size=args.mbs,
+    )
+    if not layouts:
+        print("error: no valid layouts for this model/device count",
+              file=sys.stderr)
+        return 2
+    ranked = rank_layouts(model, layouts, topo, calibration)
+    cal = calibration or Calibration.default()
+
+    best = ranked[0]
+    prediction = {
+        "label": best.layout.label,
+        "predicted_step_s": round(best.predicted_step_s, 6),
+        "world_size": best.layout.world,
+        "source": cal.source,
+        "collectives_source": best.collectives_source,
+    }
+    payload = {
+        "devices": args.devices,
+        "model": model_name,
+        "model_spec": {
+            "hidden_size": model.hidden_size,
+            "num_layers": model.num_layers,
+            "num_attention_heads": model.num_attention_heads,
+            "num_kv_heads": model.num_kv_heads,
+            "sequence_length": model.sequence_length,
+            "vocab_size": model.vocab_size,
+            "mlp_factor": model.mlp_factor,
+            "parameter_count": model.parameter_count,
+        },
+        "global_batch_size": args.global_batch,
+        "micro_batch_size": args.mbs,
+        "slice_topology": topo.to_dict(),
+        "calibration": cal.to_dict(),
+        "ranked": [s.to_dict() for s in ranked],
+        "topology_config": best.layout.topology_dict(),
+        "prediction": prediction,
+    }
+    if args.lower:
+        from ..analysis.cli import _ensure_virtual_mesh
+
+        _ensure_virtual_mesh()  # lowering needs the 8-device CPU mesh
+        payload["lowered_crosscheck"] = _lowered_crosscheck(ranked, args.lower)
+
+    print(f"tune: {len(ranked)} valid layout(s) of {model_name} on "
+          f"{args.devices} device(s) [{topo.generation}, ici_domain="
+          f"{topo.domain}]")
+    print(f"calibration: efficiency={cal.compute_efficiency:.3f} "
+          f"({cal.source})")
+    header = (f"{'rank':>4} {'layout':<28} {'step_s':>9} {'tok/s':>10} "
+              f"{'bubble':>7} {'comm_s':>8} {'mem_GB':>7} links")
+    print(header)
+    for i, s in enumerate(ranked[: args.top]):
+        links = ",".join(
+            f"{ax}:{rec['link']}" for ax, rec in sorted(s.comm_by_axis.items())
+        )
+        print(
+            f"{i + 1:>4} {s.layout.label:<28} {s.predicted_step_s:>9.4f} "
+            f"{s.tokens_per_s:>10.0f} {s.bubble_fraction:>6.1%} "
+            f"{s.comm_s:>8.4f} {s.memory_gb:>7.2f} {links}"
+        )
+    print(f"best: {best.layout.label} predicted {best.predicted_step_s:.4f}"
+          f"s/step ({best.tokens_per_s:.0f} tokens/s)")
+    print("export " + "SCALING_TPU_TUNER_PREDICTION='"
+          + json.dumps(prediction) + "'")
+
+    if args.emit_config:
+        Path(args.emit_config).write_text(
+            json.dumps(payload["topology_config"], indent=1) + "\n"
+        )
+    if args.record_events:
+        from ..logging.logger import append_jsonl_line
+
+        append_jsonl_line(
+            args.record_events,
+            json.dumps(
+                {"event": "tuner-prediction", "ts": time.time(), **prediction},
+                sort_keys=True,
+            ),
+        )
+    if args.json:
+        Path(args.json).write_text(json.dumps(payload, indent=1) + "\n")
+
+    gpath = golden_path(args.devices, model_name)
+    if args.repin_golden:
+        gpath.parent.mkdir(parents=True, exist_ok=True)
+        gpath.write_text(json.dumps(
+            {
+                "calibration": "pinned-default",
+                "ranked": [
+                    {"label": s.to_dict()["label"],
+                     "predicted_step_s": s.to_dict()["predicted_step_s"]}
+                    for s in ranked
+                ],
+            },
+            indent=1,
+        ) + "\n")
+        print(f"golden repinned -> {gpath}")
+    elif args.check_golden:
+        drift = check_golden(payload, gpath)
+        for line in drift:
+            print(f"DRIFT: {line}")
+        print(f"golden: {'OK' if not drift else 'DRIFT'}")
+        return 1 if drift else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

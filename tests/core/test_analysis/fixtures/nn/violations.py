@@ -68,3 +68,23 @@ def scan_body_branch(carry, x):
 
 def run_scan(xs):
     return jax.lax.scan(scan_body_branch, 0, xs)
+
+
+@jax.jit
+def stage_shift_concat(inp, s):
+    # STA008: the PR 7 SPMD-miscompile idiom — expanded input
+    # concatenated with a partial slice builds the shifted stage carry
+    return jnp.concatenate([inp[None], s[:-1]], axis=0)
+
+
+@jax.jit
+def stage_shift_roll_ok(inp, s):
+    # the sanctioned replacement: roll-then-overwrite partitions exactly
+    return jnp.roll(s, 1, axis=0).at[0].set(inp)
+
+
+@jax.jit
+def partial_rotary_concat_ok(q, d):
+    # concatenate WITH a partial slice but no expanded operand (the
+    # rotary partial-dim idiom) must not fire
+    return jnp.concatenate([q * 2.0, q[..., d:]], axis=-1)

@@ -28,6 +28,7 @@ EXPECTED = [
     ("STA005", 49),   # mutable default
     ("STA006", 55),   # astype(jnp.float16)
     ("STA001", 64),   # branch inside lax.scan body
+    ("STA008", 77),   # stage-shift concatenate (PR 7 SPMD miscompile idiom)
     ("STA007", 14),   # trainer: except Exception: pass
     ("STA007", 21),   # trainer: bare except, nothing surfaces
     ("STA007", 28),   # trainer: except BaseException as e, e unused
@@ -130,7 +131,8 @@ def test_rule_table_is_stable():
     """Rule IDs are a public contract (suppression comments, docs,
     golden reports reference them)."""
     assert set(RULES) == {
-        "STA001", "STA002", "STA003", "STA004", "STA005", "STA006", "STA007"
+        "STA001", "STA002", "STA003", "STA004", "STA005", "STA006", "STA007",
+        "STA008",
     }
     for rule, (severity, _) in RULES.items():
         assert severity in ("error", "warning"), rule
@@ -157,6 +159,38 @@ def test_swallowed_exception_only_flagged_in_scope_dirs(tmp_path):
         f2 = d / "mod.py"
         f2.write_text(src)
         assert [f.rule for f in lint_file(f2, root=tmp_path)] == ["STA007"], scope
+
+
+def test_stage_shift_concat_variants(tmp_path):
+    """STA008 (ISSUE 8 satellite, PR 7 follow-up): the expand+partial-
+    slice concatenate fires in a traced context in every spelling the
+    executor used (``x[None]``, ``expand_dims``); the roll-then-overwrite
+    replacement and the rotary partial-dim concat stay clean, and the
+    same shift OUTSIDE a traced context is legal (host-side assembly)."""
+    fires = [
+        "@jax.jit\ndef f(inp, s):\n"
+        "    return jax.numpy.concatenate([inp[None], s[:-1]], axis=0)\n",
+        "@jax.jit\ndef f(inp, s):\n"
+        "    return jax.numpy.concatenate(\n"
+        "        [jax.numpy.expand_dims(inp, 0), s[1:]], axis=0)\n",
+    ]
+    for src in fires:
+        rules = [f.rule for f in _lint_source(tmp_path, src)]
+        assert rules == ["STA008"], (src, rules)
+    clean = [
+        # roll-then-overwrite: the sanctioned replacement
+        "@jax.jit\ndef f(inp, s):\n"
+        "    return jax.numpy.roll(s, 1, axis=0).at[0].set(inp)\n",
+        # partial slice but no expanded operand (rotary idiom)
+        "@jax.jit\ndef f(q):\n"
+        "    return jax.numpy.concatenate([q * 2.0, q[..., 4:]], axis=-1)\n",
+        # host-side (untraced) shift: not a partitioner hazard
+        "def f(inp, s):\n"
+        "    return jax.numpy.concatenate([inp[None], s[:-1]], axis=0)\n",
+    ]
+    for src in clean:
+        rules = [f.rule for f in _lint_source(tmp_path, src)]
+        assert rules == [], (src, rules)
 
 
 def test_findings_are_json_serializable(fixture_findings):

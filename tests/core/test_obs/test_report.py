@@ -248,3 +248,101 @@ def test_pipeline_section_absent_without_config_event(tmp_path):
                     "dur_s": 0.5, "ts": 1.0}) + "\n")
     assert pipeline_section(load_run_dir(tmp_path)) == []
     assert "== pipeline ==" not in render_report(load_run_dir(tmp_path))
+
+
+# ---------------------------------------------------------- tuner section
+def _tuner_run_dir(tmp_path, predicted=1.1, steps=4, fwdbwd=0.01, sync=0.99,
+                   with_spans=True, with_steps=False):
+    lines = [json.dumps({
+        "event": "tuner-prediction", "ts": 0.0, "label": "pp1·dp8·mp1·z1",
+        "predicted_step_s": predicted, "world_size": 8,
+        "source": "bench:LAST_GOOD@test",
+    })]
+    for s in range(10, 10 + steps):
+        scale = 30.0 if s == 10 else 1.0  # compile outlier, dropped
+        if with_spans:
+            lines.append(json.dumps({"event": "span", "span": "step.fwdbwd",
+                                     "step": s, "dur_s": fwdbwd * scale,
+                                     "ts": float(s)}))
+            lines.append(json.dumps({"event": "span", "span": "step.sync",
+                                     "step": s, "dur_s": sync * scale,
+                                     "ts": float(s) + 0.5}))
+    (tmp_path / "events.jsonl").write_text("\n".join(lines) + "\n")
+    if with_steps:
+        (tmp_path / "metrics.jsonl").write_text(json.dumps({
+            "kind": "step", "step": 11, "host": 0,
+            "metrics": {"step_duration": 2.0},
+        }) + "\n")
+    return tmp_path
+
+
+def test_tuner_section_scores_prediction_vs_span_measured(tmp_path):
+    """ISSUE 8 acceptance: the tuner section compares the predicted step
+    time against the SPAN-measured compute (fwdbwd+sync p50, compile
+    step dropped — here exactly 1.0s) and reports a finite calibration
+    error (+10% for a 1.1s prediction)."""
+    from scaling_tpu.obs.report import load_run_dir, tuner_section
+
+    data = load_run_dir(_tuner_run_dir(tmp_path, predicted=1.1))
+    lines, stats = tuner_section(data)
+    text = "\n".join(lines)
+    assert "== tuner ==" in text
+    assert "layout pp1·dp8·mp1·z1: predicted 1.100s/step" in text
+    assert "measured: 1.000s/step [span-measured compute" in text
+    assert "calibration error: +10.0%" in text
+    assert stats["tuner_calibration_error"] == pytest.approx(0.10)
+    assert stats["tuner_measured_step_s"] == pytest.approx(1.0)
+
+
+def test_tuner_section_falls_back_to_step_duration(tmp_path):
+    from scaling_tpu.obs.report import load_run_dir, tuner_section
+
+    data = load_run_dir(_tuner_run_dir(
+        tmp_path, predicted=1.0, with_spans=False, with_steps=True
+    ))
+    lines, stats = tuner_section(data)
+    text = "\n".join(lines)
+    assert "step_duration p50 (no spans" in text
+    assert stats["tuner_calibration_error"] == pytest.approx(-0.5)
+
+
+def test_tuner_section_absent_without_prediction_event(tmp_path):
+    """Untuned run dirs keep their exact report layout — the committed
+    golden reports must not grow an empty tuner section."""
+    from scaling_tpu.obs.report import load_run_dir, tuner_section
+
+    (tmp_path / "events.jsonl").write_text(
+        json.dumps({"event": "span", "span": "step.fwdbwd", "step": 1,
+                    "dur_s": 0.5, "ts": 1.0}) + "\n")
+    lines, stats = tuner_section(load_run_dir(tmp_path))
+    assert lines == [] and stats == {}
+    assert "== tuner ==" not in render_report(load_run_dir(tmp_path))
+
+
+def test_tuner_calibration_gate(tmp_path):
+    """The gate fails on a too-large calibration error AND on missing
+    data (a run with no prediction must not pass by silence), and the
+    CLI wires --assert-tuner-calibration through."""
+    from scaling_tpu.obs.cli import main
+    from scaling_tpu.obs.report import load_run_dir
+
+    run = _tuner_run_dir(tmp_path, predicted=1.5)  # 50% off
+    data = load_run_dir(run)
+    assert check_gates(data, assert_tuner_calibration=0.6) == []
+    failures = check_gates(data, assert_tuner_calibration=0.25)
+    assert failures and "assert-tuner-calibration" in failures[0]
+    # missing data fails
+    empty = tmp_path / "untuned"
+    empty.mkdir()
+    (empty / "events.jsonl").write_text(
+        json.dumps({"event": "relaunch", "ts": 1.0}) + "\n")
+    assert check_gates(
+        load_run_dir(empty), assert_tuner_calibration=0.5
+    )
+    # CLI: pass and fail exit codes
+    assert main([
+        "report", str(run), "--assert-tuner-calibration", "0.6"
+    ]) == 0
+    assert main([
+        "report", str(run), "--assert-tuner-calibration", "0.25"
+    ]) == 1

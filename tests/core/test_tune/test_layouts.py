@@ -1,0 +1,117 @@
+"""Layout enumeration: the tuner's search space reuses the production
+validity rules — every emitted layout round-trips TopologyConfig, every
+known-invalid combination is excluded."""
+
+import pytest
+
+from scaling_tpu.tune.layouts import (
+    BENCH_MODELS,
+    Layout,
+    ModelSpec,
+    enumerate_layouts,
+)
+
+MODEL = BENCH_MODELS["0.5b"]  # heads=16, kv=4, layers=8, seq=2048
+
+
+@pytest.fixture(scope="module")
+def space():
+    return enumerate_layouts(
+        8, MODEL, global_batch_size=64, micro_batch_size=8
+    )
+
+
+def test_space_is_nonempty_and_deterministic(space):
+    assert len(space) > 10
+    again = enumerate_layouts(8, MODEL, global_batch_size=64,
+                              micro_batch_size=8)
+    assert [l.key() for l in space] == [l.key() for l in again]
+
+
+def test_every_layout_roundtrips_topology_config(space):
+    from scaling_tpu.topology.config import TopologyConfig
+
+    for layout in space:
+        cfg = TopologyConfig.from_dict(layout.topology_dict())
+        assert cfg.world_size == 8
+        assert (
+            cfg.pipe_parallel_size * cfg.data_parallel_size
+            * cfg.context_parallel_size * cfg.model_parallel_size
+        ) == 8
+        assert cfg.global_batch_size == 64
+
+
+def test_dryrun_grid_arms_are_in_the_space(space):
+    """The hand-picked MULTICHIP arms (dense, cp=1-or-2) must all be
+    reachable — the tuner searches a superset of the grid."""
+    keys = {l.key() for l in space}
+    # (pp, dp, cp, mp, cp_variant, zero, vpp, slices)
+    for arm in [
+        (2, 2, 1, 2, "-", 1, 1, 1),   # the hand-picked default arm
+        (2, 2, 1, 2, "-", 1, 2, 1),   # + interleaved virtual stages
+        (2, 2, 1, 2, "-", 1, 1, 2),   # + token slices
+        (1, 2, 2, 2, "ring", 1, 1, 1),
+        (1, 2, 2, 2, "ulysses", 1, 1, 1),
+        (1, 4, 1, 2, "-", 3, 1, 1),   # ZeRO-3 arm
+    ]:
+        assert arm in keys, arm
+
+
+def test_model_divisibility_excludes_invalid_arms(space):
+    """kv_heads=4 forbids mp=8; layers=8 forbids pp=8 with vpp=2 at
+    16 chunks; cp>1 with pp>1 is a config-level exclusion."""
+    for layout in space:
+        assert layout.mp <= 4  # 16 heads but only 4 kv heads
+        assert not (layout.cp > 1 and layout.pp > 1)
+        assert MODEL.num_layers % (layout.pp * layout.vpp) == 0
+        if layout.vpp > 1:
+            assert layout.gradient_accumulation_steps % layout.pp == 0
+
+
+def test_ulysses_requires_head_divisibility():
+    """A 2-kv-head model cannot run ulysses at cp=4 (kv % cp != 0); the
+    ring variant (K/V rotation, no head split) still can."""
+    model = ModelSpec(hidden_size=256, num_layers=4, num_attention_heads=4,
+                      num_kv_heads=2, sequence_length=512, vocab_size=512)
+    space = enumerate_layouts(8, model, global_batch_size=32,
+                              micro_batch_size=4)
+    cp4 = [l for l in space if l.cp == 4]
+    assert any(l.cp_variant == "ring" for l in cp4)
+    assert not any(l.cp_variant == "ulysses" for l in cp4)
+
+
+def test_invalid_layout_reports_reason():
+    bad = Layout(pp=2, dp=2, cp=2, mp=1, micro_batch_size=2,
+                 gradient_accumulation_steps=4)
+    reason = bad.validate()
+    assert reason is not None and "context_parallel" in reason
+
+
+def test_modelspec_formulas_match_reference_estimators():
+    """The jax-free duplicates pin exactly to the canonical estimators
+    in models/transformer/utils/get_tflops.py."""
+    from scaling_tpu.models.transformer.utils.get_tflops import (
+        get_flops_per_token,
+        get_model_parameter_count,
+    )
+
+    for model in BENCH_MODELS.values():
+        n_ref = get_model_parameter_count(
+            model.hidden_size, model.num_layers, model.vocab_size,
+            model.mlp_factor, glu=model.glu,
+        )
+        assert model.parameter_count == n_ref
+        assert model.flops_per_token == get_flops_per_token(
+            n_ref, model.num_layers, model.hidden_size,
+            model.sequence_length,
+        )
+
+
+def test_modelspec_from_arch_reads_config_objects():
+    arch = {
+        "hidden_size": 64, "num_layers": 4, "num_attention_heads": 4,
+        "attention_num_kv_heads": 2, "sequence_length": 32,
+        "vocab_size": 128, "mlp_factor": 2.0, "mlp_type": "swiglu",
+    }
+    spec = ModelSpec.from_arch(arch)
+    assert spec.num_kv_heads == 2 and spec.glu and not spec.moe

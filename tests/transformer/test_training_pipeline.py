@@ -3,6 +3,7 @@ checkpoint interchange across pipe layouts (reference:
 tests/core/test_training/test_training.py grid with pp=2,
 partitioned_module.py layout-independent checkpoints)."""
 
+import json
 from pathlib import Path
 
 import numpy as np
@@ -307,6 +308,55 @@ def test_pipeline_obs_report_measures_interleaved_bubble(
     assert measured["vpp2"]["pred"] < measured["naive"]["pred"], measured
     # ...and so is the span-measured idle attribution
     assert measured["vpp2"]["idle_s"] < measured["naive"]["idle_s"], measured
+
+
+def test_tuner_prediction_closes_calibration_loop(
+    tmp_path, data_prefix, monkeypatch
+):
+    """ISSUE 8 acceptance: a real CPU-mesh run launched with the tuner's
+    exported prediction (``SCALING_TPU_TUNER_PREDICTION``) lands a
+    ``tuner-prediction`` event in its run dir; ``obs report`` renders a
+    tuner section with prediction vs span-measured step time and a
+    FINITE calibration error, and the ``--assert-tuner-calibration``
+    gate passes at a generous ceiling and fails at an absurd one — the
+    cost model's error is a tracked, gateable number."""
+    import re
+
+    from scaling_tpu.obs.cli import main as obs_main
+    from scaling_tpu.obs.report import load_run_dir, tuner_section
+
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    monkeypatch.setenv("SCALING_TPU_EVENTS_PATH",
+                       str(run_dir / "events.jsonl"))
+    monkeypatch.setenv(
+        "SCALING_TPU_TUNER_PREDICTION",
+        json.dumps({"label": "pp2·dp1·mp1·z1", "predicted_step_s": 0.05,
+                    "world_size": 2, "source": "test"}),
+    )
+    cfg = make_pp_config(tmp_path / "t", data_prefix, pp=2, gas=4,
+                         train_iterations=4, save_interval=100)
+    t = build_capturing_trainer(cfg)
+    t.run_training()
+    monkeypatch.delenv("SCALING_TPU_EVENTS_PATH")
+
+    data = load_run_dir(run_dir)
+    lines, stats = tuner_section(data)
+    text = "\n".join(lines)
+    assert "layout pp2·dp1·mp1·z1: predicted 0.050s/step" in text, text
+    assert "span-measured compute" in text
+    err = stats["tuner_calibration_error"]
+    assert np.isfinite(err), stats
+    m = re.search(r"calibration error: ([+-][0-9.]+)%", text)
+    assert m and float(m.group(1)) == pytest.approx(err * 100, abs=0.05)
+    # the gate: generous ceiling passes, absurd ceiling fails (exit 1)
+    assert obs_main([
+        "report", str(run_dir), "--assert-tuner-calibration",
+        str(abs(err) * 2 + 1.0),
+    ]) == 0
+    assert obs_main([
+        "report", str(run_dir), "--assert-tuner-calibration", "1e-9",
+    ]) == 1
 
 
 def test_edge_layers_sharded_over_pipe(tmp_path, data_prefix, devices):
