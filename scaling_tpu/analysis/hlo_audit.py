@@ -455,6 +455,56 @@ def audit_decode_section(prompt_len=4, max_tokens=4) -> dict:
     return report
 
 
+def audit_serve_decode_section(num_slots=2, block_size=4,
+                               max_blocks=4) -> dict:
+    """The serving engine's single decode program (serve/engine.py): one
+    jitted step over the WHOLE slot set, sequence raggedness carried in
+    block tables + context lengths. Its recompile-key signature is the
+    no-recompile-storm contract — a scheduler change that moves shapes
+    into the signature (a new bucket axis, a per-request dimension)
+    shows up as golden drift here, not as a compile per request on the
+    chip. The static config also pins the prefill bucket ladder's floor,
+    so a bucketing-policy change drifts the hash even though prefill
+    lowers per bucket."""
+    import jax
+    import jax.numpy as jnp
+
+    from scaling_tpu.models.transformer.inference import (
+        TransformerInferenceModule,
+    )
+    from scaling_tpu.models.transformer.model import init_model
+    from scaling_tpu.serve.engine import (
+        MIN_PREFILL_BUCKET, EngineConfig, ServeEngine,
+    )
+
+    config = make_train_config()
+    module = init_model(config, None)
+    params = module.init_params(jax.random.PRNGKey(0))
+    inf = TransformerInferenceModule(config, module, params)
+    engine = ServeEngine(inf, EngineConfig(
+        num_slots=num_slots, block_size=block_size,
+        num_blocks=2 * max_blocks + 1, max_blocks_per_seq=max_blocks,
+        token_budget=64,
+    ))
+    decode = engine._build_decode_fn()
+    args = (
+        params, engine._pool_state(),
+        jnp.zeros((num_slots, max_blocks), jnp.int32),
+        jnp.zeros((num_slots,), jnp.int32),
+        jnp.zeros((num_slots,), jnp.int32),
+    )
+    lowered = decode.lower(*args)
+    static = {
+        "kind": "serve_decode", "num_slots": num_slots,
+        "block_size": block_size, "max_blocks_per_seq": max_blocks,
+        "kv_dtype": engine.config.kv_dtype,
+        "min_prefill_bucket": MIN_PREFILL_BUCKET,
+    }
+    report = _audit_lowered(lowered, args, static, mesh=None)
+    report["mesh"] = {}
+    return report
+
+
 SECTIONS = {
     "train_single": lambda: audit_train_section(),
     "train_pp2_mp2": lambda: audit_train_section(pp=2, dp=2, mp=2, zero=True),
@@ -470,6 +520,8 @@ SECTIONS = {
         pp=2, dp=2, mp=2, zero=True, gas=2, slices=2
     ),
     "decode_fused": lambda: audit_decode_section(),
+    # continuous-batching serving: the paged decode step (ISSUE 9)
+    "serve_decode": lambda: audit_serve_decode_section(),
 }
 
 

@@ -97,6 +97,10 @@ SWALLOW_SCOPE_DIRS = (
     # ISSUE 5: telemetry that silently eats its own failures is telemetry
     # you cannot trust during the post-mortem that needed it
     "obs",
+    # ISSUE 9: the serving engine is a production loop — a swallowed
+    # scheduler/pool/device error here is a request that silently never
+    # completes (the exact failure mode the TTFT gates exist to catch)
+    "serve",
 )
 
 # calls that count as "the handler surfaced the problem"

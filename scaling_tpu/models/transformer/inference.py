@@ -239,7 +239,16 @@ class TransformerInferenceModule:
     # ------------------------------------------------------------- forward
     def _run_layers(self, params, batch, caches, offset):
         """One pass through the stack; TransformerLayers consume/produce the
-        KV caches, edge layers run as in training (deterministic)."""
+        KV caches, edge layers run as in training (deterministic).
+
+        A pipelined (pp>1) stack wraps its TransformerLayers in a
+        ``PipelinedBody``, which cannot consume KV caches: the cached path
+        raises instead of silently decoding with no history (the caches
+        would be skipped and every token computed as if it were first);
+        the uncached path runs the body unstacked, like training's
+        ``ParallelModule.forward``."""
+        from ...parallel.pipeline import PipelinedBody
+
         ctx = self.module._make_ctx(deterministic=True, dropout_key=None)
         x = batch
         new_caches = []
@@ -253,8 +262,24 @@ class TransformerInferenceModule:
                     x, kv = layer(p, x, ctx, kv_cache=caches[li], cache_offset=offset)
                     new_caches.append(kv)
                     li += 1
+            elif isinstance(layer, PipelinedBody):
+                if caches is not None:
+                    raise ValueError(
+                        "cached decode through a pipelined (pp>1) layer "
+                        "stack would silently skip the KV caches and "
+                        "recompute every token without history; decode at "
+                        "pipe_parallel_size=1 (checkpoints are layout-"
+                        "independent) or use generate(use_cache=False)"
+                    )
+                x = layer(p, x, ctx, stacked=False, remat=False)
             else:
                 x = layer(p, x, ctx)
+        if caches is not None and li != len(caches):
+            raise ValueError(
+                f"layer stack consumed {li} KV cache(s) but {len(caches)} "
+                "were provided — a cache silently skipped here means "
+                "silently wrong decode output"
+            )
         return x["activations"], new_caches
 
     def _make_batch(
@@ -360,6 +385,64 @@ class TransformerInferenceModule:
             )
         return caches
 
+    def prefill_forward(self, params, token_ids, position_ids,
+                        segment_ids=None, last_index=None):
+        """Traceable prompt pass: full stack with ``return_kv=True`` (the
+        flash kernel stays active — no cache is CONSUMED here), returning
+        (logits for one position, per-layer (k, v)).
+
+        The sampled position is the last one by default; ``last_index``
+        (a traced scalar) selects another — right-padded prompts, as the
+        serving engine's bucketed prefill uses, sample at prompt_len-1.
+        Shared by ``generate``'s dense-cache prefill and the serving
+        engine's paged prefill (serve/engine.py), so the two products of
+        one prompt pass can never diverge."""
+        from ...parallel.pipeline import PipelinedBody
+
+        ctx = self.module._make_ctx(deterministic=True, dropout_key=None)
+        transformer_idxs = [
+            i for i, l in enumerate(self.module.layers)
+            if isinstance(l, TransformerLayer)
+        ]
+        if not transformer_idxs:
+            if any(isinstance(l, PipelinedBody) for l in self.module.layers):
+                raise ValueError(
+                    "cached generation through a pipelined (pp>1) layer "
+                    "stack would silently decode without its KV caches; "
+                    "decode at pipe_parallel_size=1 (checkpoints are "
+                    "layout-independent) or use generate(use_cache=False)"
+                )
+            raise ValueError(
+                "cannot run cached generation on a module with no "
+                "TransformerLayer (nothing produces KV caches); use "
+                "generate(use_cache=False) or fix the layer stack"
+            )
+        last_tl = max(transformer_idxs)
+
+        x = self._make_batch(token_ids, position_ids, segment_ids=segment_ids)
+        kvs = []
+        for i, layer in enumerate(self.module.layers):
+            p = self.module._layer_params(params, i)
+            if isinstance(layer, TransformerLayer):
+                x, kv = layer(p, x, ctx, return_kv=True)
+                kvs.append(kv)
+            else:
+                x = layer(p, x, ctx)
+            if i == last_tl:
+                # only the sampled position feeds the post-trunk layers —
+                # they are position-pointwise, and running the vocab
+                # projection over the whole prompt would materialize
+                # (b, s, vocab) logits (>1 GB at bench shapes, ~8 GB at a
+                # 32k prompt)
+                x = dict(x)
+                if last_index is None:
+                    x["activations"] = x["activations"][:, -1:]
+                else:
+                    x["activations"] = jax.lax.dynamic_slice_in_dim(
+                        x["activations"], last_index, 1, axis=1
+                    )
+        return x["activations"], kvs
+
     def _prefill(
         self,
         token_ids: jax.Array,
@@ -379,41 +462,9 @@ class TransformerInferenceModule:
             if position_ids is None
             else position_ids
         )
-        ctx = self.module._make_ctx(deterministic=True, dropout_key=None)
-
-        transformer_idxs = [
-            i for i, l in enumerate(self.module.layers)
-            if isinstance(l, TransformerLayer)
-        ]
-        if not transformer_idxs:
-            raise ValueError(
-                "cannot run cached generation on a module with no "
-                "TransformerLayer (nothing produces KV caches); use "
-                "generate(use_cache=False) or fix the layer stack"
-            )
-        last_tl = max(transformer_idxs)
-
-        def run(params, t, po, sg):
-            x = self._make_batch(t, po, segment_ids=sg)
-            kvs = []
-            for i, layer in enumerate(self.module.layers):
-                p = self.module._layer_params(params, i)
-                if isinstance(layer, TransformerLayer):
-                    x, kv = layer(p, x, ctx, return_kv=True)
-                    kvs.append(kv)
-                else:
-                    x = layer(p, x, ctx)
-                if i == last_tl:
-                    # only the final position feeds sampling, and the
-                    # post-trunk layers (final norm, lm head) are
-                    # position-pointwise — running the vocab projection
-                    # over the whole prompt would materialize (b, s, vocab)
-                    # logits (>1 GB at bench shapes, ~8 GB at a 32k prompt)
-                    x = dict(x)
-                    x["activations"] = x["activations"][:, -1:]
-            return x["activations"], kvs
-
-        logits, kvs = jax.jit(run)(self.params, token_ids, pos, segment_ids)
+        logits, kvs = jax.jit(self.prefill_forward)(
+            self.params, token_ids, pos, segment_ids
+        )
         return logits, self._alloc_caches(kvs, max_len)
 
     def _build_decode_loop(self, sample, stop_ids, steps, ragged=False):

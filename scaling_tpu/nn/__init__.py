@@ -1,5 +1,10 @@
 from .activation_function import ActivationFunction, get_activation_function
-from .attention import ParallelSelfAttention, multi_head_attention, repeat_kv
+from .attention import (
+    PagedKVCacheView,
+    ParallelSelfAttention,
+    multi_head_attention,
+    repeat_kv,
+)
 from .base_layer import BaseLayer, ForwardContext, LayerSpec, PipelineBodySpec, TiedLayerSpec
 from .linear import (
     ColumnParallelLinear,
@@ -38,6 +43,7 @@ from .seq_packing import (
 __all__ = [
     "ActivationFunction",
     "get_activation_function",
+    "PagedKVCacheView",
     "ParallelSelfAttention",
     "multi_head_attention",
     "repeat_kv",

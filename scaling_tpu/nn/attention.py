@@ -23,7 +23,7 @@ TPU-first design choices:
 from __future__ import annotations
 
 import math
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -50,6 +50,101 @@ def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
     b, s, n_kv, h = x.shape
     x = jnp.broadcast_to(x[:, :, :, None, :], (b, s, n_kv, n_rep, h))
     return x.reshape(b, s, n_kv * n_rep, h)
+
+
+class PagedKVCacheView(NamedTuple):
+    """One layer's slice of the serving engine's block-paged KV pool
+    (serve/kvcache.py), plus the batch's addressing state.
+
+    The pool is a device-resident buffer of fixed-size blocks shared by
+    every in-flight sequence (PagedAttention, SOSP '23); each decode row
+    addresses its scattered blocks through ``block_table`` and its
+    logical length through ``context_len``. Block 0 is the TRASH block:
+    never allocated to content, it absorbs writes from inactive rows and
+    padding so the jitted decode step needs no per-row branching.
+
+    ``pool_k``/``pool_v`` are ``(num_blocks, block_size, n_kv, h)``;
+    float (dense) or int8 with per-slot-per-head ``scale_k``/``scale_v``
+    of shape ``(num_blocks, block_size, n_kv)`` (quantized KV).
+    """
+
+    pool_k: jax.Array
+    pool_v: jax.Array
+    block_table: jax.Array  # (b, max_blocks) int32 block ids; 0 = trash
+    context_len: jax.Array  # (b,) int32 tokens already cached per row
+    scale_k: Optional[jax.Array] = None
+    scale_v: Optional[jax.Array] = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.scale_k is not None
+
+
+def kv_quantize_int8(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-token-per-head int8: ``x`` (..., n_kv, h) -> (q, scale)
+    with ``scale`` (..., n_kv). The ONE quantizer both the prefill pool
+    writer (serve/kvcache.py) and the decode-step write below use, so the
+    cache a prompt left behind and the cache decode appends to can never
+    disagree about the rounding."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def kv_dequantize_int8(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    return (q.astype(jnp.float32) * scale[..., None].astype(jnp.float32)).astype(dtype)
+
+
+def paged_flat_slots(block_table: jax.Array, positions: jax.Array,
+                     block_size: int) -> jax.Array:
+    """Map per-row logical token ``positions`` (b, s) to flat pool slots
+    ``block_id * block_size + offset`` via each row's block table.
+    Positions past the table's reach route into the trash block (id 0 by
+    convention sits at flat slots [0, block_size)) — NEVER into the
+    row's last real block, where a clamped write would silently corrupt
+    live cache."""
+    max_blocks = block_table.shape[1]
+    blk_idx = positions // block_size
+    blocks = jnp.take_along_axis(
+        block_table, jnp.clip(blk_idx, 0, max_blocks - 1), axis=1
+    )
+    blocks = jnp.where(blk_idx < max_blocks, blocks, 0)
+    return blocks * block_size + positions % block_size
+
+
+def paged_scatter_kv(view: PagedKVCacheView, flat: jax.Array,
+                     k_rows: jax.Array, v_rows: jax.Array) -> PagedKVCacheView:
+    """Scatter new K/V rows (``(n, n_kv, h)``) into the pool at flat
+    slots ``flat`` (``(n,)``), quantizing when the pool is int8 — the ONE
+    pool writer shared by the decode step (``_paged_attention``) and the
+    prefill writer (serve/kvcache.py), so the cache a prompt left behind
+    and the cache decode appends to can never disagree about layout or
+    rounding. Returns the view with updated pools (tables/lengths
+    untouched)."""
+    num_blocks, block_size = view.pool_k.shape[0], view.pool_k.shape[1]
+    flat_len = num_blocks * block_size
+    pk = view.pool_k.reshape(flat_len, *view.pool_k.shape[2:])
+    pv = view.pool_v.reshape(flat_len, *view.pool_v.shape[2:])
+    scale_k, scale_v = view.scale_k, view.scale_v
+    if view.quantized:
+        qk, sk = kv_quantize_int8(k_rows)
+        qv, sv = kv_quantize_int8(v_rows)
+        pk = pk.at[flat].set(qk)
+        pv = pv.at[flat].set(qv)
+        scale_k = view.scale_k.reshape(flat_len, -1)
+        scale_v = view.scale_v.reshape(flat_len, -1)
+        scale_k = scale_k.at[flat].set(sk).reshape(view.scale_k.shape)
+        scale_v = scale_v.at[flat].set(sv).reshape(view.scale_v.shape)
+    else:
+        pk = pk.at[flat].set(k_rows.astype(pk.dtype))
+        pv = pv.at[flat].set(v_rows.astype(pv.dtype))
+    return view._replace(
+        pool_k=pk.reshape(view.pool_k.shape),
+        pool_v=pv.reshape(view.pool_v.shape),
+        scale_k=scale_k, scale_v=scale_v,
+    )
 
 
 def flash_path_active(
@@ -312,6 +407,21 @@ class ParallelSelfAttention(BaseLayer):
 
         new_kv = (k, v) if return_kv else None
 
+        if isinstance(kv_cache, PagedKVCacheView):
+            # block-paged decode (serve/): append the new tokens' K/V into
+            # the shared block pool at each row's next slots, then attend
+            # over the row's gathered blocks. position_ids stays the rotary
+            # clock (applied above); context_len is the causal clock.
+            assert attention_scores_manipulation is None, (
+                "attention_scores_manipulation is unsupported on the paged "
+                "decode path"
+            )
+            assert self.num_local_attention_heads == 0, (
+                "local-window heads are unsupported on the paged decode path"
+            )
+            out, new_view = self._paged_attention(q, k, v, kv_cache, b, s)
+            return self._project_out(params, out, ctx, b, s, new_view)
+
         if kv_cache is not None:
             # incremental decode / token-slice pipelining: append new k/v at
             # cache_offset. A 3-tuple cache carries the cached slots'
@@ -482,6 +592,57 @@ class ParallelSelfAttention(BaseLayer):
             )
 
         return self._project_out(params, out, ctx, b, s, new_kv)
+
+    def _paged_attention(self, q, k, v, view: PagedKVCacheView, b: int, s: int):
+        """Decode through the block-paged KV pool: scatter the ``s`` new
+        tokens per row into the pool, gather each row's blocks back as a
+        contiguous (b, max_blocks*block_size, n_kv, h) window, and run the
+        unfused attention with slot-validity + causal masking. One jitted
+        program serves every mix of sequence lengths — raggedness lives
+        entirely in ``block_table``/``context_len``, never in shapes."""
+        block_size = view.pool_k.shape[1]
+        max_blocks = view.block_table.shape[1]
+        window = max_blocks * block_size
+        ctx_len = view.context_len.astype(jnp.int32)
+
+        # --- write: rows' next s slots (inactive rows: table is all-trash)
+        positions = ctx_len[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+        flat = paged_flat_slots(view.block_table, positions, block_size)
+        new_view = paged_scatter_kv(
+            view, flat.reshape(-1),
+            k.reshape(b * s, *k.shape[2:]), v.reshape(b * s, *v.shape[2:]),
+        )
+
+        # --- gather: each row's blocks as one contiguous KV window
+        gk = new_view.pool_k[view.block_table]  # (b, max_blocks, bs, n_kv, h)
+        gv = new_view.pool_v[view.block_table]
+        gk = gk.reshape(b, window, *gk.shape[3:])
+        gv = gv.reshape(b, window, *gv.shape[3:])
+        if view.quantized:
+            gsk = new_view.scale_k[view.block_table].reshape(b, window, -1)
+            gsv = new_view.scale_v[view.block_table].reshape(b, window, -1)
+            gk = kv_dequantize_int8(gk, gsk, k.dtype)
+            gv = kv_dequantize_int8(gv, gsv, v.dtype)
+
+        # masking runs on LOGICAL slot indices (the causal clock), exactly
+        # like the dense cache path: unwritten slots are invalid, written
+        # slots obey causal order against the query's slot
+        slots_k = jnp.broadcast_to(
+            jnp.arange(window, dtype=jnp.int32)[None, :], (b, window)
+        )
+        slots_q = positions  # (b, s)
+        valid_k = slots_k < (ctx_len + s)[:, None]
+        allowed = valid_k[:, None, :] & (
+            slots_k[:, None, :] <= slots_q[:, :, None]
+        )
+        mask = ~allowed[:, None, :, :]
+
+        gk = repeat_kv(gk, self.num_repeat_kv)
+        gv = repeat_kv(gv, self.num_repeat_kv)
+        out = multi_head_attention(
+            q, gk, gv, mask, self.scaling_factor, self.masked_softmax, None
+        )
+        return out, new_view
 
     def _project_out(self, params, out, ctx, b, s, new_kv):
         """Shared epilogue: heads -> hidden, dense projection + LoRA delta."""

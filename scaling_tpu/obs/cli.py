@@ -41,6 +41,13 @@ def main(argv=None) -> int:
                         help="fail (exit 1) when the tuner's relative "
                         "prediction error vs measured step time exceeds "
                         "CEIL (docs/TUNING.md calibration loop)")
+    parser.add_argument("--assert-serve-throughput", type=float,
+                        metavar="FLOOR",
+                        help="fail (exit 1) when serving output tokens/s "
+                        "is below FLOOR (docs/SERVING.md gates)")
+    parser.add_argument("--assert-ttft", type=float, metavar="CEIL",
+                        help="fail (exit 1) when serving p99 "
+                        "time-to-first-token exceeds CEIL seconds")
     args = parser.parse_args(argv)
 
     run_dir = Path(args.run_dir)
@@ -65,9 +72,13 @@ def main(argv=None) -> int:
         assert_step_time=args.assert_step_time,
         assert_tuner_calibration=args.assert_tuner_calibration,
         tuner_stats=tuner_stats,
+        assert_serve_throughput=args.assert_serve_throughput,
+        assert_ttft=args.assert_ttft,
     )
     if (args.assert_mfu is not None or args.assert_step_time is not None
-            or args.assert_tuner_calibration is not None):
+            or args.assert_tuner_calibration is not None
+            or args.assert_serve_throughput is not None
+            or args.assert_ttft is not None):
         print("== gates ==")
         if failures:
             for f in failures:
@@ -76,8 +87,10 @@ def main(argv=None) -> int:
             print("  PASS")
 
     if args.json:
+        from .report import serving_section
+
         _, stats = mfu_section(data)
-        stats = {**stats, **tuner_stats}
+        stats = {**stats, **tuner_stats, **serving_section(data)[1]}
         payload = {
             "files": data.files,
             "bad_lines": data.bad_lines,

@@ -446,6 +446,89 @@ def tuner_section(data: RunData) -> Tuple[List[str], Dict[str, float]]:
     return lines, stats
 
 
+def serving_section(data: RunData) -> Tuple[List[str], Dict[str, float]]:
+    """Serving-engine health (docs/SERVING.md): per-request
+    ``serve-request`` events carry TTFT / ITL / preemption counts, the
+    final ``serve-summary`` carries wall-clock throughput. Percentiles
+    are computed over the per-request events (exact, not histogram
+    buckets); throughput comes from the summary event when present and
+    falls back to tokens/wall derived from the request events. Rendered
+    only when serve events exist, so training run dirs (and the
+    committed golden reports) are unchanged. The returned stats feed the
+    ``--assert-serve-throughput`` / ``--assert-ttft`` gates."""
+    reqs = [e for e in data.lifecycle if e.get("event") == "serve-request"]
+    summaries = [
+        e for e in data.lifecycle if e.get("event") == "serve-summary"
+    ]
+    if not reqs and not summaries:
+        return [], {}
+    lines = ["== serving =="]
+    stats: Dict[str, float] = {}
+    ttfts = sorted(
+        float(e["ttft_s"]) for e in reqs if e.get("ttft_s") is not None
+    )
+    if summaries:
+        s = summaries[-1]
+        try:
+            stats["serve_tokens_per_s"] = float(s["tokens_per_s"])
+            lines.append(
+                f"  throughput: {stats['serve_tokens_per_s']:.1f} output "
+                f"tokens/s ({int(s.get('output_tokens', 0))} tokens over "
+                f"{float(s.get('wall_s', 0.0)):.3f}s, "
+                f"{int(s.get('requests', 0))} request(s))"
+            )
+        except (KeyError, TypeError, ValueError):
+            lines.append("  throughput: (summary event carries no "
+                         "tokens_per_s)")
+        lines.append(
+            f"  engine: ticks={int(s.get('ticks', 0))} "
+            f"preemptions={int(s.get('preemptions', 0))} "
+            f"prefill_compiles={int(s.get('prefill_compiles', 0))}"
+        )
+    elif reqs:
+        # crashed/partial run: derive throughput from what finished
+        tokens = sum(int(e.get("output_tokens", 0)) for e in reqs)
+        ts = [float(e["ts"]) for e in reqs if e.get("ts") is not None]
+        wall = max(ts) - min(ts) if len(ts) > 1 else 0.0
+        if wall > 0:
+            stats["serve_tokens_per_s"] = tokens / wall
+            lines.append(
+                f"  throughput: {stats['serve_tokens_per_s']:.1f} output "
+                f"tokens/s ({tokens} tokens, derived from "
+                f"{len(reqs)} request events — no serve-summary)"
+            )
+        else:
+            lines.append(
+                f"  throughput: ({tokens} tokens over {len(reqs)} "
+                "request(s); too few events to derive a rate)"
+            )
+    if ttfts:
+        stats["serve_ttft_p50_s"] = percentile(ttfts, 50)
+        stats["serve_ttft_p99_s"] = percentile(ttfts, 99)
+        lines.append(
+            f"  ttft: p50={_fmt_s(stats['serve_ttft_p50_s'])} "
+            f"p99={_fmt_s(stats['serve_ttft_p99_s'])} "
+            f"max={_fmt_s(max(ttfts))} (n={len(ttfts)})"
+        )
+    if reqs:
+        itls = sorted(
+            float(e["itl_mean_s"]) for e in reqs
+            if e.get("itl_mean_s") is not None
+        )
+        if itls:
+            lines.append(
+                f"  itl (per-request mean): p50={_fmt_s(percentile(itls, 50))} "
+                f"p99={_fmt_s(percentile(itls, 99))}"
+            )
+        preempted = sum(1 for e in reqs if int(e.get("preemptions", 0)) > 0)
+        if preempted:
+            lines.append(
+                f"  preempted-and-resumed: {preempted} of {len(reqs)} "
+                "request(s)"
+            )
+    return lines, stats
+
+
 def timeline_section(data: RunData) -> List[str]:
     lines = ["== restart / preemption timeline =="]
     lifecycle = data.lifecycle
@@ -491,12 +574,14 @@ def render_report(data: RunData, run_dir: Path | str = "") -> str:
     ]
     mfu_lines, _ = mfu_section(data)
     tuner_lines, _ = tuner_section(data)
+    serving_lines, _ = serving_section(data)
     sections = [
         header,
         step_time_section(data),
         mfu_lines,
         pipeline_section(data),  # empty (omitted) for non-pipelined runs
         tuner_lines,  # empty (omitted) for untuned runs
+        serving_lines,  # empty (omitted) for non-serving runs
         barrier_section(data),
         checkpoint_section(data),
         timeline_section(data),
@@ -507,7 +592,9 @@ def render_report(data: RunData, run_dir: Path | str = "") -> str:
 def check_gates(data: RunData, assert_mfu: Optional[float] = None,
                 assert_step_time: Optional[float] = None,
                 assert_tuner_calibration: Optional[float] = None,
-                tuner_stats: Optional[Dict[str, float]] = None) -> List[str]:
+                tuner_stats: Optional[Dict[str, float]] = None,
+                assert_serve_throughput: Optional[float] = None,
+                assert_ttft: Optional[float] = None) -> List[str]:
     """CI-style regression gates; returns failure messages (empty ==
     pass). Missing data FAILS a requested gate — a run that recorded no
     MFU must not pass an MFU floor by silence. ``tuner_stats`` lets a
@@ -515,6 +602,40 @@ def check_gates(data: RunData, assert_mfu: Optional[float] = None,
     instead of re-aggregating the spans."""
     _, stats = mfu_section(data)
     failures: List[str] = []
+    if assert_serve_throughput is not None or assert_ttft is not None:
+        _, sstats = serving_section(data)
+        if assert_serve_throughput is not None:
+            tps = sstats.get("serve_tokens_per_s")
+            if tps is None:
+                has_serve_events = any(
+                    e.get("event") in ("serve-request", "serve-summary")
+                    for e in data.lifecycle
+                )
+                failures.append(
+                    "assert-serve-throughput: "
+                    + ("no serve-summary and too few serve-request events "
+                       "to derive a rate (crashed/short run?)"
+                       if has_serve_events else
+                       "no serving telemetry in the run dir (no "
+                       "serve-summary / serve-request events)")
+                )
+            elif tps < assert_serve_throughput:
+                failures.append(
+                    f"assert-serve-throughput: {tps:.1f} output tokens/s "
+                    f"< floor {assert_serve_throughput:.1f}"
+                )
+        if assert_ttft is not None:
+            p99 = sstats.get("serve_ttft_p99_s")
+            if p99 is None:
+                failures.append(
+                    "assert-ttft: no per-request TTFT samples in the run "
+                    "dir (no serve-request events)"
+                )
+            elif p99 > assert_ttft:
+                failures.append(
+                    f"assert-ttft: p99 TTFT {p99:.4f}s > ceiling "
+                    f"{assert_ttft:.4f}s"
+                )
     if assert_tuner_calibration is not None:
         tstats = (
             tuner_stats if tuner_stats is not None

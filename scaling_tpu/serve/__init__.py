@@ -1,0 +1,42 @@
+"""Continuous-batching inference serving (docs/SERVING.md).
+
+The "millions of users" half of the north star: turns the single-request
+``TransformerInferenceModule`` generate loop into a serving engine —
+
+- :mod:`.kvcache` — block-paged KV cache: fixed-size blocks allocated
+  from one device-resident pool per layer, addressed through per-sequence
+  block tables (PagedAttention, SOSP '23); optional int8-quantized values.
+- :mod:`.scheduler` — continuous batching (Orca, OSDI '22): admission
+  from a request queue, per-tick prefill/decode mixing under a token
+  budget, preemption on pool exhaustion, completed-slot recycling.
+- :mod:`.engine` — the jitted device programs: one bucketed prefill per
+  prompt-length bucket, ONE decode program for the whole slot set (no
+  per-request recompiles; signatures pinned in the ``serve_decode`` HLO
+  audit section).
+- :mod:`.bench` / ``python -m scaling_tpu.serve bench`` — Poisson
+  load generator reporting tokens/s and TTFT/ITL percentiles through
+  ``obs.get_registry()``, gated by ``--assert-serve-throughput`` /
+  ``--assert-ttft`` (mirroring the training MFU gates).
+
+jax-free at import time (the engine imports it lazily): the scheduler and
+request/bench plumbing must stay importable from the analyzer and tests
+without paying backend init.
+"""
+
+from .scheduler import (
+    BlockAllocator,
+    ContinuousBatchingScheduler,
+    Request,
+    SchedulerConfig,
+    Sequence,
+    SequenceState,
+)
+
+__all__ = [
+    "BlockAllocator",
+    "ContinuousBatchingScheduler",
+    "Request",
+    "SchedulerConfig",
+    "Sequence",
+    "SequenceState",
+]

@@ -1,0 +1,24 @@
+"""``python -m scaling_tpu.serve bench`` — serving benchmark entrypoint."""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m scaling_tpu.serve bench [options]\n"
+              "       (see `python -m scaling_tpu.serve bench --help`)")
+        return 0 if argv else 2
+    command, rest = argv[0], argv[1:]
+    if command != "bench":
+        print(f"unknown command {command!r}; have: bench", file=sys.stderr)
+        return 2
+    from .bench import main as bench_main
+
+    return bench_main(rest)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,294 @@
+"""Serving load generator: Poisson arrivals -> engine -> obs telemetry.
+
+``python -m scaling_tpu.serve bench`` drives the continuous-batching
+engine with an open-loop Poisson arrival process (exponential
+inter-arrival gaps at ``--rate`` req/s) and prompt/output lengths sampled
+uniformly from ``--prompt-len``/``--output-len`` ranges, then reports
+tokens/s, p50/p99 time-to-first-token and inter-token latency.
+
+Telemetry rides the SAME rails training uses (docs/OBSERVABILITY.md):
+metrics through ``obs.get_registry()`` (flushed to ``<run-dir>/
+metrics.jsonl``), per-request ``serve-request`` + final ``serve-summary``
+events through ``logger.log_event`` — so ``python -m scaling_tpu.obs
+report <run-dir>`` grows a serving section, and the
+``--assert-serve-throughput`` / ``--assert-ttft`` gates work both here
+(self-gating, like ``bench.py --assert-mfu``) and on the analyzer over
+the run dir (CI reads the artifacts, not the console).
+
+The model is a randomly initialised toy transformer by default (the
+benchmark measures the ENGINE: scheduling, paging, recompile hygiene);
+``--checkpoint`` serves a real one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+from typing import List, Optional
+
+from .engine import EngineConfig, ServeEngine
+
+
+def build_toy_inference(hidden: int = 64, layers: int = 2, vocab: int = 128,
+                        heads: int = 4, seq_len: int = 256):
+    """Random-init tiny model wrapped for inference (no checkpoint)."""
+    import jax
+
+    from ..models.transformer import TransformerConfig
+    from ..models.transformer.inference import TransformerInferenceModule
+    from ..models.transformer.model import init_model
+
+    config = TransformerConfig.from_dict({
+        "topology": {
+            "model_parallel_size": 1, "pipe_parallel_size": 1,
+            "data_parallel_size": 1, "micro_batch_size": 1,
+            "gradient_accumulation_steps": 1,
+        },
+        "transformer_architecture": {
+            "vocab_size": vocab, "hidden_size": hidden, "num_layers": layers,
+            "num_attention_heads": heads, "sequence_length": seq_len,
+            "mlp_type": "swiglu", "mlp_factor": 2.0, "norm_type": "rms",
+            "weight_tying": False,
+        },
+        "optimizer": {"gradient_clipping": 1.0},
+        "learning_rate_scheduler": {
+            "learning_rate": 3e-4, "learning_rate_warmup_steps": 10,
+            "learning_rate_decay_iters": 100,
+        },
+        "trainer": {"train_iterations": 1, "seed": 0},
+        "data": {}, "logger": {"log_dir": None},
+    })
+    module = init_model(config, None)
+    params = module.init_params(jax.random.PRNGKey(0))
+    return TransformerInferenceModule(config, module, params)
+
+
+def sample_workload(n_requests: int, rate: float, prompt_len, output_len,
+                    vocab: int, seed: int):
+    """Poisson arrival offsets + per-request prompts/output budgets."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate, size=n_requests)
+    arrivals = np.cumsum(gaps)
+    arrivals[0] = 0.0  # the first request opens the run
+    work = []
+    for i in range(n_requests):
+        plen = int(rng.integers(prompt_len[0], prompt_len[1] + 1))
+        olen = int(rng.integers(output_len[0], output_len[1] + 1))
+        prompt = rng.integers(1, vocab, size=plen).tolist()
+        work.append((float(arrivals[i]), prompt, olen))
+    return work
+
+
+def run_bench(engine: ServeEngine, workload, time_scale: float = 1.0,
+              max_wall_s: float = 600.0) -> dict:
+    """Open-loop drive: submit each request when the wall clock crosses
+    its arrival offset, tick the engine continuously, drain. Returns the
+    summary stats dict (also emitted as the ``serve-summary`` event)."""
+    from ..logging import logger
+    from ..obs import get_registry, span
+
+    t0 = time.monotonic()
+    pending = sorted(workload, key=lambda w: w[0])
+    idx = 0
+    while idx < len(pending) or engine.scheduler.has_work:
+        now = time.monotonic() - t0
+        if now > max_wall_s:
+            raise RuntimeError(
+                f"bench exceeded --max-wall-s={max_wall_s}: "
+                f"{idx}/{len(pending)} submitted, "
+                f"{len(engine.finished)} finished"
+            )
+        while idx < len(pending) and pending[idx][0] * time_scale <= now:
+            arrival, prompt, olen = pending[idx]
+            engine.submit(prompt, olen, arrival_s=t0 + arrival * time_scale)
+            idx += 1
+        if engine.scheduler.has_work:
+            with span("serve.tick", step=engine.tick_index):
+                engine.tick()
+        elif idx < len(pending):
+            # idle until the next arrival (clamped: stay responsive)
+            wait = pending[idx][0] * time_scale - (time.monotonic() - t0)
+            if wait > 0:
+                time.sleep(min(wait, 0.05))
+
+    wall_s = time.monotonic() - t0
+    seqs = engine.finished
+    ttfts = sorted(s.first_token_s - s.request.arrival_s for s in seqs)
+    itls: List[float] = []
+    for s in seqs:
+        itls.extend(b - a for a, b in zip(s.token_stamps, s.token_stamps[1:]))
+    itls.sort()
+    total_tokens = sum(len(s.generated) for s in seqs)
+
+    # the SAME nearest-rank percentile `obs report` uses over the run
+    # dir, so the self-gate here and the CI gate there can never
+    # disagree about the same run's p99
+    from ..obs.report import percentile
+
+    def pct(vals, q):
+        return percentile(vals, q) if vals else None
+
+    stats = {
+        "requests": len(seqs),
+        "wall_s": round(wall_s, 6),
+        "output_tokens": total_tokens,
+        "prompt_tokens": sum(len(s.request.prompt) for s in seqs),
+        "tokens_per_s": round(total_tokens / wall_s, 3) if wall_s > 0 else 0.0,
+        "ttft_p50_s": pct(ttfts, 50),
+        "ttft_p99_s": pct(ttfts, 99),
+        "itl_p50_s": pct(itls, 50),
+        "itl_p99_s": pct(itls, 99),
+        "preemptions": engine.scheduler.preemption_count,
+        "ticks": engine.tick_index,
+        "prefill_compiles": len(engine._prefill_fns),
+    }
+    logger.log_event("serve-summary", **stats)
+    get_registry().flush_step(engine.tick_index)
+    return stats
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m scaling_tpu.serve bench",
+        description="continuous-batching serving benchmark (docs/SERVING.md)",
+    )
+    parser.add_argument("--requests", type=int, default=16)
+    parser.add_argument("--rate", type=float, default=8.0,
+                        help="Poisson arrival rate, requests/second")
+    parser.add_argument("--prompt-len", type=int, nargs=2, default=(4, 24),
+                        metavar=("MIN", "MAX"))
+    parser.add_argument("--output-len", type=int, nargs=2, default=(4, 16),
+                        metavar=("MIN", "MAX"))
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--run-dir", default="runs/serve_bench",
+                        help="telemetry output dir (events + metrics jsonl)")
+    # engine shape knobs (all land in the jitted programs' signatures)
+    parser.add_argument("--num-slots", type=int, default=8)
+    parser.add_argument("--block-size", type=int, default=16)
+    parser.add_argument("--num-blocks", type=int, default=128)
+    parser.add_argument("--max-blocks-per-seq", type=int, default=16)
+    parser.add_argument("--token-budget", type=int, default=512)
+    parser.add_argument("--kv-dtype", choices=["native", "int8"],
+                        default="native")
+    # toy model knobs / real checkpoint
+    parser.add_argument("--hidden", type=int, default=64)
+    parser.add_argument("--layers", type=int, default=2)
+    parser.add_argument("--vocab", type=int, default=128)
+    parser.add_argument("--heads", type=int, default=4)
+    parser.add_argument("--checkpoint", help="serve a real checkpoint dir "
+                        "instead of the random toy model")
+    parser.add_argument("--max-wall-s", type=float, default=600.0)
+    parser.add_argument("--json", metavar="FILE",
+                        help="also write the summary stats as JSON")
+    parser.add_argument("--assert-serve-throughput", type=float,
+                        metavar="FLOOR",
+                        help="fail (exit 1) when output tokens/s is below "
+                        "FLOOR (same gate `obs report` applies to the "
+                        "run dir)")
+    parser.add_argument("--assert-ttft", type=float, metavar="CEIL",
+                        help="fail (exit 1) when p99 time-to-first-token "
+                        "exceeds CEIL seconds")
+    args = parser.parse_args(argv)
+    if args.requests < 1:
+        parser.error("--requests must be >= 1")
+    if args.rate <= 0:
+        parser.error("--rate must be > 0")
+    for flag, (lo, hi), floor in (("--prompt-len", args.prompt_len, 1),
+                                  ("--output-len", args.output_len, 1)):
+        if lo < floor or hi < lo:
+            parser.error(f"{flag} needs {floor} <= MIN <= MAX, got {lo} {hi}")
+
+    import os
+
+    run_dir = Path(args.run_dir)
+    run_dir.mkdir(parents=True, exist_ok=True)
+    # telemetry rails: events via the logger's env hook, metrics via the
+    # registry's explicit sink (mirrors how the supervisor wires hosts)
+    os.environ.setdefault(
+        "SCALING_TPU_EVENTS_PATH", str(run_dir / "events.jsonl")
+    )
+    from ..obs import get_registry
+
+    get_registry().configure(metrics_path=str(run_dir / "metrics.jsonl"))
+
+    if args.checkpoint:
+        from ..models.transformer.inference import TransformerInferenceModule
+
+        inf = TransformerInferenceModule.from_checkpoint(args.checkpoint)
+        vocab = inf.architecture.vocab_size
+    else:
+        inf = build_toy_inference(
+            hidden=args.hidden, layers=args.layers, vocab=args.vocab,
+            heads=args.heads,
+        )
+        vocab = args.vocab
+
+    cap = args.max_blocks_per_seq * args.block_size
+    if args.prompt_len[1] + args.output_len[1] > cap:
+        print(
+            f"error: prompt+output can reach "
+            f"{args.prompt_len[1] + args.output_len[1]} tokens but the "
+            f"block table holds {cap}; raise --max-blocks-per-seq or "
+            "--block-size", file=sys.stderr,
+        )
+        return 2
+
+    engine = ServeEngine(inf, EngineConfig(
+        num_slots=args.num_slots, block_size=args.block_size,
+        num_blocks=args.num_blocks,
+        max_blocks_per_seq=args.max_blocks_per_seq,
+        token_budget=args.token_budget, kv_dtype=args.kv_dtype,
+    ))
+    workload = sample_workload(
+        args.requests, args.rate, tuple(args.prompt_len),
+        tuple(args.output_len), vocab, args.seed,
+    )
+    stats = run_bench(engine, workload, max_wall_s=args.max_wall_s)
+
+    print("== serve bench ==")
+    print(f"  requests={stats['requests']} wall={stats['wall_s']:.3f}s "
+          f"ticks={stats['ticks']} preemptions={stats['preemptions']} "
+          f"prefill_compiles={stats['prefill_compiles']}")
+    print(f"  output tokens/s: {stats['tokens_per_s']:.1f} "
+          f"({stats['output_tokens']} tokens)")
+    print(f"  ttft: p50={stats['ttft_p50_s']:.4f}s "
+          f"p99={stats['ttft_p99_s']:.4f}s")
+    if stats["itl_p50_s"] is not None:
+        print(f"  itl:  p50={stats['itl_p50_s']:.4f}s "
+              f"p99={stats['itl_p99_s']:.4f}s")
+    print(f"  run dir: {run_dir} (analyze: python -m scaling_tpu.obs "
+          f"report {run_dir})")
+
+    if args.json:
+        Path(args.json).write_text(json.dumps(stats, indent=1) + "\n")
+
+    failures = []
+    if (args.assert_serve_throughput is not None
+            and stats["tokens_per_s"] < args.assert_serve_throughput):
+        failures.append(
+            f"assert-serve-throughput: {stats['tokens_per_s']:.1f} tokens/s "
+            f"< floor {args.assert_serve_throughput:.1f}"
+        )
+    if args.assert_ttft is not None and (
+            stats["ttft_p99_s"] is None
+            or stats["ttft_p99_s"] > args.assert_ttft):
+        failures.append(
+            f"assert-ttft: p99 TTFT {stats['ttft_p99_s']}s "
+            f"> ceiling {args.assert_ttft}s"
+        )
+    if args.assert_serve_throughput is not None or args.assert_ttft is not None:
+        print("== gates ==")
+        for f in failures:
+            print(f"  FAIL {f}")
+        if not failures:
+            print("  PASS")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,323 @@
+"""The serving engine: jitted prefill/decode programs + the tick loop.
+
+Prefill/decode split (Orca; Sarathi): per tick the scheduler mixes new
+prompts (prefill — compute-bound, runs through the SAME ``prefill_forward``
+the dense-cache generate path uses, so the flash kernel stays active) with
+one decode token for every running sequence (memory-bound, one jitted
+program over the WHOLE slot set).
+
+No per-request recompiles, by construction:
+
+- the decode program compiles ONCE per engine: its shapes are the fixed
+  ``(num_slots, max_blocks_per_seq)`` batch — sequence raggedness lives in
+  block tables and context lengths, never in shapes;
+- prefill compiles once per PROMPT-LENGTH BUCKET (power-of-two ladder);
+  prompts are right-padded to their bucket, pads sit in their own
+  attention segment and write KV to the trash block.
+
+Both signatures are pinned in the ``serve_decode`` HLO-audit section
+(analysis/goldens/serve_decode.json): a scheduler shape-bucketing change
+that would trigger a recompile storm on the chip shows up as golden
+drift in CI instead.
+
+Greedy (argmax) sampling: continuous batching re-batches requests across
+ticks, and greedy decode is what makes a preempted-and-resumed sequence
+regenerate token-for-token (scheduler.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+from .. import obs
+from ..logging import logger
+from .kvcache import PagedKVPools, init_pools, write_prompt_kv
+from .scheduler import (
+    ContinuousBatchingScheduler,
+    Request,
+    SchedulerConfig,
+    Sequence,
+    Tick,
+)
+
+MIN_PREFILL_BUCKET = 8
+
+
+def prefill_bucket(prompt_len: int) -> int:
+    """Power-of-two length ladder; every prompt length in a bucket shares
+    one compiled prefill program."""
+    b = MIN_PREFILL_BUCKET
+    while b < prompt_len:
+        b *= 2
+    return b
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    num_slots: int = 8
+    block_size: int = 16
+    num_blocks: int = 128
+    max_blocks_per_seq: int = 16
+    token_budget: int = 512
+    kv_dtype: str = "native"  # 'native' | 'int8'
+    flush_interval: int = 50  # registry flush cadence (ticks)
+
+    def scheduler_config(self) -> SchedulerConfig:
+        return SchedulerConfig(
+            num_slots=self.num_slots, block_size=self.block_size,
+            num_blocks=self.num_blocks,
+            max_blocks_per_seq=self.max_blocks_per_seq,
+            token_budget=self.token_budget,
+        )
+
+
+class ServeEngine:
+    """Continuous-batching engine over a ``TransformerInferenceModule``."""
+
+    def __init__(self, inference_module, config: Optional[EngineConfig] = None):
+        import jax
+
+        self.inf = inference_module
+        self.config = config or EngineConfig()
+        self.scheduler = ContinuousBatchingScheduler(
+            self.config.scheduler_config()
+        )
+        self.pools: PagedKVPools = init_pools(
+            inference_module, self.config.num_blocks, self.config.block_size,
+            kv_dtype=self.config.kv_dtype,
+        )
+        import numpy as np
+
+        self._np = np
+        self._jax = jax
+        n, m = self.config.num_slots, self.config.max_blocks_per_seq
+        self._tables = np.zeros((n, m), np.int32)
+        self._ctx = np.zeros((n,), np.int32)
+        self._tok = np.zeros((n,), np.int32)
+        self._decode_fn = None
+        self._prefill_fns: Dict[int, object] = {}
+        self.tick_index = 0
+        self.finished: List[Sequence] = []
+        self._next_req_id = 0
+        self._reg = obs.get_registry()
+
+    # ------------------------------------------------------------- intake
+    def submit(self, prompt: List[int], max_new_tokens: int,
+               arrival_s: Optional[float] = None,
+               eos_token_id: Optional[int] = None) -> Sequence:
+        req = Request(
+            req_id=self._next_req_id, prompt=list(prompt),
+            max_new_tokens=max_new_tokens,
+            arrival_s=time.monotonic() if arrival_s is None else arrival_s,
+            eos_token_id=eos_token_id,
+        )
+        self._next_req_id += 1
+        self._reg.counter("serve_requests_admitted_total").inc()
+        return self.scheduler.add_request(req)
+
+    # --------------------------------------------------- device programs
+    def _pool_state(self):
+        p = self.pools
+        return (p.pool_k, p.pool_v, p.scale_k, p.scale_v)
+
+    def _views_from_state(self, state, block_table, context_len):
+        pool_k, pool_v, scale_k, scale_v = state
+        from ..nn.attention import PagedKVCacheView
+
+        return [
+            PagedKVCacheView(
+                pool_k=pool_k[i], pool_v=pool_v[i],
+                block_table=block_table, context_len=context_len,
+                scale_k=None if scale_k is None else scale_k[i],
+                scale_v=None if scale_v is None else scale_v[i],
+            )
+            for i in range(len(pool_k))
+        ]
+
+    def _absorb(self, views) -> None:
+        self.pools.absorb_views(views)
+
+    def _build_prefill_fn(self, bucket: int):
+        jnp = self._jax.numpy
+        block_size = self.config.block_size
+
+        def prefill(params, state, tokens, block_row, prompt_len):
+            b, L = tokens.shape  # (1, bucket)
+            pos = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None], (b, L))
+            # bucket padding sits in its own segment: content never
+            # attends to it, it never attends to content
+            seg = jnp.where(pos < prompt_len, 0, 1).astype(jnp.int32)
+            logits, kvs = self.inf.prefill_forward(
+                params, tokens, pos, seg, last_index=prompt_len - 1
+            )
+            views = self._views_from_state(
+                state, block_row[None, :], jnp.zeros((1,), jnp.int32)
+            )
+            new_views = [
+                write_prompt_kv(view, k, v, block_row, prompt_len, block_size)
+                for view, (k, v) in zip(views, kvs)
+            ]
+            next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return next_tok, new_views
+
+        # same lifecycle as decode: the old pool state dies with the call
+        # (absorb_views takes the returned arrays), so donation lets XLA
+        # scatter in place instead of copying every layer's pool per
+        # admitted prompt. CPU can't donate (every call would warn).
+        donate = (1,) if self._jax.default_backend() != "cpu" else ()
+        return self._jax.jit(prefill, donate_argnums=donate)
+
+    def _build_decode_fn(self):
+        jnp = self._jax.numpy
+
+        def decode(params, state, tables, ctx_lens, tokens):
+            b = tokens.shape[0]
+            batch = self.inf._make_batch(tokens[:, None], ctx_lens[:, None])
+            views = self._views_from_state(state, tables, ctx_lens)
+            logits, new_views = self.inf._run_layers(params, batch, views, None)
+            next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return next_tok, new_views
+
+        # the pool state dies with each call — donating it lets XLA run
+        # the scatter updates in place instead of copying every pool
+        # block per token. CPU can't donate (every call would warn).
+        donate = (1,) if self._jax.default_backend() != "cpu" else ()
+        return self._jax.jit(decode, donate_argnums=donate)
+
+    # ------------------------------------------------------------- ticking
+    def _reset_rows(self, slots: List[int]) -> None:
+        for s in slots:
+            self._tables[s] = 0
+            self._ctx[s] = 0
+            self._tok[s] = 0
+
+    def _run_prefill(self, seq: Sequence) -> None:
+        np = self._np
+        prompt = seq.resume_prompt
+        bucket = prefill_bucket(len(prompt))
+        if bucket not in self._prefill_fns:
+            self._prefill_fns[bucket] = self._build_prefill_fn(bucket)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :len(prompt)] = prompt
+        block_row = np.zeros((self.config.max_blocks_per_seq,), np.int32)
+        block_row[:len(seq.blocks)] = seq.blocks
+        with obs.span("serve.prefill", step=self.tick_index,
+                      tokens=len(prompt)):
+            next_tok, new_views = self._prefill_fns[bucket](
+                self.inf.params, self._pool_state(),
+                self._jax.numpy.asarray(tokens),
+                self._jax.numpy.asarray(block_row),
+                self._jax.numpy.int32(len(prompt)),
+            )
+            tok = int(np.asarray(next_tok)[0])
+        self._absorb(new_views)
+        now = time.monotonic()
+        slot = seq.slot
+        self._tables[slot] = block_row
+        self._ctx[slot] = len(prompt)
+        self._tok[slot] = tok
+        seq.num_cached = len(prompt)
+        self._emit_token(seq, tok, now)
+        self._reg.counter("serve_prefill_tokens_total").inc(len(prompt))
+
+    def _run_decode(self, decodes: List[Sequence]) -> None:
+        np = self._np
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode_fn()
+        for seq in decodes:
+            # the scheduler may have grown this row's block list since the
+            # table row was last written (incremental allocation)
+            row = self._tables[seq.slot]
+            row[:] = 0
+            row[:len(seq.blocks)] = seq.blocks
+        with obs.span("serve.decode", step=self.tick_index,
+                      batch=len(decodes)):
+            next_tok, new_views = self._decode_fn(
+                self.inf.params, self._pool_state(),
+                self._jax.numpy.asarray(self._tables),
+                self._jax.numpy.asarray(self._ctx),
+                self._jax.numpy.asarray(self._tok),
+            )
+            toks = np.asarray(next_tok)
+        self._absorb(new_views)
+        now = time.monotonic()
+        for seq in decodes:
+            slot = seq.slot
+            self._ctx[slot] += 1
+            seq.num_cached += 1
+            tok = int(toks[slot])
+            self._tok[slot] = tok
+            self._emit_token(seq, tok, now)
+
+    def _emit_token(self, seq: Sequence, tok: int, now: float) -> None:
+        seq.generated.append(tok)
+        if seq.first_token_s is None:
+            seq.first_token_s = now
+            self._reg.histogram("serve_ttft_seconds").observe(
+                now - seq.request.arrival_s
+            )
+        elif seq.token_stamps:
+            self._reg.histogram("serve_itl_seconds").observe(
+                now - seq.token_stamps[-1]
+            )
+        seq.token_stamps.append(now)
+        self._reg.counter("serve_tokens_generated_total").inc()
+
+    def _finish(self, seq: Sequence, now: float) -> None:
+        self.scheduler.finish(seq)  # row reset rides the freed-slot drain
+        seq.finished_s = now
+        self.finished.append(seq)
+        self._reg.counter("serve_requests_completed_total").inc()
+        itl = [
+            b - a for a, b in zip(seq.token_stamps, seq.token_stamps[1:])
+        ]
+        logger.log_event(
+            "serve-request", _level="debug",
+            req=seq.request.req_id,
+            prompt_tokens=len(seq.request.prompt),
+            output_tokens=len(seq.generated),
+            ttft_s=round(seq.first_token_s - seq.request.arrival_s, 6),
+            e2e_s=round(now - seq.request.arrival_s, 6),
+            itl_mean_s=round(sum(itl) / len(itl), 6) if itl else 0.0,
+            preemptions=seq.preemptions,
+        )
+
+    def tick(self) -> Tick:
+        """One engine step: schedule, prefill admissions, decode the
+        running set, retire completions."""
+        t = self.scheduler.schedule()
+        if t.preempted:
+            self._reg.counter("serve_preemptions_total").inc(len(t.preempted))
+        self._reset_rows(self.scheduler.drain_freed_slots())
+        for seq in t.prefills:
+            self._run_prefill(seq)
+        if t.decodes:
+            self._run_decode(t.decodes)
+        now = time.monotonic()
+        for seq in list(t.prefills) + list(t.decodes):
+            if seq.done and seq.slot is not None:
+                self._finish(seq, now)
+        self._reset_rows(self.scheduler.drain_freed_slots())
+        for name, value in self.scheduler.gauges().items():
+            self._reg.gauge(name).set(value)
+        self.tick_index += 1
+        if self.tick_index % self.config.flush_interval == 0:
+            self._reg.flush_step(self.tick_index)
+        return t
+
+    def run_until_done(self, max_ticks: int = 100_000) -> List[Sequence]:
+        """Drain every submitted request; returns finished sequences in
+        completion order."""
+        ticks = 0
+        while self.scheduler.has_work:
+            self.tick()
+            ticks += 1
+            if ticks > max_ticks:
+                raise RuntimeError(
+                    f"engine made no progress draining the queue within "
+                    f"{max_ticks} ticks — scheduler livelock?"
+                )
+        self._reg.flush_step(self.tick_index)
+        return self.finished

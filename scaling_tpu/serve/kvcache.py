@@ -1,0 +1,134 @@
+"""Block-paged KV cache pools (PagedAttention, SOSP '23).
+
+One device-resident pool per transformer layer: ``(num_blocks,
+block_size, n_kv, h)`` for keys and values, carved into fixed-size
+blocks that sequences of wildly different lengths share through
+per-sequence block tables (replacing the per-request fixed-capacity
+``_alloc_caches`` buffers, whose dense ``(b, prompt+max_tokens)`` shape
+charged every row the longest row's memory).
+
+KV shapes come from an abstract probe of the real layer stack
+(``jax.eval_shape`` over ``prefill_forward``), the same idiom as
+``TransformerLayer.init_token_slice_cache`` — GQA / head-dim / dtype
+choices can never drift from the attention that fills the pool.
+
+``kv_dtype='int8'`` stores values quantized with per-slot-per-head
+scales; the quantizer lives in ``nn/attention.py`` (``kv_quantize_int8``)
+so the prefill writer here and the decode-step write inside
+``ParallelSelfAttention`` round identically.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.attention import (
+    PagedKVCacheView,
+    paged_flat_slots,
+    paged_scatter_kv,
+)
+
+
+class PagedKVPools:
+    """Per-layer block pools (the engine builds per-layer views from the
+    raw state inside its jitted programs — ``_views_from_state``).
+
+    Pytree-friendly: the device state is plain lists of arrays so the
+    jitted prefill/decode programs thread it straight through."""
+
+    def __init__(self, pool_k: List[jax.Array], pool_v: List[jax.Array],
+                 scale_k: Optional[List[jax.Array]],
+                 scale_v: Optional[List[jax.Array]],
+                 block_size: int):
+        self.pool_k = pool_k
+        self.pool_v = pool_v
+        self.scale_k = scale_k
+        self.scale_v = scale_v
+        self.block_size = block_size
+
+    @property
+    def num_layers(self) -> int:
+        return len(self.pool_k)
+
+    @property
+    def quantized(self) -> bool:
+        return self.scale_k is not None
+
+    @property
+    def num_blocks(self) -> int:
+        return self.pool_k[0].shape[0]
+
+    def absorb_views(self, views: List[PagedKVCacheView]) -> None:
+        """Take back the updated pools a jitted program returned."""
+        self.pool_k = [v.pool_k for v in views]
+        self.pool_v = [v.pool_v for v in views]
+        if self.quantized:
+            self.scale_k = [v.scale_k for v in views]
+            self.scale_v = [v.scale_v for v in views]
+
+    def device_bytes(self) -> int:
+        total = 0
+        for arrs in (self.pool_k, self.pool_v, self.scale_k, self.scale_v):
+            if arrs is None:
+                continue
+            for a in arrs:
+                total += a.size * a.dtype.itemsize
+        return total
+
+
+def init_pools(inference_module, num_blocks: int, block_size: int,
+               kv_dtype: str = "native") -> PagedKVPools:
+    """Allocate zeroed pools shaped by probing the real layer stack.
+
+    ``kv_dtype``: ``'native'`` keeps the probe's KV dtype (the model's
+    compute dtype); ``'int8'`` stores int8 values + float32 scales."""
+    if kv_dtype not in ("native", "int8"):
+        raise ValueError(f"kv_dtype must be 'native' or 'int8', got {kv_dtype!r}")
+    params = inference_module.params
+    probe_tokens = jnp.zeros((1, 1), jnp.int32)
+    probe_pos = jnp.zeros((1, 1), jnp.int32)
+
+    def probe(p, t, po):
+        return inference_module.prefill_forward(p, t, po)[1]
+
+    kv_shapes = jax.eval_shape(probe, params, probe_tokens, probe_pos)
+    pool_k: List[jax.Array] = []
+    pool_v: List[jax.Array] = []
+    scale_k: Optional[List[jax.Array]] = [] if kv_dtype == "int8" else None
+    scale_v: Optional[List[jax.Array]] = [] if kv_dtype == "int8" else None
+    for k_aval, v_aval in kv_shapes:
+        n_kv, h = k_aval.shape[2], k_aval.shape[3]
+        store = jnp.int8 if kv_dtype == "int8" else k_aval.dtype
+        pool_k.append(jnp.zeros((num_blocks, block_size, n_kv, h), store))
+        pool_v.append(jnp.zeros((num_blocks, block_size, n_kv, h), store))
+        if kv_dtype == "int8":
+            scale_k.append(jnp.zeros((num_blocks, block_size, n_kv), jnp.float32))
+            scale_v.append(jnp.zeros((num_blocks, block_size, n_kv), jnp.float32))
+    return PagedKVPools(pool_k, pool_v, scale_k, scale_v, block_size)
+
+
+def write_prompt_kv(
+    view: PagedKVCacheView,
+    k: jax.Array,  # (1, L_padded, n_kv, h) prompt keys (right-padded)
+    v: jax.Array,
+    block_row: jax.Array,  # (max_blocks,) the sequence's block table row
+    prompt_len: jax.Array,  # scalar: real tokens; pads write to trash
+    block_size: int,
+) -> PagedKVCacheView:
+    """Scatter one prefilled prompt's KV into the pool (traceable).
+
+    Tokens past ``prompt_len`` (the length-bucket padding) are routed to
+    the trash block, so a single jitted program per bucket serves every
+    prompt length in it."""
+    L = k.shape[1]
+    positions = jnp.arange(L, dtype=jnp.int32)[None, :]
+    # pads: send the flat slot into the trash block
+    real = positions < prompt_len
+    flat = paged_flat_slots(block_row[None, :], positions, block_size)
+    flat = jnp.where(real, flat, 0).reshape(-1)
+    return paged_scatter_kv(
+        view, flat, k.reshape(L, *k.shape[2:]), v.reshape(L, *v.shape[2:])
+    )

@@ -1,0 +1,330 @@
+"""Continuous-batching scheduler (Orca, OSDI '22) — host-side policy.
+
+Pure Python, jax-free: every decision the serving engine makes about
+WHICH sequences run each tick lives here, unit-testable without a
+backend. The engine (engine.py) owns the device programs; this module
+owns admission, the per-tick prefill/decode mix under a token budget,
+block accounting, preemption on pool exhaustion, and slot recycling.
+
+Preemption is recompute-style (PagedAttention, SOSP '23 §4.5): the
+youngest running sequence drops its blocks and re-enters the waiting
+queue with ``prompt + generated-so-far`` as its new prompt. Under greedy
+sampling the resumed sequence regenerates token-for-token, so preemption
+is invisible in the output — the paged-parity tests pin exactly that.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+# block 0 is the TRASH block: never allocated, it absorbs the jitted
+# decode step's writes from inactive slots and padding (nn/attention.py
+# PagedKVCacheView). Allocators start handing out ids at 1.
+TRASH_BLOCK = 0
+
+
+class SequenceState(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+@dataclasses.dataclass
+class Request:
+    """One inference request as the load generator / API submits it."""
+
+    req_id: int
+    prompt: List[int]
+    max_new_tokens: int
+    arrival_s: float = 0.0
+    eos_token_id: Optional[int] = None
+
+
+@dataclasses.dataclass
+class Sequence:
+    """Scheduler-side state of one request's lifetime."""
+
+    request: Request
+    state: SequenceState = SequenceState.WAITING
+    generated: List[int] = dataclasses.field(default_factory=list)
+    slot: Optional[int] = None  # decode-batch row while RUNNING
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    num_cached: int = 0  # tokens whose KV sits in the pool
+    preemptions: int = 0
+    # telemetry stamps (engine fills these; monotonic seconds)
+    first_token_s: Optional[float] = None
+    finished_s: Optional[float] = None
+    token_stamps: List[float] = dataclasses.field(default_factory=list)
+
+    @property
+    def resume_prompt(self) -> List[int]:
+        """What a (re-)admission must prefill: the original prompt plus
+        everything already generated (recompute-style preemption)."""
+        return list(self.request.prompt) + list(self.generated)
+
+    @property
+    def remaining_tokens(self) -> int:
+        return self.request.max_new_tokens - len(self.generated)
+
+    @property
+    def done(self) -> bool:
+        if len(self.generated) >= self.request.max_new_tokens:
+            return True
+        eos = self.request.eos_token_id
+        return eos is not None and bool(self.generated) and self.generated[-1] == eos
+
+
+class BlockAllocator:
+    """Free-list over the pool's block ids; block 0 (trash) is reserved."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"pool needs >=2 blocks (1 trash + 1 usable), got {num_blocks}"
+            )
+        self.num_blocks = num_blocks
+        self._free: Deque[int] = deque(range(1, num_blocks))
+        self._held: set = set()
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"pool exhausted: need {n} block(s), {len(self._free)} free"
+            )
+        out = [self._free.popleft() for _ in range(n)]
+        self._held.update(out)
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if b == TRASH_BLOCK or b not in self._held:
+                raise ValueError(f"freeing block {b} not held (double free?)")
+            self._held.discard(b)
+            self._free.append(b)
+
+
+@dataclasses.dataclass
+class SchedulerConfig:
+    num_slots: int = 8  # decode-batch rows (the jitted batch size)
+    block_size: int = 16  # tokens per KV block
+    num_blocks: int = 128  # pool size incl. the trash block
+    max_blocks_per_seq: int = 16  # block-table width (jitted shape)
+    token_budget: int = 512  # prompt+decode tokens admitted per tick
+
+    def __post_init__(self):
+        cap = self.max_blocks_per_seq * self.block_size
+        if cap < 2:
+            raise ValueError("max_blocks_per_seq * block_size must be >= 2")
+
+
+@dataclasses.dataclass
+class Tick:
+    """One scheduling decision: which sequences prefill, which decode,
+    who got preempted to make room."""
+
+    prefills: List[Sequence]
+    decodes: List[Sequence]
+    preempted: List[Sequence]
+
+
+class ContinuousBatchingScheduler:
+    """Admission + per-tick prefill/decode mix + preemption policy."""
+
+    def __init__(self, config: SchedulerConfig):
+        self.config = config
+        self.allocator = BlockAllocator(config.num_blocks)
+        self.waiting: Deque[Sequence] = deque()
+        self.running: Dict[int, Sequence] = {}  # slot -> sequence
+        self._free_slots: Deque[int] = deque(range(config.num_slots))
+        self.preemption_count = 0
+        # slots whose sequence left (finish/preempt) since the engine
+        # last synced: their decode-batch rows must be zeroed before the
+        # next device step, or stale block tables would write into blocks
+        # now owned by someone else
+        self._freed_slots: List[int] = []
+
+    # ------------------------------------------------------------ intake
+    def add_request(self, request: Request) -> Sequence:
+        if request.max_new_tokens < 1:
+            # prefill emits one token unconditionally; a 0-budget request
+            # would receive a token it never asked for
+            raise ValueError(
+                f"request {request.req_id}: max_new_tokens must be >= 1, "
+                f"got {request.max_new_tokens}"
+            )
+        if not request.prompt:
+            raise ValueError(f"request {request.req_id}: empty prompt")
+        cap = self.config.max_blocks_per_seq * self.config.block_size
+        need = len(request.prompt) + request.max_new_tokens
+        if need > cap:
+            raise ValueError(
+                f"request {request.req_id} needs {need} KV slots but the "
+                f"block table holds {cap} "
+                f"(max_blocks_per_seq={self.config.max_blocks_per_seq} x "
+                f"block_size={self.config.block_size})"
+            )
+        usable = self.config.num_blocks - 1  # minus the trash block
+        if self.blocks_needed(need) > usable:
+            raise ValueError(
+                f"request {request.req_id} needs "
+                f"{self.blocks_needed(need)} blocks at full length but the "
+                f"pool holds {usable} — it could never finish"
+            )
+        seq = Sequence(request=request)
+        self.waiting.append(seq)
+        return seq
+
+    # ---------------------------------------------------------- accounting
+    def blocks_needed(self, num_tokens: int) -> int:
+        bs = self.config.block_size
+        return (num_tokens + bs - 1) // bs
+
+    # ------------------------------------------------------------- policy
+    def schedule(self) -> Tick:
+        """One tick's worth of work.
+
+        1. GROW: every running sequence gets the block its next token
+           needs (blocks are allocated incrementally, not reserved for
+           the whole horizon — that is what lets wildly different lengths
+           share one pool). On exhaustion the youngest running sequence
+           is preempted recompute-style; a sequence that cannot grow even
+           after every younger peer is gone preempts itself and waits.
+           Oldest-first, so the oldest request always progresses — the
+           policy cannot livelock.
+        2. ADMIT: prefills from the waiting queue while a slot, enough
+           pool blocks for the prompt, and token budget remain.
+        """
+        preempted: List[Sequence] = []
+
+        # --- grow running sequences (oldest first)
+        for seq in sorted(self.running.values(),
+                          key=lambda s: s.request.req_id):
+            if seq.state is not SequenceState.RUNNING:
+                continue  # evicted earlier in this very loop
+            need = self.blocks_needed(seq.num_cached + 1) - len(seq.blocks)
+            if need <= 0:
+                continue
+            while (need > self.allocator.free_blocks
+                   and self._preempt_youngest(seq, preempted)):
+                pass
+            if need <= self.allocator.free_blocks:
+                seq.blocks.extend(self.allocator.alloc(need))
+            else:
+                # every younger peer is gone and the pool is still full:
+                # this sequence yields to its elders until blocks free up
+                self._preempt(seq, preempted)
+
+        # each surviving running sequence decodes one token this tick
+        budget = self.config.token_budget - len(self.running)
+
+        prefills: List[Sequence] = []
+        while self.waiting and self._free_slots and budget > 0:
+            # pop the head BEFORE any preemption: evicted victims re-enter
+            # at the queue front, and the head must not be displaced by
+            # the very sequence evicted on its behalf
+            head = self.waiting.popleft()
+            prompt_tokens = len(head.resume_prompt)
+            # an over-budget prompt admits only as the tick's sole prefill
+            # (a prompt longer than the whole budget must still run
+            # EVENTUALLY; making it wait for an idle tick would starve it)
+            if prompt_tokens > budget and prefills:
+                self.waiting.appendleft(head)
+                break
+            need = self.blocks_needed(prompt_tokens)
+            while (need > self.allocator.free_blocks
+                   and self._preempt_youngest(head, preempted)):
+                pass
+            if need > self.allocator.free_blocks:
+                # pool genuinely full; running decodes will free blocks
+                self.waiting.appendleft(head)
+                break
+            head.blocks = self.allocator.alloc(need)
+            head.slot = self._free_slots.popleft()
+            head.state = SequenceState.RUNNING
+            head.num_cached = 0
+            self.running[head.slot] = head
+            prefills.append(head)
+            budget -= prompt_tokens
+        # a preempted victim re-admitted this tick can be evicted AGAIN by
+        # a still-older head later in the same loop — drop it from the
+        # prefill list (its slot is gone; it waits at the queue front)
+        prefills = [s for s in prefills if s.state == SequenceState.RUNNING]
+        # decodes: running sequences that were NOT just admitted (their
+        # prefill emits this tick's token) and survived preemption
+        new = {id(s) for s in prefills}
+        decodes = [
+            self.running[slot] for slot in sorted(self.running)
+            if id(self.running[slot]) not in new
+        ]
+        return Tick(prefills=prefills, decodes=decodes, preempted=preempted)
+
+    def _preempt_youngest(self, for_seq: Sequence,
+                          preempted: List[Sequence]) -> bool:
+        """Evict the most-recently-admitted running sequence to free
+        blocks for ``for_seq``. Never preempts on behalf of a YOUNGER
+        request (arrival order is the fairness clock), and never empties
+        the running set below one sequence — someone must make progress.
+        Returns True when a sequence was evicted."""
+        if len(self.running) <= 1:
+            return False
+        youngest_slot = max(
+            self.running, key=lambda s: self.running[s].request.req_id
+        )
+        victim = self.running[youngest_slot]
+        if victim.request.req_id <= for_seq.request.req_id:
+            return False
+        self._preempt(victim, preempted)
+        return True
+
+    def _preempt(self, victim: Sequence, preempted: List[Sequence]) -> None:
+        self._evict(victim)
+        victim.preemptions += 1
+        self.preemption_count += 1
+        victim.state = SequenceState.WAITING
+        self.waiting.appendleft(victim)  # resumes ahead of colder requests
+        preempted.append(victim)
+
+    def _evict(self, seq: Sequence) -> None:
+        self.allocator.free(seq.blocks)
+        seq.blocks = []
+        seq.num_cached = 0
+        self.running.pop(seq.slot)
+        self._free_slots.append(seq.slot)
+        self._freed_slots.append(seq.slot)
+        seq.slot = None
+
+    def drain_freed_slots(self) -> List[int]:
+        """Slots vacated since the last drain (engine zeroes their rows)."""
+        out, self._freed_slots = self._freed_slots, []
+        return out
+
+    # ------------------------------------------------------------ lifecycle
+    def finish(self, seq: Sequence) -> None:
+        """Completed sequence: recycle its slot and blocks immediately —
+        the freed capacity is admissible in the very next tick."""
+        assert seq.state == SequenceState.RUNNING and seq.slot is not None
+        self._evict(seq)
+        seq.state = SequenceState.FINISHED
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def gauges(self) -> Dict[str, float]:
+        """Pool/queue occupancy for the obs registry."""
+        cfg = self.config
+        usable = cfg.num_blocks - 1
+        held = usable - self.allocator.free_blocks
+        return {
+            "serve_running_seqs": float(len(self.running)),
+            "serve_waiting_seqs": float(len(self.waiting)),
+            "serve_free_blocks": float(self.allocator.free_blocks),
+            "serve_pool_utilization": held / usable if usable else 0.0,
+        }

@@ -1,0 +1,74 @@
+"""Seeded STA007 violations in a ``serve/`` path (the scope dir ISSUE 9
+added: a serving engine that silently eats a scheduler or pool error is
+a request that never completes and a gate that never fires). Line
+numbers are asserted by tests/core/test_analysis/test_lint.py and chosen
+NOT to collide with the trainer/runner/obs fixtures' lines; keep edits
+additive at the bottom."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+# padding so the first handler lands on line 49 and the second on 59 —
+# line numbers no other STA007 fixture uses (trainer: 14/21/28/63,
+# runner: 17/24/38, obs: 33/40/54) — the test's (rule, line) pairs must
+# stay unique across fixture files.
+#
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+
+
+def swallow_tick_error(engine):
+    try:
+        return engine.tick()
+    except Exception:  # STA007: a lost scheduling tick, line 49
+        return None
+
+
+def swallow_block_free(allocator, blocks):
+    # padding
+    # .
+    # .
+    try:
+        allocator.free(blocks)
+    except:  # noqa: E722  # STA007: bare except around free, line 59
+        pass
+
+
+def ok_logged_preemption_failure(scheduler, seq):
+    try:
+        scheduler.finish(seq)
+    except Exception as e:
+        logger.warning(f"finish failed: {e}")
+
+
+def suppressed_pool_probe(pools):
+    try:
+        return pools.device_bytes()
+    except Exception:  # sta: disable=STA007
+        return None

@@ -81,6 +81,27 @@ def test_audit_gate_matches_golden(tmp_path):
     ), vpp2["collectives"]
 
 
+def test_audit_gate_serve_decode_matches_golden(tmp_path):
+    """The serving engine's decode program reproduces its pinned golden
+    (ISSUE 9): a single-program signature (no per-request shapes), no
+    host callbacks in the decode loop, and a stable recompile key — the
+    no-recompile-storm contract for the continuous-batching scheduler's
+    shape bucketing."""
+    out = tmp_path / "serve.json"
+    p = run_cli("audit", "--sections", "serve_decode", "--json", str(out))
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-2000:]
+    payload = json.loads(out.read_text())
+    assert payload["audit"]["drift"] == []
+    sec = payload["audit"]["sections"]["serve_decode"]
+    assert sec["host_callbacks"] == 0
+    assert sec["infeed_outfeed"] == 0
+    static = sec["recompile_key"]["static"]
+    assert static["kind"] == "serve_decode"
+    # shapes in the signature come from engine CONFIG, never per request
+    assert {"num_slots", "block_size", "max_blocks_per_seq",
+            "min_prefill_bucket"} <= set(static)
+
+
 def test_audit_gate_detects_seeded_drift(tmp_path):
     """A doctored golden (one extra all-gather, a flipped recompile key)
     must make the same CLI invocation exit non-zero — proving the gate
@@ -112,7 +133,7 @@ def test_full_cli_all_clean(tmp_path):
     assert payload["exit_code"] == 0
     assert set(payload["audit"]["sections"]) == {
         "train_single", "train_pp2_mp2", "train_pp2_vpp2",
-        "train_pp2_tokenslice", "decode_fused"
+        "train_pp2_tokenslice", "decode_fused", "serve_decode"
     }
     pp2 = payload["audit"]["sections"]["train_pp2_mp2"]
     axes = {(r["op"], r["axis"]) for r in pp2["collectives"]}

@@ -13,8 +13,9 @@ REPO = Path(__file__).resolve().parents[3]
 FIXTURES = Path(__file__).resolve().parent / "fixtures"
 
 # (rule, line) pairs seeded in fixtures/nn/violations.py,
-# fixtures/trainer/swallowed.py, fixtures/runner/swallowed.py and
-# fixtures/obs/swallowed.py — line numbers are part of the fixtures'
+# fixtures/trainer/swallowed.py, fixtures/runner/swallowed.py,
+# fixtures/obs/swallowed.py and fixtures/serve/swallowed.py — line
+# numbers are part of the fixtures'
 # contract (edits there stay additive at the bottom; each fixture's
 # lines deliberately avoid the others' so every (rule, line) pair
 # stays unique)
@@ -36,12 +37,15 @@ EXPECTED = [
     ("STA007", 24),   # runner: bare except around spawn
     ("STA007", 33),   # obs: swallowed metrics flush
     ("STA007", 40),   # obs: bare except around span emit
+    ("STA007", 49),   # serve: swallowed scheduling tick
+    ("STA007", 59),   # serve: bare except around block free
 ]
 SUPPRESSED = [
     ("STA003", 60),  # sta: disable=STA003
     ("STA007", 63),  # trainer: sta: disable=STA007
     ("STA007", 38),  # runner: sta: disable=STA007
     ("STA007", 54),  # obs: sta: disable=STA007
+    ("STA007", 73),  # serve: sta: disable=STA007
 ]
 
 

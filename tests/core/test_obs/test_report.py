@@ -346,3 +346,97 @@ def test_tuner_calibration_gate(tmp_path):
     assert main([
         "report", str(run), "--assert-tuner-calibration", "0.25"
     ]) == 1
+
+
+# ---------------------------------------------------------------- serving
+def _serve_run_dir(tmp_path, with_summary=True, n_requests=4):
+    """Canned serving run dir (ISSUE 9): serve-request events with known
+    TTFTs + a serve-summary with known throughput."""
+    run = tmp_path / "serve_run"
+    run.mkdir(exist_ok=True)
+    lines = []
+    for i in range(n_requests):
+        lines.append(json.dumps({
+            "event": "serve-request", "ts": 10.0 + i, "req": i,
+            "prompt_tokens": 8, "output_tokens": 4,
+            "ttft_s": 0.1 * (i + 1), "e2e_s": 0.5 + 0.1 * i,
+            "itl_mean_s": 0.01 * (i + 1),
+            "preemptions": 1 if i == 2 else 0,
+        }))
+    if with_summary:
+        lines.append(json.dumps({
+            "event": "serve-summary", "ts": 20.0, "requests": n_requests,
+            "wall_s": 2.0, "output_tokens": 4 * n_requests,
+            "tokens_per_s": 2 * n_requests, "ticks": 12, "preemptions": 1,
+            "prefill_compiles": 2,
+        }))
+    (run / "events.jsonl").write_text("\n".join(lines) + "\n")
+    return run
+
+
+def test_serving_section_renders_percentiles_and_throughput(tmp_path):
+    """ISSUE 9 acceptance: the serving section reports tokens/s from the
+    summary event and exact TTFT percentiles over the per-request
+    events, plus the preempted-and-resumed count."""
+    from scaling_tpu.obs.report import load_run_dir, serving_section
+
+    data = load_run_dir(_serve_run_dir(tmp_path))
+    lines, stats = serving_section(data)
+    text = "\n".join(lines)
+    assert "== serving ==" in text
+    assert "throughput: 8.0 output tokens/s" in text
+    assert "ticks=12 preemptions=1 prefill_compiles=2" in text
+    assert "preempted-and-resumed: 1 of 4" in text
+    assert stats["serve_tokens_per_s"] == pytest.approx(8.0)
+    assert stats["serve_ttft_p50_s"] == pytest.approx(0.2)
+    assert stats["serve_ttft_p99_s"] == pytest.approx(0.4)
+
+
+def test_serving_section_derives_throughput_without_summary(tmp_path):
+    """A crashed run (no serve-summary) still reports: throughput is
+    derived from the request events' tokens and timestamps."""
+    from scaling_tpu.obs.report import load_run_dir, serving_section
+
+    data = load_run_dir(_serve_run_dir(tmp_path, with_summary=False))
+    lines, stats = serving_section(data)
+    text = "\n".join(lines)
+    assert "no serve-summary" in text
+    # 16 tokens over ts spread 3.0s
+    assert stats["serve_tokens_per_s"] == pytest.approx(16 / 3.0)
+    assert stats["serve_ttft_p99_s"] == pytest.approx(0.4)
+
+
+def test_serving_section_absent_for_training_runs(tmp_path):
+    """Training run dirs keep their exact report layout — the committed
+    golden reports must not grow an empty serving section."""
+    from scaling_tpu.obs.report import load_run_dir, serving_section
+
+    (tmp_path / "events.jsonl").write_text(
+        json.dumps({"event": "span", "span": "step.fwdbwd", "step": 1,
+                    "dur_s": 0.5, "ts": 1.0}) + "\n")
+    lines, stats = serving_section(load_run_dir(tmp_path))
+    assert lines == [] and stats == {}
+    assert "== serving ==" not in render_report(load_run_dir(tmp_path))
+
+
+def test_serving_gates_thresholds_and_missing_data(tmp_path):
+    """--assert-serve-throughput / --assert-ttft: pass at sane
+    thresholds, fail at absurd ones, fail on run dirs with no serving
+    telemetry at all (silence must not pass a gate)."""
+    data = load_run_dir(_serve_run_dir(tmp_path))
+    assert check_gates(data, assert_serve_throughput=1.0,
+                       assert_ttft=1.0) == []
+    failures = check_gates(data, assert_serve_throughput=1e9,
+                           assert_ttft=1e-9)
+    assert len(failures) == 2
+    assert "assert-serve-throughput" in failures[0]
+    assert "assert-ttft" in failures[1]
+    empty = tmp_path / "training_only"
+    empty.mkdir()
+    (empty / "events.jsonl").write_text(
+        json.dumps({"event": "span", "span": "step.fwdbwd", "step": 1,
+                    "dur_s": 0.5, "ts": 1.0}) + "\n")
+    failures = check_gates(load_run_dir(empty),
+                           assert_serve_throughput=1.0, assert_ttft=1.0)
+    assert len(failures) == 2
+    assert all("no " in f for f in failures)

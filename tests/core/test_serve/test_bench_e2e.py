@@ -1,0 +1,104 @@
+"""Serving smoke e2e (ISSUE 9 tier-1 satellite): a subprocess run of the
+real benchmark entrypoint serving ~8 concurrent toy requests on the CPU
+mesh, then the real ``obs report`` analyzer over its run dir — the
+serving section parses, the gates pass at sane thresholds and fail at
+absurd ones."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[3]
+
+BENCH_ARGS = [
+    "--requests", "8", "--rate", "50", "--seed", "3",
+    "--prompt-len", "4", "12", "--output-len", "3", "6",
+    "--num-slots", "4", "--block-size", "4", "--num-blocks", "64",
+    "--max-blocks-per-seq", "8", "--token-budget", "64",
+    "--hidden", "32", "--layers", "2", "--vocab", "64", "--heads", "4",
+]
+
+
+@pytest.fixture(scope="module")
+def bench_run(tmp_path_factory):
+    run_dir = tmp_path_factory.mktemp("serve_bench")
+    stats_json = run_dir / "stats.json"
+    cmd = [
+        sys.executable, "-m", "scaling_tpu.serve", "bench",
+        *BENCH_ARGS, "--run-dir", str(run_dir), "--json", str(stats_json),
+        "--assert-serve-throughput", "0.5", "--assert-ttft", "120",
+    ]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "SCALING_TPU_TEST_CACHE": "off"}
+    env.pop("SCALING_TPU_EVENTS_PATH", None)
+    p = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                       text=True, timeout=420)
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-3000:]
+    return run_dir, stats_json, p.stdout
+
+
+def test_bench_serves_all_requests_with_finite_stats(bench_run):
+    run_dir, stats_json, stdout = bench_run
+    stats = json.loads(stats_json.read_text())
+    assert stats["requests"] == 8
+    assert stats["output_tokens"] > 0
+    assert stats["tokens_per_s"] > 0
+    assert 0 < stats["ttft_p99_s"] < 120
+    assert "== gates ==" in stdout and "PASS" in stdout
+    # telemetry artifacts landed on the standard rails
+    assert (run_dir / "events.jsonl").is_file()
+    assert (run_dir / "metrics.jsonl").is_file()
+
+
+def test_obs_report_grows_serving_section_over_bench_run_dir(bench_run,
+                                                             capsys):
+    """The REAL analyzer over the real run dir: parses cleanly (exit 0),
+    renders the serving section with finite numbers, and the gates
+    mirror the training MFU gates' exit-code contract."""
+    from scaling_tpu.obs.cli import main
+
+    run_dir, _, _ = bench_run
+    rc = main(["report", str(run_dir),
+               "--assert-serve-throughput", "0.5", "--assert-ttft", "120"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "== serving ==" in out
+    assert "output tokens/s" in out
+    assert "ttft: p50=" in out
+    assert "PASS" in out
+
+
+def test_obs_report_serving_gates_fail_at_absurd_thresholds(bench_run,
+                                                            capsys):
+    from scaling_tpu.obs.cli import main
+
+    run_dir, _, _ = bench_run
+    rc = main(["report", str(run_dir),
+               "--assert-serve-throughput", "1e9", "--assert-ttft", "1e-9"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "FAIL assert-serve-throughput" in out
+    assert "FAIL assert-ttft" in out
+
+
+def test_bench_registry_metrics_flushed(bench_run):
+    """The engine's counters/gauges land in the metrics JSONL through
+    obs.get_registry() — the same registry training flushes through."""
+    run_dir, _, _ = bench_run
+    recs = [
+        json.loads(line)
+        for line in (run_dir / "metrics.jsonl").read_text().splitlines()
+        if line.strip()
+    ]
+    regs = [r for r in recs if r.get("kind") == "registry"]
+    assert regs
+    counters = regs[-1]["counters"]
+    assert counters["serve_requests_completed_total"] == 8.0
+    assert counters["serve_tokens_generated_total"] > 0
+    gauges = regs[-1]["gauges"]
+    assert gauges["serve_running_seqs"] == 0.0
+    assert gauges["serve_free_blocks"] == 63.0  # all recycled at drain

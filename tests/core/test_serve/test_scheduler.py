@@ -1,0 +1,207 @@
+"""Continuous-batching scheduler policy units (ISSUE 9) — jax-free:
+admission order, token-budget mixing, incremental block growth,
+preemption on pool exhaustion, slot/block recycling."""
+
+import pytest
+
+from scaling_tpu.serve.scheduler import (
+    BlockAllocator,
+    ContinuousBatchingScheduler,
+    Request,
+    SchedulerConfig,
+    SequenceState,
+)
+
+
+def make_sched(num_slots=4, block_size=2, num_blocks=16,
+               max_blocks_per_seq=8, token_budget=64):
+    return ContinuousBatchingScheduler(SchedulerConfig(
+        num_slots=num_slots, block_size=block_size, num_blocks=num_blocks,
+        max_blocks_per_seq=max_blocks_per_seq, token_budget=token_budget,
+    ))
+
+
+def submit(sched, req_id, prompt_len=4, max_new=4):
+    return sched.add_request(Request(
+        req_id=req_id, prompt=list(range(1, prompt_len + 1)),
+        max_new_tokens=max_new,
+    ))
+
+
+def settle_prefills(tick):
+    """What the engine does after running a prefill: the prompt's KV is
+    now cached."""
+    for seq in tick.prefills:
+        seq.num_cached = len(seq.resume_prompt)
+        seq.generated.append(1)  # the prefill emits the first token
+
+
+def settle_decodes(tick):
+    for seq in tick.decodes:
+        seq.num_cached += 1
+        seq.generated.append(1)
+
+
+# ------------------------------------------------------------- allocator
+def test_allocator_never_hands_out_trash_block():
+    alloc = BlockAllocator(8)
+    got = alloc.alloc(7)
+    assert 0 not in got
+    assert sorted(got) == list(range(1, 8))
+
+
+def test_allocator_exhaustion_and_double_free():
+    alloc = BlockAllocator(4)
+    blocks = alloc.alloc(3)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        alloc.alloc(1)
+    alloc.free(blocks[:1])
+    assert alloc.free_blocks == 1
+    with pytest.raises(ValueError, match="double free"):
+        alloc.free(blocks[:1])
+    with pytest.raises(ValueError):
+        alloc.free([0])  # the trash block is never freeable
+
+
+# ------------------------------------------------------------- admission
+def test_admission_fifo_and_slot_assignment():
+    sched = make_sched()
+    a, b = submit(sched, 0), submit(sched, 1)
+    tick = sched.schedule()
+    assert tick.prefills == [a, b]
+    assert a.state is SequenceState.RUNNING and a.slot is not None
+    assert a.slot != b.slot
+    assert not tick.decodes  # just-admitted sequences prefill, not decode
+
+
+def test_token_budget_limits_prefills_per_tick():
+    sched = make_sched(token_budget=10)
+    seqs = [submit(sched, i, prompt_len=4) for i in range(4)]
+    tick = sched.schedule()
+    # 4+4 fits the budget of 10; the third prompt would cross it
+    assert tick.prefills == seqs[:2]
+    settle_prefills(tick)
+    tick2 = sched.schedule()
+    # the 2 running decodes charge the budget; 4+4 still fits alongside
+    assert tick2.prefills == seqs[2:]
+    assert tick2.decodes == seqs[:2]
+
+
+def test_over_budget_prompt_admits_alone():
+    sched = make_sched(token_budget=6, num_blocks=32, max_blocks_per_seq=16)
+    big = submit(sched, 0, prompt_len=12)  # prompt alone exceeds the budget
+    small = submit(sched, 1, prompt_len=2)
+    tick = sched.schedule()
+    assert tick.prefills == [big]  # sole prefill; never starved
+    tick2_prefills = sched.schedule().prefills
+    assert tick2_prefills == [small]
+
+
+def test_degenerate_requests_rejected():
+    """A 0-token budget would still receive prefill's unconditional first
+    token; an empty prompt has nothing to prefill. Both reject at intake."""
+    sched = make_sched()
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        sched.add_request(Request(req_id=0, prompt=[1, 2], max_new_tokens=0))
+    with pytest.raises(ValueError, match="empty prompt"):
+        sched.add_request(Request(req_id=1, prompt=[], max_new_tokens=4))
+
+
+def test_request_too_big_for_table_or_pool_rejected():
+    sched = make_sched(block_size=2, max_blocks_per_seq=4)  # cap 8 tokens
+    with pytest.raises(ValueError, match="block table"):
+        submit(sched, 0, prompt_len=6, max_new=4)
+    sched2 = make_sched(block_size=2, num_blocks=3, max_blocks_per_seq=8)
+    with pytest.raises(ValueError, match="could never finish"):
+        submit(sched2, 0, prompt_len=3, max_new=3)  # 3 blocks > 2 usable
+
+
+# ------------------------------------------------------ growth/preemption
+def test_incremental_block_growth():
+    sched = make_sched(block_size=2)
+    a = submit(sched, 0, prompt_len=4, max_new=4)
+    settle_prefills(sched.schedule())
+    assert len(a.blocks) == 2  # prompt only: 4 tokens / 2 per block
+    settle_decodes(sched.schedule())  # grows for the decode token (slot 4)
+    assert len(a.blocks) == 3
+
+
+def test_preemption_on_pool_exhaustion_evicts_youngest():
+    # 4 usable blocks, block_size 2: two 4-token prompts fill the pool
+    sched = make_sched(block_size=2, num_blocks=5)
+    a = submit(sched, 0, prompt_len=4, max_new=4)
+    b = submit(sched, 1, prompt_len=4, max_new=4)
+    settle_prefills(sched.schedule())
+    assert sched.allocator.free_blocks == 0
+    tick = sched.schedule()  # a needs a growth block -> b must go
+    assert tick.preempted == [b]
+    assert b.state is SequenceState.WAITING
+    assert b.slot is None and b.blocks == [] and b.num_cached == 0
+    assert b.preemptions == 1 and sched.preemption_count == 1
+    assert tick.decodes == [a]
+    # the engine must zero the vacated decode row before the next step
+    assert len(sched.drain_freed_slots()) == 1
+
+
+def test_preempted_sequence_resumes_with_generated_tokens():
+    sched = make_sched(block_size=2, num_blocks=5)
+    a = submit(sched, 0, prompt_len=4, max_new=4)
+    b = submit(sched, 1, prompt_len=4, max_new=4)
+    settle_prefills(sched.schedule())
+    b_generated_before = list(b.generated)
+    settle_decodes(sched.schedule())  # preempts b
+    assert b.state is SequenceState.WAITING
+    # b resumes with prompt + already-generated as its new prompt
+    assert b.resume_prompt == list(b.request.prompt) + b_generated_before
+    # drain a to completion; b re-admits once blocks free up
+    for _ in range(20):
+        tick = sched.schedule()
+        settle_prefills(tick)
+        settle_decodes(tick)
+        for seq in list(tick.prefills) + list(tick.decodes):
+            if seq.done and seq.slot is not None:
+                sched.finish(seq)
+        if b.state is SequenceState.RUNNING and a.state is SequenceState.FINISHED:
+            break
+    assert a.state is SequenceState.FINISHED
+    assert b.state in (SequenceState.RUNNING, SequenceState.FINISHED)
+
+
+def test_oldest_never_preempted_for_younger():
+    sched = make_sched(block_size=2, num_blocks=5)
+    a = submit(sched, 0, prompt_len=4, max_new=4)
+    settle_prefills(sched.schedule())
+    b = submit(sched, 1, prompt_len=4, max_new=4)
+    tick = sched.schedule()
+    # b's admission cannot evict the older a; b waits for capacity
+    assert tick.prefills == [] and a.state is SequenceState.RUNNING
+    assert b.state is SequenceState.WAITING
+
+
+# ------------------------------------------------------------- recycling
+def test_finish_recycles_slot_and_blocks():
+    sched = make_sched(num_slots=1, block_size=2, num_blocks=5)
+    a = submit(sched, 0, prompt_len=4, max_new=1)
+    b = submit(sched, 1, prompt_len=4, max_new=1)
+    tick = sched.schedule()
+    assert tick.prefills == [a]  # one slot
+    settle_prefills(tick)
+    assert a.done
+    slot = a.slot
+    sched.finish(a)
+    assert a.state is SequenceState.FINISHED
+    assert sched.drain_freed_slots() == [slot]
+    tick2 = sched.schedule()
+    assert tick2.prefills == [b] and b.slot == slot  # recycled
+
+
+def test_gauges_track_occupancy():
+    sched = make_sched(block_size=2, num_blocks=9)
+    submit(sched, 0, prompt_len=4, max_new=2)
+    submit(sched, 1, prompt_len=4, max_new=2)
+    sched.schedule()
+    g = sched.gauges()
+    assert g["serve_running_seqs"] == 2.0
+    assert g["serve_waiting_seqs"] == 0.0
+    assert g["serve_free_blocks"] == 4.0
+    assert g["serve_pool_utilization"] == pytest.approx(0.5)

@@ -397,3 +397,56 @@ def test_generate_text_batch(tmp_path):
     for text, out in zip(["a b", "a"], outs):
         alone = module.generate(text, max_tokens=3)
         assert out.completion_ids == alone.completion_ids
+
+
+def _pp2_inference_module():
+    """A pipelined (pp=2) stack wrapped for inference DIRECTLY (bypassing
+    from_checkpoint's topology guard) — the ISSUE 9 silent-wrong-decode
+    hazard: the PipelinedBody cannot consume KV caches, so cached decode
+    would recompute every token with no history."""
+    import jax
+
+    from scaling_tpu.analysis.hlo_audit import make_train_config
+    from scaling_tpu.models.transformer.model import init_model
+    from scaling_tpu.topology import Topology
+
+    config = make_train_config(pp=2)
+    topology = Topology(config.topology)
+    module = init_model(config, topology)
+    params = module.shard_params(module.init_params(jax.random.PRNGKey(0)))
+    return TransformerInferenceModule(config, module, params)
+
+
+def test_pp_stack_cached_generate_raises():
+    """Cached generation through a pp>1 stack must raise loudly, never
+    silently decode without the caches (ISSUE 9 satellite)."""
+    inf = _pp2_inference_module()
+    with pytest.raises(ValueError, match="pp>1"):
+        inf.generate([1, 2, 3, 4], max_tokens=4, use_cache=True)
+
+
+def test_pp_stack_uncached_generate_works():
+    """The documented fallback: use_cache=False refeeds the whole buffer
+    through the pipelined stack (stacked=False, like training's forward)
+    and produces tokens."""
+    inf = _pp2_inference_module()
+    out = inf.generate([1, 2, 3, 4], max_tokens=3, use_cache=False)
+    assert len(out.completion_ids) == 3
+    assert all(isinstance(t, int) for t in out.completion_ids)
+
+
+def test_run_layers_rejects_mismatched_cache_count(checkpoint_dir):
+    """A cache list the stack cannot fully consume is a silently-wrong
+    decode in the making; _run_layers must refuse it."""
+    import jax.numpy as jnp
+
+    mod = TransformerInferenceModule.from_checkpoint(checkpoint_dir)
+    n_layers = mod.architecture.num_layers
+    b, cap, kv, hd = 1, 8, mod.architecture.num_attention_heads, 8
+    fake = [(jnp.zeros((b, cap, kv, hd)), jnp.zeros((b, cap, kv, hd)))] * (
+        n_layers + 1
+    )
+    batch = mod._make_batch(jnp.zeros((1, 1), jnp.int32),
+                            jnp.zeros((1, 1), jnp.int32))
+    with pytest.raises(ValueError, match="consumed"):
+        mod._run_layers(mod.params, batch, fake, jnp.int32(0))
