@@ -455,17 +455,32 @@ def audit_decode_section(prompt_len=4, max_tokens=4) -> dict:
     return report
 
 
+def _count_pallas_custom_calls(text: str) -> int:
+    """Pallas kernels lower to ``tpu_custom_call`` custom-calls on a real
+    chip; off-TPU (interpret mode) the kernel body inlines as plain HLO
+    and the count is 0. Pinning the count makes a silent fall-off-the-
+    kernel regression (someone reroutes decode through the gather path on
+    chip) golden drift, not a quiet 2x HBM-traffic surprise."""
+    return len(re.findall(r"stablehlo\.custom_call\s*@tpu_custom_call", text))
+
+
 def audit_serve_decode_section(num_slots=2, block_size=4,
-                               max_blocks=4) -> dict:
+                               max_blocks=4, prefill_chunk=8) -> dict:
     """The serving engine's single decode program (serve/engine.py): one
     jitted step over the WHOLE slot set, sequence raggedness carried in
-    block tables + context lengths. Its recompile-key signature is the
+    block tables + context lengths, per-request sampler settings as
+    traced per-row arrays. Its recompile-key signature is the
     no-recompile-storm contract — a scheduler change that moves shapes
     into the signature (a new bucket axis, a per-request dimension)
     shows up as golden drift here, not as a compile per request on the
-    chip. The static config also pins the prefill bucket ladder's floor,
-    so a bucketing-policy change drifts the hash even though prefill
-    lowers per bucket."""
+    chip. The static config also pins the paged-attention back-end, the
+    chunked-prefill chunk size, and the legacy prefill bucket ladder's
+    floor, so a policy change drifts the hash even though prefill lowers
+    per bucket. ``chunk_program`` pins the chunked-prefill program's
+    signature the same way (ONE compile per chunk size), and
+    ``pallas_custom_calls`` counts the paged-decode kernel's custom
+    calls in the lowered decode HLO (0 off-TPU where the kernel runs
+    interpreted)."""
     import jax
     import jax.numpy as jnp
 
@@ -484,14 +499,20 @@ def audit_serve_decode_section(num_slots=2, block_size=4,
     engine = ServeEngine(inf, EngineConfig(
         num_slots=num_slots, block_size=block_size,
         num_blocks=2 * max_blocks + 1, max_blocks_per_seq=max_blocks,
-        token_budget=64,
+        token_budget=64, prefill_chunk=prefill_chunk,
     ))
+    base_key = jax.random.PRNGKey(0)
     decode = engine._build_decode_fn()
     args = (
         params, engine._pool_state(),
         jnp.zeros((num_slots, max_blocks), jnp.int32),
         jnp.zeros((num_slots,), jnp.int32),
         jnp.zeros((num_slots,), jnp.int32),
+        jnp.zeros((num_slots,), jnp.float32),  # temperatures
+        jnp.zeros((num_slots,), jnp.int32),    # top-ks
+        jnp.zeros((num_slots,), jnp.int32),    # request ids
+        jnp.zeros((num_slots,), jnp.int32),    # generated counts
+        base_key,
     )
     lowered = decode.lower(*args)
     static = {
@@ -499,9 +520,38 @@ def audit_serve_decode_section(num_slots=2, block_size=4,
         "block_size": block_size, "max_blocks_per_seq": max_blocks,
         "kv_dtype": engine.config.kv_dtype,
         "min_prefill_bucket": MIN_PREFILL_BUCKET,
+        "paged_kernel": engine.config.paged_kernel,
+        "prefill_chunk": prefill_chunk,
     }
     report = _audit_lowered(lowered, args, static, mesh=None)
     report["mesh"] = {}
+    report["pallas_custom_calls"] = _count_pallas_custom_calls(
+        lowered.as_text()
+    )
+    # the chunk program's compile-once contract rides the same golden:
+    # its signature must depend on the CHUNK SIZE only, never on prompt
+    # length or prefill progress (those are the traced ctx/new_len args)
+    chunk_fn = engine._build_chunk_fn(prefill_chunk)
+    chunk_args = (
+        params, engine._pool_state(),
+        jnp.zeros((1, prefill_chunk), jnp.int32),
+        jnp.zeros((max_blocks,), jnp.int32),
+        jnp.zeros((1,), jnp.int32),            # context length
+        jnp.ones((1,), jnp.int32),             # real tokens in chunk
+        jnp.zeros((1,), jnp.float32),
+        jnp.zeros((1,), jnp.int32),
+        jnp.zeros((1,), jnp.int32),
+        jnp.zeros((1,), jnp.int32),
+        base_key,
+    )
+    chunk_lowered = chunk_fn.lower(*chunk_args)
+    report["chunk_program"] = recompile_signature(chunk_args, {
+        "kind": "serve_chunk_prefill", "prefill_chunk": prefill_chunk,
+        "paged_kernel": engine.config.paged_kernel,
+    })
+    report["chunk_program"]["pallas_custom_calls"] = (
+        _count_pallas_custom_calls(chunk_lowered.as_text())
+    )
     return report
 
 
@@ -604,10 +654,20 @@ def compare_to_golden(
     for field in (
         "bf16_to_f32_dot_upcasts", "host_callbacks", "infeed_outfeed",
         "rng_ops", "dot_general_count", "mesh",
+        # serving sections only (None == None elsewhere): the paged
+        # kernel's custom-call presence is part of the hot-path contract
+        "pallas_custom_calls",
     ):
         exact(field, golden.get(field), report.get(field))
     exact("recompile_key.hash", golden.get("recompile_key", {}).get("hash"),
           report.get("recompile_key", {}).get("hash"))
+    # serving sections pin a second program (chunked prefill) per golden
+    exact("chunk_program.hash",
+          (golden.get("chunk_program") or {}).get("hash"),
+          (report.get("chunk_program") or {}).get("hash"))
+    exact("chunk_program.pallas_custom_calls",
+          (golden.get("chunk_program") or {}).get("pallas_custom_calls"),
+          (report.get("chunk_program") or {}).get("pallas_custom_calls"))
 
     def inv_map(inv):
         return {(r["op"], r["axis"]): r for r in inv or []}

@@ -134,6 +134,61 @@ def _sampler_cache_id(sample: Callable) -> Any:
     return getattr(sample, "_sampler_key", sample)
 
 
+def sample_rows(
+    logits: jax.Array,       # (rows, vocab)
+    temperatures: jax.Array,  # (rows,) f32; <= 0 means greedy
+    top_ks: jax.Array,        # (rows,) i32; <= 0 or >= vocab disables
+    keys: jax.Array,          # (rows, 2) uint32 per-row PRNG keys
+) -> jax.Array:
+    """Per-row temperature / top-k sampling with per-row keys — the
+    serving engine's batched counterpart of :func:`make_sampler`.
+
+    The engine decodes MANY requests in one jitted program, so the
+    sampler configuration must be traced per-row data, never baked-in
+    constants (a per-config program would be a recompile per request —
+    the exact storm the ``serve_decode`` golden pins against). The math
+    mirrors ``make_sampler`` op-for-op (same temperature clamp, same
+    sort-based top-k cutoff, same ``jax.random.categorical``) so a row
+    here and a single-request ``generate()`` with the same settings and
+    key draw the SAME token — parity-pinned in
+    tests/transformer/test_serving.py. ``temperature <= 0`` short-
+    circuits to argmax: greedy stays the default AND the zero-
+    temperature limit, with no randomness consumed."""
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits.astype(jnp.float32) / jnp.maximum(
+        temperatures, 1e-6
+    )[:, None]
+    # traced per-row k: make_sampler's static `sort(...)[..., -k]` becomes
+    # a take_along_axis at index vocab - k on the ascending sort — the
+    # identical cutoff value, so the masked logits match bit-for-bit
+    sorted_scaled = jnp.sort(scaled, axis=-1)
+    k_active = (top_ks > 0) & (top_ks < vocab)
+    k_idx = jnp.clip(vocab - top_ks, 0, vocab - 1)
+    kth = jnp.take_along_axis(sorted_scaled, k_idx[:, None], axis=-1)
+    scaled = jnp.where(
+        k_active[:, None] & (scaled < kth), -jnp.inf, scaled
+    )
+    sampled = jax.vmap(
+        lambda key, row: jax.random.categorical(key, row[None], axis=-1)[0]
+    )(keys, scaled)
+    return jnp.where(temperatures <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+def request_sample_key(base_key: jax.Array, req_id: jax.Array,
+                       num_generated: jax.Array) -> jax.Array:
+    """The per-token sampling key: ``fold_in(fold_in(base, req_id), n)``
+    where ``n`` counts tokens already generated for the request.
+
+    Keyed by REQUEST position, not by engine tick: a preempted-and-
+    resumed sequence regenerates its tokens at the same positions and so
+    redraws the SAME samples — recompute-style preemption stays invisible
+    in the output even for temperature > 0 rows."""
+    return jax.random.fold_in(
+        jax.random.fold_in(base_key, req_id), num_generated
+    )
+
+
 class TransformerInferenceModule:
     """Single-host inference over a trained checkpoint."""
 
@@ -237,9 +292,15 @@ class TransformerInferenceModule:
         return cls(config, module, params, tokenizer)
 
     # ------------------------------------------------------------- forward
-    def _run_layers(self, params, batch, caches, offset):
+    def _run_layers(self, params, batch, caches, offset, paged_kernel=None):
         """One pass through the stack; TransformerLayers consume/produce the
         KV caches, edge layers run as in training (deterministic).
+
+        ``paged_kernel`` (static; serving engine only) selects the
+        attention back-end for block-paged caches: 'pallas' streams KV
+        blocks through the flash-style kernel (nn/paged_attention.py),
+        'xla' gathers each row's window (the fallback). Dense caches
+        ignore it.
 
         A pipelined (pp>1) stack wraps its TransformerLayers in a
         ``PipelinedBody``, which cannot consume KV caches: the cached path
@@ -250,6 +311,8 @@ class TransformerInferenceModule:
         from ...parallel.pipeline import PipelinedBody
 
         ctx = self.module._make_ctx(deterministic=True, dropout_key=None)
+        if paged_kernel is not None:
+            ctx.paged_kernel = paged_kernel
         x = batch
         new_caches = []
         li = 0

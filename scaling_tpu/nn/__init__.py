@@ -5,6 +5,7 @@ from .attention import (
     multi_head_attention,
     repeat_kv,
 )
+from .paged_attention import paged_decode_attention
 from .base_layer import BaseLayer, ForwardContext, LayerSpec, PipelineBodySpec, TiedLayerSpec
 from .linear import (
     ColumnParallelLinear,
@@ -46,6 +47,7 @@ __all__ = [
     "PagedKVCacheView",
     "ParallelSelfAttention",
     "multi_head_attention",
+    "paged_decode_attention",
     "repeat_kv",
     "BaseLayer",
     "ForwardContext",

@@ -66,6 +66,13 @@ class PagedKVCacheView(NamedTuple):
     ``pool_k``/``pool_v`` are ``(num_blocks, block_size, n_kv, h)``;
     float (dense) or int8 with per-slot-per-head ``scale_k``/``scale_v``
     of shape ``(num_blocks, block_size, n_kv)`` (quantized KV).
+
+    ``new_len`` (per row, optional) is how many of the ``s`` presented
+    tokens are REAL: a prefill CHUNK padded to its fixed program shape
+    routes its pad tokens' KV to the trash block and excludes their
+    slots from every mask, so one compiled chunk program serves every
+    chunk length (Sarathi-style chunked prefill, serve/engine.py).
+    ``None`` means all ``s`` tokens are real (the decode step).
     """
 
     pool_k: jax.Array
@@ -74,6 +81,7 @@ class PagedKVCacheView(NamedTuple):
     context_len: jax.Array  # (b,) int32 tokens already cached per row
     scale_k: Optional[jax.Array] = None
     scale_v: Optional[jax.Array] = None
+    new_len: Optional[jax.Array] = None  # (b,) int32 real tokens among s
 
     @property
     def quantized(self) -> bool:
@@ -419,7 +427,7 @@ class ParallelSelfAttention(BaseLayer):
             assert self.num_local_attention_heads == 0, (
                 "local-window heads are unsupported on the paged decode path"
             )
-            out, new_view = self._paged_attention(q, k, v, kv_cache, b, s)
+            out, new_view = self._paged_attention(q, k, v, kv_cache, b, s, ctx)
             return self._project_out(params, out, ctx, b, s, new_view)
 
         if kv_cache is not None:
@@ -593,24 +601,64 @@ class ParallelSelfAttention(BaseLayer):
 
         return self._project_out(params, out, ctx, b, s, new_kv)
 
-    def _paged_attention(self, q, k, v, view: PagedKVCacheView, b: int, s: int):
-        """Decode through the block-paged KV pool: scatter the ``s`` new
-        tokens per row into the pool, gather each row's blocks back as a
-        contiguous (b, max_blocks*block_size, n_kv, h) window, and run the
-        unfused attention with slot-validity + causal masking. One jitted
-        program serves every mix of sequence lengths — raggedness lives
-        entirely in ``block_table``/``context_len``, never in shapes."""
+    def _paged_attention(self, q, k, v, view: PagedKVCacheView, b: int, s: int,
+                         ctx: ForwardContext):
+        """Decode (or chunk-prefill) through the block-paged KV pool:
+        scatter the ``s`` new tokens per row into the pool, then attend
+        each row over its blocks with slot-validity + causal masking. One
+        jitted program serves every mix of sequence lengths — raggedness
+        lives entirely in ``block_table``/``context_len``/``new_len``,
+        never in shapes.
+
+        Two attention back-ends behind one scatter (``ctx.paged_kernel``):
+
+        - ``'pallas'`` — the flash-style streaming kernel
+          (nn/paged_attention.py): KV blocks DMA from the pool per row
+          into an online softmax; no gathered window is materialized.
+          Runs interpreted off-TPU, so the CPU mesh tests the real body.
+        - ``'xla'`` — the fallback: gather each row's blocks as one
+          contiguous (b, max_blocks*block_size, n_kv, h) window, then run
+          the unfused attention. Fine on CPU, pure extra HBM traffic on
+          a chip.
+        """
         block_size = view.pool_k.shape[1]
         max_blocks = view.block_table.shape[1]
         window = max_blocks * block_size
         ctx_len = view.context_len.astype(jnp.int32)
+        if view.new_len is None:
+            new_len = jnp.full((b,), s, jnp.int32)
+        else:
+            new_len = view.new_len.astype(jnp.int32)
 
-        # --- write: rows' next s slots (inactive rows: table is all-trash)
+        # --- write: rows' next new_len slots (inactive rows: table is
+        # all-trash); chunk padding past new_len routes to the trash block
+        # — a clamped write into the row's own blocks would corrupt the
+        # slots the NEXT chunk is about to fill
         positions = ctx_len[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+        real = jnp.arange(s, dtype=jnp.int32)[None, :] < new_len[:, None]
         flat = paged_flat_slots(view.block_table, positions, block_size)
+        flat = jnp.where(real, flat, 0)
         new_view = paged_scatter_kv(
             view, flat.reshape(-1),
             k.reshape(b * s, *k.shape[2:]), v.reshape(b * s, *v.shape[2:]),
+        )
+
+        valid_len = ctx_len + new_len  # written slots per row
+        kernel = getattr(ctx, "paged_kernel", "xla")
+        if kernel == "pallas":
+            from .paged_attention import paged_decode_attention
+
+            out = paged_decode_attention(
+                q, new_view.pool_k, new_view.pool_v,
+                view.block_table, valid_len, ctx_len,
+                sm_scale=self.scaling_factor,
+                num_repeat_kv=self.num_repeat_kv,
+                scale_k=new_view.scale_k, scale_v=new_view.scale_v,
+            )
+            return out, new_view
+        assert kernel == "xla", (
+            f"unknown paged_kernel {kernel!r} (expected 'pallas' or 'xla') "
+            "— refusing to silently pick an attention path"
         )
 
         # --- gather: each row's blocks as one contiguous KV window
@@ -631,7 +679,7 @@ class ParallelSelfAttention(BaseLayer):
             jnp.arange(window, dtype=jnp.int32)[None, :], (b, window)
         )
         slots_q = positions  # (b, s)
-        valid_k = slots_k < (ctx_len + s)[:, None]
+        valid_k = slots_k < valid_len[:, None]
         allowed = valid_k[:, None, :] & (
             slots_k[:, None, :] <= slots_q[:, :, None]
         )

@@ -37,6 +37,11 @@ class ForwardContext:
     context_parallel_variant: str = "ring"
     # mesh is needed for explicit collectives; None on single device
     mesh: Optional[Any] = None
+    # paged-decode attention back-end (static): 'xla' gathers each row's
+    # block window, 'pallas' streams blocks through the flash-style
+    # kernel (nn/paged_attention.py). Only the serving engine's programs
+    # flip this (TransformerInferenceModule._run_layers paged_kernel=).
+    paged_kernel: str = "xla"
 
     _key_counter: int = 0
 

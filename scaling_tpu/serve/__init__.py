@@ -9,10 +9,15 @@ The "millions of users" half of the north star: turns the single-request
 - :mod:`.scheduler` — continuous batching (Orca, OSDI '22): admission
   from a request queue, per-tick prefill/decode mixing under a token
   budget, preemption on pool exhaustion, completed-slot recycling.
-- :mod:`.engine` — the jitted device programs: one bucketed prefill per
-  prompt-length bucket, ONE decode program for the whole slot set (no
-  per-request recompiles; signatures pinned in the ``serve_decode`` HLO
-  audit section).
+- :mod:`.engine` — the jitted device programs: ONE decode program for
+  the whole slot set (paged attention streamed through the Pallas
+  kernel in ``nn/paged_attention.py`` by default, XLA gather as the
+  fallback), ONE chunked-prefill program per chunk size (Sarathi-style
+  — several prompts stream per tick) or one bucketed whole-prompt
+  prefill per length bucket in legacy mode, per-request
+  temperature/top-k sampling as traced per-row arrays (no per-request
+  recompiles; signatures pinned in the ``serve_decode`` HLO audit
+  section).
 - :mod:`.bench` / ``python -m scaling_tpu.serve bench`` — Poisson
   load generator reporting tokens/s and TTFT/ITL percentiles through
   ``obs.get_registry()``, gated by ``--assert-serve-throughput`` /

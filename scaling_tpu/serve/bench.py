@@ -145,7 +145,8 @@ def run_bench(engine: ServeEngine, workload, time_scale: float = 1.0,
         "itl_p99_s": pct(itls, 99),
         "preemptions": engine.scheduler.preemption_count,
         "ticks": engine.tick_index,
-        "prefill_compiles": len(engine._prefill_fns),
+        "prefill_compiles": engine.prefill_program_count,
+        "max_concurrent_prefills": engine.max_concurrent_prefills,
     }
     logger.log_event("serve-summary", **stats)
     get_registry().flush_step(engine.tick_index)
@@ -175,6 +176,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--token-budget", type=int, default=512)
     parser.add_argument("--kv-dtype", choices=["native", "int8"],
                         default="native")
+    parser.add_argument("--prefill-chunk", type=int, default=32,
+                        help="Sarathi-style chunked prefill: tokens per "
+                        "chunk (prompts stream into the pool sharing the "
+                        "tick budget with decodes); 0 = legacy "
+                        "whole-prompt prefill")
+    parser.add_argument("--paged-kernel", choices=["pallas", "xla"],
+                        default="pallas",
+                        help="paged-decode attention back-end: the "
+                        "streaming Pallas kernel (interpreted off-TPU) or "
+                        "the XLA block-window gather fallback")
     # toy model knobs / real checkpoint
     parser.add_argument("--hidden", type=int, default=64)
     parser.add_argument("--layers", type=int, default=2)
@@ -243,6 +254,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         num_blocks=args.num_blocks,
         max_blocks_per_seq=args.max_blocks_per_seq,
         token_budget=args.token_budget, kv_dtype=args.kv_dtype,
+        prefill_chunk=args.prefill_chunk or None,
+        paged_kernel=args.paged_kernel,
     ))
     workload = sample_workload(
         args.requests, args.rate, tuple(args.prompt_len),
@@ -254,6 +267,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(f"  requests={stats['requests']} wall={stats['wall_s']:.3f}s "
           f"ticks={stats['ticks']} preemptions={stats['preemptions']} "
           f"prefill_compiles={stats['prefill_compiles']}")
+    print(f"  hot path: paged_kernel={args.paged_kernel} "
+          f"prefill_chunk={args.prefill_chunk or 'off'} "
+          f"max_concurrent_prefills={stats['max_concurrent_prefills']}")
     print(f"  output tokens/s: {stats['tokens_per_s']:.1f} "
           f"({stats['output_tokens']} tokens)")
     print(f"  ttft: p50={stats['ttft_p50_s']:.4f}s "

@@ -1,28 +1,47 @@
 """The serving engine: jitted prefill/decode programs + the tick loop.
 
-Prefill/decode split (Orca; Sarathi): per tick the scheduler mixes new
-prompts (prefill — compute-bound, runs through the SAME ``prefill_forward``
-the dense-cache generate path uses, so the flash kernel stays active) with
-one decode token for every running sequence (memory-bound, one jitted
-program over the WHOLE slot set).
+Prefill/decode split (Orca; Sarathi): per tick the scheduler mixes
+prompt prefill work with one decode token for every running sequence
+(memory-bound, one jitted program over the WHOLE slot set). Prefill runs
+in one of two modes:
+
+- **chunked** (default; Sarathi-style): prompts stream into the paged
+  pool in fixed-size chunks through ONE compiled chunk program per chunk
+  size — each chunk scatters its KV at the sequence's next slots and
+  attends over the pool (the same paged-attention path decode uses), so
+  several prompts prefill in the same tick and a long prompt can never
+  monopolize it;
+- **whole-prompt** (``prefill_chunk=None``): one prompt per tick through
+  the SAME ``prefill_forward`` the dense-cache generate path uses (the
+  flash kernel stays active), compiled once per pow2 prompt-length
+  bucket.
+
+Decode attention streams KV blocks through the Pallas paged-decode
+kernel by default (``paged_kernel='pallas'``, nn/paged_attention.py —
+interpreted off-TPU so the CPU mesh runs the real kernel body); the
+XLA gather path stays config-selectable (``paged_kernel='xla'``).
 
 No per-request recompiles, by construction:
 
 - the decode program compiles ONCE per engine: its shapes are the fixed
-  ``(num_slots, max_blocks_per_seq)`` batch — sequence raggedness lives in
-  block tables and context lengths, never in shapes;
-- prefill compiles once per PROMPT-LENGTH BUCKET (power-of-two ladder);
-  prompts are right-padded to their bucket, pads sit in their own
-  attention segment and write KV to the trash block.
+  ``(num_slots, max_blocks_per_seq)`` batch — sequence raggedness lives
+  in block tables and context lengths, never in shapes;
+- chunk programs compile once per CHUNK SIZE (the final ragged chunk of
+  every prompt pads to the chunk shape; pads write KV to the trash block
+  and are masked — ``PagedKVCacheView.new_len``); bucketed prefill
+  compiles once per pow2 prompt-length bucket.
 
-Both signatures are pinned in the ``serve_decode`` HLO-audit section
-(analysis/goldens/serve_decode.json): a scheduler shape-bucketing change
-that would trigger a recompile storm on the chip shows up as golden
-drift in CI instead.
+All signatures are pinned in the ``serve_decode`` HLO-audit section
+(analysis/goldens/serve_decode.json): a scheduler shape-bucketing or
+kernel change that would trigger a recompile storm on the chip shows up
+as golden drift in CI instead.
 
-Greedy (argmax) sampling: continuous batching re-batches requests across
-ticks, and greedy decode is what makes a preempted-and-resumed sequence
-regenerate token-for-token (scheduler.py).
+Sampling is per-request (``inference.sample_rows``): temperature/top-k
+ride the jitted programs as traced per-row arrays, greedy is the
+``temperature=0`` default. Sample keys derive from (request id, token
+position) — ``inference.request_sample_key`` — so a preempted-and-
+resumed sequence redraws the SAME tokens and recompute-style preemption
+(scheduler.py) stays invisible in the output even for sampled rows.
 """
 
 from __future__ import annotations
@@ -33,7 +52,7 @@ from typing import Dict, List, Optional
 
 from .. import obs
 from ..logging import logger
-from .kvcache import PagedKVPools, init_pools, write_prompt_kv
+from .kvcache import PagedKVPools, build_layer_views, init_pools, write_prompt_kv
 from .scheduler import (
     ContinuousBatchingScheduler,
     Request,
@@ -47,7 +66,7 @@ MIN_PREFILL_BUCKET = 8
 
 def prefill_bucket(prompt_len: int) -> int:
     """Power-of-two length ladder; every prompt length in a bucket shares
-    one compiled prefill program."""
+    one compiled prefill program (whole-prompt mode only)."""
     b = MIN_PREFILL_BUCKET
     while b < prompt_len:
         b *= 2
@@ -62,7 +81,22 @@ class EngineConfig:
     max_blocks_per_seq: int = 16
     token_budget: int = 512
     kv_dtype: str = "native"  # 'native' | 'int8'
+    # Sarathi-style chunked prefill (tokens per chunk); None = legacy
+    # whole-prompt prefill through the pow2 bucket ladder
+    prefill_chunk: Optional[int] = 32
+    # paged-decode attention back-end: 'pallas' streams KV blocks through
+    # the flash-style kernel (nn/paged_attention.py; interpreted off-TPU),
+    # 'xla' gathers each row's whole block window (the fallback)
+    paged_kernel: str = "pallas"
+    sample_seed: int = 0  # base key for per-request sampling
     flush_interval: int = 50  # registry flush cadence (ticks)
+
+    def __post_init__(self):
+        if self.paged_kernel not in ("pallas", "xla"):
+            raise ValueError(
+                f"paged_kernel must be 'pallas' or 'xla', "
+                f"got {self.paged_kernel!r}"
+            )
 
     def scheduler_config(self) -> SchedulerConfig:
         return SchedulerConfig(
@@ -70,6 +104,7 @@ class EngineConfig:
             num_blocks=self.num_blocks,
             max_blocks_per_seq=self.max_blocks_per_seq,
             token_budget=self.token_budget,
+            prefill_chunk=self.prefill_chunk,
         )
 
 
@@ -96,22 +131,33 @@ class ServeEngine:
         self._tables = np.zeros((n, m), np.int32)
         self._ctx = np.zeros((n,), np.int32)
         self._tok = np.zeros((n,), np.int32)
+        # per-slot sampler state (traced per-row arrays in the programs)
+        self._temp = np.zeros((n,), np.float32)
+        self._topk = np.zeros((n,), np.int32)
+        self._reqid = np.zeros((n,), np.int32)
+        self._gen = np.zeros((n,), np.int32)
+        self._base_key = jax.random.PRNGKey(self.config.sample_seed)
         self._decode_fn = None
-        self._prefill_fns: Dict[int, object] = {}
+        self._prefill_fns: Dict[int, object] = {}  # whole-prompt buckets
+        self._chunk_fns: Dict[int, object] = {}  # chunk-size -> program
         self.tick_index = 0
         self.finished: List[Sequence] = []
+        self.max_concurrent_prefills = 0
         self._next_req_id = 0
         self._reg = obs.get_registry()
 
     # ------------------------------------------------------------- intake
     def submit(self, prompt: List[int], max_new_tokens: int,
                arrival_s: Optional[float] = None,
-               eos_token_id: Optional[int] = None) -> Sequence:
+               eos_token_id: Optional[int] = None,
+               temperature: float = 0.0,
+               top_k: Optional[int] = None) -> Sequence:
         req = Request(
             req_id=self._next_req_id, prompt=list(prompt),
             max_new_tokens=max_new_tokens,
             arrival_s=time.monotonic() if arrival_s is None else arrival_s,
             eos_token_id=eos_token_id,
+            temperature=temperature, top_k=top_k,
         )
         self._next_req_id += 1
         self._reg.counter("serve_requests_admitted_total").inc()
@@ -122,28 +168,31 @@ class ServeEngine:
         p = self.pools
         return (p.pool_k, p.pool_v, p.scale_k, p.scale_v)
 
-    def _views_from_state(self, state, block_table, context_len):
-        pool_k, pool_v, scale_k, scale_v = state
-        from ..nn.attention import PagedKVCacheView
-
-        return [
-            PagedKVCacheView(
-                pool_k=pool_k[i], pool_v=pool_v[i],
-                block_table=block_table, context_len=context_len,
-                scale_k=None if scale_k is None else scale_k[i],
-                scale_v=None if scale_v is None else scale_v[i],
-            )
-            for i in range(len(pool_k))
-        ]
+    def _views_from_state(self, state, block_table, context_len,
+                          new_len=None):
+        return build_layer_views(state, block_table, context_len, new_len)
 
     def _absorb(self, views) -> None:
         self.pools.absorb_views(views)
+
+    def _sample_last(self, logits, temps, topks, reqids, gens, base_key):
+        """Shared sampling epilogue: per-row keys from (request, position),
+        then the per-row temperature/top-k sampler."""
+        from ..models.transformer.inference import (
+            request_sample_key, sample_rows,
+        )
+
+        keys = self._jax.vmap(
+            request_sample_key, in_axes=(None, 0, 0)
+        )(base_key, reqids, gens)
+        return sample_rows(logits, temps, topks, keys)
 
     def _build_prefill_fn(self, bucket: int):
         jnp = self._jax.numpy
         block_size = self.config.block_size
 
-        def prefill(params, state, tokens, block_row, prompt_len):
+        def prefill(params, state, tokens, block_row, prompt_len,
+                    temp, topk, reqid, gen, base_key):
             b, L = tokens.shape  # (1, bucket)
             pos = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None], (b, L))
             # bucket padding sits in its own segment: content never
@@ -159,7 +208,9 @@ class ServeEngine:
                 write_prompt_kv(view, k, v, block_row, prompt_len, block_size)
                 for view, (k, v) in zip(views, kvs)
             ]
-            next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            next_tok = self._sample_last(
+                logits[:, -1], temp, topk, reqid, gen, base_key
+            )
             return next_tok, new_views
 
         # same lifecycle as decode: the old pool state dies with the call
@@ -169,15 +220,52 @@ class ServeEngine:
         donate = (1,) if self._jax.default_backend() != "cpu" else ()
         return self._jax.jit(prefill, donate_argnums=donate)
 
-    def _build_decode_fn(self):
+    def _build_chunk_fn(self, chunk: int):
+        """ONE compiled program per chunk size: scatter the chunk's KV at
+        the sequence's next slots and attend over the pool — the same
+        paged path decode uses, so a chunk sees every previous chunk's KV
+        without any per-prompt-length shapes. ``new_len`` routes the
+        final ragged chunk's padding to the trash block."""
         jnp = self._jax.numpy
 
-        def decode(params, state, tables, ctx_lens, tokens):
-            b = tokens.shape[0]
+        def chunk_prefill(params, state, tokens, block_row, ctx_len, new_len,
+                          temp, topk, reqid, gen, base_key):
+            b, L = tokens.shape  # (1, chunk)
+            pos = ctx_len[:, None] + jnp.arange(L, dtype=jnp.int32)[None, :]
+            batch = self.inf._make_batch(tokens, pos)
+            views = self._views_from_state(
+                state, block_row[None, :], ctx_len, new_len
+            )
+            logits, new_views = self.inf._run_layers(
+                params, batch, views, None,
+                paged_kernel=self.config.paged_kernel,
+            )
+            # the chunk's last REAL position predicts the next token; it
+            # only counts when this chunk completes the prompt (host-side
+            # decision — mid-prompt samples are discarded)
+            last = self._jax.lax.dynamic_slice_in_dim(
+                logits, new_len[0] - 1, 1, axis=1
+            )[:, 0]
+            next_tok = self._sample_last(
+                last, temp, topk, reqid, gen, base_key
+            )
+            return next_tok, new_views
+
+        donate = (1,) if self._jax.default_backend() != "cpu" else ()
+        return self._jax.jit(chunk_prefill, donate_argnums=donate)
+
+    def _build_decode_fn(self):
+        def decode(params, state, tables, ctx_lens, tokens,
+                   temps, topks, reqids, gens, base_key):
             batch = self.inf._make_batch(tokens[:, None], ctx_lens[:, None])
             views = self._views_from_state(state, tables, ctx_lens)
-            logits, new_views = self.inf._run_layers(params, batch, views, None)
-            next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            logits, new_views = self.inf._run_layers(
+                params, batch, views, None,
+                paged_kernel=self.config.paged_kernel,
+            )
+            next_tok = self._sample_last(
+                logits[:, -1], temps, topks, reqids, gens, base_key
+            )
             return next_tok, new_views
 
         # the pool state dies with each call — donating it lets XLA run
@@ -192,8 +280,30 @@ class ServeEngine:
             self._tables[s] = 0
             self._ctx[s] = 0
             self._tok[s] = 0
+            self._temp[s] = 0.0
+            self._topk[s] = 0
+            self._reqid[s] = 0
+            self._gen[s] = 0
+
+    def _admit_slot(self, seq: Sequence) -> None:
+        """Per-slot sampler state for a newly-admitted sequence."""
+        slot = seq.slot
+        self._temp[slot] = seq.request.temperature
+        self._topk[slot] = seq.request.top_k or 0
+        self._reqid[slot] = seq.request.req_id
+
+    def _scalar_sample_args(self, seq: Sequence):
+        np = self._np
+        return (
+            np.asarray([seq.request.temperature], np.float32),
+            np.asarray([seq.request.top_k or 0], np.int32),
+            np.asarray([seq.request.req_id], np.int32),
+            np.asarray([len(seq.generated)], np.int32),
+        )
 
     def _run_prefill(self, seq: Sequence) -> None:
+        """Whole-prompt prefill (legacy mode): one pow2-bucketed program
+        pass over the entire resume prompt."""
         np = self._np
         prompt = seq.resume_prompt
         bucket = prefill_bucket(len(prompt))
@@ -203,6 +313,7 @@ class ServeEngine:
         tokens[0, :len(prompt)] = prompt
         block_row = np.zeros((self.config.max_blocks_per_seq,), np.int32)
         block_row[:len(seq.blocks)] = seq.blocks
+        self._admit_slot(seq)
         with obs.span("serve.prefill", step=self.tick_index,
                       tokens=len(prompt)):
             next_tok, new_views = self._prefill_fns[bucket](
@@ -210,6 +321,7 @@ class ServeEngine:
                 self._jax.numpy.asarray(tokens),
                 self._jax.numpy.asarray(block_row),
                 self._jax.numpy.int32(len(prompt)),
+                *self._scalar_sample_args(seq), self._base_key,
             )
             tok = int(np.asarray(next_tok)[0])
         self._absorb(new_views)
@@ -222,23 +334,77 @@ class ServeEngine:
         self._emit_token(seq, tok, now)
         self._reg.counter("serve_prefill_tokens_total").inc(len(prompt))
 
+    def _run_prefill_chunk(self, seq: Sequence) -> None:
+        """One fixed-size chunk of ``seq``'s prompt: scatter its KV into
+        the pool (pads to trash) and, when it completes the prompt, emit
+        the first token."""
+        np = self._np
+        chunk = self.config.prefill_chunk
+        if chunk not in self._chunk_fns:
+            self._chunk_fns[chunk] = self._build_chunk_fn(chunk)
+        prompt = seq.resume_prompt
+        start = seq.num_cached
+        n_real = min(chunk, len(prompt) - start)
+        assert n_real > 0, "chunk scheduled for a fully-prefilled sequence"
+        tokens = np.zeros((1, chunk), np.int32)
+        tokens[0, :n_real] = prompt[start:start + n_real]
+        block_row = np.zeros((self.config.max_blocks_per_seq,), np.int32)
+        block_row[:len(seq.blocks)] = seq.blocks
+        if start == 0:
+            self._admit_slot(seq)
+        finishing = start + n_real == len(prompt)
+        with obs.span("serve.prefill_chunk", step=self.tick_index,
+                      tokens=n_real, start=start):
+            next_tok, new_views = self._chunk_fns[chunk](
+                self.inf.params, self._pool_state(),
+                self._jax.numpy.asarray(tokens),
+                self._jax.numpy.asarray(block_row),
+                self._jax.numpy.asarray([start], np.int32),
+                self._jax.numpy.asarray([n_real], np.int32),
+                *self._scalar_sample_args(seq), self._base_key,
+            )
+            tok = int(np.asarray(next_tok)[0])
+        self._absorb(new_views)
+        slot = seq.slot
+        self._tables[slot] = block_row
+        self._ctx[slot] = start + n_real
+        seq.num_cached = start + n_real
+        self._reg.counter("serve_prefill_tokens_total").inc(n_real)
+        if finishing:
+            self._tok[slot] = tok
+            self._emit_token(seq, tok, time.monotonic())
+
     def _run_decode(self, decodes: List[Sequence]) -> None:
         np = self._np
         if self._decode_fn is None:
             self._decode_fn = self._build_decode_fn()
+        active = np.zeros((self.config.num_slots,), bool)
         for seq in decodes:
             # the scheduler may have grown this row's block list since the
             # table row was last written (incremental allocation)
             row = self._tables[seq.slot]
             row[:] = 0
             row[:len(seq.blocks)] = seq.blocks
+            self._gen[seq.slot] = len(seq.generated)
+            active[seq.slot] = True
+        # rows not decoding this tick (empty, or mid-prefill under
+        # chunked prefill) run against an all-trash table with ctx 0:
+        # their device-side writes can never land in blocks a prefilling
+        # sequence is about to fill
+        tables = np.where(active[:, None], self._tables, 0)
+        ctx = np.where(active, self._ctx, 0)
         with obs.span("serve.decode", step=self.tick_index,
                       batch=len(decodes)):
             next_tok, new_views = self._decode_fn(
                 self.inf.params, self._pool_state(),
-                self._jax.numpy.asarray(self._tables),
-                self._jax.numpy.asarray(self._ctx),
+                self._jax.numpy.asarray(tables),
+                self._jax.numpy.asarray(ctx),
                 self._jax.numpy.asarray(self._tok),
+                self._jax.numpy.asarray(self._temp),
+                self._jax.numpy.asarray(self._topk),
+                self._jax.numpy.asarray(self._reqid),
+                self._jax.numpy.asarray(self._gen),
+                self._base_key,
             )
             toks = np.asarray(next_tok)
         self._absorb(new_views)
@@ -285,14 +451,20 @@ class ServeEngine:
         )
 
     def tick(self) -> Tick:
-        """One engine step: schedule, prefill admissions, decode the
-        running set, retire completions."""
+        """One engine step: schedule, prefill admissions/chunks, decode
+        the running set, retire completions."""
         t = self.scheduler.schedule()
         if t.preempted:
             self._reg.counter("serve_preemptions_total").inc(len(t.preempted))
         self._reset_rows(self.scheduler.drain_freed_slots())
+        chunked = self.config.prefill_chunk is not None
         for seq in t.prefills:
-            self._run_prefill(seq)
+            if chunked:
+                self._run_prefill_chunk(seq)
+            else:
+                self._run_prefill(seq)
+        if len(t.prefills) > self.max_concurrent_prefills:
+            self.max_concurrent_prefills = len(t.prefills)
         if t.decodes:
             self._run_decode(t.decodes)
         now = time.monotonic()
@@ -306,6 +478,12 @@ class ServeEngine:
         if self.tick_index % self.config.flush_interval == 0:
             self._reg.flush_step(self.tick_index)
         return t
+
+    @property
+    def prefill_program_count(self) -> int:
+        """Compiled prefill-side programs: pow2 buckets (whole-prompt
+        mode) plus chunk programs (bounded by the chunk-size set)."""
+        return len(self._prefill_fns) + len(self._chunk_fns)
 
     def run_until_done(self, max_ticks: int = 100_000) -> List[Sequence]:
         """Drain every submitted request; returns finished sequences in

@@ -20,7 +20,7 @@ so the prefill writer here and the decode-step write inside
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +30,34 @@ from ..nn.attention import (
     paged_flat_slots,
     paged_scatter_kv,
 )
+
+
+def build_layer_views(
+    state: Tuple,                    # (pool_k, pool_v, scale_k, scale_v)
+    block_table: jax.Array,          # (rows, max_blocks) int32
+    context_len: jax.Array,          # (rows,) int32
+    new_len: Optional[jax.Array] = None,  # (rows,) int32 real new tokens
+) -> List[PagedKVCacheView]:
+    """Per-layer :class:`PagedKVCacheView` s over the raw pool state —
+    the shape the engine's jitted programs thread through ``_run_layers``.
+
+    ``new_len`` carries the chunked-prefill pad contract (mid-prompt
+    pad-to-trash routing): of the ``s`` tokens a fixed-size chunk
+    program presents, only the first ``new_len`` per row are real — the
+    attention path writes the rest to the trash block and masks their
+    slots, so ONE compiled chunk program serves every chunk length
+    (including the final ragged chunk of every prompt)."""
+    pool_k, pool_v, scale_k, scale_v = state
+    return [
+        PagedKVCacheView(
+            pool_k=pool_k[i], pool_v=pool_v[i],
+            block_table=block_table, context_len=context_len,
+            scale_k=None if scale_k is None else scale_k[i],
+            scale_v=None if scale_v is None else scale_v[i],
+            new_len=new_len,
+        )
+        for i in range(len(pool_k))
+    ]
 
 
 class PagedKVPools:
@@ -95,6 +123,16 @@ def init_pools(inference_module, num_blocks: int, block_size: int,
         return inference_module.prefill_forward(p, t, po)[1]
 
     kv_shapes = jax.eval_shape(probe, params, probe_tokens, probe_pos)
+    # commit the fresh pools to the device the programs will run on: an
+    # uncommitted zeros-array keys a SECOND executable-cache entry for
+    # the engine's very first program call (every later call sees the
+    # committed jit outputs absorb_views hands back) — a silent 2x
+    # compile of the largest serving programs
+    device = jax.local_devices()[0]
+
+    def zeros(shape, dtype):
+        return jax.device_put(jnp.zeros(shape, dtype), device)
+
     pool_k: List[jax.Array] = []
     pool_v: List[jax.Array] = []
     scale_k: Optional[List[jax.Array]] = [] if kv_dtype == "int8" else None
@@ -102,11 +140,11 @@ def init_pools(inference_module, num_blocks: int, block_size: int,
     for k_aval, v_aval in kv_shapes:
         n_kv, h = k_aval.shape[2], k_aval.shape[3]
         store = jnp.int8 if kv_dtype == "int8" else k_aval.dtype
-        pool_k.append(jnp.zeros((num_blocks, block_size, n_kv, h), store))
-        pool_v.append(jnp.zeros((num_blocks, block_size, n_kv, h), store))
+        pool_k.append(zeros((num_blocks, block_size, n_kv, h), store))
+        pool_v.append(zeros((num_blocks, block_size, n_kv, h), store))
         if kv_dtype == "int8":
-            scale_k.append(jnp.zeros((num_blocks, block_size, n_kv), jnp.float32))
-            scale_v.append(jnp.zeros((num_blocks, block_size, n_kv), jnp.float32))
+            scale_k.append(zeros((num_blocks, block_size, n_kv), jnp.float32))
+            scale_v.append(zeros((num_blocks, block_size, n_kv), jnp.float32))
     return PagedKVPools(pool_k, pool_v, scale_k, scale_v, block_size)
 
 

@@ -34,13 +34,20 @@ class SequenceState(enum.Enum):
 
 @dataclasses.dataclass
 class Request:
-    """One inference request as the load generator / API submits it."""
+    """One inference request as the load generator / API submits it.
+
+    ``temperature`` / ``top_k`` are per-request sampler settings carried
+    into the engine's jitted programs as traced per-row arrays
+    (inference.sample_rows); ``temperature=0`` (the default) is greedy —
+    the zero-temperature special case, not a separate code path."""
 
     req_id: int
     prompt: List[int]
     max_new_tokens: int
     arrival_s: float = 0.0
     eos_token_id: Optional[int] = None
+    temperature: float = 0.0
+    top_k: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -53,6 +60,7 @@ class Sequence:
     slot: Optional[int] = None  # decode-batch row while RUNNING
     blocks: List[int] = dataclasses.field(default_factory=list)
     num_cached: int = 0  # tokens whose KV sits in the pool
+    prefill_len: int = 0  # resume-prompt length at (re-)admission
     preemptions: int = 0
     # telemetry stamps (engine fills these; monotonic seconds)
     first_token_s: Optional[float] = None
@@ -68,6 +76,13 @@ class Sequence:
     @property
     def remaining_tokens(self) -> int:
         return self.request.max_new_tokens - len(self.generated)
+
+    @property
+    def prefilling(self) -> bool:
+        """RUNNING but the prompt's KV is not fully in the pool yet —
+        under chunked prefill such a sequence streams chunks instead of
+        decoding (it has no first token to decode from)."""
+        return self.slot is not None and self.num_cached < self.prefill_len
 
     @property
     def done(self) -> bool:
@@ -117,17 +132,28 @@ class SchedulerConfig:
     num_blocks: int = 128  # pool size incl. the trash block
     max_blocks_per_seq: int = 16  # block-table width (jitted shape)
     token_budget: int = 512  # prompt+decode tokens admitted per tick
+    # Sarathi-style chunked prefill: prompts stream into the pool in
+    # fixed-size chunks that share the tick budget with decode rows (no
+    # prompt ever monopolizes a tick); None = legacy whole-prompt
+    # prefill through the pow2 bucket ladder
+    prefill_chunk: Optional[int] = None
 
     def __post_init__(self):
         cap = self.max_blocks_per_seq * self.block_size
         if cap < 2:
             raise ValueError("max_blocks_per_seq * block_size must be >= 2")
+        if self.prefill_chunk is not None and self.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1 (or None for whole-prompt "
+                f"prefill), got {self.prefill_chunk}"
+            )
 
 
 @dataclasses.dataclass
 class Tick:
-    """One scheduling decision: which sequences prefill, which decode,
-    who got preempted to make room."""
+    """One scheduling decision: which sequences do prefill work this
+    tick (the whole prompt, or ONE chunk each under chunked prefill),
+    which decode, who got preempted to make room."""
 
     prefills: List[Sequence]
     decodes: List[Sequence]
@@ -190,25 +216,38 @@ class ContinuousBatchingScheduler:
     def schedule(self) -> Tick:
         """One tick's worth of work.
 
-        1. GROW: every running sequence gets the block its next token
-           needs (blocks are allocated incrementally, not reserved for
-           the whole horizon — that is what lets wildly different lengths
-           share one pool). On exhaustion the youngest running sequence
-           is preempted recompute-style; a sequence that cannot grow even
-           after every younger peer is gone preempts itself and waits.
-           Oldest-first, so the oldest request always progresses — the
-           policy cannot livelock.
-        2. ADMIT: prefills from the waiting queue while a slot, enough
-           pool blocks for the prompt, and token budget remain.
+        1. GROW: every running sequence gets the blocks its next tokens
+           need — one decode token, or its next prefill CHUNK under
+           chunked prefill (blocks are allocated incrementally, not
+           reserved for the whole horizon — that is what lets wildly
+           different lengths share one pool). On exhaustion the youngest
+           running sequence is preempted recompute-style; a sequence that
+           cannot grow even after every younger peer is gone preempts
+           itself and waits. Oldest-first, so the oldest request always
+           progresses — the policy cannot livelock.
+        2. CHUNKS (chunked prefill only): every mid-prefill sequence
+           streams its next chunk, oldest first, while budget remains;
+           the oldest mid-prefill sequence always gets its chunk even on
+           a spent budget (it must finish EVENTUALLY), and decode rows
+           are charged before any chunk — a long prompt can no longer
+           monopolize a tick the way the legacy sole-prefill rule let it.
+        3. ADMIT: prefills from the waiting queue while a slot, enough
+           pool blocks (first chunk / whole prompt), and token budget
+           remain.
         """
         preempted: List[Sequence] = []
+        chunk = self.config.prefill_chunk
 
         # --- grow running sequences (oldest first)
         for seq in sorted(self.running.values(),
                           key=lambda s: s.request.req_id):
             if seq.state is not SequenceState.RUNNING:
                 continue  # evicted earlier in this very loop
-            need = self.blocks_needed(seq.num_cached + 1) - len(seq.blocks)
+            if chunk is not None and seq.prefilling:
+                step = min(chunk, seq.prefill_len - seq.num_cached)
+            else:
+                step = 1
+            need = self.blocks_needed(seq.num_cached + step) - len(seq.blocks)
             if need <= 0:
                 continue
             while (need > self.allocator.free_blocks
@@ -221,23 +260,59 @@ class ContinuousBatchingScheduler:
                 # this sequence yields to its elders until blocks free up
                 self._preempt(seq, preempted)
 
-        # each surviving running sequence decodes one token this tick
-        budget = self.config.token_budget - len(self.running)
+        # each surviving decoding sequence decodes one token this tick;
+        # mid-prefill rows don't decode (they have no token yet) and are
+        # charged per chunk below instead
+        decoding = [
+            s for s in self.running.values()
+            if not (chunk is not None and s.prefilling)
+        ]
+        budget = self.config.token_budget - len(decoding)
 
         prefills: List[Sequence] = []
+        if chunk is not None:
+            # already-running mid-prefill sequences stream their next
+            # chunk, oldest first; the first one is never budget-starved
+            # (decode rows recur every tick — waiting for a slack tick
+            # could starve the prompt forever)
+            for seq in sorted(self.running.values(),
+                              key=lambda s: s.request.req_id):
+                if not seq.prefilling:
+                    continue
+                if budget <= 0 and prefills:
+                    break
+                prefills.append(seq)
+                budget -= min(chunk, seq.prefill_len - seq.num_cached)
+
         while self.waiting and self._free_slots and budget > 0:
             # pop the head BEFORE any preemption: evicted victims re-enter
             # at the queue front, and the head must not be displaced by
             # the very sequence evicted on its behalf
             head = self.waiting.popleft()
             prompt_tokens = len(head.resume_prompt)
-            # an over-budget prompt admits only as the tick's sole prefill
-            # (a prompt longer than the whole budget must still run
-            # EVENTUALLY; making it wait for an idle tick would starve it)
-            if prompt_tokens > budget and prefills:
-                self.waiting.appendleft(head)
-                break
-            need = self.blocks_needed(prompt_tokens)
+            if chunk is not None:
+                # chunked mode admits at the chunk budget: the first
+                # chunk runs this tick, the rest stream on later ticks.
+                # A chunk that would cross the remaining budget defers to
+                # the next tick — unless the tick has no prefill work at
+                # all (the progress guarantee; overshoot is then bounded
+                # by one chunk, never by a whole prompt)
+                admit_tokens = min(chunk, prompt_tokens)
+                if admit_tokens > budget and prefills:
+                    self.waiting.appendleft(head)
+                    break
+                first_blocks = self.blocks_needed(admit_tokens)
+            else:
+                # an over-budget prompt admits only as the tick's sole
+                # prefill (a prompt longer than the whole budget must
+                # still run EVENTUALLY; making it wait for an idle tick
+                # would starve it)
+                if prompt_tokens > budget and prefills:
+                    self.waiting.appendleft(head)
+                    break
+                admit_tokens = prompt_tokens
+                first_blocks = self.blocks_needed(prompt_tokens)
+            need = first_blocks
             while (need > self.allocator.free_blocks
                    and self._preempt_youngest(head, preempted)):
                 pass
@@ -249,19 +324,22 @@ class ContinuousBatchingScheduler:
             head.slot = self._free_slots.popleft()
             head.state = SequenceState.RUNNING
             head.num_cached = 0
+            head.prefill_len = prompt_tokens
             self.running[head.slot] = head
             prefills.append(head)
-            budget -= prompt_tokens
+            budget -= admit_tokens
         # a preempted victim re-admitted this tick can be evicted AGAIN by
         # a still-older head later in the same loop — drop it from the
         # prefill list (its slot is gone; it waits at the queue front)
         prefills = [s for s in prefills if s.state == SequenceState.RUNNING]
         # decodes: running sequences that were NOT just admitted (their
-        # prefill emits this tick's token) and survived preemption
+        # prefill emits this tick's token), are not mid-prefill, and
+        # survived preemption
         new = {id(s) for s in prefills}
         decodes = [
             self.running[slot] for slot in sorted(self.running)
             if id(self.running[slot]) not in new
+            and not (chunk is not None and self.running[slot].prefilling)
         ]
         return Tick(prefills=prefills, decodes=decodes, preempted=preempted)
 
@@ -325,6 +403,9 @@ class ContinuousBatchingScheduler:
         return {
             "serve_running_seqs": float(len(self.running)),
             "serve_waiting_seqs": float(len(self.waiting)),
+            "serve_prefilling_seqs": float(
+                sum(1 for s in self.running.values() if s.prefilling)
+            ),
             "serve_free_blocks": float(self.allocator.free_blocks),
             "serve_pool_utilization": held / usable if usable else 0.0,
         }

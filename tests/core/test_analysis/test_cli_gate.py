@@ -97,9 +97,20 @@ def test_audit_gate_serve_decode_matches_golden(tmp_path):
     assert sec["infeed_outfeed"] == 0
     static = sec["recompile_key"]["static"]
     assert static["kind"] == "serve_decode"
-    # shapes in the signature come from engine CONFIG, never per request
+    # shapes in the signature come from engine CONFIG, never per request;
+    # the hot-path policy knobs (ISSUE 10) are pinned alongside
     assert {"num_slots", "block_size", "max_blocks_per_seq",
-            "min_prefill_bucket"} <= set(static)
+            "min_prefill_bucket", "paged_kernel", "prefill_chunk"} <= set(
+                static)
+    assert static["paged_kernel"] == "pallas"
+    # the chunked-prefill program rides the same golden: one compile per
+    # CHUNK SIZE, never per prompt length (ctx/new_len are traced)
+    chunk = sec["chunk_program"]
+    assert chunk["static"]["kind"] == "serve_chunk_prefill"
+    assert chunk["static"]["prefill_chunk"] == static["prefill_chunk"]
+    # off-TPU the paged kernel runs interpreted (inlined HLO, 0 custom
+    # calls); an on-chip repin records the real custom-call count
+    assert sec["pallas_custom_calls"] == 0
 
 
 def test_audit_gate_detects_seeded_drift(tmp_path):

@@ -165,6 +165,26 @@ def test_swallowed_exception_only_flagged_in_scope_dirs(tmp_path):
         assert [f.rule for f in lint_file(f2, root=tmp_path)] == ["STA007"], scope
 
 
+def test_paged_kernel_module_is_lint_scoped_and_clean():
+    """ISSUE 10 satellite: the new Pallas paged-decode kernel module
+    (nn/paged_attention.py) sits inside the traced-module allowlist —
+    STA001-006/STA008 apply to it, a traced-context violation there
+    would fire — and the clean tree stays at zero findings over it."""
+    from pathlib import Path
+
+    from scaling_tpu.analysis.lint import _ModuleLint, lint_file
+
+    repo = Path(__file__).resolve().parents[3]
+    module = repo / "scaling_tpu" / "nn" / "paged_attention.py"
+    assert module.is_file()
+    ml = _ModuleLint(
+        module, "scaling_tpu/nn/paged_attention.py", module.read_text()
+    )
+    assert ml.in_traced_dir  # STA008 and the traced-context rules apply
+    findings = lint_file(module, root=repo)
+    assert [f.rule for f in findings] == [], findings
+
+
 def test_stage_shift_concat_variants(tmp_path):
     """STA008 (ISSUE 8 satellite, PR 7 follow-up): the expand+partial-
     slice concatenate fires in a traced context in every spelling the

@@ -1,8 +1,11 @@
-"""Serving smoke e2e (ISSUE 9 tier-1 satellite): a subprocess run of the
-real benchmark entrypoint serving ~8 concurrent toy requests on the CPU
-mesh, then the real ``obs report`` analyzer over its run dir — the
-serving section parses, the gates pass at sane thresholds and fail at
-absurd ones."""
+"""Serving smoke e2e (ISSUE 9, hot path rebuilt in ISSUE 10): a
+subprocess run of the real benchmark entrypoint serving ~8 concurrent
+toy requests on the CPU mesh — through the Pallas paged-decode kernel
+(interpreted) WITH chunked prefill, so the tier-1 smoke exercises the
+production hot path, not the fallbacks — then the real ``obs report``
+analyzer over its run dir: the serving section parses (including the
+prefill-chunk vs decode tick-time attribution), the gates pass at sane
+thresholds and fail at absurd ones."""
 
 import json
 import os
@@ -19,6 +22,10 @@ BENCH_ARGS = [
     "--prompt-len", "4", "12", "--output-len", "3", "6",
     "--num-slots", "4", "--block-size", "4", "--num-blocks", "64",
     "--max-blocks-per-seq", "8", "--token-budget", "64",
+    # the hot path: streaming Pallas kernel + 4-token prefill chunks
+    # (prompts of 4-12 tokens span 1-3 chunks, so several prompts are
+    # mid-prefill at once — asserted below)
+    "--paged-kernel", "pallas", "--prefill-chunk", "4",
     "--hidden", "32", "--layers", "2", "--vocab", "64", "--heads", "4",
 ]
 
@@ -54,6 +61,17 @@ def test_bench_serves_all_requests_with_finite_stats(bench_run):
     assert (run_dir / "metrics.jsonl").is_file()
 
 
+def test_bench_exercised_concurrent_chunked_prefill(bench_run):
+    """The ISSUE 10 acceptance shape: at least 2 prompts prefilled in the
+    same tick (chunked admission shares the budget), through exactly ONE
+    compiled chunk program — no per-prompt-length recompiles."""
+    _, stats_json, stdout = bench_run
+    stats = json.loads(stats_json.read_text())
+    assert stats["max_concurrent_prefills"] >= 2, stats
+    assert stats["prefill_compiles"] == 1, stats
+    assert "prefill_chunk=4" in stdout and "paged_kernel=pallas" in stdout
+
+
 def test_obs_report_grows_serving_section_over_bench_run_dir(bench_run,
                                                              capsys):
     """The REAL analyzer over the real run dir: parses cleanly (exit 0),
@@ -69,6 +87,9 @@ def test_obs_report_grows_serving_section_over_bench_run_dir(bench_run,
     assert "== serving ==" in out
     assert "output tokens/s" in out
     assert "ttft: p50=" in out
+    # tick-time attribution: the chunked run must show both phases
+    assert "tick time:" in out
+    assert "prefill-chunk" in out and "decode" in out
     assert "PASS" in out
 
 

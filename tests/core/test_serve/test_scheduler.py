@@ -195,6 +195,149 @@ def test_finish_recycles_slot_and_blocks():
     assert tick2.prefills == [b] and b.slot == slot  # recycled
 
 
+# ------------------------------------------------------- chunked prefill
+def make_chunked(num_slots=4, block_size=2, num_blocks=32,
+                 max_blocks_per_seq=16, token_budget=8, prefill_chunk=4):
+    return ContinuousBatchingScheduler(SchedulerConfig(
+        num_slots=num_slots, block_size=block_size, num_blocks=num_blocks,
+        max_blocks_per_seq=max_blocks_per_seq, token_budget=token_budget,
+        prefill_chunk=prefill_chunk,
+    ))
+
+
+def settle_chunks(sched, tick):
+    """What the engine does after running one chunk per prefill entry."""
+    chunk = sched.config.prefill_chunk
+    for seq in tick.prefills:
+        n = min(chunk, seq.prefill_len - seq.num_cached)
+        seq.num_cached += n
+        if seq.num_cached == seq.prefill_len:
+            seq.generated.append(1)  # the final chunk emits token one
+
+
+def test_chunked_prompt_streams_across_ticks():
+    sched = make_chunked()
+    a = submit(sched, 0, prompt_len=10, max_new=2)
+    tick = sched.schedule()
+    assert tick.prefills == [a] and a.state is SequenceState.RUNNING
+    settle_chunks(sched, tick)
+    assert a.num_cached == 4 and a.prefilling
+    # only first-chunk blocks were allocated, not the whole prompt's
+    assert len(a.blocks) == 2
+    for expected in (8, 10):
+        tick = sched.schedule()
+        assert tick.prefills == [a] and tick.decodes == []
+        settle_chunks(sched, tick)
+        assert a.num_cached == expected
+    assert not a.prefilling and a.generated == [1]
+    tick = sched.schedule()  # prefill done -> decodes from here on
+    assert tick.prefills == [] and tick.decodes == [a]
+
+
+def test_over_budget_prompt_streams_and_decodes_never_starve():
+    """The ISSUE 10 scheduler fix: a prompt bigger than the whole token
+    budget no longer admits as a monopolizing sole prefill — it streams
+    one chunk per tick while every running decode row still advances."""
+    sched = make_chunked(token_budget=6, prefill_chunk=4)
+    small = submit(sched, 0, prompt_len=2, max_new=8)
+    settle_prefills_chunked_first_tick = sched.schedule()
+    settle_chunks(sched, settle_prefills_chunked_first_tick)
+    assert not small.prefilling  # 2-token prompt = one chunk
+    big = submit(sched, 1, prompt_len=20, max_new=2)  # >> budget of 6
+    while big.prefilling or big.slot is None:
+        tick = sched.schedule()
+        # the decode row advances EVERY tick the big prompt streams
+        assert small in tick.decodes
+        assert len(tick.prefills) <= 1 and (
+            not tick.prefills or tick.prefills[0] is big
+        )
+        settle_chunks(sched, tick)
+        settle_decodes(tick)
+        if small.done:
+            break
+    assert big.num_cached == 20 and big.generated == [1]
+    # 20 tokens at chunk 4 took 5 ticks, never one monopolized tick
+    assert len(small.generated) >= 5
+
+
+def test_chunked_admission_shares_tick_across_prompts():
+    """Several prompts prefill together under one tick's budget — the
+    'one prompt per tick' serialization is gone."""
+    sched = make_chunked(token_budget=16, prefill_chunk=4)
+    seqs = [submit(sched, i, prompt_len=8, max_new=2) for i in range(3)]
+    tick = sched.schedule()
+    assert tick.prefills == seqs  # 3 first chunks of 4 <= budget 16
+    settle_chunks(sched, tick)
+    assert all(s.prefilling and s.num_cached == 4 for s in seqs)
+    tick2 = sched.schedule()
+    assert tick2.prefills == seqs and tick2.decodes == []
+    settle_chunks(sched, tick2)
+    assert all(not s.prefilling for s in seqs)
+
+
+def test_chunked_budget_defers_excess_chunks_but_oldest_progresses():
+    sched = make_chunked(token_budget=5, prefill_chunk=4)
+    a = submit(sched, 0, prompt_len=8, max_new=2)
+    b = submit(sched, 1, prompt_len=8, max_new=2)
+    tick = sched.schedule()
+    # budget 5: a's first chunk (4) fits, b's would cross -> next tick
+    assert tick.prefills == [a]
+    settle_chunks(sched, tick)
+    tick2 = sched.schedule()
+    # a streams its second chunk (oldest first); b's admission waits
+    assert tick2.prefills[0] is a
+    settle_chunks(sched, tick2)
+    for _ in range(6):
+        t = sched.schedule()
+        settle_chunks(sched, t)
+        if not b.prefilling and b.slot is not None:
+            break
+    assert b.num_cached == 8  # b still got there
+
+
+def test_mid_prefill_preemption_restarts_prompt():
+    """A mid-prefill sequence that cannot grow its next chunk re-enters
+    the queue with zero progress (its blocks are gone) and later
+    re-streams the whole prompt; the older peer always progresses."""
+    sched = make_chunked(block_size=2, num_blocks=7, token_budget=32,
+                         prefill_chunk=4, max_blocks_per_seq=8)
+    a = submit(sched, 0, prompt_len=8, max_new=2)
+    b = submit(sched, 1, prompt_len=8, max_new=2)
+    t = sched.schedule()  # both admit first chunks: 2+2 of 6 usable blocks
+    assert t.prefills == [a, b]
+    settle_chunks(sched, t)
+    # second chunks need 2 blocks each; a (oldest) takes the last 2 free,
+    # b cannot grow and self-preempts — dropping ALL its progress — then
+    # re-admits from the queue front in the same tick's ADMIT phase (its
+    # own freed blocks cover a fresh first chunk: no wasted tick)
+    t2 = sched.schedule()
+    assert t2.preempted == [b]
+    assert b.preemptions == 1
+    assert b.num_cached == 0  # restarts the prompt from token zero
+    assert t2.prefills == [a, b]
+    settle_chunks(sched, t2)
+    assert a.num_cached == 8 and not a.prefilling  # oldest progressed
+    # drain a; b must re-admit and re-stream its prompt from token zero
+    for _ in range(20):
+        tick = sched.schedule()
+        settle_chunks(sched, tick)
+        settle_decodes(tick)
+        for seq in list(tick.prefills) + list(tick.decodes):
+            if seq.done and seq.slot is not None:
+                sched.finish(seq)
+        if b.state is SequenceState.FINISHED:
+            break
+    assert b.state is SequenceState.FINISHED
+    # the full prompt re-streamed after the restart(s) and decode ran to
+    # its budget (finish() recycles blocks, so num_cached is 0 again here)
+    assert len(b.generated) == 2
+
+
+def test_prefill_chunk_validation():
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        SchedulerConfig(prefill_chunk=0)
+
+
 def test_gauges_track_occupancy():
     sched = make_sched(block_size=2, num_blocks=9)
     submit(sched, 0, prompt_len=4, max_new=2)

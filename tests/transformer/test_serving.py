@@ -1,8 +1,13 @@
-"""Paged-cache serving correctness (ISSUE 9): decode through the
-block-paged (and int8-quantized) KV cache must match the existing
-dense-cache and uncached generate paths token-for-token under greedy
-sampling — including prompts spanning multiple blocks and a sequence
-preempted mid-decode and resumed.
+"""Paged-cache serving correctness (ISSUE 9 + the ISSUE 10 hot path):
+decode through the block-paged (and int8-quantized) KV cache must match
+the existing dense-cache and uncached generate paths token-for-token
+under greedy sampling — through BOTH paged-attention back-ends (the
+streaming Pallas kernel, interpreted on the CPU mesh, and the XLA
+block-window gather fallback), with chunked (Sarathi-style) and
+whole-prompt prefill — including prompts spanning multiple blocks and a
+sequence preempted mid-decode and resumed. Per-request sampling
+(temperature/top-k as traced per-row arrays) is parity-pinned against
+the generate path's sampler zoo.
 
 The model is TRAINED briefly on cyclic data (not random-init): int8 KV
 quantization perturbs logits by ~1%, and a random-init model's near-tied
@@ -71,12 +76,16 @@ def run_engine(inf, prompts, **cfg_overrides):
     return engine, {s.request.req_id: s.generated for s in finished}
 
 
+@pytest.mark.parametrize("paged_kernel", ["pallas", "xla"])
 def test_paged_decode_matches_dense_and_uncached(trained_inference,
-                                                 reference_completions):
+                                                 reference_completions,
+                                                 paged_kernel):
     """The tentpole parity: continuous-batched decode through the paged
     pool == single-request dense-cache generate == uncached generate,
-    token for token, for a ragged batch including a multi-block prompt."""
-    engine, by_id = run_engine(trained_inference, PROMPTS)
+    token for token, for a ragged batch including a multi-block prompt —
+    through the streaming Pallas kernel AND the XLA gather fallback."""
+    engine, by_id = run_engine(trained_inference, PROMPTS,
+                               paged_kernel=paged_kernel)
     for i, ref in enumerate(reference_completions):
         assert by_id[i] == ref, f"request {i}: {by_id[i]} != dense {ref}"
     # anchor the reference itself against the uncached path (one prompt
@@ -86,6 +95,27 @@ def test_paged_decode_matches_dense_and_uncached(trained_inference,
     ).completion_ids
     assert reference_completions[0] == uncached
     assert engine.scheduler.preemption_count == 0  # pool was ample
+
+
+def test_chunked_prefill_matches_whole_prompt(trained_inference,
+                                              reference_completions):
+    """Sarathi-style chunked prefill (prompts streamed into the pool 4
+    tokens at a time, several prompts per tick) produces exactly the
+    whole-prompt-prefill generations — and actually exercises multi-chunk
+    streaming and concurrent prefilling, not a degenerate single chunk."""
+    chunked, by_id = run_engine(trained_inference, PROMPTS, prefill_chunk=4)
+    whole, by_id_whole = run_engine(trained_inference, PROMPTS,
+                                    prefill_chunk=None)
+    for i, ref in enumerate(reference_completions):
+        assert by_id[i] == ref, f"request {i} (chunked): {by_id[i]} != {ref}"
+        assert by_id_whole[i] == ref, f"request {i} (whole): {by_id_whole[i]}"
+    # the 12-token prompt streamed over 3 chunks through ONE program
+    assert set(chunked._chunk_fns) == {4}
+    assert not chunked._prefill_fns  # the pow2 bucket ladder never ran
+    # several prompts prefilled in the same tick (the throughput point)
+    assert chunked.max_concurrent_prefills >= 2
+    # whole-prompt mode is unchanged: pow2 buckets, no chunk programs
+    assert set(whole._prefill_fns) == {8, 16} and not whole._chunk_fns
 
 
 def test_preempted_and_resumed_sequence_is_token_exact(
@@ -101,28 +131,164 @@ def test_preempted_and_resumed_sequence_is_token_exact(
         assert by_id[i] == ref, f"request {i} (preemption run): {by_id[i]}"
 
 
+@pytest.mark.parametrize("paged_kernel", ["pallas", "xla"])
 def test_int8_paged_decode_is_token_exact(trained_inference,
-                                          reference_completions):
-    engine, by_id = run_engine(trained_inference, PROMPTS, kv_dtype="int8")
+                                          reference_completions,
+                                          paged_kernel):
+    """int8 KV through both back-ends: the Pallas variant dequantizes
+    IN-KERNEL with the same kv_quantize_int8 scales the pool writer
+    produced, so it must land on the same tokens the XLA gather path
+    (and the dense f32 cache) does."""
+    engine, by_id = run_engine(trained_inference, PROMPTS, kv_dtype="int8",
+                               paged_kernel=paged_kernel)
     assert engine.pools.quantized
     for i, ref in enumerate(reference_completions):
         assert by_id[i] == ref, f"request {i} (int8): {by_id[i]} != {ref}"
 
 
 def test_no_per_request_recompiles(trained_inference):
-    """The decode program compiles once for the whole run; prefill
-    compiles once per length bucket — more requests must not mean more
-    compiles (the serve_decode HLO golden pins the signature itself)."""
-    engine, _ = run_engine(trained_inference, PROMPTS + [[4, 5, 6, 7]])
+    """The decode program compiles once for the whole run; the chunked
+    prefill program compiles once per CHUNK SIZE (the chunk-size set) —
+    more requests, prompt lengths, or prefill offsets must not mean more
+    compiles (the serve_decode HLO golden pins the signatures)."""
+    engine, _ = run_engine(trained_inference, PROMPTS + [[4, 5, 6, 7]],
+                           prefill_chunk=4)
     assert engine.tick_index > 2
-    buckets = set(engine._prefill_fns)
-    # prompt lens 3/4 share the floor bucket (8); 9/12 share 16
-    assert buckets == {8, 16}, buckets
+    # 4 prompts x 4 lengths x many offsets -> ONE chunk program
+    assert set(engine._chunk_fns) == {4}
+    assert engine.prefill_program_count == 1
+    chunk_fn = engine._chunk_fns[4]
+    assert hasattr(chunk_fn, "_cache_size")
+    assert chunk_fn._cache_size() == 1, "chunk program recompiled"
     # a jax upgrade renaming the private probe must FAIL here (replace
     # the probe), not silently pass a recompile-storm regression
     assert hasattr(engine._decode_fn, "_cache_size")
     cache_size = engine._decode_fn._cache_size()
     assert cache_size == 1, f"decode program compiled {cache_size}x"
+
+
+def test_no_per_request_recompiles_whole_prompt_mode(trained_inference):
+    """Legacy whole-prompt mode keeps the pow2 bucket contract: prefill
+    compiles once per length bucket, decode once per engine."""
+    engine, _ = run_engine(trained_inference, PROMPTS + [[4, 5, 6, 7]],
+                           prefill_chunk=None)
+    buckets = set(engine._prefill_fns)
+    # prompt lens 3/4 share the floor bucket (8); 9/12 share 16
+    assert buckets == {8, 16}, buckets
+    assert not engine._chunk_fns
+    assert engine._decode_fn._cache_size() == 1
+
+
+# ------------------------------------------------- per-request samplers
+def test_sample_rows_matches_generate_sampler_zoo():
+    """The engine's per-row traced sampler must draw the SAME token the
+    generate path's make_sampler draws for identical settings and key —
+    per-request sampling cannot fork the sampling math."""
+    import jax
+    import jax.numpy as jnp
+
+    from scaling_tpu.models.transformer.inference import (
+        make_sampler, sample_argmax, sample_rows,
+    )
+
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.normal(size=(1, 53)) * 4.0, jnp.float32)
+    for temperature, top_k in [(0.7, None), (1.0, 3), (1.3, 10), (0.2, 1),
+                               (1.0, None), (2.5, 53)]:
+        key = jax.random.PRNGKey(17)
+        ref = make_sampler(temperature=temperature, top_k=top_k)(logits, key)
+        got = sample_rows(
+            logits,
+            jnp.asarray([temperature], jnp.float32),
+            jnp.asarray([top_k or 0], jnp.int32),
+            key[None],
+        )
+        assert int(got[0]) == int(ref[0]), (temperature, top_k)
+    # temperature 0 is greedy — the default, with no randomness consumed
+    greedy = sample_rows(
+        logits, jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.int32),
+        jax.random.PRNGKey(0)[None],
+    )
+    assert int(greedy[0]) == int(sample_argmax(logits)[0])
+
+
+def test_sample_rows_is_per_row():
+    """One jitted call, mixed per-row settings: a greedy row, a top-1 row
+    (deterministic), and a hot sampled row must each behave per their own
+    config — the point of carrying the settings as traced arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    from scaling_tpu.models.transformer.inference import sample_rows
+
+    rng = np.random.default_rng(5)
+    logits = jnp.asarray(rng.normal(size=(3, 31)) * 3.0, jnp.float32)
+    keys = jnp.stack([jax.random.PRNGKey(i) for i in range(3)])
+    toks = sample_rows(
+        logits,
+        jnp.asarray([0.0, 1.0, 5.0], jnp.float32),
+        jnp.asarray([0, 1, 0], jnp.int32),
+        keys,
+    )
+    argmaxes = np.asarray(jnp.argmax(logits, axis=-1))
+    assert int(toks[0]) == argmaxes[0]  # greedy row
+    assert int(toks[1]) == argmaxes[1]  # top-1 sampling == argmax
+    assert 0 <= int(toks[2]) < 31
+
+
+def test_sampled_requests_are_deterministic_and_survive_preemption(
+        trained_inference):
+    """Per-request sampling keys derive from (request id, token position)
+    — not engine ticks — so the same workload redraws the same tokens
+    run-to-run AND a preempted-and-resumed sampled sequence regenerates
+    exactly (recompute-style preemption stays invisible even at
+    temperature > 0)."""
+    def run(num_blocks):
+        engine = ServeEngine(trained_inference, EngineConfig(
+            num_slots=4, block_size=4, num_blocks=num_blocks,
+            max_blocks_per_seq=8, token_budget=64, prefill_chunk=4,
+        ))
+        for p in PROMPTS:
+            engine.submit(p, max_new_tokens=MAX_NEW, temperature=0.9,
+                          top_k=5)
+        finished = engine.run_until_done()
+        return engine, {s.request.req_id: s.generated for s in finished}
+
+    _, ample = run(num_blocks=32)
+    engine, again = run(num_blocks=32)
+    assert ample == again  # deterministic run-to-run
+    tight_engine, tight = run(num_blocks=9)  # forces preemption
+    assert tight_engine.scheduler.preemption_count > 0
+    assert tight == ample, "preemption changed a sampled generation"
+
+
+def test_decode_rows_never_starve_behind_long_prompt(trained_inference):
+    """ISSUE 10 scheduler fix: with chunked prefill an over-budget prompt
+    streams at the chunk budget — running decode rows must advance EVERY
+    tick while it prefills, where the legacy sole-prefill rule stalled
+    them for the whole prompt."""
+    engine = ServeEngine(trained_inference, EngineConfig(
+        num_slots=4, block_size=4, num_blocks=32, max_blocks_per_seq=8,
+        token_budget=8, prefill_chunk=4,
+    ))
+    short = engine.submit([5, 6, 7], max_new_tokens=12)
+    engine.tick()  # admits + fully prefills the short prompt (one chunk)
+    assert len(short.generated) == 1
+    long = engine.submit(list(range(1, 18)), max_new_tokens=2)
+    ticks_while_prefilling = 0
+    while long.prefilling or long.slot is None:
+        before = len(short.generated)
+        engine.tick()
+        if long.slot is not None and long.prefilling:
+            ticks_while_prefilling += 1
+            assert len(short.generated) == before + 1, (
+                "decode starved behind a streaming prefill"
+            )
+        if len(short.generated) >= 12:
+            break
+    assert ticks_while_prefilling >= 2, (
+        "the 17-token prompt should have needed several 4-token chunks"
+    )
 
 
 def test_completed_slots_are_recycled(trained_inference):
