@@ -465,21 +465,22 @@ def _count_pallas_custom_calls(text: str) -> int:
 
 
 def audit_serve_decode_section(num_slots=2, block_size=4,
-                               max_blocks=4, prefill_chunk=8) -> dict:
-    """The serving engine's single decode program (serve/engine.py): one
-    jitted step over the WHOLE slot set, sequence raggedness carried in
-    block tables + context lengths, per-request sampler settings as
-    traced per-row arrays. Its recompile-key signature is the
-    no-recompile-storm contract — a scheduler change that moves shapes
-    into the signature (a new bucket axis, a per-request dimension)
-    shows up as golden drift here, not as a compile per request on the
-    chip. The static config also pins the paged-attention back-end, the
-    chunked-prefill chunk size, and the legacy prefill bucket ladder's
-    floor, so a policy change drifts the hash even though prefill lowers
-    per bucket. ``chunk_program`` pins the chunked-prefill program's
-    signature the same way (ONE compile per chunk size), and
-    ``pallas_custom_calls`` counts the paged-decode kernel's custom
-    calls in the lowered decode HLO (0 off-TPU where the kernel runs
+                               max_blocks=4, prefill_chunk=8,
+                               spec_k=3) -> dict:
+    """The serving engine's single MIXED program (serve/engine.py,
+    ISSUE 11): ONE jitted step per tick covers the whole slot set —
+    decode rows (last token + up to ``spec_k`` speculative drafts) and
+    prefill-chunk rows alike, tagged purely by traced per-row lengths.
+    Its recompile-key signature is the no-recompile-storm contract: the
+    key bakes the (chunk, draft-length) width signature plus the engine
+    shape config, and NOTHING per-request — a scheduler change that
+    moves prompt lengths, prefill offsets, or draft contents into the
+    signature shows up as golden drift here, not as a compile storm on
+    the chip. The static config also pins the paged-attention back-end
+    and the legacy prefill bucket ladder's floor (policy drift moves the
+    hash even though legacy prefill lowers per bucket), and
+    ``pallas_custom_calls`` counts the paged-attention kernel's custom
+    calls in the lowered HLO (0 off-TPU where the kernel runs
     interpreted)."""
     import jax
     import jax.numpy as jnp
@@ -499,58 +500,39 @@ def audit_serve_decode_section(num_slots=2, block_size=4,
     engine = ServeEngine(inf, EngineConfig(
         num_slots=num_slots, block_size=block_size,
         num_blocks=2 * max_blocks + 1, max_blocks_per_seq=max_blocks,
-        token_budget=64, prefill_chunk=prefill_chunk,
+        token_budget=64, prefill_chunk=prefill_chunk, spec_k=spec_k,
     ))
     base_key = jax.random.PRNGKey(0)
-    decode = engine._build_decode_fn()
+    width = engine.config.mixed_width
+    mixed = engine._build_mixed_fn(width)
     args = (
         params, engine._pool_state(),
-        jnp.zeros((num_slots, max_blocks), jnp.int32),
-        jnp.zeros((num_slots,), jnp.int32),
-        jnp.zeros((num_slots,), jnp.int32),
-        jnp.zeros((num_slots,), jnp.float32),  # temperatures
-        jnp.zeros((num_slots,), jnp.int32),    # top-ks
-        jnp.zeros((num_slots,), jnp.int32),    # request ids
-        jnp.zeros((num_slots,), jnp.int32),    # generated counts
+        jnp.zeros((num_slots, max_blocks), jnp.int32),  # block tables
+        jnp.zeros((num_slots,), jnp.int32),             # context lengths
+        jnp.zeros((num_slots, width), jnp.int32),       # tokens
+        jnp.ones((num_slots,), jnp.int32),              # real per row
+        jnp.zeros((num_slots,), jnp.float32),           # temperatures
+        jnp.zeros((num_slots,), jnp.float32),           # top-ps
+        jnp.zeros((num_slots,), jnp.int32),             # top-ks
+        jnp.zeros((num_slots,), jnp.int32),             # request ids
+        jnp.zeros((num_slots,), jnp.int32),             # key-fold bases
         base_key,
     )
-    lowered = decode.lower(*args)
+    lowered = mixed.lower(*args)
     static = {
-        "kind": "serve_decode", "num_slots": num_slots,
+        "kind": "serve_mixed_step", "num_slots": num_slots,
         "block_size": block_size, "max_blocks_per_seq": max_blocks,
         "kv_dtype": engine.config.kv_dtype,
         "min_prefill_bucket": MIN_PREFILL_BUCKET,
         "paged_kernel": engine.config.paged_kernel,
         "prefill_chunk": prefill_chunk,
+        "spec_k": spec_k,
+        "mixed_width": width,
     }
     report = _audit_lowered(lowered, args, static, mesh=None)
     report["mesh"] = {}
     report["pallas_custom_calls"] = _count_pallas_custom_calls(
         lowered.as_text()
-    )
-    # the chunk program's compile-once contract rides the same golden:
-    # its signature must depend on the CHUNK SIZE only, never on prompt
-    # length or prefill progress (those are the traced ctx/new_len args)
-    chunk_fn = engine._build_chunk_fn(prefill_chunk)
-    chunk_args = (
-        params, engine._pool_state(),
-        jnp.zeros((1, prefill_chunk), jnp.int32),
-        jnp.zeros((max_blocks,), jnp.int32),
-        jnp.zeros((1,), jnp.int32),            # context length
-        jnp.ones((1,), jnp.int32),             # real tokens in chunk
-        jnp.zeros((1,), jnp.float32),
-        jnp.zeros((1,), jnp.int32),
-        jnp.zeros((1,), jnp.int32),
-        jnp.zeros((1,), jnp.int32),
-        base_key,
-    )
-    chunk_lowered = chunk_fn.lower(*chunk_args)
-    report["chunk_program"] = recompile_signature(chunk_args, {
-        "kind": "serve_chunk_prefill", "prefill_chunk": prefill_chunk,
-        "paged_kernel": engine.config.paged_kernel,
-    })
-    report["chunk_program"]["pallas_custom_calls"] = (
-        _count_pallas_custom_calls(chunk_lowered.as_text())
     )
     return report
 

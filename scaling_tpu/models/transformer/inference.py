@@ -139,21 +139,23 @@ def sample_rows(
     temperatures: jax.Array,  # (rows,) f32; <= 0 means greedy
     top_ks: jax.Array,        # (rows,) i32; <= 0 or >= vocab disables
     keys: jax.Array,          # (rows, 2) uint32 per-row PRNG keys
+    top_ps: Optional[jax.Array] = None,  # (rows,) f32; <=0 or >=1 disables
 ) -> jax.Array:
-    """Per-row temperature / top-k sampling with per-row keys — the
-    serving engine's batched counterpart of :func:`make_sampler`.
+    """Per-row temperature / top-k / top-p sampling with per-row keys —
+    the serving engine's batched counterpart of :func:`make_sampler`.
 
     The engine decodes MANY requests in one jitted program, so the
     sampler configuration must be traced per-row data, never baked-in
     constants (a per-config program would be a recompile per request —
     the exact storm the ``serve_decode`` golden pins against). The math
     mirrors ``make_sampler`` op-for-op (same temperature clamp, same
-    sort-based top-k cutoff, same ``jax.random.categorical``) so a row
-    here and a single-request ``generate()`` with the same settings and
-    key draw the SAME token — parity-pinned in
-    tests/transformer/test_serving.py. ``temperature <= 0`` short-
-    circuits to argmax: greedy stays the default AND the zero-
-    temperature limit, with no randomness consumed."""
+    sort-based top-k cutoff, same nucleus cutoff over the descending
+    sort, same ``jax.random.categorical``) so a row here and a
+    single-request ``generate()`` with the same settings and key draw
+    the SAME token — parity-pinned in tests/transformer/test_serving.py.
+    ``temperature <= 0`` short-circuits to argmax: greedy stays the
+    default AND the zero-temperature limit, with no randomness
+    consumed."""
     vocab = logits.shape[-1]
     greedy = jnp.argmax(logits, axis=-1)
     scaled = logits.astype(jnp.float32) / jnp.maximum(
@@ -169,6 +171,24 @@ def sample_rows(
     scaled = jnp.where(
         k_active[:, None] & (scaled < kth), -jnp.inf, scaled
     )
+    if top_ps is not None:
+        # nucleus cutoff AFTER top-k, exactly make_sampler's order; the
+        # math is already shape-static in p, so the per-row threshold
+        # simply rides in as traced data — same ops, bit-identical mask
+        p_active = (top_ps > 0.0) & (top_ps < 1.0)
+        # re-sort AFTER the top-k mask, like make_sampler: nucleus mass
+        # is computed over the surviving (possibly -inf-masked) logits
+        sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = cum - probs < top_ps[:, None]
+        kept = jnp.sum(keep_sorted, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(
+            sorted_desc, jnp.maximum(kept - 1, 0), axis=-1
+        )
+        scaled = jnp.where(
+            p_active[:, None] & (scaled < cutoff), -jnp.inf, scaled
+        )
     sampled = jax.vmap(
         lambda key, row: jax.random.categorical(key, row[None], axis=-1)[0]
     )(keys, scaled)

@@ -27,9 +27,16 @@ Variants share one kernel body:
 Masking follows the paged-decode contract exactly (``nn/attention.py``
 ``_paged_attention``): LOGICAL slot indices are the causal clock; slot
 ``k`` is visible to query slot ``q`` iff ``k < valid_len`` (written) and
-``k <= q`` (causal). Queries may be a single decode token (s=1) or a
-prefill CHUNK (s=chunk) whose K/V were scattered into the pool by the
-caller before attending — the same math serves both.
+``k <= q`` (causal). Queries may be a single decode token (s=1), a
+prefill CHUNK (s=chunk), or a decode token plus its speculative DRAFTS
+(s=k+1 — the engine's mixed program scores all k candidates in this one
+call; rejected candidates' writes are simply re-covered by the next
+call because ``valid_len`` never admits them) — K/V are scattered into
+the pool by the caller before attending, and the same per-row
+``valid_len``/``q_slot_base`` math serves every row kind, so one fused
+program covers a whole mixed tick (serve/engine.py ``_build_mixed_fn``).
+Rows past their real tokens (``new_len`` pads) produce garbage query
+outputs that the host discards; their writes land in the trash block.
 
 Off-TPU the kernel runs with ``interpret=True`` (the whole grid executes
 as traced jax ops), so the CPU-mesh tests exercise the REAL kernel body,

@@ -48,6 +48,11 @@ def main(argv=None) -> int:
     parser.add_argument("--assert-ttft", type=float, metavar="CEIL",
                         help="fail (exit 1) when serving p99 "
                         "time-to-first-token exceeds CEIL seconds")
+    parser.add_argument("--assert-spec-accept-rate", type=float,
+                        metavar="FLOOR",
+                        help="fail (exit 1) when the speculative-decoding "
+                        "accept rate is below FLOOR, or the run recorded "
+                        "no speculation telemetry (docs/SERVING.md)")
     args = parser.parse_args(argv)
 
     run_dir = Path(args.run_dir)
@@ -74,11 +79,13 @@ def main(argv=None) -> int:
         tuner_stats=tuner_stats,
         assert_serve_throughput=args.assert_serve_throughput,
         assert_ttft=args.assert_ttft,
+        assert_spec_accept_rate=args.assert_spec_accept_rate,
     )
     if (args.assert_mfu is not None or args.assert_step_time is not None
             or args.assert_tuner_calibration is not None
             or args.assert_serve_throughput is not None
-            or args.assert_ttft is not None):
+            or args.assert_ttft is not None
+            or args.assert_spec_accept_rate is not None):
         print("== gates ==")
         if failures:
             for f in failures:

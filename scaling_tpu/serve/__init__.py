@@ -9,19 +9,26 @@ The "millions of users" half of the north star: turns the single-request
 - :mod:`.scheduler` — continuous batching (Orca, OSDI '22): admission
   from a request queue, per-tick prefill/decode mixing under a token
   budget, preemption on pool exhaustion, completed-slot recycling.
-- :mod:`.engine` — the jitted device programs: ONE decode program for
-  the whole slot set (paged attention streamed through the Pallas
-  kernel in ``nn/paged_attention.py`` by default, XLA gather as the
-  fallback), ONE chunked-prefill program per chunk size (Sarathi-style
-  — several prompts stream per tick) or one bucketed whole-prompt
-  prefill per length bucket in legacy mode, per-request
-  temperature/top-k sampling as traced per-row arrays (no per-request
-  recompiles; signatures pinned in the ``serve_decode`` HLO audit
-  section).
+- :mod:`.engine` — the jitted device programs: ONE fused mixed program
+  per tick covering the whole slot set — prefill-chunk rows and
+  decode rows (each carrying up to ``spec_k`` self-drafted speculative
+  candidates, accepted pathwise-exactly at any temperature) tagged by
+  traced lengths (paged attention streamed through the Pallas kernel
+  in ``nn/paged_attention.py`` by default, XLA gather as the
+  fallback); legacy separate decode/chunk programs behind
+  ``fused_tick=False``, bucketed whole-prompt prefill in
+  ``prefill_chunk=None`` mode; per-request temperature/top-k/top-p
+  sampling as traced per-row arrays (no per-request recompiles;
+  signatures pinned in the ``serve_decode`` HLO audit section). The
+  scheduler's prefix trie (``PrefixCache``) maps shared-prompt blocks
+  straight into new sequences' tables, so a prompt family pays its
+  prefill once (docs/SERVING.md "Raw speed").
 - :mod:`.bench` / ``python -m scaling_tpu.serve bench`` — Poisson
-  load generator reporting tokens/s and TTFT/ITL percentiles through
-  ``obs.get_registry()``, gated by ``--assert-serve-throughput`` /
-  ``--assert-ttft`` (mirroring the training MFU gates).
+  load generator reporting tokens/s, TTFT/ITL percentiles, prefix-hit
+  and speculative-accept rates through ``obs.get_registry()``, gated
+  by ``--assert-serve-throughput`` / ``--assert-ttft`` (mirroring the
+  training MFU gates; ``--assert-spec-accept-rate`` rides the
+  analyzer).
 
 jax-free at import time (the engine imports it lazily): the scheduler and
 request/bench plumbing must stay importable from the analyzer and tests
@@ -31,17 +38,21 @@ without paying backend init.
 from .scheduler import (
     BlockAllocator,
     ContinuousBatchingScheduler,
+    PrefixCache,
     Request,
     SchedulerConfig,
     Sequence,
     SequenceState,
+    ngram_propose,
 )
 
 __all__ = [
     "BlockAllocator",
     "ContinuousBatchingScheduler",
+    "PrefixCache",
     "Request",
     "SchedulerConfig",
     "Sequence",
     "SequenceState",
+    "ngram_propose",
 ]

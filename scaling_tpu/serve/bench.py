@@ -67,11 +67,21 @@ def build_toy_inference(hidden: int = 64, layers: int = 2, vocab: int = 128,
 
 
 def sample_workload(n_requests: int, rate: float, prompt_len, output_len,
-                    vocab: int, seed: int):
-    """Poisson arrival offsets + per-request prompts/output budgets."""
+                    vocab: int, seed: int, shared_prefix_len: int = 0,
+                    prefix_families: int = 1):
+    """Poisson arrival offsets + per-request prompts/output budgets.
+
+    ``shared_prefix_len > 0`` models the dominant real-traffic shape:
+    requests draw one of ``prefix_families`` fixed system prompts of
+    that length and append a random tail sampled from ``prompt_len`` —
+    the prefix-cache arm of the benchmark (``--shared-prefix-len``)."""
     import numpy as np
 
     rng = np.random.default_rng(seed)
+    prefixes = [
+        rng.integers(1, vocab, size=shared_prefix_len).tolist()
+        for _ in range(prefix_families)
+    ] if shared_prefix_len > 0 else []
     gaps = rng.exponential(1.0 / rate, size=n_requests)
     arrivals = np.cumsum(gaps)
     arrivals[0] = 0.0  # the first request opens the run
@@ -79,7 +89,8 @@ def sample_workload(n_requests: int, rate: float, prompt_len, output_len,
     for i in range(n_requests):
         plen = int(rng.integers(prompt_len[0], prompt_len[1] + 1))
         olen = int(rng.integers(output_len[0], output_len[1] + 1))
-        prompt = rng.integers(1, vocab, size=plen).tolist()
+        tail = rng.integers(1, vocab, size=plen).tolist()
+        prompt = (prefixes[i % prefix_families] + tail) if prefixes else tail
         work.append((float(arrivals[i]), prompt, olen))
     return work
 
@@ -93,6 +104,7 @@ def run_bench(engine: ServeEngine, workload, time_scale: float = 1.0,
     from ..obs import get_registry, span
 
     t0 = time.monotonic()
+    start_ticks = engine.tick_index  # warmup ticks stay off the books
     pending = sorted(workload, key=lambda w: w[0])
     idx = 0
     while idx < len(pending) or engine.scheduler.has_work:
@@ -133,20 +145,40 @@ def run_bench(engine: ServeEngine, workload, time_scale: float = 1.0,
     def pct(vals, q):
         return percentile(vals, q) if vals else None
 
+    prompt_tokens = sum(len(s.request.prompt) for s in seqs)
+    # hits count every (re-)admission match (a preempted sequence
+    # re-matching its own cached blocks included), so the rate is
+    # work-avoided / work-demanded: hit / (hit + actually-prefilled) —
+    # bounded [0, 1] even when preemptions force re-prefills
+    hit = engine.scheduler.prefix_hit_tokens
+    prefilled = engine.prefilled_tokens
     stats = {
         "requests": len(seqs),
         "wall_s": round(wall_s, 6),
         "output_tokens": total_tokens,
-        "prompt_tokens": sum(len(s.request.prompt) for s in seqs),
+        "prompt_tokens": prompt_tokens,
         "tokens_per_s": round(total_tokens / wall_s, 3) if wall_s > 0 else 0.0,
         "ttft_p50_s": pct(ttfts, 50),
         "ttft_p99_s": pct(ttfts, 99),
         "itl_p50_s": pct(itls, 50),
         "itl_p99_s": pct(itls, 99),
         "preemptions": engine.scheduler.preemption_count,
-        "ticks": engine.tick_index,
+        "ticks": engine.tick_index - start_ticks,
         "prefill_compiles": engine.prefill_program_count,
         "max_concurrent_prefills": engine.max_concurrent_prefills,
+        # raw-speed rails (ISSUE 11): prefill work actually paid after
+        # shared-prefix reuse, and the self-drafting accept rate
+        "prefix_hit_tokens": hit,
+        "prefix_hit_rate": (
+            round(hit / (hit + prefilled), 4) if hit + prefilled else 0.0
+        ),
+        "prefilled_tokens": prefilled,
+        "spec_drafted_tokens": engine.spec_drafted_tokens,
+        "spec_accepted_tokens": engine.spec_accepted_tokens,
+        "spec_accept_rate": (
+            round(engine.spec_accept_rate, 4)
+            if engine.spec_accept_rate is not None else None
+        ),
     }
     logger.log_event("serve-summary", **stats)
     get_registry().flush_step(engine.tick_index)
@@ -186,6 +218,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="paged-decode attention back-end: the "
                         "streaming Pallas kernel (interpreted off-TPU) or "
                         "the XLA block-window gather fallback")
+    parser.add_argument("--spec-k", type=int, default=0,
+                        help="self-drafting speculative decoding: n-gram "
+                        "draft tokens scored per decode row per tick "
+                        "(0 = off)")
+    parser.add_argument("--shared-prefix-len", type=int, default=0,
+                        help="prefix-cache arm: every request shares one "
+                        "of --prefix-families system prompts of this "
+                        "length (0 = fully random prompts)")
+    parser.add_argument("--prefix-families", type=int, default=1,
+                        help="number of distinct shared prefixes for "
+                        "--shared-prefix-len")
+    parser.add_argument("--no-prefix-cache", action="store_true",
+                        help="disable shared-prefix block reuse (the A/B "
+                        "for --shared-prefix-len)")
+    parser.add_argument("--no-fused-tick", action="store_true",
+                        help="legacy dispatch: separate decode + "
+                        "per-sequence chunk programs instead of ONE "
+                        "mixed program per tick")
+    parser.add_argument("--warmup", type=int, default=0,
+                        help="serve N throwaway requests (excluded from "
+                        "stats) before the open-loop clock starts, so "
+                        "first-tick jit compiles don't distort arrival "
+                        "timing")
     # toy model knobs / real checkpoint
     parser.add_argument("--hidden", type=int, default=64)
     parser.add_argument("--layers", type=int, default=2)
@@ -240,14 +295,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         vocab = args.vocab
 
     cap = args.max_blocks_per_seq * args.block_size
-    if args.prompt_len[1] + args.output_len[1] > cap:
+    longest = (args.prompt_len[1] + args.shared_prefix_len
+               + args.output_len[1])
+    if longest > cap:
         print(
-            f"error: prompt+output can reach "
-            f"{args.prompt_len[1] + args.output_len[1]} tokens but the "
+            f"error: prompt+output can reach {longest} tokens but the "
             f"block table holds {cap}; raise --max-blocks-per-seq or "
             "--block-size", file=sys.stderr,
         )
         return 2
+    if args.shared_prefix_len > 0 and args.prefix_families < 1:
+        parser.error("--prefix-families must be >= 1")
 
     engine = ServeEngine(inf, EngineConfig(
         num_slots=args.num_slots, block_size=args.block_size,
@@ -256,11 +314,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         token_budget=args.token_budget, kv_dtype=args.kv_dtype,
         prefill_chunk=args.prefill_chunk or None,
         paged_kernel=args.paged_kernel,
+        fused_tick=not args.no_fused_tick,
+        enable_prefix_cache=not args.no_prefix_cache,
+        spec_k=args.spec_k,
     ))
     workload = sample_workload(
         args.requests, args.rate, tuple(args.prompt_len),
         tuple(args.output_len), vocab, args.seed,
+        shared_prefix_len=args.shared_prefix_len,
+        prefix_families=args.prefix_families,
     )
+    if args.warmup > 0:
+        # compile the tick programs off the clock: the first mixed-step
+        # call jit-compiles for seconds, and an open-loop workload that
+        # arrives during it measures the compiler, not the engine
+        engine.warmup_mode = True
+        for _ in range(args.warmup):
+            engine.submit([1], 2)
+        engine.run_until_done()
+        engine.warmup_mode = False
+        engine.finished.clear()
     stats = run_bench(engine, workload, max_wall_s=args.max_wall_s)
 
     print("== serve bench ==")
@@ -269,7 +342,18 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"prefill_compiles={stats['prefill_compiles']}")
     print(f"  hot path: paged_kernel={args.paged_kernel} "
           f"prefill_chunk={args.prefill_chunk or 'off'} "
+          f"fused_tick={not args.no_fused_tick} "
           f"max_concurrent_prefills={stats['max_concurrent_prefills']}")
+    if stats["prefix_hit_tokens"]:
+        print(f"  prefix cache: {stats['prefix_hit_tokens']} tokens hit, "
+              f"{stats['prefilled_tokens']} prefilled "
+              f"({stats['prompt_tokens']} prompt tokens submitted; "
+              f"hit rate {stats['prefix_hit_rate']:.1%})")
+    if stats["spec_accept_rate"] is not None:
+        print(f"  speculation: k={args.spec_k} accepted "
+              f"{stats['spec_accepted_tokens']}/"
+              f"{stats['spec_drafted_tokens']} drafts "
+              f"(accept rate {stats['spec_accept_rate']:.1%})")
     print(f"  output tokens/s: {stats['tokens_per_s']:.1f} "
           f"({stats['output_tokens']} tokens)")
     print(f"  ttft: p50={stats['ttft_p50_s']:.4f}s "

@@ -1,47 +1,52 @@
-"""The serving engine: jitted prefill/decode programs + the tick loop.
+"""The serving engine: the fused per-tick program + the tick loop.
 
-Prefill/decode split (Orca; Sarathi): per tick the scheduler mixes
-prompt prefill work with one decode token for every running sequence
-(memory-bound, one jitted program over the WHOLE slot set). Prefill runs
-in one of two modes:
+Per tick the scheduler mixes prompt prefill work with decode work for
+every running sequence; the engine runs it all as **ONE fused
+Sarathi-style mixed program** (default): every slot row is either a
+prefill CHUNK (prompts stream into the paged pool in fixed-size chunks)
+or a decode row carrying its last token plus up to ``spec_k``
+self-drafted speculative candidates — tagged purely by traced per-row
+lengths, so a tick with 4 prefilling prompts dispatches 1 executable,
+not 5. Two fallback dispatch modes survive behind config:
 
-- **chunked** (default; Sarathi-style): prompts stream into the paged
-  pool in fixed-size chunks through ONE compiled chunk program per chunk
-  size — each chunk scatters its KV at the sequence's next slots and
-  attends over the pool (the same paged-attention path decode uses), so
-  several prompts prefill in the same tick and a long prompt can never
-  monopolize it;
-- **whole-prompt** (``prefill_chunk=None``): one prompt per tick through
-  the SAME ``prefill_forward`` the dense-cache generate path uses (the
-  flash kernel stays active), compiled once per pow2 prompt-length
-  bucket.
+- ``fused_tick=False``: the PR 10 separate programs — one decode
+  program over the whole slot set plus one chunk program call per
+  prefilling sequence (parity-pinned against the mixed program);
+- ``prefill_chunk=None``: legacy whole-prompt prefill through the SAME
+  ``prefill_forward`` the dense-cache generate path uses, compiled once
+  per pow2 prompt-length bucket.
 
-Decode attention streams KV blocks through the Pallas paged-decode
+Shared-prefix block reuse and speculative acceptance ride the tick
+(docs/SERVING.md "Raw speed"): the scheduler's prefix trie maps cached
+prompt blocks straight into new sequences' tables (prefill skipped for
+the shared prefix; copy-on-write forks applied by ``_apply_cow`` before
+programs run), and ``_accept_speculative`` emits the longest sampled
+run consistent with the drafts — pathwise-exact at any temperature
+because every scored position draws with the (request, position) key
+plain decode would use.
+
+Paged attention streams KV blocks through the Pallas paged-decode
 kernel by default (``paged_kernel='pallas'``, nn/paged_attention.py —
 interpreted off-TPU so the CPU mesh runs the real kernel body); the
 XLA gather path stays config-selectable (``paged_kernel='xla'``).
 
-No per-request recompiles, by construction:
-
-- the decode program compiles ONCE per engine: its shapes are the fixed
-  ``(num_slots, max_blocks_per_seq)`` batch — sequence raggedness lives
-  in block tables and context lengths, never in shapes;
-- chunk programs compile once per CHUNK SIZE (the final ragged chunk of
-  every prompt pads to the chunk shape; pads write KV to the trash block
-  and are masked — ``PagedKVCacheView.new_len``); bucketed prefill
-  compiles once per pow2 prompt-length bucket.
-
+No per-request recompiles, by construction: the mixed program compiles
+once per ``(prefill_chunk, spec_k)`` width signature — its shapes are
+the fixed ``(num_slots, mixed_width, max_blocks_per_seq)`` batch, and
+sequence raggedness (prompt lengths, prefill offsets, draft lengths)
+lives in block tables / context lengths / new_lens, never in shapes.
 All signatures are pinned in the ``serve_decode`` HLO-audit section
 (analysis/goldens/serve_decode.json): a scheduler shape-bucketing or
 kernel change that would trigger a recompile storm on the chip shows up
 as golden drift in CI instead.
 
-Sampling is per-request (``inference.sample_rows``): temperature/top-k
-ride the jitted programs as traced per-row arrays, greedy is the
-``temperature=0`` default. Sample keys derive from (request id, token
-position) — ``inference.request_sample_key`` — so a preempted-and-
-resumed sequence redraws the SAME tokens and recompute-style preemption
-(scheduler.py) stays invisible in the output even for sampled rows.
+Sampling is per-request (``inference.sample_rows``): temperature /
+top-k / top-p ride the jitted programs as traced per-row arrays, greedy
+is the ``temperature=0`` default. Sample keys derive from (request id,
+token position) — ``inference.request_sample_key`` — so a preempted-
+and-resumed sequence redraws the SAME tokens and recompute-style
+preemption (scheduler.py) stays invisible in the output even for
+sampled rows, including mid-speculation.
 """
 
 from __future__ import annotations
@@ -88,6 +93,18 @@ class EngineConfig:
     # the flash-style kernel (nn/paged_attention.py; interpreted off-TPU),
     # 'xla' gathers each row's whole block window (the fallback)
     paged_kernel: str = "pallas"
+    # ONE fused mixed program per tick (Sarathi piggybacking): every
+    # row is a decode row (s>=1 with speculative drafts) or a prefill
+    # chunk, tagged by traced lengths — a tick with 4 prefilling prompts
+    # dispatches 1 program, not 5. Chunked mode only; False falls back
+    # to the PR 10 separate decode + per-sequence chunk programs.
+    fused_tick: bool = True
+    # shared-prefix KV block reuse (RadixAttention-style trie admission;
+    # chunked mode only — see SchedulerConfig.prefix_cache)
+    enable_prefix_cache: bool = True
+    # self-drafting speculative decoding: n-gram drafts scored k-at-once
+    # through the mixed program's s>1 rows; 0 = off
+    spec_k: int = 0
     sample_seed: int = 0  # base key for per-request sampling
     flush_interval: int = 50  # registry flush cadence (ticks)
 
@@ -97,6 +114,26 @@ class EngineConfig:
                 f"paged_kernel must be 'pallas' or 'xla', "
                 f"got {self.paged_kernel!r}"
             )
+        if self.spec_k > 0 and (self.prefill_chunk is None
+                                or not self.fused_tick):
+            raise ValueError(
+                "spec_k > 0 needs chunked prefill AND the fused mixed "
+                "program (drafts are scored through its s>1 rows)"
+            )
+
+    @property
+    def fused(self) -> bool:
+        """The mixed program replaces decode + chunk dispatch (chunked
+        mode only — whole-prompt mode keeps its bucket ladder)."""
+        return self.fused_tick and self.prefill_chunk is not None
+
+    @property
+    def mixed_width(self) -> int:
+        """The mixed program's per-row token width: chunk rows need
+        ``prefill_chunk`` slots, speculative decode rows ``spec_k + 1``
+        (last accepted token + k drafts). One program per (chunk, k)
+        signature — the recompile key the serve_decode golden pins."""
+        return max(self.prefill_chunk or 1, self.spec_k + 1)
 
     def scheduler_config(self) -> SchedulerConfig:
         return SchedulerConfig(
@@ -105,6 +142,8 @@ class EngineConfig:
             max_blocks_per_seq=self.max_blocks_per_seq,
             token_budget=self.token_budget,
             prefill_chunk=self.prefill_chunk,
+            prefix_cache=self.enable_prefix_cache,
+            spec_k=self.spec_k if self.fused else 0,
         )
 
 
@@ -134,33 +173,46 @@ class ServeEngine:
         # per-slot sampler state (traced per-row arrays in the programs)
         self._temp = np.zeros((n,), np.float32)
         self._topk = np.zeros((n,), np.int32)
+        self._topp = np.zeros((n,), np.float32)
         self._reqid = np.zeros((n,), np.int32)
         self._gen = np.zeros((n,), np.int32)
         self._base_key = jax.random.PRNGKey(self.config.sample_seed)
         self._decode_fn = None
         self._prefill_fns: Dict[int, object] = {}  # whole-prompt buckets
         self._chunk_fns: Dict[int, object] = {}  # chunk-size -> program
+        # (width,) -> the ONE fused mixed program per (chunk, k) signature
+        self._mixed_fns: Dict[int, object] = {}
         self.tick_index = 0
         self.finished: List[Sequence] = []
         self.max_concurrent_prefills = 0
         self._next_req_id = 0
+        # bench warmup: while True, completions emit no serve-request
+        # events (the analyzer's percentiles must mirror the measured
+        # workload, not the off-the-clock compile traffic)
+        self.warmup_mode = False
         self._reg = obs.get_registry()
+        self._prefix_hits_flushed = 0  # scheduler counter already mirrored
+        self.prefilled_tokens = 0  # prompt tokens actually prefilled
+        self.spec_drafted_tokens = 0
+        self.spec_accepted_tokens = 0
 
     # ------------------------------------------------------------- intake
     def submit(self, prompt: List[int], max_new_tokens: int,
                arrival_s: Optional[float] = None,
                eos_token_id: Optional[int] = None,
                temperature: float = 0.0,
-               top_k: Optional[int] = None) -> Sequence:
+               top_k: Optional[int] = None,
+               top_p: Optional[float] = None) -> Sequence:
         req = Request(
             req_id=self._next_req_id, prompt=list(prompt),
             max_new_tokens=max_new_tokens,
             arrival_s=time.monotonic() if arrival_s is None else arrival_s,
             eos_token_id=eos_token_id,
-            temperature=temperature, top_k=top_k,
+            temperature=temperature, top_k=top_k, top_p=top_p,
         )
         self._next_req_id += 1
-        self._reg.counter("serve_requests_admitted_total").inc()
+        if not self.warmup_mode:
+            self._reg.counter("serve_requests_admitted_total").inc()
         return self.scheduler.add_request(req)
 
     # --------------------------------------------------- device programs
@@ -175,9 +227,21 @@ class ServeEngine:
     def _absorb(self, views) -> None:
         self.pools.absorb_views(views)
 
-    def _sample_last(self, logits, temps, topks, reqids, gens, base_key):
+    def _span(self, name: str, **fields):
+        """obs.span, silenced during bench warmup: warmup ticks carry the
+        multi-second first-call jit compile, and a span record for them
+        would dominate the analyzer's tick-time attribution for exactly
+        the traffic --warmup exists to keep off the books."""
+        if self.warmup_mode:
+            import contextlib
+
+            return contextlib.nullcontext()
+        return obs.span(name, **fields)
+
+    def _sample_last(self, logits, temps, topps, topks, reqids, gens,
+                     base_key):
         """Shared sampling epilogue: per-row keys from (request, position),
-        then the per-row temperature/top-k sampler."""
+        then the per-row temperature/top-k/top-p sampler."""
         from ..models.transformer.inference import (
             request_sample_key, sample_rows,
         )
@@ -185,14 +249,47 @@ class ServeEngine:
         keys = self._jax.vmap(
             request_sample_key, in_axes=(None, 0, 0)
         )(base_key, reqids, gens)
-        return sample_rows(logits, temps, topks, keys)
+        return sample_rows(logits, temps, topks, keys, top_ps=topps)
+
+    def _sample_grid(self, logits, temps, topps, topks, reqids, gen0,
+                     base_key):
+        """Sample EVERY position of a (rows, s, vocab) logit grid with
+        the key plain decode would use there: position ``i`` of a row
+        draws with ``fold_in(fold_in(base, req), gen0 + i)``. This is
+        what makes speculative acceptance PATHWISE exact at any
+        temperature — the verifier computes the very token plain decode
+        would have emitted, not merely one from the same distribution —
+        and what lets chunk rows sample their first token at the last
+        real position with the same key the legacy chunk program used
+        (``gen0`` is per-row: chunk rows offset it so position
+        ``new_len - 1`` folds the true generated count)."""
+        from ..models.transformer.inference import (
+            request_sample_key, sample_rows,
+        )
+        jnp = self._jax.numpy
+
+        rows, s, vocab = logits.shape
+        positions = gen0[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+        keys = self._jax.vmap(
+            self._jax.vmap(request_sample_key, in_axes=(None, None, 0)),
+            in_axes=(None, 0, 0),
+        )(base_key, reqids, positions)  # (rows, s, 2)
+
+        def rep(x):
+            return jnp.repeat(x, s, axis=0)
+
+        flat = sample_rows(
+            logits.reshape(rows * s, vocab), rep(temps), rep(topks),
+            keys.reshape(rows * s, keys.shape[-1]), top_ps=rep(topps),
+        )
+        return flat.reshape(rows, s)
 
     def _build_prefill_fn(self, bucket: int):
         jnp = self._jax.numpy
         block_size = self.config.block_size
 
         def prefill(params, state, tokens, block_row, prompt_len,
-                    temp, topk, reqid, gen, base_key):
+                    temp, topp, topk, reqid, gen, base_key):
             b, L = tokens.shape  # (1, bucket)
             pos = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None], (b, L))
             # bucket padding sits in its own segment: content never
@@ -209,7 +306,7 @@ class ServeEngine:
                 for view, (k, v) in zip(views, kvs)
             ]
             next_tok = self._sample_last(
-                logits[:, -1], temp, topk, reqid, gen, base_key
+                logits[:, -1], temp, topp, topk, reqid, gen, base_key
             )
             return next_tok, new_views
 
@@ -229,7 +326,7 @@ class ServeEngine:
         jnp = self._jax.numpy
 
         def chunk_prefill(params, state, tokens, block_row, ctx_len, new_len,
-                          temp, topk, reqid, gen, base_key):
+                          temp, topp, topk, reqid, gen, base_key):
             b, L = tokens.shape  # (1, chunk)
             pos = ctx_len[:, None] + jnp.arange(L, dtype=jnp.int32)[None, :]
             batch = self.inf._make_batch(tokens, pos)
@@ -247,7 +344,7 @@ class ServeEngine:
                 logits, new_len[0] - 1, 1, axis=1
             )[:, 0]
             next_tok = self._sample_last(
-                last, temp, topk, reqid, gen, base_key
+                last, temp, topp, topk, reqid, gen, base_key
             )
             return next_tok, new_views
 
@@ -256,7 +353,7 @@ class ServeEngine:
 
     def _build_decode_fn(self):
         def decode(params, state, tables, ctx_lens, tokens,
-                   temps, topks, reqids, gens, base_key):
+                   temps, topps, topks, reqids, gens, base_key):
             batch = self.inf._make_batch(tokens[:, None], ctx_lens[:, None])
             views = self._views_from_state(state, tables, ctx_lens)
             logits, new_views = self.inf._run_layers(
@@ -264,7 +361,7 @@ class ServeEngine:
                 paged_kernel=self.config.paged_kernel,
             )
             next_tok = self._sample_last(
-                logits[:, -1], temps, topks, reqids, gens, base_key
+                logits[:, -1], temps, topps, topks, reqids, gens, base_key
             )
             return next_tok, new_views
 
@@ -274,6 +371,42 @@ class ServeEngine:
         donate = (1,) if self._jax.default_backend() != "cpu" else ()
         return self._jax.jit(decode, donate_argnums=donate)
 
+    def _build_mixed_fn(self, width: int):
+        """ONE fused Sarathi-style program per tick: every slot row is a
+        decode row (its last token plus up to ``spec_k`` drafted
+        candidates) or a prefill chunk, tagged purely by traced per-row
+        lengths — a tick that used to dispatch one decode program plus
+        one chunk program PER prefilling sequence now dispatches exactly
+        one executable. Rows share the scatter-then-attend paged path
+        (``new_len`` routes each row's pads to the trash block; rows
+        never share pool blocks, so fusing their writes is exact), and
+        EVERY position is sampled with its plain-decode key
+        (``_sample_grid``): decode rows read positions ``0..new_len-1``
+        for speculative acceptance, a chunk row that completes its
+        prompt reads position ``new_len - 1``. Compiles once per
+        (chunk, k) width signature — pinned in the serve_decode golden."""
+        jnp = self._jax.numpy
+
+        def mixed(params, state, tables, ctx_lens, tokens, new_lens,
+                  temps, topps, topks, reqids, gen0, base_key):
+            pos = ctx_lens[:, None] + jnp.arange(
+                width, dtype=jnp.int32
+            )[None, :]
+            batch = self.inf._make_batch(tokens, pos)
+            views = self._views_from_state(state, tables, ctx_lens,
+                                           new_lens)
+            logits, new_views = self.inf._run_layers(
+                params, batch, views, None,
+                paged_kernel=self.config.paged_kernel,
+            )
+            sampled = self._sample_grid(
+                logits, temps, topps, topks, reqids, gen0, base_key
+            )
+            return sampled, new_views
+
+        donate = (1,) if self._jax.default_backend() != "cpu" else ()
+        return self._jax.jit(mixed, donate_argnums=donate)
+
     # ------------------------------------------------------------- ticking
     def _reset_rows(self, slots: List[int]) -> None:
         for s in slots:
@@ -282,6 +415,7 @@ class ServeEngine:
             self._tok[s] = 0
             self._temp[s] = 0.0
             self._topk[s] = 0
+            self._topp[s] = 0.0
             self._reqid[s] = 0
             self._gen[s] = 0
 
@@ -290,12 +424,14 @@ class ServeEngine:
         slot = seq.slot
         self._temp[slot] = seq.request.temperature
         self._topk[slot] = seq.request.top_k or 0
+        self._topp[slot] = seq.request.top_p or 0.0
         self._reqid[slot] = seq.request.req_id
 
     def _scalar_sample_args(self, seq: Sequence):
         np = self._np
         return (
             np.asarray([seq.request.temperature], np.float32),
+            np.asarray([seq.request.top_p or 0.0], np.float32),
             np.asarray([seq.request.top_k or 0], np.int32),
             np.asarray([seq.request.req_id], np.int32),
             np.asarray([len(seq.generated)], np.int32),
@@ -314,7 +450,7 @@ class ServeEngine:
         block_row = np.zeros((self.config.max_blocks_per_seq,), np.int32)
         block_row[:len(seq.blocks)] = seq.blocks
         self._admit_slot(seq)
-        with obs.span("serve.prefill", step=self.tick_index,
+        with self._span("serve.prefill", step=self.tick_index,
                       tokens=len(prompt)):
             next_tok, new_views = self._prefill_fns[bucket](
                 self.inf.params, self._pool_state(),
@@ -332,7 +468,9 @@ class ServeEngine:
         self._tok[slot] = tok
         seq.num_cached = len(prompt)
         self._emit_token(seq, tok, now)
-        self._reg.counter("serve_prefill_tokens_total").inc(len(prompt))
+        if not self.warmup_mode:
+            self.prefilled_tokens += len(prompt)
+            self._reg.counter("serve_prefill_tokens_total").inc(len(prompt))
 
     def _run_prefill_chunk(self, seq: Sequence) -> None:
         """One fixed-size chunk of ``seq``'s prompt: scatter its KV into
@@ -350,10 +488,11 @@ class ServeEngine:
         tokens[0, :n_real] = prompt[start:start + n_real]
         block_row = np.zeros((self.config.max_blocks_per_seq,), np.int32)
         block_row[:len(seq.blocks)] = seq.blocks
-        if start == 0:
+        if start == seq.prefix_cached:
+            # first chunk of this admission (a prefix hit starts past 0)
             self._admit_slot(seq)
         finishing = start + n_real == len(prompt)
-        with obs.span("serve.prefill_chunk", step=self.tick_index,
+        with self._span("serve.prefill_chunk", step=self.tick_index,
                       tokens=n_real, start=start):
             next_tok, new_views = self._chunk_fns[chunk](
                 self.inf.params, self._pool_state(),
@@ -369,7 +508,9 @@ class ServeEngine:
         self._tables[slot] = block_row
         self._ctx[slot] = start + n_real
         seq.num_cached = start + n_real
-        self._reg.counter("serve_prefill_tokens_total").inc(n_real)
+        if not self.warmup_mode:
+            self.prefilled_tokens += n_real
+            self._reg.counter("serve_prefill_tokens_total").inc(n_real)
         if finishing:
             self._tok[slot] = tok
             self._emit_token(seq, tok, time.monotonic())
@@ -393,7 +534,7 @@ class ServeEngine:
         # sequence is about to fill
         tables = np.where(active[:, None], self._tables, 0)
         ctx = np.where(active, self._ctx, 0)
-        with obs.span("serve.decode", step=self.tick_index,
+        with self._span("serve.decode", step=self.tick_index,
                       batch=len(decodes)):
             next_tok, new_views = self._decode_fn(
                 self.inf.params, self._pool_state(),
@@ -401,6 +542,7 @@ class ServeEngine:
                 self._jax.numpy.asarray(ctx),
                 self._jax.numpy.asarray(self._tok),
                 self._jax.numpy.asarray(self._temp),
+                self._jax.numpy.asarray(self._topp),
                 self._jax.numpy.asarray(self._topk),
                 self._jax.numpy.asarray(self._reqid),
                 self._jax.numpy.asarray(self._gen),
@@ -417,24 +559,174 @@ class ServeEngine:
             self._tok[slot] = tok
             self._emit_token(seq, tok, now)
 
+    def _apply_cow(self, pairs) -> None:
+        """Copy-on-write block forks the scheduler ordered this tick:
+        duplicate pool block ``src`` into freshly-allocated ``dst``
+        across every layer (K, V, and int8 scales) BEFORE the tick's
+        programs run. Eager host-dispatched ops — forks never occur in
+        the steady state (full-block prefix sharing places writes past
+        every shared block), so this path stays off the hot loop."""
+        if not pairs:
+            return
+        p = self.pools
+        for src, dst in pairs:
+            for arrs in (p.pool_k, p.pool_v, p.scale_k, p.scale_v):
+                if arrs is None:
+                    continue
+                for i in range(len(arrs)):
+                    arrs[i] = arrs[i].at[dst].set(arrs[i][src])
+        self._reg.counter("serve_cow_forks_total").inc(len(pairs))
+
+    def _run_mixed(self, t: Tick) -> None:
+        """The fused tick (Sarathi piggybacking): ONE program call
+        covers every prefill chunk AND the whole decode batch, each row
+        tagged by its traced ``new_len``/``ctx_len``. Decode rows carry
+        their speculative drafts; acceptance happens host-side on the
+        returned per-position samples (``_accept_speculative``)."""
+        np = self._np
+        jnp = self._jax.numpy
+        cfg = self.config
+        width = cfg.mixed_width
+        if width not in self._mixed_fns:
+            self._mixed_fns[width] = self._build_mixed_fn(width)
+        n = cfg.num_slots
+        tokens = np.zeros((n, width), np.int32)
+        new_lens = np.zeros((n,), np.int32)
+        ctx = np.zeros((n,), np.int32)
+        gen0 = np.zeros((n,), np.int32)
+        tables = np.zeros((n, cfg.max_blocks_per_seq), np.int32)
+        chunk_rows = []  # (seq, start, n_real)
+        for seq in t.prefills:
+            slot = seq.slot
+            prompt = seq.resume_prompt
+            start = seq.num_cached
+            n_real = min(cfg.prefill_chunk, seq.prefill_len - start)
+            assert n_real > 0, "chunk row scheduled with nothing to prefill"
+            tokens[slot, :n_real] = prompt[start:start + n_real]
+            new_lens[slot] = n_real
+            ctx[slot] = start
+            tables[slot, :len(seq.blocks)] = seq.blocks
+            if start == seq.prefix_cached:
+                # first chunk of this admission (prefix hits start past 0)
+                self._admit_slot(seq)
+            # the chunk's last REAL position must draw with the key plain
+            # decode uses for the request's first generated token
+            gen0[slot] = len(seq.generated) - (n_real - 1)
+            chunk_rows.append((seq, start, n_real))
+        for seq in t.decodes:
+            slot = seq.slot
+            d = seq.draft
+            tokens[slot, 0] = seq.generated[-1]
+            if d:
+                tokens[slot, 1:1 + len(d)] = d
+            new_lens[slot] = 1 + len(d)
+            ctx[slot] = seq.num_cached
+            tables[slot, :len(seq.blocks)] = seq.blocks
+            gen0[slot] = len(seq.generated)
+            self._gen[slot] = len(seq.generated)
+        # inactive rows keep all-trash tables + new_len 0: their writes
+        # land in the trash block and they expose zero visible slots
+        with self._span("serve.mixed", step=self.tick_index,
+                      decodes=len(t.decodes), chunks=len(t.prefills)):
+            sampled, new_views = self._mixed_fns[width](
+                self.inf.params, self._pool_state(),
+                jnp.asarray(tables), jnp.asarray(ctx),
+                jnp.asarray(tokens), jnp.asarray(new_lens),
+                jnp.asarray(self._temp), jnp.asarray(self._topp),
+                jnp.asarray(self._topk), jnp.asarray(self._reqid),
+                jnp.asarray(gen0), self._base_key,
+            )
+            sampled = np.asarray(sampled)
+        self._absorb(new_views)
+        now = time.monotonic()
+        for seq, start, n_real in chunk_rows:
+            slot = seq.slot
+            seq.num_cached = start + n_real
+            self._tables[slot] = tables[slot]
+            self._ctx[slot] = seq.num_cached
+            if not self.warmup_mode:
+                self.prefilled_tokens += n_real
+                self._reg.counter("serve_prefill_tokens_total").inc(n_real)
+            if seq.num_cached == seq.prefill_len:
+                tok = int(sampled[slot, n_real - 1])
+                self._tok[slot] = tok
+                self._emit_token(seq, tok, now)
+        for seq in t.decodes:
+            self._tables[seq.slot] = tables[seq.slot]
+            self._accept_speculative(seq, sampled[seq.slot], now)
+
+    def _accept_speculative(self, seq: Sequence, row_samples, now) -> None:
+        """Exact speculative acceptance (Leviathan et al., arxiv
+        2211.17192, specialized to pathwise-deterministic keys): every
+        scored position was sampled with the key plain decode would use
+        there, so position ``j``'s sample IS plain decode's next token
+        — PROVIDED the conditioning holds, i.e. every earlier draft
+        matched its sample. Emit the sample run up to and including the
+        first mismatch; advance the sequence (and so the per-request key
+        fold) by tokens ACCEPTED, never tokens scored — a preempted-and-
+        resumed sequence mid-speculation redraws identical tokens."""
+        draft = seq.draft
+        xs = [int(x) for x in row_samples[:len(draft) + 1]]
+        emitted = [xs[0]]
+        matched = 0
+        for j, d in enumerate(draft):
+            if d != xs[j]:
+                break
+            matched += 1
+            emitted.append(xs[j + 1])
+        # the request's budget and EOS cut the run exactly where plain
+        # decode would have stopped asking for tokens
+        emitted = emitted[:seq.remaining_tokens]
+        eos = seq.request.eos_token_id
+        if eos is not None and eos in emitted:
+            emitted = emitted[:emitted.index(eos) + 1]
+        accepted = min(matched, len(emitted) - 1)
+        if self.warmup_mode:
+            draft = []
+        self.spec_drafted_tokens += len(draft)
+        self.spec_accepted_tokens += accepted if draft else 0
+        if draft:
+            self._reg.counter("serve_spec_drafted_tokens_total").inc(
+                len(draft)
+            )
+            if accepted:
+                self._reg.counter("serve_spec_accepted_tokens_total").inc(
+                    accepted
+                )
+        seq.draft = []
+        slot = seq.slot
+        # KV validity: slot ctx held the last token's write, plus one
+        # slot per accepted draft — rejected drafts' slots are simply
+        # overwritten by the next call (ctx never admits them)
+        seq.num_cached += len(emitted)
+        self._ctx[slot] = seq.num_cached
+        for tok in emitted:
+            self._tok[slot] = tok
+            self._emit_token(seq, tok, now)
+        self._gen[slot] = len(seq.generated)
+
     def _emit_token(self, seq: Sequence, tok: int, now: float) -> None:
         seq.generated.append(tok)
         if seq.first_token_s is None:
             seq.first_token_s = now
-            self._reg.histogram("serve_ttft_seconds").observe(
-                now - seq.request.arrival_s
-            )
-        elif seq.token_stamps:
+            if not self.warmup_mode:
+                self._reg.histogram("serve_ttft_seconds").observe(
+                    now - seq.request.arrival_s
+                )
+        elif seq.token_stamps and not self.warmup_mode:
             self._reg.histogram("serve_itl_seconds").observe(
                 now - seq.token_stamps[-1]
             )
         seq.token_stamps.append(now)
-        self._reg.counter("serve_tokens_generated_total").inc()
+        if not self.warmup_mode:
+            self._reg.counter("serve_tokens_generated_total").inc()
 
     def _finish(self, seq: Sequence, now: float) -> None:
         self.scheduler.finish(seq)  # row reset rides the freed-slot drain
         seq.finished_s = now
         self.finished.append(seq)
+        if self.warmup_mode:
+            return
         self._reg.counter("serve_requests_completed_total").inc()
         itl = [
             b - a for a, b in zip(seq.token_stamps, seq.token_stamps[1:])
@@ -451,22 +743,37 @@ class ServeEngine:
         )
 
     def tick(self) -> Tick:
-        """One engine step: schedule, prefill admissions/chunks, decode
-        the running set, retire completions."""
+        """One engine step: draft speculative candidates, schedule,
+        run the fused mixed program (or the legacy separate programs),
+        retire completions."""
+        if self.config.spec_k > 0:
+            with self._span("serve.draft", step=self.tick_index):
+                self.scheduler.propose_drafts()
         t = self.scheduler.schedule()
         if t.preempted:
             self._reg.counter("serve_preemptions_total").inc(len(t.preempted))
+        sched = self.scheduler
+        if sched.prefix_hit_tokens > self._prefix_hits_flushed:
+            self._reg.counter("serve_prefix_hit_tokens_total").inc(
+                sched.prefix_hit_tokens - self._prefix_hits_flushed
+            )
+            self._prefix_hits_flushed = sched.prefix_hit_tokens
         self._reset_rows(self.scheduler.drain_freed_slots())
-        chunked = self.config.prefill_chunk is not None
-        for seq in t.prefills:
-            if chunked:
-                self._run_prefill_chunk(seq)
-            else:
-                self._run_prefill(seq)
+        self._apply_cow(t.cow_pairs)
+        if self.config.fused:
+            if t.prefills or t.decodes:
+                self._run_mixed(t)
+        else:
+            chunked = self.config.prefill_chunk is not None
+            for seq in t.prefills:
+                if chunked:
+                    self._run_prefill_chunk(seq)
+                else:
+                    self._run_prefill(seq)
+            if t.decodes:
+                self._run_decode(t.decodes)
         if len(t.prefills) > self.max_concurrent_prefills:
             self.max_concurrent_prefills = len(t.prefills)
-        if t.decodes:
-            self._run_decode(t.decodes)
         now = time.monotonic()
         for seq in list(t.prefills) + list(t.decodes):
             if seq.done and seq.slot is not None:
@@ -474,16 +781,30 @@ class ServeEngine:
         self._reset_rows(self.scheduler.drain_freed_slots())
         for name, value in self.scheduler.gauges().items():
             self._reg.gauge(name).set(value)
+        if self.spec_drafted_tokens:
+            self._reg.gauge("serve_spec_accept_rate").set(
+                self.spec_accepted_tokens / self.spec_drafted_tokens
+            )
         self.tick_index += 1
         if self.tick_index % self.config.flush_interval == 0:
             self._reg.flush_step(self.tick_index)
         return t
 
     @property
+    def spec_accept_rate(self) -> Optional[float]:
+        """Accepted / drafted speculative tokens (None before any
+        drafting) — the self-drafting proposer's quality signal."""
+        if not self.spec_drafted_tokens:
+            return None
+        return self.spec_accepted_tokens / self.spec_drafted_tokens
+
+    @property
     def prefill_program_count(self) -> int:
         """Compiled prefill-side programs: pow2 buckets (whole-prompt
-        mode) plus chunk programs (bounded by the chunk-size set)."""
-        return len(self._prefill_fns) + len(self._chunk_fns)
+        mode), chunk programs, and fused mixed programs (one per
+        (chunk, k) width signature)."""
+        return (len(self._prefill_fns) + len(self._chunk_fns)
+                + len(self._mixed_fns))
 
     def run_until_done(self, max_ticks: int = 100_000) -> List[Sequence]:
         """Drain every submitted request; returns finished sequences in

@@ -82,9 +82,11 @@ def test_audit_gate_matches_golden(tmp_path):
 
 
 def test_audit_gate_serve_decode_matches_golden(tmp_path):
-    """The serving engine's decode program reproduces its pinned golden
-    (ISSUE 9): a single-program signature (no per-request shapes), no
-    host callbacks in the decode loop, and a stable recompile key — the
+    """The serving engine's MIXED program reproduces its pinned golden
+    (ISSUE 9; repinned for ISSUE 11's fused tick): ONE program per tick
+    covers decode rows (with speculative drafts) and prefill chunks —
+    its signature carries no per-request shapes, no host callbacks, and
+    a stable recompile key baking the (chunk, draft-length) width — the
     no-recompile-storm contract for the continuous-batching scheduler's
     shape bucketing."""
     out = tmp_path / "serve.json"
@@ -96,18 +98,19 @@ def test_audit_gate_serve_decode_matches_golden(tmp_path):
     assert sec["host_callbacks"] == 0
     assert sec["infeed_outfeed"] == 0
     static = sec["recompile_key"]["static"]
-    assert static["kind"] == "serve_decode"
+    assert static["kind"] == "serve_mixed_step"
     # shapes in the signature come from engine CONFIG, never per request;
-    # the hot-path policy knobs (ISSUE 10) are pinned alongside
+    # the hot-path policy knobs (ISSUE 10/11) are pinned alongside —
+    # incl. the speculative draft length and the fused program width
     assert {"num_slots", "block_size", "max_blocks_per_seq",
-            "min_prefill_bucket", "paged_kernel", "prefill_chunk"} <= set(
-                static)
+            "min_prefill_bucket", "paged_kernel", "prefill_chunk",
+            "spec_k", "mixed_width"} <= set(static)
     assert static["paged_kernel"] == "pallas"
-    # the chunked-prefill program rides the same golden: one compile per
-    # CHUNK SIZE, never per prompt length (ctx/new_len are traced)
-    chunk = sec["chunk_program"]
-    assert chunk["static"]["kind"] == "serve_chunk_prefill"
-    assert chunk["static"]["prefill_chunk"] == static["prefill_chunk"]
+    assert static["mixed_width"] == max(static["prefill_chunk"],
+                                        static["spec_k"] + 1)
+    # the separate chunk program is GONE — one mixed program replaced
+    # the decode + per-sequence chunk dispatch
+    assert sec.get("chunk_program") is None
     # off-TPU the paged kernel runs interpreted (inlined HLO, 0 custom
     # calls); an on-chip repin records the real custom-call count
     assert sec["pallas_custom_calls"] == 0

@@ -62,14 +62,17 @@ def test_bench_serves_all_requests_with_finite_stats(bench_run):
 
 
 def test_bench_exercised_concurrent_chunked_prefill(bench_run):
-    """The ISSUE 10 acceptance shape: at least 2 prompts prefilled in the
-    same tick (chunked admission shares the budget), through exactly ONE
-    compiled chunk program — no per-prompt-length recompiles."""
+    """The ISSUE 10 acceptance shape, now through the ISSUE 11 fused
+    tick: at least 2 prompts prefilled in the same tick (chunked
+    admission shares the budget) through exactly ONE compiled mixed
+    program — a tick with N prefilling prompts dispatches 1 executable,
+    not N+1."""
     _, stats_json, stdout = bench_run
     stats = json.loads(stats_json.read_text())
     assert stats["max_concurrent_prefills"] >= 2, stats
     assert stats["prefill_compiles"] == 1, stats
     assert "prefill_chunk=4" in stdout and "paged_kernel=pallas" in stdout
+    assert "fused_tick=True" in stdout
 
 
 def test_obs_report_grows_serving_section_over_bench_run_dir(bench_run,
@@ -87,9 +90,9 @@ def test_obs_report_grows_serving_section_over_bench_run_dir(bench_run,
     assert "== serving ==" in out
     assert "output tokens/s" in out
     assert "ttft: p50=" in out
-    # tick-time attribution: the chunked run must show both phases
+    # tick-time attribution: the fused run lands in the mixed phase
     assert "tick time:" in out
-    assert "prefill-chunk" in out and "decode" in out
+    assert "mixed" in out
     assert "PASS" in out
 
 
@@ -104,6 +107,87 @@ def test_obs_report_serving_gates_fail_at_absurd_thresholds(bench_run,
     assert rc == 1
     assert "FAIL assert-serve-throughput" in out
     assert "FAIL assert-ttft" in out
+
+
+@pytest.fixture(scope="module")
+def prefix_bench_run(tmp_path_factory):
+    """The ISSUE 11 acceptance arm: 8 requests per prompt family sharing
+    a 48-token system prompt, arriving slowly enough that followers hit
+    the warm trie — with self-drafting speculation on — under the SAME
+    --assert-ttft gate as the general run."""
+    run_dir = tmp_path_factory.mktemp("serve_bench_prefix")
+    stats_json = run_dir / "stats.json"
+    cmd = [
+        sys.executable, "-m", "scaling_tpu.serve", "bench",
+        "--requests", "8", "--rate", "3", "--seed", "5", "--warmup", "1",
+        "--shared-prefix-len", "48", "--prefix-families", "1",
+        "--spec-k", "4",
+        "--prompt-len", "2", "6", "--output-len", "3", "6",
+        "--num-slots", "4", "--block-size", "4", "--num-blocks", "64",
+        "--max-blocks-per-seq", "16", "--token-budget", "64",
+        "--paged-kernel", "pallas", "--prefill-chunk", "8",
+        "--hidden", "32", "--layers", "2", "--vocab", "64", "--heads", "4",
+        "--run-dir", str(run_dir), "--json", str(stats_json),
+        "--assert-serve-throughput", "0.5", "--assert-ttft", "120",
+    ]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "SCALING_TPU_TEST_CACHE": "off"}
+    env.pop("SCALING_TPU_EVENTS_PATH", None)
+    p = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                       text=True, timeout=420)
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-3000:]
+    return run_dir, stats_json, p.stdout
+
+
+def test_prefix_arm_cuts_prefill_work_4x_under_same_gates(prefix_bench_run):
+    """8 requests/prompt-family pay the shared prefix once: prefill
+    token work (prompt tokens actually prefilled) drops >= 4x vs the
+    no-cache total, while the standard TTFT/throughput gates still
+    PASS."""
+    _, stats_json, stdout = prefix_bench_run
+    stats = json.loads(stats_json.read_text())
+    assert stats["requests"] == 8
+    assert stats["prefix_hit_tokens"] > 0, stats
+    assert stats["prefilled_tokens"] * 4 <= stats["prompt_tokens"], stats
+    assert "prefix cache:" in stdout and "tokens hit" in stdout
+    assert "PASS" in stdout
+
+
+def test_prefix_arm_reports_speculation_and_gates(prefix_bench_run, capsys):
+    """obs report over the prefix arm's run dir renders the prefix-hit
+    and accept-rate lines; --assert-spec-accept-rate passes at floor 0
+    (data present) and fails at an absurd floor — and fails LOUDLY on a
+    run dir with no speculation telemetry."""
+    from scaling_tpu.obs.cli import main
+
+    run_dir, stats_json, _ = prefix_bench_run
+    stats = json.loads(stats_json.read_text())
+    assert stats["spec_drafted_tokens"] > 0, stats
+    assert stats["spec_accept_rate"] is not None
+    rc = main(["report", str(run_dir),
+               "--assert-spec-accept-rate", "0"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "prefix cache:" in out and "tokens hit" in out
+    assert "speculation: accepted" in out
+    rc = main(["report", str(run_dir),
+               "--assert-spec-accept-rate", "1.1"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "FAIL assert-spec-accept-rate" in out
+
+
+def test_spec_accept_rate_gate_fails_on_missing_data(bench_run, capsys):
+    """Missing data FAILS a requested gate: the general run (spec off)
+    recorded no accept rate, so the gate must fire, not pass silently."""
+    from scaling_tpu.obs.cli import main
+
+    run_dir, _, _ = bench_run
+    rc = main(["report", str(run_dir), "--assert-spec-accept-rate", "0"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "FAIL assert-spec-accept-rate" in out
+    assert "no speculative-decoding telemetry" in out
 
 
 def test_bench_registry_metrics_flushed(bench_run):

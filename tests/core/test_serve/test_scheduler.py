@@ -197,11 +197,12 @@ def test_finish_recycles_slot_and_blocks():
 
 # ------------------------------------------------------- chunked prefill
 def make_chunked(num_slots=4, block_size=2, num_blocks=32,
-                 max_blocks_per_seq=16, token_budget=8, prefill_chunk=4):
+                 max_blocks_per_seq=16, token_budget=8, prefill_chunk=4,
+                 prefix_cache=True):
     return ContinuousBatchingScheduler(SchedulerConfig(
         num_slots=num_slots, block_size=block_size, num_blocks=num_blocks,
         max_blocks_per_seq=max_blocks_per_seq, token_budget=token_budget,
-        prefill_chunk=prefill_chunk,
+        prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
     ))
 
 
@@ -298,9 +299,13 @@ def test_chunked_budget_defers_excess_chunks_but_oldest_progresses():
 def test_mid_prefill_preemption_restarts_prompt():
     """A mid-prefill sequence that cannot grow its next chunk re-enters
     the queue with zero progress (its blocks are gone) and later
-    re-streams the whole prompt; the older peer always progresses."""
+    re-streams the whole prompt; the older peer always progresses.
+    (Prefix cache off: WITH it, the preempted sequence's registered
+    blocks survive eviction and it resumes mid-prompt instead —
+    test_prefix_cache.py pins that path.)"""
     sched = make_chunked(block_size=2, num_blocks=7, token_budget=32,
-                         prefill_chunk=4, max_blocks_per_seq=8)
+                         prefill_chunk=4, max_blocks_per_seq=8,
+                         prefix_cache=False)
     a = submit(sched, 0, prompt_len=8, max_new=2)
     b = submit(sched, 1, prompt_len=8, max_new=2)
     t = sched.schedule()  # both admit first chunks: 2+2 of 6 usable blocks
@@ -336,6 +341,77 @@ def test_mid_prefill_preemption_restarts_prompt():
 def test_prefill_chunk_validation():
     with pytest.raises(ValueError, match="prefill_chunk"):
         SchedulerConfig(prefill_chunk=0)
+
+
+# ------------------------------------------------- speculative drafting
+def test_ngram_propose_copies_after_longest_recent_match():
+    from scaling_tpu.serve.scheduler import ngram_propose
+
+    # trigram (7, 8, 9) recurs: the continuation after its last earlier
+    # occurrence is the draft
+    history = [7, 8, 9, 1, 2, 3, 7, 8, 9]
+    assert ngram_propose(history, 4) == [1, 2, 3, 7]
+    assert ngram_propose(history, 2) == [1, 2]
+    # no n-gram of the tail recurs -> no draft (plain decode this tick)
+    assert ngram_propose([1, 2, 3, 4], 4) == []
+    # unigram fallback when the bigram is fresh
+    assert ngram_propose([5, 1, 5], 3) == [1, 5]
+    assert ngram_propose([1, 2], 0) == []
+
+
+def test_propose_drafts_caps_at_remaining_budget_and_grows_blocks():
+    """A draft never overshoots the request: at most remaining - 1
+    candidates (full acceptance + bonus token lands exactly on budget),
+    and GROW books blocks for every scored slot."""
+    sched = make_chunked(block_size=2, token_budget=32, prefill_chunk=4)
+    sched.config.spec_k = 4
+    # history after prefill: [1, 2, 3, 1, 2, 3, 1, 2] + generated [1] —
+    # the final unigram recurs, with [2, 3, ...] as its continuation
+    seq = sched.add_request(Request(
+        req_id=0, prompt=[1, 2, 3, 1, 2, 3, 1, 2], max_new_tokens=3,
+    ))
+    settle_chunks(sched, sched.schedule())
+    settle_chunks(sched, sched.schedule())
+    assert not seq.prefilling and seq.generated == [1]
+    drafted = sched.propose_drafts()
+    # remaining = 2 -> at most 1 draft despite spec_k = 4
+    assert drafted == len(seq.draft) == 1
+    tick = sched.schedule()
+    assert tick.decodes == [seq]
+    # 8 cached + (1 token + 1 draft) scored slots = 10 -> 5 blocks at bs 2
+    assert len(seq.blocks) == 5
+
+
+def test_drafts_shed_before_preempting_for_scratch_space():
+    """Speculation is opportunistic: under pool pressure a row drops its
+    drafts (step shrinks to 1) rather than evicting a peer for the
+    rejected-slot scratch."""
+    sched = make_chunked(block_size=2, num_blocks=7, token_budget=32,
+                         prefill_chunk=2, prefix_cache=False)
+    sched.config.spec_k = 4
+    a = sched.add_request(Request(
+        req_id=0, prompt=[5, 6, 5, 6], max_new_tokens=6))
+    b = sched.add_request(Request(
+        req_id=1, prompt=[7, 8], max_new_tokens=4))
+    for _ in range(3):
+        settle_chunks(sched, sched.schedule())
+    assert not a.prefilling and not b.prefilling
+    # pool: 6 usable, a holds 2, b holds 1 -> 3 free
+    a.draft = [5, 6, 5, 6]  # would need 3 extra blocks (4+5 slots)
+    b.draft = [7, 8, 7, 8]
+    tick = sched.schedule()
+    assert not tick.preempted
+    assert a.state is SequenceState.RUNNING
+    assert b.state is SequenceState.RUNNING
+    # at least one row shed its draft instead of preempting the other
+    assert len(a.draft) + len(b.draft) < 8
+
+
+def test_spec_k_requires_chunked_prefill():
+    with pytest.raises(ValueError, match="spec_k"):
+        SchedulerConfig(spec_k=-1)
+    with pytest.raises(ValueError, match="chunked prefill"):
+        SchedulerConfig(spec_k=2, prefill_chunk=None)
 
 
 def test_gauges_track_occupancy():

@@ -109,13 +109,17 @@ def test_chunked_prefill_matches_whole_prompt(trained_inference,
     for i, ref in enumerate(reference_completions):
         assert by_id[i] == ref, f"request {i} (chunked): {by_id[i]} != {ref}"
         assert by_id_whole[i] == ref, f"request {i} (whole): {by_id_whole[i]}"
-    # the 12-token prompt streamed over 3 chunks through ONE program
-    assert set(chunked._chunk_fns) == {4}
-    assert not chunked._prefill_fns  # the pow2 bucket ladder never ran
+    # the 12-token prompt streamed over 3 chunks through ONE fused mixed
+    # program (width = chunk size at spec_k=0); no separate chunk or
+    # bucket programs ever compiled
+    assert set(chunked._mixed_fns) == {4}
+    assert not chunked._chunk_fns and not chunked._prefill_fns
+    assert chunked._decode_fn is None  # decode rides the mixed program
     # several prompts prefilled in the same tick (the throughput point)
     assert chunked.max_concurrent_prefills >= 2
     # whole-prompt mode is unchanged: pow2 buckets, no chunk programs
     assert set(whole._prefill_fns) == {8, 16} and not whole._chunk_fns
+    assert not whole._mixed_fns
 
 
 def test_preempted_and_resumed_sequence_is_token_exact(
@@ -147,24 +151,24 @@ def test_int8_paged_decode_is_token_exact(trained_inference,
 
 
 def test_no_per_request_recompiles(trained_inference):
-    """The decode program compiles once for the whole run; the chunked
-    prefill program compiles once per CHUNK SIZE (the chunk-size set) —
-    more requests, prompt lengths, or prefill offsets must not mean more
-    compiles (the serve_decode HLO golden pins the signatures)."""
+    """ONE fused mixed program serves every tick — chunk rows, decode
+    rows, and speculative drafts alike. More requests, prompt lengths,
+    prefill offsets, or draft contents must not mean more compiles (the
+    serve_decode HLO golden pins the signature)."""
     engine, _ = run_engine(trained_inference, PROMPTS + [[4, 5, 6, 7]],
-                           prefill_chunk=4)
+                           prefill_chunk=4, spec_k=3)
     assert engine.tick_index > 2
-    # 4 prompts x 4 lengths x many offsets -> ONE chunk program
-    assert set(engine._chunk_fns) == {4}
+    # 4 prompts x 4 lengths x many offsets x ragged drafts -> ONE mixed
+    # program at width max(chunk=4, k+1=4)
+    assert set(engine._mixed_fns) == {4}
     assert engine.prefill_program_count == 1
-    chunk_fn = engine._chunk_fns[4]
-    assert hasattr(chunk_fn, "_cache_size")
-    assert chunk_fn._cache_size() == 1, "chunk program recompiled"
+    assert engine._decode_fn is None and not engine._chunk_fns
+    mixed_fn = engine._mixed_fns[4]
     # a jax upgrade renaming the private probe must FAIL here (replace
     # the probe), not silently pass a recompile-storm regression
-    assert hasattr(engine._decode_fn, "_cache_size")
-    cache_size = engine._decode_fn._cache_size()
-    assert cache_size == 1, f"decode program compiled {cache_size}x"
+    assert hasattr(mixed_fn, "_cache_size")
+    cache_size = mixed_fn._cache_size()
+    assert cache_size == 1, f"mixed program compiled {cache_size}x"
 
 
 def test_no_per_request_recompiles_whole_prompt_mode(trained_inference):
@@ -177,6 +181,148 @@ def test_no_per_request_recompiles_whole_prompt_mode(trained_inference):
     assert buckets == {8, 16}, buckets
     assert not engine._chunk_fns
     assert engine._decode_fn._cache_size() == 1
+
+
+# ---------------------------------------------- shared-prefix KV reuse
+def test_shared_prefix_reuse_is_token_exact_and_skips_prefill(
+        trained_inference):
+    """ISSUE 11 rung (a): requests extending a cached prefix map its
+    full blocks straight from the trie and prefill only the tail —
+    token-for-token identical to cold prefill, with the shared prompt's
+    prefill paid ONCE. 8 requests/prompt-family must cut prefill token
+    work >= 4x."""
+    prefix = [(i % 17) + 1 for i in range(16)]  # 4 full blocks at bs=4
+    tails = [[1, 2], [3, 4], [5, 6, 7], [8], [9, 10], [11, 12], [13],
+             [14, 15]]
+    prompts = [prefix + t for t in tails]
+    refs = [
+        trained_inference.generate(p, max_tokens=4,
+                                   use_cache=True).completion_ids
+        for p in prompts
+    ]
+    engine = ServeEngine(trained_inference, EngineConfig(
+        num_slots=8, block_size=4, num_blocks=64, max_blocks_per_seq=8,
+        token_budget=64, prefill_chunk=4,
+    ))
+    # the first family member prefills (and caches) the shared prefix...
+    engine.submit(prompts[0], max_new_tokens=4)
+    engine.run_until_done()
+    # ...then the other 7 arrive concurrently and hit the trie
+    for p in prompts[1:]:
+        engine.submit(p, max_new_tokens=4)
+    finished = engine.run_until_done()
+    by_id = {s.request.req_id: s.generated for s in finished}
+    for i, ref in enumerate(refs):
+        assert by_id[i] == ref, f"request {i} (prefix hit): {by_id[i]}"
+    hit = engine.scheduler.prefix_hit_tokens
+    assert hit == 7 * len(prefix), hit  # every follower skipped the prefix
+    total_prompt = sum(len(p) for p in prompts)
+    # prefill work ACTUALLY dispatched (engine-side counter) fell >= 4x
+    assert engine.prefilled_tokens + hit == total_prompt
+    assert engine.prefilled_tokens * 4 <= total_prompt, (
+        engine.prefilled_tokens, total_prompt)
+    # followers shared blocks, they did not copy them
+    followers = [s for s in finished if s.request.req_id > 0]
+    assert all(s.prefix_cached == len(prefix) for s in followers)
+
+
+def test_prefix_hit_survives_preemption_and_stays_exact(trained_inference):
+    """A preempted prefix-sharing sequence releases only its private
+    blocks; on resume it re-matches the trie (now including its own
+    registered blocks) and still emits the exact greedy output."""
+    prefix = [(i % 17) + 1 for i in range(12)]
+    prompts = [prefix + [1, 2], prefix + [3, 4], prefix + [5, 6]]
+    refs = [
+        trained_inference.generate(p, max_tokens=4,
+                                   use_cache=True).completion_ids
+        for p in prompts
+    ]
+    engine = ServeEngine(trained_inference, EngineConfig(
+        num_slots=4, block_size=4, num_blocks=11, max_blocks_per_seq=8,
+        token_budget=64, prefill_chunk=4,
+    ))
+    for p in prompts:
+        engine.submit(p, max_new_tokens=4)
+    finished = engine.run_until_done()
+    by_id = {s.request.req_id: s.generated for s in finished}
+    for i, ref in enumerate(refs):
+        assert by_id[i] == ref, f"request {i}: {by_id[i]} != {ref}"
+
+
+# ------------------------------------------ self-drafting speculation
+SPEC_PROMPT = [(i % 17) + 1 for i in range(20)]  # wraps: n-grams repeat
+
+
+def test_speculative_decode_is_token_exact_and_faster(trained_inference):
+    """ISSUE 11 rung (b), greedy: scoring k n-gram drafts per row in one
+    mixed-program call emits exactly the plain-decode tokens — and on
+    the cyclic-data model (whose continuations the proposer CAN predict)
+    accepts enough drafts to finish in strictly fewer ticks."""
+    ref = trained_inference.generate(
+        SPEC_PROMPT, max_tokens=8, use_cache=True
+    ).completion_ids
+
+    def run(spec_k):
+        engine = ServeEngine(trained_inference, EngineConfig(
+            num_slots=4, block_size=4, num_blocks=32, max_blocks_per_seq=8,
+            token_budget=64, prefill_chunk=4, spec_k=spec_k,
+        ))
+        engine.submit(SPEC_PROMPT, max_new_tokens=8)
+        finished = engine.run_until_done()
+        return engine, finished[0].generated
+
+    plain_engine, plain = run(0)
+    spec_engine, spec = run(4)
+    assert plain == ref and spec == ref
+    assert spec_engine.spec_drafted_tokens > 0
+    assert spec_engine.spec_accepted_tokens > 0
+    assert spec_engine.spec_accept_rate > 0
+    # accepted drafts collapse decode ticks
+    assert spec_engine.tick_index < plain_engine.tick_index, (
+        spec_engine.tick_index, plain_engine.tick_index
+    )
+
+
+def test_speculative_decode_sampled_exact_across_preemption(
+        trained_inference):
+    """Speculation at temperature > 0 is PATHWISE exact: every scored
+    position samples with the key plain decode would use there, and the
+    key fold advances by tokens accepted (never scored) — so spec-on ==
+    spec-off token-for-token, and a preemption landing mid-speculation
+    changes nothing."""
+    def run(spec_k, num_blocks):
+        engine = ServeEngine(trained_inference, EngineConfig(
+            num_slots=4, block_size=4, num_blocks=num_blocks,
+            max_blocks_per_seq=8, token_budget=64, prefill_chunk=4,
+            spec_k=spec_k,
+        ))
+        for p in [SPEC_PROMPT, SPEC_PROMPT[2:], PROMPTS[0]]:
+            engine.submit(p, max_new_tokens=6, temperature=0.9, top_k=5,
+                          top_p=0.95)
+        finished = engine.run_until_done()
+        return engine, {s.request.req_id: s.generated for s in finished}
+
+    _, plain = run(0, num_blocks=64)
+    spec_engine, spec = run(4, num_blocks=64)
+    assert spec == plain, "speculation changed a sampled generation"
+    assert spec_engine.spec_drafted_tokens > 0
+    tight_engine, tight = run(4, num_blocks=15)  # forces preemption
+    assert tight_engine.scheduler.preemption_count > 0
+    assert tight == plain, "preemption mid-speculation changed output"
+
+
+def test_mixed_program_matches_separate_programs(trained_inference):
+    """ISSUE 11 rung (c): the ONE fused mixed program per tick emits
+    exactly what the legacy separate decode + per-sequence chunk
+    programs emit, over a ragged mix of prefilling and decoding rows."""
+    fused, by_id = run_engine(trained_inference, PROMPTS, prefill_chunk=4,
+                              fused_tick=True)
+    legacy, by_id_legacy = run_engine(trained_inference, PROMPTS,
+                                      prefill_chunk=4, fused_tick=False)
+    assert by_id == by_id_legacy
+    assert set(fused._mixed_fns) == {4} and fused._decode_fn is None
+    assert set(legacy._chunk_fns) == {4} and not legacy._mixed_fns
+    assert legacy._decode_fn is not None
 
 
 # ------------------------------------------------- per-request samplers
@@ -193,17 +339,25 @@ def test_sample_rows_matches_generate_sampler_zoo():
 
     rng = np.random.default_rng(3)
     logits = jnp.asarray(rng.normal(size=(1, 53)) * 4.0, jnp.float32)
-    for temperature, top_k in [(0.7, None), (1.0, 3), (1.3, 10), (0.2, 1),
-                               (1.0, None), (2.5, 53)]:
+    for temperature, top_k, top_p in [
+            (0.7, None, None), (1.0, 3, None), (1.3, 10, None),
+            (0.2, 1, None), (1.0, None, None), (2.5, 53, None),
+            # top-p (ISSUE 11 satellite): traced per-row nucleus cutoff
+            # must reproduce make_sampler's static math bit-for-bit,
+            # alone and composed with temperature/top-k
+            (1.0, None, 0.9), (0.7, None, 0.5), (1.5, 10, 0.8),
+            (1.0, 3, 0.99), (2.0, None, 0.05)]:
         key = jax.random.PRNGKey(17)
-        ref = make_sampler(temperature=temperature, top_k=top_k)(logits, key)
+        ref = make_sampler(temperature=temperature, top_k=top_k,
+                           top_p=top_p)(logits, key)
         got = sample_rows(
             logits,
             jnp.asarray([temperature], jnp.float32),
             jnp.asarray([top_k or 0], jnp.int32),
             key[None],
+            top_ps=jnp.asarray([top_p or 0.0], jnp.float32),
         )
-        assert int(got[0]) == int(ref[0]), (temperature, top_k)
+        assert int(got[0]) == int(ref[0]), (temperature, top_k, top_p)
     # temperature 0 is greedy — the default, with no randomness consumed
     greedy = sample_rows(
         logits, jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.int32),
@@ -234,6 +388,41 @@ def test_sample_rows_is_per_row():
     assert int(toks[0]) == argmaxes[0]  # greedy row
     assert int(toks[1]) == argmaxes[1]  # top-1 sampling == argmax
     assert 0 <= int(toks[2]) < 31
+
+
+def test_top_p_is_per_row_and_deterministic(trained_inference):
+    """Per-request top-p rides the programs as a traced per-row array:
+    a tight nucleus on a peaked model collapses to greedy, and the same
+    workload redraws the same tokens run-to-run."""
+    import jax
+    import jax.numpy as jnp
+
+    from scaling_tpu.models.transformer.inference import sample_rows
+
+    rng = np.random.default_rng(9)
+    logits = jnp.asarray(rng.normal(size=(2, 31)) * 6.0, jnp.float32)
+    keys = jnp.stack([jax.random.PRNGKey(i) for i in range(2)])
+    toks = sample_rows(
+        logits, jnp.asarray([1.0, 1.0], jnp.float32),
+        jnp.zeros((2,), jnp.int32), keys,
+        top_ps=jnp.asarray([1e-6, 0.0], jnp.float32),
+    )
+    # row 0's nucleus keeps only the best token -> argmax; row 1 is
+    # unconstrained sampling
+    assert int(toks[0]) == int(jnp.argmax(logits[0]))
+
+    def run():
+        engine = ServeEngine(trained_inference, EngineConfig(
+            num_slots=4, block_size=4, num_blocks=32, max_blocks_per_seq=8,
+            token_budget=64, prefill_chunk=4,
+        ))
+        for p in PROMPTS:
+            engine.submit(p, max_new_tokens=MAX_NEW, temperature=0.9,
+                          top_p=0.8)
+        finished = engine.run_until_done()
+        return {s.request.req_id: s.generated for s in finished}
+
+    assert run() == run()  # deterministic run-to-run
 
 
 def test_sampled_requests_are_deterministic_and_survive_preemption(
