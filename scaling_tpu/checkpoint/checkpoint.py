@@ -194,6 +194,19 @@ def save_model_checkpoint(
             _emit(writer, path / sep_name, group_arrs, recorder)
 
 
+def _load_artifact(path: Path):
+    """Open one checkpoint npz for leaf assembly. Fires the
+    ``restore.assemble`` fault point (docs/RESILIENCE.md): an injected
+    failure here is an OSError, so the trainer's bounded-retry load
+    layer retries it and a persistent one demotes the candidate —
+    restore falls back to the newest valid checkpoint instead of
+    aborting mid-reshard."""
+    from ..resilience.faults import get_fault_plan
+
+    get_fault_plan().fire("restore.assemble", path=path)
+    return np.load(path)
+
+
 def _compile_patterns(patterns: Optional[List[str]]) -> list:
     return [re.compile(p) for p in (patterns or [])]
 
@@ -269,7 +282,9 @@ def load_model_checkpoint(
 
     enforce_allow_lists(model_keys, available, allowed_missing, allowed_unexpected)
 
-    # load per-file lazily
+    # load per-file lazily — leaves stream through one file's worth of
+    # host arrays at a time, which is what keeps a reshard restore's
+    # memory bounded no matter the saving mesh
     cache: dict[Path, Any] = {}
     new_leaves = []
     for p, m in zip(p_leaves, m_leaves):
@@ -281,7 +296,7 @@ def load_model_checkpoint(
             restored_keys.add(key)
         f, name = available[key]
         if f not in cache:
-            cache[f] = np.load(f)
+            cache[f] = _load_artifact(f)
         arr = cache[f][name]
         if tuple(arr.shape) != tuple(p.shape):
             raise ValueError(
@@ -351,12 +366,12 @@ def load_optimizer_checkpoint(dir: Path | str, opt_state, metas: Any):
         legacy = path / f"optimizer_state_layer_{layer_index}_{field}.npz"
         if f.exists():
             if f not in cache:
-                cache[f] = np.load(f)
+                cache[f] = _load_artifact(f)
             return cache[f][f"{field}.{param_name}"]
         if legacy.exists():
             # pre-r2 layout: one file per (layer, field), plain param keys
             if legacy not in cache:
-                cache[legacy] = np.load(legacy)
+                cache[legacy] = _load_artifact(legacy)
             return cache[legacy][param_name]
         raise FileNotFoundError(f"optimizer checkpoint file missing: {f}")
 

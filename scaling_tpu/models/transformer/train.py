@@ -144,8 +144,13 @@ def _read_dataset(config: TransformerConfig, prefixes: Optional[List[Any]]):
 
 
 class TransformerTrainer(BaseTrainer):
-    def run_training(self, log_metrics_fn_=None) -> None:  # noqa: D102
-        super().run_training(log_metrics_fn=log_metrics_fn_ or log_metrics_fn)
+    # accepts BOTH the legacy positional name and the BaseTrainer keyword
+    # (run_with_resume and other generic wrappers call the base surface
+    # `run_training(log_metrics_fn=...)` — it must not TypeError here)
+    def run_training(self, log_metrics_fn_=None, *,
+                     log_metrics_fn=None) -> None:  # noqa: D102
+        fn = log_metrics_fn_ or log_metrics_fn or globals()["log_metrics_fn"]
+        super().run_training(log_metrics_fn=fn)
 
 
 def main(config: TransformerConfig) -> TransformerTrainer:

@@ -53,6 +53,11 @@ def main(argv=None) -> int:
                         help="fail (exit 1) when the speculative-decoding "
                         "accept rate is below FLOOR, or the run recorded "
                         "no speculation telemetry (docs/SERVING.md)")
+    parser.add_argument("--assert-max-downsizes", type=int, metavar="CEIL",
+                        help="fail (exit 1) when a supervised run "
+                        "downsized more than CEIL times, or the run dir "
+                        "holds no supervisor telemetry at all "
+                        "(docs/RESILIENCE.md elastic resharding)")
     args = parser.parse_args(argv)
 
     run_dir = Path(args.run_dir)
@@ -80,12 +85,14 @@ def main(argv=None) -> int:
         assert_serve_throughput=args.assert_serve_throughput,
         assert_ttft=args.assert_ttft,
         assert_spec_accept_rate=args.assert_spec_accept_rate,
+        assert_max_downsizes=args.assert_max_downsizes,
     )
     if (args.assert_mfu is not None or args.assert_step_time is not None
             or args.assert_tuner_calibration is not None
             or args.assert_serve_throughput is not None
             or args.assert_ttft is not None
-            or args.assert_spec_accept_rate is not None):
+            or args.assert_spec_accept_rate is not None
+            or args.assert_max_downsizes is not None):
         print("== gates ==")
         if failures:
             for f in failures:

@@ -56,17 +56,20 @@ class RunData:
         return [e for e in self.events if e.get("event") != SPAN_EVENT]
 
 
-def load_run_dir(run_dir: Path | str) -> RunData:
+def load_run_dir(run_dir: Path | str, recursive: bool = True) -> RunData:
     """Parse every JSONL under ``run_dir``; tolerant of torn tails (a
     SIGKILLed host's last line) and foreign files — unparseable lines
-    are counted, never fatal."""
+    are counted, never fatal. ``recursive=False`` reads only the
+    directory's own files (callers that walk subdirectories themselves
+    would otherwise double-count them)."""
     run_dir = Path(run_dir)
     events: List[dict] = []
     steps: List[dict] = []
     registry: List[dict] = []
     files = 0
     bad = 0
-    for path in sorted(run_dir.rglob("*.jsonl")):
+    glob = run_dir.rglob if recursive else run_dir.glob
+    for path in sorted(glob("*.jsonl")):
         files += 1
         try:
             text = path.read_text()
@@ -581,6 +584,28 @@ def serving_section(data: RunData) -> Tuple[List[str], Dict[str, float]]:
     return lines, stats
 
 
+def world_size_transitions(data: RunData) -> List[str]:
+    """World-size transitions of an elastic run, as ``old->new`` labels:
+    supervisor ``downsize`` events (the replan decision) and trainer
+    ``ckpt-reshard`` events (a restore that actually crossed mesh
+    shapes). Deduplicated consecutively — N hosts restoring the same
+    transition is one transition."""
+    out: List[str] = []
+    for e in data.lifecycle:
+        if e.get("event") == "downsize":
+            label = (f"{e.get('old_world', '?')}->{e.get('new_world', '?')}"
+                     f" (downsize/{e.get('source', '?')})")
+        elif e.get("event") == "ckpt-reshard":
+            label = (f"{e.get('saved_world', '?')}->"
+                     f"{e.get('restoring_world', '?')} (reshard "
+                     f"{e.get('saved', '?')} -> {e.get('restoring', '?')})")
+        else:
+            continue
+        if not out or out[-1] != label:
+            out.append(label)
+    return out
+
+
 def timeline_section(data: RunData) -> List[str]:
     lines = ["== restart / preemption timeline =="]
     lifecycle = data.lifecycle
@@ -602,10 +627,19 @@ def timeline_section(data: RunData) -> List[str]:
         if e["event"] in ("preempt-broadcast", "preempt-relay")
     )
     stalls = sum(1 for e in lifecycle if e["event"] == "step-stall")
-    lines.append(
+    downsizes = sum(1 for e in lifecycle if e["event"] == "downsize")
+    totals = (
         f"  totals: restarts={restarts} preemptions={preempts} "
         f"stalls={stalls}"
     )
+    if downsizes:
+        # appended only for elastic runs so committed golden reports
+        # from non-elastic runs stay byte-identical
+        totals += f" downsizes={downsizes}"
+    lines.append(totals)
+    transitions = world_size_transitions(data)
+    if transitions:
+        lines.append("  world-size transitions: " + ", ".join(transitions))
     return lines
 
 
@@ -647,7 +681,8 @@ def check_gates(data: RunData, assert_mfu: Optional[float] = None,
                 tuner_stats: Optional[Dict[str, float]] = None,
                 assert_serve_throughput: Optional[float] = None,
                 assert_ttft: Optional[float] = None,
-                assert_spec_accept_rate: Optional[float] = None
+                assert_spec_accept_rate: Optional[float] = None,
+                assert_max_downsizes: Optional[int] = None
                 ) -> List[str]:
     """CI-style regression gates; returns failure messages (empty ==
     pass). Missing data FAILS a requested gate — a run that recorded no
@@ -723,6 +758,28 @@ def check_gates(data: RunData, assert_mfu: Optional[float] = None,
                 f"{abs(err):.3f} > ceiling {assert_tuner_calibration:.3f} "
                 f"(predicted {tstats['tuner_predicted_step_s']:.3f}s vs "
                 f"measured {tstats['tuner_measured_step_s']:.3f}s)"
+            )
+    if assert_max_downsizes is not None:
+        # the gate only means something for a SUPERVISED run: without
+        # supervisor lifecycle events the absence of downsize events is
+        # silence, not health — missing data fails, like every gate
+        supervised = any(
+            e.get("event") == "epoch-start" for e in data.lifecycle
+        )
+        downsizes = sum(
+            1 for e in data.lifecycle if e.get("event") == "downsize"
+        )
+        if not supervised:
+            failures.append(
+                "assert-max-downsizes: no supervisor telemetry in the run "
+                "dir (no epoch-start events — was the run launched with "
+                "runner.supervise?)"
+            )
+        elif downsizes > assert_max_downsizes:
+            failures.append(
+                f"assert-max-downsizes: {downsizes} downsize(s) > ceiling "
+                f"{assert_max_downsizes} (world-size transitions: "
+                f"{', '.join(world_size_transitions(data)) or 'none'})"
             )
     if assert_mfu is not None:
         mean = stats.get("mfu_mean")

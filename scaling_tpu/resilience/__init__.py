@@ -21,6 +21,14 @@ pieces, all host-side (nothing here touches the lowered step program):
   that dumps thread stacks.
 - :mod:`.restore` — verified restore: scan ``global_step*`` newest-first
   for the most recent checkpoint that passes manifest verification.
+- :mod:`.meshmeta` — ``MESH.json``: the logical param tree (global
+  shapes, dtypes, sharding specs) plus the saving topology, written
+  next to the manifest so any reader can reconstruct global arrays
+  without the original mesh.
+- :mod:`.reshard` — reshard-on-restore policy (ISSUE 12, elastic
+  training): mesh-transition planning, the consumed-samples carry
+  contract, a mesh-free streaming leaf reader, and the
+  ``ckpt.reshard`` / ``restore.assemble`` fault points.
 - :mod:`.resume` — ``run_with_resume``: bounded auto-restart from the
   newest valid checkpoint after a recoverable failure.
 - :mod:`.controlplane` — the multi-host supervision channel (ISSUE 4):
@@ -60,6 +68,24 @@ from .manifest import (
     verify_checkpoint,
     write_manifest,
 )
+from .meshmeta import (
+    MESH_NAME,
+    build_mesh_meta,
+    mesh_matches,
+    param_record,
+    read_mesh_meta,
+    signature_label,
+    topology_signature,
+    write_mesh_meta,
+)
+from .reshard import (
+    ReshardError,
+    ReshardPlan,
+    fire_reshard_point,
+    iter_global_leaves,
+    rescale_consumed_samples,
+    reshard_plan,
+)
 from .restore import scan_step_dirs, select_checkpoint
 from .resume import run_with_resume
 
@@ -87,6 +113,20 @@ __all__ = [
     "prune_manifest_entries",
     "verify_checkpoint",
     "write_manifest",
+    "MESH_NAME",
+    "build_mesh_meta",
+    "mesh_matches",
+    "param_record",
+    "read_mesh_meta",
+    "signature_label",
+    "topology_signature",
+    "write_mesh_meta",
+    "ReshardError",
+    "ReshardPlan",
+    "fire_reshard_point",
+    "iter_global_leaves",
+    "rescale_consumed_samples",
+    "reshard_plan",
     "scan_step_dirs",
     "select_checkpoint",
     "run_with_resume",

@@ -45,6 +45,18 @@ point               fired
                     commit (manifest + rename done), before entering the
                     ``commit:step-N`` barrier — the precise "committed
                     my shard, never told the others" window
+``ckpt.reshard``    once per ENGAGED reshard restore (the checkpoint's
+                    ``MESH.json`` topology differs from the restoring
+                    one), before any leaf is re-sliced onto the new
+                    mesh (``resilience.reshard.fire_reshard_point``)
+``restore.assemble``  once per checkpoint artifact file opened for leaf
+                    assembly during restore
+                    (``checkpoint._load_artifact`` and the mesh-free
+                    ``reshard.iter_global_leaves`` reader); ``fail``
+                    here is an OSError inside the trainer's bounded-
+                    retry load layer — transient failures retry, a
+                    persistent one demotes the candidate and restore
+                    falls back to the newest valid checkpoint
 ==================  =====================================================
 
 Spec grammar (comma list): ``point=action[@N][xM][@host=K]`` — fire

@@ -1,0 +1,176 @@
+"""Mesh-shape-independent checkpoint metadata: ``MESH.json``.
+
+Every checkpoint committed through :class:`.commit.CheckpointCommit`
+carries, next to ``MANIFEST.json``, a ``MESH.json`` recording
+
+- the **logical parameter tree**: for every parameter (and mirrored
+  optimizer leaf) its meta key, GLOBAL shape, dtype, and per-axis
+  sharding spec — enough for any reader to reconstruct global arrays
+  from the on-disk artifacts without instantiating the saving mesh;
+- the **saving topology**: pp / dp / cp / mp, virtual stages, token
+  slices, world size, batch hierarchy, and the host count of the
+  supervised pod that wrote it.
+
+Restore compares the recorded topology against the restoring one
+(:func:`mesh_matches`); a mismatch routes the load through the
+reshard-aware path (:mod:`.reshard`) instead of assuming the shapes on
+disk line up with the current mesh. Checkpoints WITHOUT a ``MESH.json``
+(legacy layouts, external trees) restore exactly as before — at the
+same shape, unverified (backward compatibility, pinned by test).
+
+Like the rest of :mod:`scaling_tpu.resilience`, this module is
+jax-free: the trainer hands it plain shapes/dtypes/spec strings.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from .manifest import CheckpointCorruptionError
+
+MESH_NAME = "MESH.json"
+MESH_SCHEMA_VERSION = 1
+
+# the topology fields whose change means the on-disk layout was written
+# by a DIFFERENT mesh than the one restoring (order fixed for rendering)
+SIGNATURE_FIELDS = (
+    "world_size",
+    "pipe_parallel_size",
+    "data_parallel_size",
+    "context_parallel_size",
+    "model_parallel_size",
+    "pipe_virtual_size",
+    "pipe_token_slices",
+    "num_hosts",
+)
+
+
+def _spec_entry(part: Any) -> Any:
+    """One partition-spec dim as JSON: None, an axis name, or a list of
+    fused axis names."""
+    if part is None or isinstance(part, str):
+        return part
+    if isinstance(part, (tuple, list)):
+        return [str(p) for p in part]
+    return str(part)
+
+
+def param_record(shape, dtype, partition_spec) -> dict:
+    """One leaf's logical record (global shape — never a shard's)."""
+    return {
+        "shape": [int(s) for s in shape],
+        "dtype": str(dtype),
+        "partition_spec": [_spec_entry(p) for p in (partition_spec or ())],
+    }
+
+
+def topology_signature(topo: Dict[str, Any]) -> Dict[str, Any]:
+    """The layout-identity slice of a topology dict (missing fields
+    default to the single-host / unsliced value, so legacy writers and
+    minimal dicts compare cleanly)."""
+    defaults = {"num_hosts": 1, "pipe_virtual_size": 1, "pipe_token_slices": 1}
+    return {
+        f: int(topo.get(f, defaults.get(f, 1)) or defaults.get(f, 1))
+        for f in SIGNATURE_FIELDS
+    }
+
+
+def signature_label(topo: Dict[str, Any]) -> str:
+    """Compact human label: ``world4·pp2·dp2·cp1·mp1·hosts1``."""
+    sig = topology_signature(topo)
+    parts = [
+        f"world{sig['world_size']}",
+        f"pp{sig['pipe_parallel_size']}",
+        f"dp{sig['data_parallel_size']}",
+        f"cp{sig['context_parallel_size']}",
+        f"mp{sig['model_parallel_size']}",
+    ]
+    if sig["pipe_virtual_size"] > 1:
+        parts.append(f"v{sig['pipe_virtual_size']}")
+    if sig["pipe_token_slices"] > 1:
+        parts.append(f"ts{sig['pipe_token_slices']}")
+    parts.append(f"hosts{sig['num_hosts']}")
+    return "·".join(parts)
+
+
+def mesh_matches(meta: Dict[str, Any], current_topology: Dict[str, Any]) -> bool:
+    """True when the checkpoint's saving topology and the restoring one
+    are the same mesh shape (restore may take the plain path)."""
+    return topology_signature(meta.get("topology", {})) == topology_signature(
+        current_topology
+    )
+
+
+def build_mesh_meta(
+    topology: Dict[str, Any],
+    params: Dict[str, dict],
+    optimizer: Optional[Dict[str, Any]] = None,
+    step: Optional[int] = None,
+) -> dict:
+    """Assemble the MESH.json payload. ``params`` maps meta key ->
+    :func:`param_record`; ``optimizer`` carries the optimizer-state
+    layout facts a resharder needs (zero stage, partitioned-or-global)."""
+    return {
+        "schema_version": MESH_SCHEMA_VERSION,
+        "step": step,
+        "topology": dict(topology),
+        "params": dict(params),
+        "optimizer": dict(optimizer or {}),
+    }
+
+
+def write_mesh_meta(stage_dir: Path | str, meta: dict) -> Path:
+    """Write ``MESH.json`` into a checkpoint STAGING dir (the atomic
+    commit's manifest scan digests it like every other staged file, so
+    it is covered by restore verification)."""
+    out = Path(stage_dir) / MESH_NAME
+    out.write_text(json.dumps(meta, indent=1, sort_keys=True))
+    return out
+
+
+def read_mesh_meta(step_dir: Path | str) -> Optional[dict]:
+    """Parsed ``MESH.json``, or None when absent (legacy checkpoint —
+    restorable at the same shape only). Raises
+    :class:`CheckpointCorruptionError` on an unparseable or
+    future-schema file: a checkpoint CLAIMING mesh metadata it cannot
+    deliver must not silently restore as legacy."""
+    f = Path(step_dir) / MESH_NAME
+    if not f.is_file():
+        return None
+    try:
+        payload = json.loads(f.read_text())
+    except (json.JSONDecodeError, OSError) as e:
+        raise CheckpointCorruptionError(f"{f}: unreadable MESH.json ({e})") from e
+    if not isinstance(payload, dict):
+        raise CheckpointCorruptionError(f"{f}: MESH.json is not an object")
+    if payload.get("schema_version", 0) > MESH_SCHEMA_VERSION:
+        raise CheckpointCorruptionError(
+            f"{f}: MESH.json schema {payload.get('schema_version')} is newer "
+            f"than this build understands ({MESH_SCHEMA_VERSION})"
+        )
+    return payload
+
+
+def validate_param_tree(
+    meta: Dict[str, Any], current_params: Dict[str, dict]
+) -> List[str]:
+    """Reshard pre-flight: every key BOTH trees know must agree on the
+    global shape ([] == compatible). Keys only one side has are left to
+    the loader's allow-list policy (PEFT adds/drops adapters
+    legitimately); a GLOBAL-shape disagreement can never be resharded —
+    it is a different model, and re-slicing it would be wrong science."""
+    problems: List[str] = []
+    recorded = meta.get("params", {})
+    for key, rec in current_params.items():
+        old = recorded.get(key)
+        if old is None:
+            continue
+        if list(old.get("shape", [])) != list(rec.get("shape", [])):
+            problems.append(
+                f"{key}: global shape {old.get('shape')} (saved) != "
+                f"{rec.get('shape')} (restoring) — not a reshard, a "
+                "different model"
+            )
+    return problems

@@ -1,0 +1,223 @@
+"""Reshard-on-restore: continue a run on a different mesh shape.
+
+The checkpoint format is already layout-independent — per-logical-layer
+files holding GLOBAL arrays keyed by parameter path, with the pipeline
+stage stacking undone by ``ckpt_view``/``ckpt_unview`` before disk — so
+the mechanics of restoring onto a different mesh are: assemble each
+param / optimizer leaf to its global value (host-streamed, one leaf at
+a time — bounded memory) and re-slice it onto the NEW mesh via the
+current metas' shardings. What this module adds is the POLICY around
+those mechanics (ATP, arxiv 2301.08658 — adaptive re-parallelization on
+world-size change):
+
+- :func:`reshard_plan` — compare the checkpoint's ``MESH.json``
+  signature against the restoring topology, pre-flight the logical
+  param tree (:func:`.meshmeta.validate_param_tree` — a global-shape
+  disagreement is a different model, never a reshard), and describe the
+  transition for the obs rails;
+- :func:`rescale_consumed_samples` — the data-stream contract across a
+  reshard. The loader stream is a pure function of
+  ``(seed, consumed_samples)`` and each step consumes one contiguous
+  ``global_batch_size`` block, so the SAME global count resumes the
+  stream with no sample skipped or repeated at any dp — provided the
+  new ``micro_batch_size * dp`` grid divides it (validated here, with
+  an actionable error when the operator picks an incompatible batch
+  hierarchy);
+- :func:`iter_global_leaves` — a mesh-free streaming reader over the
+  committed npz artifacts (one leaf at a time through ``retry_io``),
+  for tooling that reconstructs global arrays without building a model;
+- the ``ckpt.reshard`` / ``restore.assemble`` fault points
+  (docs/RESILIENCE.md): ``restore.assemble`` fires once per artifact
+  file the leaf assembly reads, inside the trainer's bounded-retry load
+  layer — a transient failure retries, a persistent one demotes the
+  candidate and restore falls back to the newest valid checkpoint;
+  ``ckpt.reshard`` fires once when the reshard path engages.
+
+jax-free like the rest of the package; numpy is imported lazily by the
+streaming reader only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+from ..logging import logger
+from .faults import get_fault_plan
+from .guards import retry_io
+from .meshmeta import (
+    mesh_matches,
+    signature_label,
+    topology_signature,
+    validate_param_tree,
+)
+
+
+class ReshardError(ValueError):
+    """The checkpoint cannot be resharded onto the requested topology
+    (different model, or an incompatible batch hierarchy). Deliberately
+    NOT a corruption error: falling back to an older checkpoint would
+    hit the same wall — the config is wrong, not the disk."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshardPlan:
+    """One restore's mesh transition, ready for logging/telemetry."""
+
+    saved: Dict[str, int]
+    restoring: Dict[str, int]
+
+    @property
+    def needed(self) -> bool:
+        return self.saved != self.restoring
+
+    @property
+    def saved_label(self) -> str:
+        return signature_label(self.saved)
+
+    @property
+    def restoring_label(self) -> str:
+        return signature_label(self.restoring)
+
+    def event_fields(self) -> dict:
+        """Fields for the ``ckpt-reshard`` lifecycle event the restart
+        timeline renders as a world-size transition."""
+        return {
+            "saved": self.saved_label,
+            "restoring": self.restoring_label,
+            "saved_world": self.saved["world_size"],
+            "restoring_world": self.restoring["world_size"],
+            "saved_hosts": self.saved["num_hosts"],
+            "restoring_hosts": self.restoring["num_hosts"],
+        }
+
+
+def reshard_plan(
+    mesh_meta: Optional[dict],
+    current_topology: Dict[str, Any],
+    current_params: Optional[Dict[str, dict]] = None,
+) -> Optional[ReshardPlan]:
+    """Decide whether this restore crosses mesh shapes.
+
+    Returns None when no decision is possible or needed: a legacy
+    checkpoint without ``MESH.json`` (same-shape restore assumed, as
+    always) or a matching signature. Otherwise pre-flights the logical
+    param tree and returns the transition; an incompatible tree raises
+    :class:`ReshardError`.
+    """
+    if mesh_meta is None:
+        return None
+    if mesh_matches(mesh_meta, current_topology):
+        return None
+    plan = ReshardPlan(
+        saved=topology_signature(mesh_meta.get("topology", {})),
+        restoring=topology_signature(current_topology),
+    )
+    if current_params is not None:
+        problems = validate_param_tree(mesh_meta, current_params)
+        if problems:
+            raise ReshardError(
+                f"cannot reshard {plan.saved_label} -> "
+                f"{plan.restoring_label}: " + "; ".join(problems)
+            )
+    return plan
+
+
+def fire_reshard_point(step_dir: Path | str, plan: ReshardPlan) -> None:
+    """The ``ckpt.reshard`` fault point: fired once per engaged reshard
+    restore, before any leaf is re-sliced onto the new mesh."""
+    get_fault_plan().fire("ckpt.reshard", path=step_dir)
+    logger.info(
+        f"resharding checkpoint {Path(step_dir).name}: "
+        f"{plan.saved_label} -> {plan.restoring_label}"
+    )
+
+
+def rescale_consumed_samples(
+    consumed_samples: int,
+    *,
+    micro_batch_size: int,
+    data_parallel_size: int,
+    what: str = "consumed_samples",
+    on_misaligned: str = "error",
+) -> int:
+    """Carry the data cursor across a mesh change, skip/repeat-free.
+
+    ``consumed_samples`` counts GLOBAL samples and each optimizer step
+    consumes one contiguous ``global_batch_size`` block of the
+    deterministic stream, so the count itself is mesh-independent — the
+    "rescale" is the invariant that the same number resumes the stream
+    exactly. The one hard constraint is the sampler's grid: the new
+    ``micro_batch_size * data_parallel_size`` must divide the saved
+    count, else micro-batch boundaries would land mid-stride and the
+    loader (correctly) refuses. Validated here so a downsized relaunch
+    fails with an actionable message at RESTORE time, not steps later
+    inside the sampler.
+
+    ``on_misaligned``: ``"error"`` (the TRAIN cursor — loss-exactness
+    rides on it) raises; ``"floor"`` aligns DOWN to the nearest grid
+    multiple with a warning — for the EVAL cursor, which advances by
+    the OLD ``mbs * dp`` per eval micro-batch and so is legitimately
+    not gbs-aligned: re-seeing a few eval samples is harmless, while
+    hard-failing there would turn a viable downsize into budget
+    exhaustion.
+    """
+    grid = micro_batch_size * data_parallel_size
+    if grid <= 0:
+        raise ReshardError(f"invalid batch grid mbs*dp = {grid}")
+    if consumed_samples % grid != 0:
+        if on_misaligned == "floor":
+            aligned = (consumed_samples // grid) * grid
+            logger.warning(
+                f"{what} ({consumed_samples}) is not a multiple of the "
+                f"new mbs*dp grid ({grid}); aligning down to {aligned} "
+                f"({consumed_samples - aligned} sample(s) will be "
+                "re-seen)"
+            )
+            return aligned
+        raise ReshardError(
+            f"{what} ({consumed_samples}) is not divisible by the new "
+            f"micro_batch_size * data_parallel_size ({grid}): resuming "
+            "here would split a micro-batch stride mid-step (samples "
+            "skipped or repeated). Pick a batch hierarchy whose mbs*dp "
+            f"divides {consumed_samples} — the saving run's "
+            "global_batch_size always does"
+        )
+    return consumed_samples
+
+
+# ------------------------------------------------- mesh-free leaf streaming
+def iter_global_leaves(
+    step_dir: Path | str,
+    *,
+    retry_attempts: int = 3,
+    retry_backoff: float = 0.05,
+) -> Iterator[Tuple[str, str, Any]]:
+    """Stream ``(file_name, entry_name, global_array)`` for every model
+    and optimizer artifact in a committed npz checkpoint — one array
+    materialized at a time, each file read through ``retry_io`` with the
+    ``restore.assemble`` fault point. This is the "any reader can
+    reconstruct global arrays without the original mesh" contract
+    MESH.json promises, usable without building a module or a mesh.
+    """
+    import numpy as np
+
+    step_dir = Path(step_dir)
+    files = sorted(step_dir.glob("model_state_layer_*.npz")) + sorted(
+        step_dir.glob("optimizer_state_layer_*.npz")
+    )
+    for f in files:
+        def _open(path=f):
+            get_fault_plan().fire("restore.assemble", path=path)
+            return np.load(path)
+
+        z = retry_io(
+            _open, attempts=retry_attempts, base_delay=retry_backoff,
+            what=f"reshard assemble {f.name}",
+        )
+        try:
+            for name in z.files:
+                yield f.name, name, z[name]
+        finally:
+            z.close()

@@ -115,6 +115,36 @@ class RunnerConfig(BaseConfig):
     supervisor_poll_seconds: float = Field(
         0.2, description="supervisor monitoring loop period", gt=0
     )
+    downsize_after: Optional[int] = Field(
+        None,
+        description="elastic downsizing (docs/RESILIENCE.md 'Elastic "
+        "resharding'): after this many CONSECUTIVE failed epochs that "
+        "each lost capacity, drop the most recently dead hosts from "
+        "the worker plan and relaunch the survivors at the smaller "
+        "world size instead of burning the rest of the restart budget "
+        "waiting for capacity to return (workers resume via "
+        "reshard-on-restore). The restart budget resets on each "
+        "downsize — it budgets relaunches PER world size. None "
+        "disables (legacy behavior: retry at full size until the "
+        "budget runs out)",
+        ge=1,
+    )
+    min_hosts: int = Field(
+        1,
+        description="never downsize below this many hosts (a pod that "
+        "cannot fit the model on fewer hosts should give up, not "
+        "thrash)",
+        ge=1,
+    )
+    downsize_model: Optional[str] = Field(
+        None,
+        description="model spec for the downsize replan: a bench model "
+        "name ('0.5b', '1b') the tuner prices so the NEW layout is "
+        "picked by comm cost (tune.best_layout over the surviving "
+        "slots) rather than by naively shrinking dp. None skips the "
+        "tuner and only shrinks the world (the payload topology, when "
+        "present, is still rewritten to the new world size)",
+    )
 
 
 class LaunchConfig(BaseConfig):

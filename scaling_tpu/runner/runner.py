@@ -102,13 +102,22 @@ def build_worker_command(
     return [sys.executable, "-u", "-m", script, f"--payload={encoded_payload}"]
 
 
+# the one definition of "local" — spawn/teardown/downsize all consult it
+LOCAL_HOSTS = ("localhost", "127.0.0.1")
+
+
+def is_local_pool(pool) -> bool:
+    """True when every host of the pool (any hostname iterable) is this
+    machine — the mode where slots expand into local worker processes."""
+    return all(h in LOCAL_HOSTS for h in pool)
+
+
 def plan_workers(pool: Dict[str, int]) -> List[tuple]:
     """``(host, slot)`` per worker process. All-localhost pools expand
     slots into local worker processes (each claiming its own device slot
     via LOCAL_SLOT/local_device_ids); remote hosts get one process each,
     owning all local devices."""
-    all_local = all(h in ("localhost", "127.0.0.1") for h in pool)
-    if all_local:
+    if is_local_pool(pool):
         # the reference's pdsh-on-localhost mode (tests/core/test_runner
         # exercises a real multi-process rendezvous this way)
         return [
@@ -153,7 +162,7 @@ def spawn_worker(
     cmd = build_worker_command(config, env_exports, encoded_payload)
     docker = config.runner_type == RunnerType.PDSH_DOCKER
     quoted = " ".join(shlex.quote(a) for a in cmd)
-    if host in ("localhost", "127.0.0.1"):
+    if host in LOCAL_HOSTS:
         return subprocess.Popen(cmd, env={**os.environ, **env_exports})
     if docker:
         # env already rides inside the docker argv; no cd — the

@@ -28,20 +28,37 @@ direct flag write — see :func:`_relay_sigterm`): the workers' handlers
 run the coordinated-preemption protocol, every host saves at the same
 step boundary, exits 0, and the epoch counts as clean — no relaunch.
 
+**Elastic downsizing** (``runner.downsize_after``, docs/RESILIENCE.md
+"Elastic resharding"): when the SAME capacity keeps dying — a reclaimed
+slice that is not coming back — retrying at full size burns the whole
+restart budget on a recoverable failure. After ``downsize_after``
+consecutive failed epochs the supervisor instead drops the lost hosts
+from the worker plan, replans the layout for the surviving slots
+(``tune.best_layout`` when ``runner.downsize_model`` names a model —
+the new layout is picked by comm cost, ATP arxiv 2301.08658 /
+Megatron-LM arxiv 2104.04473 — else a plain world shrink), rewrites the
+payload topology when one rides along, emits a ``downsize`` event on
+the obs rails, and relaunches: the workers resume through
+reshard-on-restore (``resilience.reshard``). The restart budget resets
+per world size. Restored capacity sizes back up through the same
+mechanism: relaunching the supervisor over the full host list restores
+the downsized checkpoint onto the bigger mesh.
+
 Every transition lands as a structured event (``logger.log_event``):
 ``epoch-start``, ``host-dead``, ``teardown-complete``, ``relaunch``,
 ``preempt-relay``, ``epoch-clean-exit``, ``epoch-stalled``,
-``give-up``.
+``downsize``, ``give-up``.
 """
 
 from __future__ import annotations
 
+import os
 import shutil
 import signal
 import subprocess
 import time
 from pathlib import Path
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 from ..logging import logger
 from ..obs import span
@@ -58,8 +75,10 @@ from ..resilience.controlplane import (
 )
 from .config import RunnerConfig
 from .runner import (
+    LOCAL_HOSTS,
     encode_payload,
     get_resource_pool,
+    is_local_pool,
     plan_workers,
     spawn_worker,
     worker_env,
@@ -162,7 +181,7 @@ def _relay_sigterm(
     for (host, _slot), p in zip(workers, procs):
         if p.poll() is not None:
             continue
-        if host in ("localhost", "127.0.0.1"):
+        if host in LOCAL_HOSTS:
             _signal_local(p, "TERM")
         else:
             # never terminate the ssh client here: the session dying
@@ -207,7 +226,7 @@ def _teardown_inner(
         # here would leave every survivor wedged in its collective
         logger.warning(f"abort flag write failed (continuing): {e!r}")
     remote_hosts = sorted(
-        {h for h, _ in workers if h not in ("localhost", "127.0.0.1")}
+        {h for h, _ in workers if h not in LOCAL_HOSTS}
     )
     for p in procs:
         if p.poll() is None:
@@ -254,13 +273,14 @@ def _run_epoch(
     master_addr: str,
     control_root: Path,
     epoch: int,
-    state: Dict[str, bool],
+    state: Dict[str, Any],
 ) -> int:
     """One coordinator epoch: spawn, monitor, and (on failure) tear down.
 
     Returns 0 on a clean epoch (training finished or coordinated
     preemption), non-zero when a host died/hung and the epoch was torn
-    down."""
+    down. ``state["gone"]`` is left holding the worker indices this
+    epoch lost (empty on a clean epoch) — the downsize planner's input."""
     epoch_dir = control_root / f"epoch-{epoch}"
     if epoch_dir.exists():
         # ephemeral coordination state from a PREVIOUS supervisor run
@@ -294,6 +314,7 @@ def _run_epoch(
     )
     started = time.monotonic()
     preempt_broadcast = False
+    state["gone"] = []
     while True:
         time.sleep(config.supervisor_poll_seconds)
         if state["preempted"] and not preempt_broadcast:
@@ -327,6 +348,7 @@ def _run_epoch(
                 )
                 return 0
             bad = {h: rcs[h] for h in range(num_hosts) if rcs[h] != 0}
+            state["gone"] = sorted(bad)
             logger.log_event(
                 "host-dead", epoch=epoch, hosts=sorted(bad), reason="exit",
                 exit_codes=bad,
@@ -350,6 +372,7 @@ def _run_epoch(
         if not verdict["dead"] and not verdict["hung"]:
             continue
         gone = verdict["dead"] or verdict["hung"]
+        state["gone"] = sorted(gone)
         reason = "exit" if verdict["dead"] else "heartbeat-stale"
         # the SAME snapshot that produced the verdict: a host whose
         # heartbeat refreshes between two reads would otherwise render a
@@ -368,6 +391,137 @@ def _run_epoch(
         )
         _teardown(cp, procs, workers, encoded, config)
         return 1
+
+
+def replan_layout(
+    config: RunnerConfig, new_slots: int, payload: Any
+) -> Optional[dict]:
+    """Tuner-picked layout for the downsized world, or None.
+
+    When ``runner.downsize_model`` names a model, the surviving slot
+    count goes through ``tune.best_layout`` so the new placement is
+    chosen by comm cost (the ATP adaptive-re-parallelization move), not
+    by naively shrinking dp; accumulated run-dir telemetry corrects the
+    cost model per axis when the events path points at prior epochs'
+    run dirs. Annotation-not-fatal: any tuner failure downgrades to a
+    plain world shrink — a replan must never block the relaunch."""
+    if config.downsize_model is None:
+        return None
+    try:
+        from ..tune import best_layout
+        from ..tune.costmodel import AxisCorrection, SliceTopology
+
+        kwargs: Dict[str, Any] = {}
+        topo = payload.get("topology") if isinstance(payload, dict) else None
+        if isinstance(topo, dict):
+            if topo.get("global_batch_size"):
+                kwargs["global_batch_size"] = int(topo["global_batch_size"])
+            if topo.get("micro_batch_size"):
+                kwargs["micro_batch_size"] = int(topo["micro_batch_size"])
+        events_path = os.environ.get("SCALING_TPU_EVENTS_PATH")
+        if events_path:
+            correction = AxisCorrection.from_run_dirs(Path(events_path).parent)
+            if correction is not None:
+                kwargs["correction"] = correction
+        best, ranked = best_layout(
+            config.downsize_model, SliceTopology(chips=new_slots), **kwargs
+        )
+        return {
+            "label": best.label,
+            "predicted_step_s": round(ranked[0].predicted_step_s, 6),
+            "topology": best.topology_dict(),
+        }
+    except Exception as e:
+        logger.warning(
+            f"downsize replan via tune.best_layout failed ({e!r}); "
+            "falling back to a plain world shrink"
+        )
+        return None
+
+
+def _shrink_topology(topo: Dict[str, Any], new_slots: int
+                     ) -> Optional[Dict[str, Any]]:
+    """Plain-shrink rewrite of a payload-carried topology: keep the
+    model axes (pp/cp/mp — shrinking those needs the tuner's validity
+    rules) and fold the lost capacity out of the data axis. Preserves
+    the saving run's global_batch_size when the new grid divides it
+    (gas grows — the data stream then continues skip/repeat-free at the
+    same per-step sample blocks); otherwise keeps gas and re-derives
+    gbs. None when the surviving slots cannot host the fixed axes."""
+    try:
+        pp = int(topo.get("pipe_parallel_size") or 1)
+        cp = int(topo.get("context_parallel_size") or 1)
+        mp = int(topo.get("model_parallel_size") or 1)
+    except (TypeError, ValueError):
+        return None
+    fixed = pp * cp * mp
+    if fixed <= 0 or new_slots % fixed:
+        return None
+    dp = new_slots // fixed
+    if dp < 1:
+        return None
+    out = {**topo, "world_size": new_slots, "data_parallel_size": dp}
+    mbs = topo.get("micro_batch_size")
+    gbs = topo.get("global_batch_size")
+    if mbs and gbs and int(gbs) % (int(mbs) * dp) == 0:
+        out["gradient_accumulation_steps"] = int(gbs) // (int(mbs) * dp)
+    elif mbs and topo.get("gradient_accumulation_steps"):
+        out["global_batch_size"] = (
+            int(mbs) * int(topo["gradient_accumulation_steps"]) * dp
+        )
+    return out
+
+
+def plan_downsize(
+    config: RunnerConfig,
+    pool: Dict[str, int],
+    workers: List[tuple],
+    gone: List[int],
+    payload: Any,
+) -> Optional[tuple]:
+    """The downsized plan after repeated failures: drop the lost worker
+    indices, rebuild the pool from the survivors, replan the layout.
+
+    Returns ``(pool, workers, replan, payload)`` — ``replan`` is the
+    tuner's pick or None — or None when downsizing is impossible
+    (nothing identifiably dead, or the floor ``runner.min_hosts`` would
+    be crossed: better to give up loudly than thrash below a size the
+    model cannot fit)."""
+    dead = {h for h in gone if 0 <= h < len(workers)}
+    if not dead:
+        return None
+    survivors = [w for i, w in enumerate(workers) if i not in dead]
+    if len(survivors) < max(config.min_hosts, 1):
+        return None
+    new_pool: Dict[str, int] = {}
+    for host, _slot in survivors:
+        new_pool[host] = new_pool.get(host, 0) + 1
+    # remote pools plan one worker per host owning all its slots — keep
+    # the surviving hosts' full slot counts in that mode
+    if not is_local_pool(new_pool):
+        new_pool = {h: pool[h] for h, _ in survivors}
+    new_slots = sum(new_pool.values())
+    replan = replan_layout(config, new_slots, payload)
+    new_payload = payload
+    if isinstance(payload, dict) and isinstance(payload.get("topology"), dict):
+        # a payload-carried topology MUST be rewritten to the new world
+        # size — relaunching 4 survivors into an 8-way mesh fails every
+        # downsized epoch at startup and burns the fresh budget. Tuner
+        # pick when available, else the plain dp shrink.
+        new_topo = (
+            replan["topology"] if replan is not None
+            else _shrink_topology(payload["topology"], new_slots)
+        )
+        if new_topo is not None:
+            new_payload = {**payload, "topology": new_topo}
+        else:
+            logger.warning(
+                "downsize: the payload topology's pp*cp*mp does not fit "
+                f"{new_slots} surviving slot(s) and no tuner replan is "
+                "available; relaunching with the topology UNCHANGED — "
+                "set runner.downsize_model so the layout is replanned"
+            )
+    return new_pool, plan_workers(new_pool), replan, new_payload
 
 
 def supervise_main(config: RunnerConfig, payload: Any) -> int:
@@ -399,6 +553,11 @@ def supervise_main(config: RunnerConfig, payload: Any) -> int:
 
     restarts = 0
     epoch = 0
+    # downsize bookkeeping: consecutive failed epochs that each LOST
+    # capacity (stall drains lose none and do not count) at the current
+    # world size — runner.downsize_after epochs of that means the
+    # capacity is not coming back and the survivors should carry on
+    consecutive_losses = 0
     while True:
         with span("supervisor.epoch", level="info", epoch=epoch) as ep:
             rc = _run_epoch(
@@ -413,6 +572,58 @@ def supervise_main(config: RunnerConfig, payload: Any) -> int:
             # not a reason to spin the pod back up
             logger.error("epoch failed during preemption drain; not relaunching")
             return rc
+        gone = list(state.get("gone") or [])
+        consecutive_losses = consecutive_losses + 1 if gone else 0
+        if (
+            config.downsize_after is not None
+            and consecutive_losses >= config.downsize_after
+        ):
+            plan = plan_downsize(config, pool, workers, gone, payload)
+            if plan is None:
+                logger.warning(
+                    f"downsize requested after {consecutive_losses} "
+                    f"consecutive capacity losses but no viable smaller "
+                    f"plan exists (min_hosts={config.min_hosts}); "
+                    "continuing relaunches at the current size"
+                )
+            else:
+                old_world = len(workers)
+                removed_hostnames = set(pool) - set(plan[0])
+                pool, workers, replan, payload = plan
+                encoded = encode_payload(payload)
+                master_addr = config.master_addr or list(pool)[0]
+                if master_addr in removed_hostnames:
+                    # a pinned master_addr naming a host the downsize
+                    # just removed would make every downsized epoch
+                    # rendezvous against the dead coordinator and burn
+                    # the fresh budget on guaranteed failures —
+                    # re-elect a survivor
+                    master_addr = list(pool)[0]
+                    logger.warning(
+                        f"downsize removed the pinned master_addr "
+                        f"({config.master_addr}); re-electing "
+                        f"{master_addr} as coordinator"
+                    )
+                logger.log_event(
+                    "downsize", epoch=epoch, old_world=old_world,
+                    new_world=len(workers), removed_hosts=sorted(gone),
+                    layout=replan["label"] if replan else None,
+                    predicted_step_s=(
+                        replan["predicted_step_s"] if replan else None
+                    ),
+                    source="tuner" if replan else "shrink",
+                )
+                logger.warning(
+                    f"downsizing pod {old_world} -> {len(workers)} host(s) "
+                    f"after {consecutive_losses} consecutive capacity "
+                    "losses; survivors relaunch via reshard-on-restore"
+                    + (f" into tuner layout {replan['label']}" if replan
+                       else "")
+                )
+                consecutive_losses = 0
+                # a fresh budget for the new world size: the old one was
+                # spent discovering the lost capacity is not coming back
+                restarts = 0
         restarts += 1
         if restarts > config.restart_budget:
             logger.log_event(

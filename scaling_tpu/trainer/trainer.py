@@ -11,6 +11,7 @@ working.
 from __future__ import annotations
 
 import json
+import os
 import shutil
 import time
 import zipfile
@@ -51,6 +52,7 @@ from ..resilience import (
 )
 from ..resilience.controlplane import (
     ABORT_FLAG,
+    ENV_NUM_HOSTS,
     PREEMPT_FLAG,
     STALL_FLAG,
     ControlPlane,
@@ -58,6 +60,17 @@ from ..resilience.controlplane import (
     straggler_table,
 )
 from ..resilience.manifest import CheckpointCorruptionError, read_manifest
+from ..resilience.meshmeta import (
+    build_mesh_meta,
+    param_record,
+    read_mesh_meta,
+    write_mesh_meta,
+)
+from ..resilience.reshard import (
+    fire_reshard_point,
+    rescale_consumed_samples,
+    reshard_plan,
+)
 from ..resilience.restore import checkpoint_candidates, verify_checkpoint
 
 # disk-corruption error types the load fallback may skip past; everything
@@ -1048,6 +1061,74 @@ class BaseTrainer:
         blob = _json.dumps(cfg.model_dump(mode="json"), sort_keys=True, default=str)
         return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
+    # ------------------------------------------------- mesh metadata (elastic)
+    def _num_hosts(self) -> int:
+        """Host count of the pod writing/reading this checkpoint: the
+        control plane when attached (supervised runs), the supervisor's
+        env contract otherwise, falling back to the jax process count."""
+        if self._control_plane is not None:
+            return int(self._control_plane.num_hosts)
+        env = os.environ.get(ENV_NUM_HOSTS)
+        if env is not None:
+            return int(env)
+        return int(jax.process_count())
+
+    def _current_topology_dict(self) -> dict:
+        cfg = self.topology.config
+        return {
+            "world_size": cfg.world_size,
+            "pipe_parallel_size": cfg.pipe_parallel_size,
+            "data_parallel_size": cfg.data_parallel_size,
+            "context_parallel_size": cfg.context_parallel_size,
+            "model_parallel_size": cfg.model_parallel_size,
+            "pipe_virtual_size": cfg.pipe_virtual_size,
+            "pipe_token_slices": cfg.pipe_token_slices,
+            "micro_batch_size": cfg.micro_batch_size,
+            "gradient_accumulation_steps": cfg.gradient_accumulation_steps,
+            "global_batch_size": cfg.global_batch_size,
+            "num_hosts": self._num_hosts(),
+        }
+
+    def _param_records(self, params_view, metas) -> dict:
+        """meta key -> global shape/dtype/sharding-spec record for
+        MESH.json. The ckpt-view tree holds GLOBAL logical arrays (the
+        stage stacking is already undone), so .shape here is the
+        mesh-independent shape any reader reconstructs — no device sync
+        (shape/dtype are host-side metadata)."""
+        from ..nn.param import ParamMeta
+
+        p_leaves = jax.tree.leaves(params_view)
+        m_leaves = jax.tree.leaves(
+            metas, is_leaf=lambda x: isinstance(x, ParamMeta)
+        )
+        return {
+            m.key: param_record(
+                p.shape, p.dtype, getattr(m, "partition_spec", ())
+            )
+            for p, m in zip(p_leaves, m_leaves)
+        }
+
+    def _mesh_meta(self, params_view, metas) -> dict:
+        opt_cfg = self.optimizer.config
+        zero_stage = (
+            int(getattr(opt_cfg, "zero_stage", 1))
+            if getattr(opt_cfg, "zero", False)
+            else 0
+        )
+        return build_mesh_meta(
+            topology=self._current_topology_dict(),
+            params=self._param_records(params_view, metas),
+            optimizer={
+                "zero_stage": zero_stage,
+                "fields": ["master", "exp_avg", "exp_avg_sq"],
+                # on-disk optimizer leaves mirror the param tree as
+                # GLOBAL arrays (ckpt_view gathers zero-partitioned
+                # state), so a resharder re-slices them the same way
+                "layout": "global-per-layer",
+            },
+            step=self.context.iterations,
+        )
+
     def save_checkpoint(self, dir: Optional[Path | str] = None) -> Path:
         """Atomic commit protocol (docs/RESILIENCE.md): everything is
         written into a ``.tmp-global_stepN`` staging dir, checksummed
@@ -1086,10 +1167,12 @@ class BaseTrainer:
             exp_avg=self.module.ckpt_view(self.opt_state.exp_avg),
             exp_avg_sq=self.module.ckpt_view(self.opt_state.exp_avg_sq),
         )
+        metas = self.module.ckpt_metas()
+        params_view = self.module.ckpt_view(self.params)
         with span("ckpt.stage", step=self.context.iterations,
                   backend=self.config.checkpoint_backend.value):
             if self.config.checkpoint_backend == CheckpointBackend.ORBAX:
-                self._save_orbax(stage_dir, viewed_opt)
+                self._save_orbax(stage_dir, viewed_opt, params_view)
             else:
                 # checked here, not in config validation: jax.process_count()
                 # initializes the backend as a side effect, which would break a
@@ -1100,9 +1183,8 @@ class BaseTrainer:
                         "and cannot run multi-process; set "
                         "trainer.checkpoint_backend: orbax for multi-host runs"
                     )
-                metas = self.module.ckpt_metas()
                 save_model_checkpoint(
-                    stage_dir, self.module.ckpt_view(self.params), metas,
+                    stage_dir, params_view, metas,
                     separate_file_for_parameters=getattr(
                         self.module, "separate_file_for_parameters", None
                     ),
@@ -1113,6 +1195,12 @@ class BaseTrainer:
                     stage_dir, viewed_opt, metas, writer=writer,
                     recorder=commit.record,
                 )
+            # MESH.json (docs/RESILIENCE.md "Elastic resharding"): the
+            # logical param tree + saving topology, staged with the rest
+            # so the commit's manifest scan digests it — restore at a
+            # different mesh shape verifies against it instead of
+            # assuming the disk layout matches the current mesh
+            write_mesh_meta(stage_dir, self._mesh_meta(params_view, metas))
             self.context.save_checkpoint(stage_dir)
             # full config travels with the weights so inference can rebuild
             # the architecture (reference: context.py:113-125 config.yml copy)
@@ -1176,7 +1264,8 @@ class BaseTrainer:
 
                 prune_manifest_entries(old, removed)
 
-    def _save_orbax(self, step_dir: Path, viewed_opt: OptimizerState) -> None:
+    def _save_orbax(self, step_dir: Path, viewed_opt: OptimizerState,
+                    params_view=None) -> None:
         """Tensorstore-backed sharded save: every host writes only its own
         shards — no host gather, unlike the npz path (save trees are the
         same per-layer canonical views, so pp/mp relayouts still restore)."""
@@ -1184,7 +1273,8 @@ class BaseTrainer:
 
         save_orbax(
             step_dir,
-            self.module.ckpt_view(self.params),
+            params_view if params_view is not None
+            else self.module.ckpt_view(self.params),
             {
                 "step": viewed_opt.step,
                 "master": viewed_opt.master,
@@ -1194,7 +1284,8 @@ class BaseTrainer:
             },
         )
 
-    def _restore_orbax_params(self, step_dir: Path, metas, restored_keys=None):
+    def _restore_orbax_params(self, step_dir: Path, metas, restored_keys=None,
+                              params_view=None):
         """Restore the param view tree, re-sharded to the CURRENT mesh
         layout (orbax reads each shard from tensorstore). Non-strict under
         the same allow-list regexes as the npz loader, so PEFT/LoRA loads
@@ -1203,7 +1294,8 @@ class BaseTrainer:
 
         return restore_orbax_params(
             step_dir,
-            self.module.ckpt_view(self.params),
+            params_view if params_view is not None
+            else self.module.ckpt_view(self.params),
             metas,
             allowed_missing_keys=self.config.allowed_missing_keys_in_checkpoint,
             allowed_unexpected_keys=self.config.allowed_unexpected_keys_in_checkpoint,
@@ -1331,15 +1423,39 @@ class BaseTrainer:
                 "falling back to the npz files in the same step dir"
             )
         metas = self.module.ckpt_metas()
+        current_view = self.module.ckpt_view(self.params)
+        # reshard-on-restore (docs/RESILIENCE.md "Elastic resharding"):
+        # when the checkpoint's MESH.json topology differs from the
+        # restoring one, pre-flight the logical param tree (a global-
+        # shape disagreement is a different model — abort, never "fall
+        # back"), then take the SAME per-layer global-array load below:
+        # device_put against the current metas re-slices every leaf
+        # (params AND zero-partitioned optimizer state) onto the new
+        # mesh, with ckpt_view/ckpt_unview handling the vpp stacking.
+        # Legacy checkpoints without MESH.json restore at the same
+        # shape exactly as before (plan is None).
+        plan = reshard_plan(
+            read_mesh_meta(step_dir),
+            self._current_topology_dict(),
+            self._param_records(current_view, metas),
+        )
+        if plan is not None:
+            fire_reshard_point(step_dir, plan)
+            logger.log_event(
+                "ckpt-reshard", step=manifest.get("step")
+                if manifest is not None else None,
+                **plan.event_fields(),
+            )
         self.restored_model_keys = set()
         if orbax_backend:
             params_view = self._restore_orbax_params(
-                step_dir, metas, restored_keys=self.restored_model_keys
+                step_dir, metas, restored_keys=self.restored_model_keys,
+                params_view=current_view,
             )
         else:
             params_view = load_model_checkpoint(
                 step_dir,
-                self.module.ckpt_view(self.params),
+                current_view,
                 metas,
                 allowed_missing_keys=self.config.allowed_missing_keys_in_checkpoint,
                 allowed_unexpected_keys=self.config.allowed_unexpected_keys_in_checkpoint,
@@ -1406,6 +1522,29 @@ class BaseTrainer:
             logger.info("re-derived fresh optimizer state from loaded parameters")
         if self.config.load_context:
             self.context.load_checkpoint(step_dir)
+            # the data cursor is a GLOBAL sample count, mesh-independent
+            # by construction — but the new batch hierarchy's sampler
+            # grid must divide it or micro-batch strides would split
+            # mid-step (samples skipped/repeated). Validate at restore
+            # time, where the error is actionable, not steps later.
+            cfg = self.topology.config
+            self.context.consumed_samples = rescale_consumed_samples(
+                self.context.consumed_samples,
+                micro_batch_size=cfg.micro_batch_size,
+                data_parallel_size=cfg.data_parallel_size,
+            )
+            # the eval cursor advances by the OLD mbs*dp per eval
+            # micro-batch, so it is legitimately not aligned to the new
+            # grid after a reshard — floor-align it (a few re-seen eval
+            # samples are harmless; hard-failing here would kill every
+            # downsized relaunch at startup)
+            self.context.consumed_eval_samples = rescale_consumed_samples(
+                self.context.consumed_eval_samples,
+                micro_batch_size=cfg.micro_batch_size,
+                data_parallel_size=cfg.data_parallel_size,
+                what="consumed_eval_samples",
+                on_misaligned="floor",
+            )
         logger.info(f"loaded checkpoint {step_dir}")
 
 

@@ -44,6 +44,7 @@ _LAZY = {
     "ModelSpec": "layouts", "Layout": "layouts", "BENCH_MODELS": "layouts",
     "enumerate_layouts": "layouts",
     "SliceTopology": "costmodel", "Calibration": "costmodel",
+    "AxisCorrection": "costmodel",
     "LayoutScore": "costmodel", "score_layout": "costmodel",
     "rank_layouts": "costmodel", "analytic_collectives": "costmodel",
     "link_for_axis": "costmodel",
@@ -101,11 +102,15 @@ def best_layout(
     global_batch_size: int = 64,
     micro_batch_size: int = 8,
     calibration=None,
+    correction=None,
 ) -> Tuple["Layout", list]:
     """Search the layout space of ``model_cfg`` (a ``ModelSpec``, a
     transformer-architecture config object, or a bench model name like
     ``"0.5b"``) over ``slice_topology`` and return
-    ``(best_layout, ranked_scores)``."""
+    ``(best_layout, ranked_scores)``. ``correction`` (an
+    ``AxisCorrection``) re-prices candidates by the accumulated
+    prediction-vs-measured telemetry — the supervisor's downsize replan
+    passes it so every prior epoch sharpens the next placement."""
     from .costmodel import SliceTopology, rank_layouts
     from .layouts import BENCH_MODELS, ModelSpec, enumerate_layouts
 
@@ -125,7 +130,8 @@ def best_layout(
             f"no valid layout of this model on {topo.chips} device(s) at "
             f"gbs={global_batch_size} mbs={micro_batch_size}"
         )
-    ranked = rank_layouts(model, layouts, topo, calibration)
+    ranked = rank_layouts(model, layouts, topo, calibration,
+                          correction=correction)
     return ranked[0].layout, ranked
 
 

@@ -226,6 +226,11 @@ def main(argv=None) -> int:
     parser.add_argument("--obs-root",
                         help="root to search for the newest obs run dir "
                         "when the bench capture is stale")
+    parser.add_argument("--correct-from-runs", metavar="ROOT",
+                        help="accumulate tuner-prediction vs span-measured "
+                        "pairs from every run dir under ROOT and apply the "
+                        "per-axis multiplicative correction to the ranking "
+                        "(docs/TUNING.md calibration loop)")
     parser.add_argument("--emit-config", metavar="FILE",
                         help="write the best layout's TopologyConfig dict")
     parser.add_argument("--record-events", metavar="FILE",
@@ -243,7 +248,12 @@ def main(argv=None) -> int:
                         "(forces the default calibration)")
     args = parser.parse_args(argv)
 
-    from .costmodel import Calibration, SliceTopology, rank_layouts
+    from .costmodel import (
+        AxisCorrection,
+        Calibration,
+        SliceTopology,
+        rank_layouts,
+    )
     from .layouts import BENCH_MODELS, ModelSpec, enumerate_layouts
 
     if args.model in BENCH_MODELS:
@@ -281,7 +291,17 @@ def main(argv=None) -> int:
         print("error: no valid layouts for this model/device count",
               file=sys.stderr)
         return 2
-    ranked = rank_layouts(model, layouts, topo, calibration)
+    correction = None
+    if args.correct_from_runs and not pinning:
+        correction = AxisCorrection.from_run_dirs(args.correct_from_runs)
+        if correction is None:
+            print(
+                f"correction: no tuner prediction/measured pairs under "
+                f"{args.correct_from_runs}; ranking uncorrected",
+                file=sys.stderr,
+            )
+    ranked = rank_layouts(model, layouts, topo, calibration,
+                          correction=correction)
     cal = calibration or Calibration.default()
 
     best = ranked[0]
@@ -309,6 +329,7 @@ def main(argv=None) -> int:
         "micro_batch_size": args.mbs,
         "slice_topology": topo.to_dict(),
         "calibration": cal.to_dict(),
+        "axis_correction": correction.to_dict() if correction else None,
         "ranked": [s.to_dict() for s in ranked],
         "topology_config": best.layout.topology_dict(),
         "prediction": prediction,
@@ -324,6 +345,12 @@ def main(argv=None) -> int:
           f"{topo.domain}]")
     print(f"calibration: efficiency={cal.compute_efficiency:.3f} "
           f"({cal.source})")
+    if correction is not None:
+        facs = " ".join(
+            f"{a}={f:.3f}" for a, f in sorted(correction.factors.items())
+        )
+        print(f"axis correction: {facs or '(none)'} "
+              f"[{correction.pairs} pair(s), {correction.source}]")
     header = (f"{'rank':>4} {'layout':<28} {'step_s':>9} {'tok/s':>10} "
               f"{'bubble':>7} {'comm_s':>8} {'mem_GB':>7} links")
     print(header)

@@ -33,6 +33,9 @@ token-slice), not a closed-form guess.
 from __future__ import annotations
 
 import dataclasses
+import math
+import re
+from pathlib import Path
 from typing import Dict, List, Optional
 
 from .layouts import Layout, ModelSpec
@@ -309,6 +312,137 @@ class Calibration:
         }
 
 
+# --------------------------------------------------- per-axis correction
+_LABEL_AXES = (
+    ("pipe", re.compile(r"(?:^|·)pp(\d+)")),
+    ("data", re.compile(r"(?:^|·)dp(\d+)")),
+    ("context", re.compile(r"(?:^|·)cp(\d+)")),
+    ("model", re.compile(r"(?:^|·)mp(\d+)")),
+)
+
+
+def _axes_of_label(label: str) -> List[str]:
+    """The parallel axes a layout label says are active (size > 1);
+    ``["compute"]`` for a single-device / pure-replication layout."""
+    active = [
+        axis for axis, rx in _LABEL_AXES
+        if (m := rx.search(label)) and int(m.group(1)) > 1
+    ]
+    return active or ["compute"]
+
+
+def _axes_of_layout(layout: Layout) -> List[str]:
+    active = [a for a, n in axis_sizes(layout).items() if n > 1]
+    return active or ["compute"]
+
+
+@dataclasses.dataclass(frozen=True)
+class AxisCorrection:
+    """Per-axis multiplicative correction learned from the calibration
+    loop's accumulated (tuner-prediction, span-measured) pairs.
+
+    Every run that exported a prediction leaves a ``tuner-prediction``
+    event + measured step time in its run dir (docs/TUNING.md); each
+    such pair contributes its measured/predicted ratio to the bucket of
+    every parallel axis its layout label says is active (``compute``
+    when none). A layout's correction is the geometric mean of its
+    active axes' factors — so if every dp-dominant run measured 1.5x
+    the prediction, dp-heavy candidates are re-priced up before the
+    next placement decision (the supervisor's downsize replan reads
+    this, so every prior epoch's telemetry sharpens the next layout)."""
+
+    factors: Dict[str, float]
+    pairs: int = 0
+    source: str = "identity"
+
+    @classmethod
+    def identity(cls) -> "AxisCorrection":
+        return cls(factors={}, pairs=0, source="identity")
+
+    @classmethod
+    def from_pairs(cls, pairs: List[dict], source: str = "pairs"
+                   ) -> "AxisCorrection":
+        """``pairs``: dicts with ``label``, ``predicted_step_s``,
+        ``measured_step_s``. Non-finite / non-positive entries are
+        dropped, never fatal (telemetry quality varies per run dir)."""
+        logs: Dict[str, List[float]] = {}
+        kept = 0
+        for p in pairs:
+            try:
+                predicted = float(p["predicted_step_s"])
+                measured = float(p["measured_step_s"])
+                label = str(p["label"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if not (
+                math.isfinite(predicted) and math.isfinite(measured)
+                and predicted > 0 and measured > 0
+            ):
+                continue
+            kept += 1
+            ratio = math.log(measured / predicted)
+            for axis in _axes_of_label(label):
+                logs.setdefault(axis, []).append(ratio)
+        factors = {
+            axis: round(math.exp(sum(v) / len(v)), 6)
+            for axis, v in logs.items()
+        }
+        return cls(factors=factors, pairs=kept, source=source)
+
+    @classmethod
+    def from_run_dirs(cls, root: Path | str) -> Optional["AxisCorrection"]:
+        """Accumulate pairs from the run dirs under ``root``: each
+        immediate subdirectory is one run dir (scanned recursively),
+        plus ``root``'s own direct files as one more — a flat telemetry
+        dir with an incidental subdirectory (checkpoints, plots, a
+        control dir) must not lose its own events. Root is read
+        NON-recursively so subdirectory telemetry is never counted
+        twice. None when no run recorded a usable pair."""
+        from ..obs.report import load_run_dir, tuner_section  # stdlib-only
+
+        root = Path(root)
+        if not root.is_dir():
+            return None
+        subdirs = sorted(p for p in root.iterdir() if p.is_dir())
+        pairs: List[dict] = []
+        for d in subdirs + [root]:
+            data = load_run_dir(d, recursive=d is not root)
+            _, stats = tuner_section(data)
+            predicted = stats.get("tuner_predicted_step_s")
+            measured = stats.get("tuner_measured_step_s")
+            if predicted is None or measured is None:
+                continue
+            preds = [
+                e for e in data.lifecycle
+                if e.get("event") == "tuner-prediction"
+            ]
+            label = preds[-1].get("label", "") if preds else ""
+            pairs.append({
+                "label": label, "predicted_step_s": predicted,
+                "measured_step_s": measured,
+            })
+        if not pairs:
+            return None
+        return cls.from_pairs(pairs, source=f"run-dirs:{root}")
+
+    def factor_for(self, layout: Layout) -> float:
+        """Geometric mean of the layout's active axes' factors (axes
+        with no accumulated telemetry contribute 1.0)."""
+        logs = [
+            math.log(self.factors[a])
+            for a in _axes_of_layout(layout) if a in self.factors
+        ]
+        if not logs:
+            return 1.0
+        return math.exp(sum(logs) / len(logs))
+
+    def to_dict(self) -> dict:
+        return {
+            "factors": dict(self.factors), "pairs": self.pairs,
+            "source": self.source,
+        }
+
+
 # ------------------------------------------------------------------ scoring
 @dataclasses.dataclass
 class LayoutScore:
@@ -371,6 +505,7 @@ def score_layout(
     calibration: Optional[Calibration] = None,
     collectives: Optional[List[dict]] = None,
     collectives_source: str = "analytic",
+    correction: Optional[AxisCorrection] = None,
 ) -> LayoutScore:
     """Predicted seconds per optimizer step for ``layout``.
 
@@ -381,7 +516,8 @@ def score_layout(
     (data/model/context axes) are priced per axis against the link class
     the slice topology assigns and added to the critical path — no
     overlap is assumed, which is conservative and, like every constant
-    here, corrected by the calibration loop.
+    here, corrected by the calibration loop. ``correction`` applies the
+    accumulated per-axis prediction-vs-measured factors on top.
     """
     cal = calibration or Calibration.default()
     L = layout
@@ -459,6 +595,8 @@ def score_layout(
         step_core = compute_s
 
     predicted = step_core + comm_s
+    if correction is not None:
+        predicted *= correction.factor_for(layout)
     score = LayoutScore(
         layout=layout,
         predicted_step_s=predicted,
@@ -478,9 +616,12 @@ def rank_layouts(
     layouts: List[Layout],
     slice_topology: SliceTopology,
     calibration: Optional[Calibration] = None,
+    correction: Optional[AxisCorrection] = None,
 ) -> List[LayoutScore]:
     scored = [
-        score_layout(model, l, slice_topology, calibration) for l in layouts
+        score_layout(model, l, slice_topology, calibration,
+                     correction=correction)
+        for l in layouts
     ]
     scored.sort(key=lambda s: (s.predicted_step_s, s.layout.label))
     return scored

@@ -222,3 +222,34 @@ def test_findings_are_json_serializable(fixture_findings):
 
     payload = json.dumps([f.to_dict() for f in fixture_findings])
     assert "STA004" in payload
+
+
+def test_reshard_modules_are_swallow_scoped_and_clean(tmp_path):
+    """ISSUE 12 satellite: the elastic-resharding modules
+    (resilience/reshard.py, resilience/meshmeta.py) live inside the
+    STA007 swallow-scope — an exception silently eaten mid-reshard is
+    exactly how a half-restored run trains on the wrong state — and the
+    clean tree stays at zero findings over them."""
+    from pathlib import Path
+
+    from scaling_tpu.analysis.lint import lint_file
+
+    # scope applies to resilience/ files: a seeded swallow fires there
+    d = tmp_path / "resilience"
+    d.mkdir()
+    f = d / "reshard.py"
+    f.write_text(
+        "def f(fn):\n"
+        "    try:\n"
+        "        fn()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    assert [x.rule for x in lint_file(f, root=tmp_path)] == ["STA007"]
+
+    repo = Path(__file__).resolve().parents[3]
+    for mod in ("reshard.py", "meshmeta.py"):
+        module = repo / "scaling_tpu" / "resilience" / mod
+        assert module.is_file()
+        findings = lint_file(module, root=repo)
+        assert [x.rule for x in findings] == [], (mod, findings)

@@ -440,3 +440,81 @@ def test_serving_gates_thresholds_and_missing_data(tmp_path):
                            assert_serve_throughput=1.0, assert_ttft=1.0)
     assert len(failures) == 2
     assert all("no " in f for f in failures)
+
+
+# ----------------------------------------------------- elastic downsizing
+def _elastic_run_dir(tmp_path, downsizes=1, supervised=True):
+    """Canned supervised run dir with a downsize + reshard transition
+    (ISSUE 12): the restart timeline must render the world-size
+    transition and the --assert-max-downsizes gate must count it."""
+    run = tmp_path / "elastic_run"
+    run.mkdir(parents=True, exist_ok=True)
+    lines = []
+    if supervised:
+        lines.append(json.dumps({
+            "event": "epoch-start", "ts": 1.0, "epoch": 0, "num_hosts": 2,
+        }))
+    for i in range(downsizes):
+        lines.append(json.dumps({
+            "event": "downsize", "ts": 5.0 + i, "epoch": i,
+            "old_world": 2 - i, "new_world": 1 - i, "removed_hosts": [1],
+            "layout": None, "predicted_step_s": None, "source": "shrink",
+        }))
+    if downsizes:
+        lines.append(json.dumps({
+            "event": "ckpt-reshard", "ts": 8.0, "step": 3,
+            "saved": "world2·pp1·dp2·cp1·mp1·hosts2",
+            "restoring": "world1·pp1·dp1·cp1·mp1·hosts1",
+            "saved_world": 2, "restoring_world": 1,
+            "saved_hosts": 2, "restoring_hosts": 1,
+        }))
+    (run / "events.jsonl").write_text("\n".join(lines) + "\n")
+    return run
+
+
+def test_timeline_renders_world_size_transitions(tmp_path):
+    from scaling_tpu.obs.report import load_run_dir, timeline_section
+
+    run = _elastic_run_dir(tmp_path)
+    lines = timeline_section(load_run_dir(run))
+    joined = "\n".join(lines)
+    assert "downsizes=1" in joined
+    assert "world-size transitions:" in joined
+    assert "2->1 (downsize/shrink)" in joined
+    assert "2->1 (reshard" in joined
+    # non-elastic runs render neither suffix nor transition line (the
+    # committed golden reports stay byte-identical)
+    plain = tmp_path / "plain"
+    plain.mkdir()
+    (plain / "events.jsonl").write_text(
+        json.dumps({"event": "relaunch", "ts": 1.0}) + "\n")
+    plain_lines = "\n".join(timeline_section(load_run_dir(plain)))
+    assert "downsizes" not in plain_lines
+    assert "world-size transitions" not in plain_lines
+
+
+def test_max_downsizes_gate_counts_and_fails_on_missing_data(tmp_path):
+    from scaling_tpu.obs.cli import main
+    from scaling_tpu.obs.report import load_run_dir
+
+    run = _elastic_run_dir(tmp_path)
+    data = load_run_dir(run)
+    assert check_gates(data, assert_max_downsizes=1) == []
+    failures = check_gates(data, assert_max_downsizes=0)
+    assert failures and "assert-max-downsizes" in failures[0]
+    assert "2->1" in failures[0]  # the transition rides the message
+    # missing data fails: no supervisor telemetry at all
+    unsupervised = tmp_path / "unsup"
+    unsupervised.mkdir()
+    (unsupervised / "events.jsonl").write_text(
+        json.dumps({"event": "relaunch", "ts": 1.0}) + "\n")
+    failures = check_gates(
+        load_run_dir(unsupervised), assert_max_downsizes=3
+    )
+    assert failures and "no supervisor telemetry" in failures[0]
+    # a supervised run with zero downsizes passes any ceiling
+    healthy = _elastic_run_dir(tmp_path / "h", downsizes=0)
+    assert check_gates(load_run_dir(healthy), assert_max_downsizes=0) == []
+    # CLI wiring: pass and fail exit codes
+    assert main(["report", str(run), "--assert-max-downsizes", "1"]) == 0
+    assert main(["report", str(run), "--assert-max-downsizes", "0"]) == 1

@@ -11,7 +11,8 @@ Usage: ``python tests/core/test_resilience/multihost_driver.py SPEC.json``
 Spec keys: ``master_port``, ``num_hosts``, ``control_dir``, ``payload``
 (forwarded to multihost_script), plus optional supervisor knobs
 ``heartbeat_timeout`` / ``startup_grace`` / ``restart_budget`` /
-``restart_backoff`` / ``worker_grace``.
+``restart_backoff`` / ``worker_grace`` / ``downsize_after`` /
+``min_hosts``.
 """
 
 import json
@@ -41,6 +42,8 @@ def main() -> int:
         "restart_budget": spec.get("restart_budget", 1),
         "restart_backoff_seconds": spec.get("restart_backoff", 0.1),
         "worker_grace_seconds": spec.get("worker_grace", 5.0),
+        "downsize_after": spec.get("downsize_after"),
+        "min_hosts": spec.get("min_hosts", 1),
     })
     return runner_main(config, payload=spec["payload"])
 

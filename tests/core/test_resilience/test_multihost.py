@@ -342,6 +342,75 @@ def test_sigterm_to_supervisor_drains_all_hosts_same_boundary(baseline):
     assert not any(e["event"] == "relaunch" for e in events)
 
 
+def test_downsize_two_hosts_to_one_continues_loss_exact(baseline):
+    """Elastic downsizing e2e (ISSUE 12): host 1 dies at its 5th loop
+    entry in EVERY epoch (``x*`` re-arms per relaunch) — the capacity is
+    never coming back. With ``downsize_after=2`` the supervisor retries
+    the full size twice, then drops host 1 from the plan and relaunches
+    the survivor alone: the downsized epoch resumes from the newest
+    checkpoint (written under the 2-host world — the restoring 1-host
+    topology differs, so the trainer's reshard path engages and logs the
+    ``ckpt-reshard`` transition), completes loss-exact, and the
+    supervisor exits 0 instead of burning its budget and giving up.
+    The run dir must parse through ``obs report`` with the downsize in
+    the restart timeline and pass/fail ``--assert-max-downsizes``."""
+    tmp, gold = baseline
+    p, workdir = run_supervised(
+        tmp, "downsize", faults="host.kill=kill@5x*@host=1",
+        restart_budget=2, downsize_after=2,
+    )
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-3000:]
+    # the survivor finished the run in the downsized epoch, resuming
+    # from the last checkpoint the 2-host world committed
+    result = read_result(workdir, 0)
+    assert result["iterations"] == 8
+    assert result["resumed_from"] == 6
+    assert result["epoch"] == 2  # epochs 0,1 at world 2; epoch 2 at world 1
+    losses = read_losses(workdir, 0)
+    assert sorted(losses) == list(range(1, 9))
+    np.testing.assert_array_equal(
+        np.asarray([losses[s] for s in range(1, 9)]),
+        np.asarray([gold[s] for s in range(1, 9)]),
+    )
+    ckpt = workdir / "host0" / "ckpt"
+    assert (ckpt / "latest").read_text() == "global_step6"
+    assert verify_checkpoint(ckpt / "global_step6") == []
+    # host 1 never finished: SIGKILLed in both full-size epochs
+    assert not (workdir / "host1_result.json").exists()
+
+    events = read_events(tmp, "downsize")
+    downs = [e for e in events if e["event"] == "downsize"]
+    assert len(downs) == 1
+    assert downs[0]["old_world"] == 2 and downs[0]["new_world"] == 1
+    assert downs[0]["removed_hosts"] == [1]
+    dead = [e for e in events if e["event"] == "host-dead"]
+    assert len(dead) == 2 and all(e["hosts"] == [1] for e in dead)
+    # the downsized epoch's restore crossed mesh shapes: 2 hosts -> 1
+    reshards = [e for e in events if e["event"] == "ckpt-reshard"]
+    assert reshards and reshards[-1]["saved_hosts"] == 2
+    assert reshards[-1]["restoring_hosts"] == 1
+    assert any(e["event"] == "epoch-clean-exit" for e in events)
+
+    # obs report: the incident run dir parses; the restart timeline
+    # carries the world-size transition; the gate counts downsizes and
+    # fails at a too-low ceiling
+    from scaling_tpu.obs.cli import main as obs_main
+    from scaling_tpu.obs.report import load_run_dir, render_report
+
+    telemetry = tmp / "downsize_telemetry"
+    data = load_run_dir(telemetry)
+    assert data.bad_lines == 0, f"unparseable telemetry: {data.bad_lines}"
+    report = render_report(data, telemetry)
+    assert "downsizes=1" in report
+    assert "world-size transitions:" in report and "2->1" in report
+    assert obs_main([
+        "report", str(telemetry), "--assert-max-downsizes", "1",
+    ]) == 0
+    assert obs_main([
+        "report", str(telemetry), "--assert-max-downsizes", "0",
+    ]) == 1
+
+
 @pytest.mark.slow
 def test_hung_host_detected_by_stale_heartbeat_and_relaunched(baseline):
     """host.hang wedges host 0's loop without exiting — only the missing

@@ -262,3 +262,227 @@ def test_teardown_escalates_sigterm_to_sigkill(tmp_path):
     assert meek.poll() == -15  # SIGTERM sufficed
     assert cp.get_flag("abort") is not None
     assert elapsed < 30
+
+
+# ----------------------------------------------------- elastic downsizing
+def test_plan_downsize_drops_dead_workers_and_rebuilds_pool():
+    from scaling_tpu.runner.supervise import plan_downsize
+
+    config = RunnerConfig.from_dict({
+        "hosts": ["localhost"], "supervise": True, "control_dir": "/tmp/x",
+        "downsize_after": 1,
+    })
+    pool = {"localhost": 3}
+    workers = [("localhost", 0), ("localhost", 1), ("localhost", 2)]
+    plan = plan_downsize(config, pool, workers, gone=[1], payload={})
+    assert plan is not None
+    new_pool, new_workers, replan, payload = plan
+    assert sum(new_pool.values()) == 2 and len(new_workers) == 2
+    assert replan is None  # no downsize_model: plain world shrink
+    # min_hosts floors the shrink: dropping below it refuses to plan
+    config2 = RunnerConfig.from_dict({
+        "hosts": ["localhost"], "supervise": True, "control_dir": "/tmp/x",
+        "downsize_after": 1, "min_hosts": 3,
+    })
+    assert plan_downsize(config2, pool, workers, gone=[1], payload={}) is None
+    # nothing identifiably dead: nothing to downsize
+    assert plan_downsize(config, pool, workers, gone=[], payload={}) is None
+
+
+def test_plan_downsize_remote_pool_keeps_surviving_slot_counts():
+    from scaling_tpu.runner.supervise import plan_downsize
+
+    config = RunnerConfig.from_dict({
+        "hosts": ["tpu-a", "tpu-b"], "supervise": True,
+        "control_dir": "/tmp/x", "downsize_after": 1,
+        "default_gpu_count": 4,
+    })
+    pool = {"tpu-a": 4, "tpu-b": 4}
+    workers = [("tpu-a", 0), ("tpu-b", 0)]  # one proc per remote host
+    plan = plan_downsize(config, pool, workers, gone=[0], payload={})
+    assert plan is not None
+    new_pool, new_workers, _, _ = plan
+    assert new_pool == {"tpu-b": 4} and new_workers == [("tpu-b", 0)]
+
+
+def test_replan_layout_picks_tuner_layout_and_rewrites_payload():
+    """With downsize_model set, the replanned layout comes from
+    tune.best_layout over the surviving slots — a runnable topology at
+    the new world size — and plan_downsize rewrites a payload that
+    carries one."""
+    from scaling_tpu.runner.supervise import plan_downsize, replan_layout
+
+    config = RunnerConfig.from_dict({
+        "hosts": ["localhost"], "supervise": True, "control_dir": "/tmp/x",
+        "downsize_after": 1, "downsize_model": "0.5b",
+        "default_gpu_count": 8,
+    })
+    replan = replan_layout(config, 4, {})
+    assert replan is not None
+    assert replan["topology"]["world_size"] == 4
+    assert replan["predicted_step_s"] > 0
+
+    pool = {"localhost": 8}
+    workers = [("localhost", s) for s in range(8)]
+    payload = {"topology": {"world_size": 8, "data_parallel_size": 8,
+                            "global_batch_size": 64,
+                            "micro_batch_size": 8}, "other": 1}
+    plan = plan_downsize(config, pool, workers,
+                         gone=[4, 5, 6, 7], payload=payload)
+    assert plan is not None
+    _, new_workers, replan2, new_payload = plan
+    assert len(new_workers) == 4
+    assert new_payload["topology"]["world_size"] == 4
+    assert new_payload["other"] == 1  # the rest of the payload rides along
+    # a broken tuner must downgrade to a shrink, never block the relaunch
+    config_bad = RunnerConfig.from_dict({
+        "hosts": ["localhost"], "supervise": True, "control_dir": "/tmp/x",
+        "downsize_after": 1, "downsize_model": "no-such-model",
+    })
+    assert replan_layout(config_bad, 4, {}) is None
+
+
+def test_supervise_main_downsizes_after_consecutive_losses(
+    tmp_path, monkeypatch
+):
+    """The decision loop: two consecutive capacity-losing epochs at
+    downsize_after=2 -> the dead worker leaves the plan, a `downsize`
+    event lands, the restart budget resets, and the smaller pod's clean
+    epoch ends the run with exit 0."""
+    import json
+
+    from scaling_tpu.runner import supervise
+
+    events = tmp_path / "events.jsonl"
+    monkeypatch.setenv("SCALING_TPU_EVENTS_PATH", str(events))
+
+    seen = []
+
+    def fake_run_epoch(config, pool, workers, encoded, master_addr,
+                       control_root, epoch, state):
+        seen.append(list(workers))
+        if len(workers) > 1:
+            state["gone"] = [1]  # worker 1 dies every epoch at full size
+            return 1
+        state["gone"] = []
+        return 0  # the downsized pod completes
+
+    monkeypatch.setattr(supervise, "_run_epoch", fake_run_epoch)
+    config = RunnerConfig.from_dict({
+        "hosts": ["localhost"], "supervise": True,
+        "control_dir": str(tmp_path / "cp"), "default_gpu_count": 2,
+        "downsize_after": 2, "restart_budget": 2,
+        "restart_backoff_seconds": 0.0,
+    })
+    assert supervise.supervise_main(config, payload={}) == 0
+    # two 2-worker epochs, then the downsized single-worker epoch
+    assert [len(w) for w in seen] == [2, 2, 1]
+    recs = [json.loads(l) for l in events.read_text().splitlines()]
+    downsizes = [r for r in recs if r["event"] == "downsize"]
+    assert len(downsizes) == 1
+    assert downsizes[0]["old_world"] == 2
+    assert downsizes[0]["new_world"] == 1
+    assert downsizes[0]["removed_hosts"] == [1]
+    assert downsizes[0]["source"] == "shrink"
+
+
+def test_supervise_main_stall_drains_do_not_count_toward_downsize(
+    tmp_path, monkeypatch
+):
+    """Failed epochs that lost NO capacity (stall drains) must not
+    trigger a downsize — there is no one to drop, and shrinking a
+    healthy pod for a storage stall would be wrong twice."""
+    import json
+
+    from scaling_tpu.runner import supervise
+
+    events = tmp_path / "events.jsonl"
+    monkeypatch.setenv("SCALING_TPU_EVENTS_PATH", str(events))
+    calls = {"n": 0}
+
+    def fake_run_epoch(config, pool, workers, encoded, master_addr,
+                       control_root, epoch, state):
+        calls["n"] += 1
+        state["gone"] = []
+        return 1 if calls["n"] <= 2 else 0  # two stalls, then clean
+
+    monkeypatch.setattr(supervise, "_run_epoch", fake_run_epoch)
+    config = RunnerConfig.from_dict({
+        "hosts": ["localhost"], "supervise": True,
+        "control_dir": str(tmp_path / "cp"), "default_gpu_count": 2,
+        "downsize_after": 1, "restart_budget": 3,
+        "restart_backoff_seconds": 0.0,
+    })
+    assert supervise.supervise_main(config, payload={}) == 0
+    recs = [json.loads(l) for l in events.read_text().splitlines()]
+    assert not any(r["event"] == "downsize" for r in recs)
+
+
+def test_plan_downsize_plain_shrink_rewrites_payload_topology():
+    """Without a tuner model the payload-carried topology must STILL be
+    rewritten to the new world size (4 survivors relaunched into an
+    8-way mesh fail every downsized epoch at startup): the data axis
+    shrinks, gbs is preserved when the new grid divides it (gas grows),
+    and an unshrinkable pp*cp*mp leaves the payload untouched with a
+    loud warning rather than a silent half-rewrite."""
+    from scaling_tpu.runner.supervise import _shrink_topology, plan_downsize
+
+    config = RunnerConfig.from_dict({
+        "hosts": ["localhost"], "supervise": True, "control_dir": "/tmp/x",
+        "downsize_after": 1, "default_gpu_count": 8,
+    })
+    pool = {"localhost": 8}
+    workers = [("localhost", s) for s in range(8)]
+    payload = {"topology": {
+        "world_size": 8, "pipe_parallel_size": 2, "data_parallel_size": 4,
+        "model_parallel_size": 1, "micro_batch_size": 2,
+        "gradient_accumulation_steps": 2, "global_batch_size": 16,
+    }}
+    plan = plan_downsize(config, pool, workers,
+                         gone=[4, 5, 6, 7], payload=payload)
+    assert plan is not None
+    _, new_workers, replan, new_payload = plan
+    assert replan is None and len(new_workers) == 4
+    topo = new_payload["topology"]
+    assert topo["world_size"] == 4
+    assert topo["data_parallel_size"] == 2  # pp2 fixed, data axis folds
+    # gbs preserved: the stream continues skip/repeat-free (gas doubles)
+    assert topo["global_batch_size"] == 16
+    assert topo["gradient_accumulation_steps"] == 4
+    # model axes the shrink cannot fold -> payload untouched, not mangled
+    assert _shrink_topology({"pipe_parallel_size": 3}, 4) is None
+    bad = {"topology": {"world_size": 8, "pipe_parallel_size": 3}}
+    plan2 = plan_downsize(config, pool, workers, gone=[7], payload=bad)
+    assert plan2 is not None and plan2[3] is bad  # unchanged object
+
+
+def test_downsize_reelects_master_when_pinned_addr_is_removed(
+    tmp_path, monkeypatch
+):
+    """A pinned master_addr naming the host the downsize just removed
+    must be re-elected to a survivor — otherwise every downsized epoch
+    rendezvouses against the dead coordinator and burns the fresh
+    budget on guaranteed failures."""
+    from scaling_tpu.runner import supervise
+
+    masters = []
+
+    def fake_run_epoch(config, pool, workers, encoded, master_addr,
+                       control_root, epoch, state):
+        masters.append(master_addr)
+        if "tpu-a" in pool:
+            state["gone"] = [0]  # tpu-a (worker 0, the pinned master) dies
+            return 1
+        state["gone"] = []
+        return 0
+
+    monkeypatch.setattr(supervise, "_run_epoch", fake_run_epoch)
+    config = RunnerConfig.from_dict({
+        "hosts": ["tpu-a", "tpu-b"], "supervise": True,
+        "master_addr": "tpu-a", "control_dir": str(tmp_path / "cp"),
+        "downsize_after": 1, "restart_budget": 1,
+        "restart_backoff_seconds": 0.0,
+    })
+    assert supervise.supervise_main(config, payload={}) == 0
+    assert masters[0] == "tpu-a"       # full-size epoch: pinned master
+    assert masters[-1] == "tpu-b"      # downsized epoch: re-elected

@@ -168,3 +168,90 @@ def test_calibration_from_run_dir_reads_mfu(tmp_path):
 def test_memory_estimate_orders_sharded_below_replicated(ranked):
     t = by_label(ranked)
     assert t["pp1·dp8·mp1·z3"].memory_gb < t["pp1·dp8·mp1·z1"].memory_gb
+
+
+# ------------------------------------------------- per-axis correction
+def test_axis_correction_from_pairs_and_reranking():
+    """Accumulated prediction-vs-measured pairs correct the ranking per
+    axis: runs whose dp-dominant layouts measured 2x the prediction push
+    every dp-active candidate down by exactly that factor, pp-only
+    candidates stay untouched, and garbage pairs are dropped."""
+    from scaling_tpu.tune.costmodel import (
+        AxisCorrection,
+        SliceTopology,
+        score_layout,
+    )
+    from scaling_tpu.tune.layouts import BENCH_MODELS, Layout
+
+    corr = AxisCorrection.from_pairs([
+        {"label": "pp1·dp8·mp1·z1", "predicted_step_s": 1.0,
+         "measured_step_s": 2.0},
+        {"label": "pp1·dp8·mp1·z1", "predicted_step_s": 1.0,
+         "measured_step_s": 8.0},
+        {"label": "bogus", "predicted_step_s": float("nan"),
+         "measured_step_s": 1.0},  # dropped, never fatal
+        {"label": "no-numbers"},  # dropped
+    ])
+    assert corr.pairs == 2
+    assert corr.factors == {"data": 4.0}  # geomean(2, 8)
+
+    model = BENCH_MODELS["0.5b"]
+    topo = SliceTopology(chips=8)
+    dp_layout = Layout(pp=1, dp=8, cp=1, mp=1, micro_batch_size=8,
+                       gradient_accumulation_steps=1)
+    pp_layout = Layout(pp=2, dp=4, cp=1, mp=1, micro_batch_size=8,
+                       gradient_accumulation_steps=2)
+    base_dp = score_layout(model, dp_layout, topo).predicted_step_s
+    corr_dp = score_layout(model, dp_layout, topo,
+                           correction=corr).predicted_step_s
+    assert corr_dp == pytest.approx(base_dp * 4.0)
+    # the pp2 layout is also dp-active (dp=4): geomean over {data} only
+    # (pipe has no telemetry) is still the data factor
+    base_pp = score_layout(model, pp_layout, topo).predicted_step_s
+    corr_pp = score_layout(model, pp_layout, topo,
+                           correction=corr).predicted_step_s
+    assert corr_pp == pytest.approx(base_pp * 4.0)
+    # identity leaves everything untouched
+    ident = AxisCorrection.identity()
+    assert ident.factor_for(dp_layout) == 1.0
+
+
+def test_axis_correction_from_run_dirs(tmp_path):
+    """Pairs accumulate across run dirs: each dir's tuner-prediction
+    event + step records yield one (predicted, measured) pair tagged by
+    the layout label; dirs without usable telemetry are skipped."""
+    import json
+
+    from scaling_tpu.tune.costmodel import AxisCorrection
+
+    def write_run(d, label, predicted, measured):
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "events.jsonl").write_text(json.dumps({
+            "event": "tuner-prediction", "ts": 1.0, "label": label,
+            "predicted_step_s": predicted,
+        }) + "\n")
+        recs = [json.dumps({
+            "kind": "step", "step": s, "host": 0,
+            "metrics": {"step_duration": measured},
+        }) for s in range(1, 4)]
+        (d / "metrics.jsonl").write_text("\n".join(recs) + "\n")
+
+    root = tmp_path / "runs"
+    write_run(root / "epoch0", "pp1·dp2·mp1·z1", 1.0, 3.0)
+    write_run(root / "epoch1", "pp2·dp1·mp1·z1", 2.0, 1.0)
+    (root / "empty").mkdir()
+
+    corr = AxisCorrection.from_run_dirs(root)
+    assert corr is not None and corr.pairs == 2
+    assert corr.factors["data"] == pytest.approx(3.0)
+    assert corr.factors["pipe"] == pytest.approx(0.5)
+    # no telemetry at all -> None (callers fall back to uncorrected)
+    assert AxisCorrection.from_run_dirs(tmp_path / "nothing") is None
+    # a FLAT telemetry dir with an incidental subdirectory (checkpoints,
+    # a control dir) must still contribute its own direct files — once
+    flat = tmp_path / "flat"
+    write_run(flat, "pp1·dp4·mp1·z1", 1.0, 2.0)
+    (flat / "ckpt").mkdir()
+    corr_flat = AxisCorrection.from_run_dirs(flat)
+    assert corr_flat is not None and corr_flat.pairs == 1
+    assert corr_flat.factors["data"] == pytest.approx(2.0)
