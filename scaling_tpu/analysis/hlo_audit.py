@@ -528,6 +528,10 @@ def audit_serve_decode_section(num_slots=2, block_size=4,
         "prefill_chunk": prefill_chunk,
         "spec_k": spec_k,
         "mixed_width": width,
+        # positions gathered per row before the vocab projection — a
+        # change that silently re-projects every width position shows
+        # up as golden drift, not a quiet FLOPs regression
+        "sample_width": engine.config.sample_width,
     }
     report = _audit_lowered(lowered, args, static, mesh=None)
     report["mesh"] = {}

@@ -312,7 +312,8 @@ class TransformerInferenceModule:
         return cls(config, module, params, tokenizer)
 
     # ------------------------------------------------------------- forward
-    def _run_layers(self, params, batch, caches, offset, paged_kernel=None):
+    def _run_layers(self, params, batch, caches, offset, paged_kernel=None,
+                    gather_start=None, gather_width=None):
         """One pass through the stack; TransformerLayers consume/produce the
         KV caches, edge layers run as in training (deterministic).
 
@@ -321,6 +322,17 @@ class TransformerInferenceModule:
         blocks through the flash-style kernel (nn/paged_attention.py),
         'xla' gathers each row's window (the fallback). Dense caches
         ignore it.
+
+        ``gather_start`` (a traced per-row (b,) start index) with
+        ``gather_width`` (static) slices each row's window of trunk
+        activations AFTER the last TransformerLayer and BEFORE the
+        post-trunk layers — which are position-pointwise, so only the
+        positions that will actually be SAMPLED pay the final norm and
+        the vocab projection (the serving engine's fused mixed program
+        samples ≤ spec_k+1 of its ``mixed_width`` positions per row;
+        projecting all of them priced a (rows, width, vocab) logit
+        block nobody read). The returned logits then cover positions
+        ``gather_start .. gather_start + gather_width - 1`` per row.
 
         A pipelined (pp>1) stack wraps its TransformerLayers in a
         ``PipelinedBody``, which cannot consume KV caches: the cached path
@@ -333,6 +345,18 @@ class TransformerInferenceModule:
         ctx = self.module._make_ctx(deterministic=True, dropout_key=None)
         if paged_kernel is not None:
             ctx.paged_kernel = paged_kernel
+        last_tl = None
+        if gather_start is not None:
+            tls = [
+                i for i, l in enumerate(self.module.layers)
+                if isinstance(l, TransformerLayer)
+            ]
+            if not tls:
+                raise ValueError(
+                    "gather_start needs a TransformerLayer trunk to "
+                    "gather after (pipelined/edge-only stacks have none)"
+                )
+            last_tl = max(tls)
         x = batch
         new_caches = []
         li = 0
@@ -345,6 +369,13 @@ class TransformerInferenceModule:
                     x, kv = layer(p, x, ctx, kv_cache=caches[li], cache_offset=offset)
                     new_caches.append(kv)
                     li += 1
+                if i == last_tl:
+                    x = dict(x)
+                    x["activations"] = jax.vmap(
+                        lambda a, s: jax.lax.dynamic_slice_in_dim(
+                            a, s, gather_width, axis=0
+                        )
+                    )(x["activations"], gather_start)
             elif isinstance(layer, PipelinedBody):
                 if caches is not None:
                     raise ValueError(

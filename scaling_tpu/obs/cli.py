@@ -58,6 +58,16 @@ def main(argv=None) -> int:
                         "downsized more than CEIL times, or the run dir "
                         "holds no supervisor telemetry at all "
                         "(docs/RESILIENCE.md elastic resharding)")
+    parser.add_argument("--assert-max-shed-rate", type=float,
+                        metavar="CEIL",
+                        help="fail (exit 1) when the serving shed rate "
+                        "exceeds CEIL, or the run dir holds no shed "
+                        "telemetry at all (docs/SERVING.md resilience)")
+    parser.add_argument("--assert-max-serve-timeouts", type=int,
+                        metavar="CEIL",
+                        help="fail (exit 1) when more than CEIL serving "
+                        "requests hit their deadline, or the run dir "
+                        "holds no timeout telemetry at all")
     args = parser.parse_args(argv)
 
     run_dir = Path(args.run_dir)
@@ -86,13 +96,17 @@ def main(argv=None) -> int:
         assert_ttft=args.assert_ttft,
         assert_spec_accept_rate=args.assert_spec_accept_rate,
         assert_max_downsizes=args.assert_max_downsizes,
+        assert_max_shed_rate=args.assert_max_shed_rate,
+        assert_max_serve_timeouts=args.assert_max_serve_timeouts,
     )
     if (args.assert_mfu is not None or args.assert_step_time is not None
             or args.assert_tuner_calibration is not None
             or args.assert_serve_throughput is not None
             or args.assert_ttft is not None
             or args.assert_spec_accept_rate is not None
-            or args.assert_max_downsizes is not None):
+            or args.assert_max_downsizes is not None
+            or args.assert_max_shed_rate is not None
+            or args.assert_max_serve_timeouts is not None):
         print("== gates ==")
         if failures:
             for f in failures:

@@ -511,6 +511,36 @@ def serving_section(data: RunData) -> Tuple[List[str], Dict[str, float]]:
                 f"{int(s.get('spec_drafted_tokens', 0))} drafts "
                 f"(accept rate {stats['serve_spec_accept_rate']:.1%})"
             )
+        # resilience rails (docs/SERVING.md "Resilience"): overload
+        # sheds, deadline timeouts, supervised restarts, drain state —
+        # the artifacts the --assert-max-shed-rate /
+        # --assert-max-serve-timeouts gates read. Only rendered when
+        # the summary carries the fields, so pre-resilience run dirs
+        # (and committed golden reports) are unchanged.
+        if "requests_shed" in s or "requests_timeout" in s:
+            shed = int(s.get("requests_shed", 0))
+            timeouts = int(s.get("requests_timeout", 0))
+            rate = float(s.get("shed_rate") or 0.0)
+            # the supervisor logs serve-restart per relaunch — even one
+            # that crashed before journaling anything (a serve-resume
+            # is only emitted once a replay has content); a manual
+            # `--resume` run has no supervisor, so fall back to its
+            # serve-resume events
+            restarts = sum(
+                1 for e in data.lifecycle
+                if e.get("event") == "serve-restart"
+            ) or sum(
+                1 for e in data.lifecycle if e.get("event") == "serve-resume"
+            )
+            stats["serve_shed_rate"] = rate
+            stats["serve_timeouts"] = float(timeouts)
+            stats["serve_restarts"] = float(restarts)
+            line = (f"  resilience: shed={shed} (rate {rate:.1%}) "
+                    f"timeouts={timeouts} restarts={restarts}")
+            if s.get("drained"):
+                line += (f" [drained; {int(s.get('unsubmitted', 0))} "
+                         "unsubmitted]")
+            lines.append(line)
     elif reqs:
         # crashed/partial run: derive throughput from what finished
         tokens = sum(int(e.get("output_tokens", 0)) for e in reqs)
@@ -682,7 +712,9 @@ def check_gates(data: RunData, assert_mfu: Optional[float] = None,
                 assert_serve_throughput: Optional[float] = None,
                 assert_ttft: Optional[float] = None,
                 assert_spec_accept_rate: Optional[float] = None,
-                assert_max_downsizes: Optional[int] = None
+                assert_max_downsizes: Optional[int] = None,
+                assert_max_shed_rate: Optional[float] = None,
+                assert_max_serve_timeouts: Optional[int] = None
                 ) -> List[str]:
     """CI-style regression gates; returns failure messages (empty ==
     pass). Missing data FAILS a requested gate — a run that recorded no
@@ -693,9 +725,38 @@ def check_gates(data: RunData, assert_mfu: Optional[float] = None,
     failures: List[str] = []
     serving_gates = (assert_serve_throughput is not None
                      or assert_ttft is not None
-                     or assert_spec_accept_rate is not None)
+                     or assert_spec_accept_rate is not None
+                     or assert_max_shed_rate is not None
+                     or assert_max_serve_timeouts is not None)
     if serving_gates:
         _, sstats = serving_section(data)
+        if assert_max_shed_rate is not None:
+            rate = sstats.get("serve_shed_rate")
+            if rate is None:
+                failures.append(
+                    "assert-max-shed-rate: no shed telemetry in the run "
+                    "dir (serve-summary carries no requests_shed — "
+                    "pre-resilience bench, or no summary at all?)"
+                )
+            elif rate > assert_max_shed_rate:
+                failures.append(
+                    f"assert-max-shed-rate: shed rate {rate:.3f} > "
+                    f"ceiling {assert_max_shed_rate:.3f}"
+                )
+        if assert_max_serve_timeouts is not None:
+            timeouts = sstats.get("serve_timeouts")
+            if timeouts is None:
+                failures.append(
+                    "assert-max-serve-timeouts: no timeout telemetry in "
+                    "the run dir (serve-summary carries no "
+                    "requests_timeout)"
+                )
+            elif timeouts > assert_max_serve_timeouts:
+                failures.append(
+                    f"assert-max-serve-timeouts: {int(timeouts)} "
+                    f"deadline timeout(s) > ceiling "
+                    f"{assert_max_serve_timeouts}"
+                )
         if assert_spec_accept_rate is not None:
             rate = sstats.get("serve_spec_accept_rate")
             if rate is None:

@@ -57,15 +57,33 @@ point               fired
                     retry load layer — transient failures retry, a
                     persistent one demotes the candidate and restore
                     falls back to the newest valid checkpoint
+``serve.tick``      at the top of every serving-engine tick
+                    (``serve.engine.ServeEngine.tick``) — ``kill`` here
+                    is the crash-replay drill's mid-tick crash; the
+                    request journal plus a supervised relaunch replay
+                    the incomplete requests token-exactly
+``serve.admit``     once per ``ServeEngine.submit`` call, before the
+                    admission/backpressure decision
+``serve.journal``   once per request-journal append
+                    (``serve.journal.RequestJournal``); ``fail`` is an
+                    IOError at the journal write
+``serve.pool``      once per KV-block allocation batch
+                    (``serve.scheduler.ContinuousBatchingScheduler``'s
+                    block grants — admission, growth, CoW forks)
 ==================  =====================================================
 
-Spec grammar (comma list): ``point=action[@N][xM][@host=K]`` — fire
-``action`` on hits ``N .. N+M-1`` of ``point`` (1-based; ``N`` defaults
-to 1, ``M`` to 1, ``x*`` means every hit from ``N`` on). ``@host=K``
+Spec grammar (comma list): ``point=action[@N][xM][@host=K][@epoch=E]``
+— fire ``action`` on hits ``N .. N+M-1`` of ``point`` (1-based; ``N``
+defaults to 1, ``M`` to 1, ``x*`` means every hit from ``N`` on). The
+same point may appear in SEVERAL entries (e.g. two ``host.kill`` rules
+scoped to different hosts — the chaos downsize drill's 3→2→1 script);
+every rule sees every hit and the first armed match fires. ``@host=K``
 scopes the rule to the host whose ``SCALING_TPU_HOST_ID`` environment
 variable equals ``K`` (supervised multi-host runs export it per worker);
-on other hosts — or outside a supervised launch — the rule never fires,
-though hits are still counted. Actions:
+``@epoch=E`` scopes it to supervisor relaunch epoch ``E``
+(``SCALING_TPU_COORD_EPOCH``). On non-matching hosts/epochs — or
+outside a supervised launch — the rule never fires, though hits are
+still counted. Actions:
 
 - ``kill``    SIGKILL this process (no cleanup runs — a real crash)
 - ``fail``    raise :class:`InjectedFault` (an ``IOError``, so the
@@ -90,7 +108,7 @@ from __future__ import annotations
 import os
 import re
 import signal
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from ..logging import logger
 
@@ -102,11 +120,12 @@ ACTIONS = ("kill", "fail", "sigterm", "hang", "corrupt", "nan")
 _EXECUTED = ("kill", "fail", "sigterm", "hang")
 
 HOST_ID_ENV = "SCALING_TPU_HOST_ID"
+EPOCH_ENV = "SCALING_TPU_COORD_EPOCH"
 
 _SPEC_RE = re.compile(
     r"^(?P<point>[a-z_.]+)=(?P<action>[a-z]+)"
     r"(?:@(?P<first>\d+))?(?:x(?P<count>\d+|\*))?"
-    r"(?:@host=(?P<host>\d+))?$"
+    r"(?:@host=(?P<host>\d+))?(?:@epoch=(?P<epoch>\d+))?$"
 )
 
 
@@ -115,14 +134,15 @@ class InjectedFault(IOError):
 
 
 class _Rule:
-    __slots__ = ("action", "first", "count", "host")
+    __slots__ = ("action", "first", "count", "host", "epoch")
 
     def __init__(self, action: str, first: int, count: Optional[int],
-                 host: Optional[int] = None):
+                 host: Optional[int] = None, epoch: Optional[int] = None):
         self.action = action
         self.first = first
         self.count = count  # None -> every hit from `first` on
         self.host = host  # None -> any host
+        self.epoch = epoch  # None -> any supervisor epoch
 
     def matches(self, hit: int) -> bool:
         if self.host is not None:
@@ -130,6 +150,10 @@ class _Rule:
             # without rebuilding the plan
             here = os.environ.get(HOST_ID_ENV)
             if here is None or int(here) != self.host:
+                return False
+        if self.epoch is not None:
+            now = os.environ.get(EPOCH_ENV)
+            if now is None or int(now) != self.epoch:
                 return False
         if hit < self.first:
             return False
@@ -141,7 +165,9 @@ class FaultPlan:
 
     def __init__(self, spec: str = ""):
         self.spec = spec
-        self._rules: Dict[str, _Rule] = {}
+        # several rules may arm the SAME point (host-/epoch-scoped chaos
+        # scripts); each hit consults them in spec order
+        self._rules: Dict[str, List[_Rule]] = {}
         self._hits: Dict[str, int] = {}
         for entry in filter(None, (s.strip() for s in spec.split(","))):
             m = _SPEC_RE.match(entry)
@@ -158,12 +184,14 @@ class FaultPlan:
                 )
             count = m.group("count")
             host = m.group("host")
-            self._rules[m.group("point")] = _Rule(
+            epoch = m.group("epoch")
+            self._rules.setdefault(m.group("point"), []).append(_Rule(
                 action,
                 int(m.group("first") or 1),
                 None if count == "*" else int(count or 1),
                 int(host) if host is not None else None,
-            )
+                int(epoch) if epoch is not None else None,
+            ))
 
     def hits(self, point: str) -> int:
         return self._hits.get(point, 0)
@@ -177,8 +205,10 @@ class FaultPlan:
         """
         hit = self._hits.get(point, 0) + 1
         self._hits[point] = hit
-        rule = self._rules.get(point)
-        if rule is None or not rule.matches(hit):
+        rule = next(
+            (r for r in self._rules.get(point, ()) if r.matches(hit)), None
+        )
+        if rule is None:
             return None
         if rule.action in _EXECUTED:
             logger.warning(

@@ -27,15 +27,33 @@ The "millions of users" half of the north star: turns the single-request
   load generator reporting tokens/s, TTFT/ITL percentiles, prefix-hit
   and speculative-accept rates through ``obs.get_registry()``, gated
   by ``--assert-serve-throughput`` / ``--assert-ttft`` (mirroring the
-  training MFU gates; ``--assert-spec-accept-rate`` rides the
-  analyzer).
+  training MFU gates; ``--assert-spec-accept-rate`` /
+  ``--assert-max-shed-rate`` / ``--assert-max-serve-timeouts`` ride
+  the analyzer).
+- resilience (docs/SERVING.md "Resilience"): per-request TTFT/total
+  deadlines cancelled at tick boundaries (terminal status
+  ``timeout``), watermark overload shedding with hysteresis
+  (``scheduler.Backpressure`` — the fleet router's signal), SIGTERM
+  graceful drain (``engine.install_drain_handler``), the
+  :mod:`.journal` crash-replay request journal behind
+  ``serve bench --resume`` / ``--restarts`` (token-exact replay via
+  the (request, position) sampler keys), and ``serve.tick`` /
+  ``serve.admit`` / ``serve.journal`` / ``serve.pool`` fault points
+  under ``SCALING_TPU_FAULTS``.
 
 jax-free at import time (the engine imports it lazily): the scheduler and
 request/bench plumbing must stay importable from the analyzer and tests
 without paying backend init.
 """
 
+from .journal import (
+    JournalReplay,
+    RequestJournal,
+    open_journal,
+    replay_journal,
+)
 from .scheduler import (
+    Backpressure,
     BlockAllocator,
     ContinuousBatchingScheduler,
     PrefixCache,
@@ -47,12 +65,17 @@ from .scheduler import (
 )
 
 __all__ = [
+    "Backpressure",
     "BlockAllocator",
     "ContinuousBatchingScheduler",
+    "JournalReplay",
     "PrefixCache",
     "Request",
+    "RequestJournal",
     "SchedulerConfig",
     "Sequence",
     "SequenceState",
     "ngram_propose",
+    "open_journal",
+    "replay_journal",
 ]

@@ -29,7 +29,8 @@ import time
 from pathlib import Path
 from typing import List, Optional
 
-from .engine import EngineConfig, ServeEngine
+from .engine import EngineConfig, ServeEngine, install_drain_handler
+from .scheduler import Backpressure
 
 
 def build_toy_inference(hidden: int = 64, layers: int = 2, vocab: int = 128,
@@ -96,41 +97,99 @@ def sample_workload(n_requests: int, rate: float, prompt_len, output_len,
 
 
 def run_bench(engine: ServeEngine, workload, time_scale: float = 1.0,
-              max_wall_s: float = 600.0) -> dict:
+              max_wall_s: float = 600.0, tick_timeout_s: float = 0.0,
+              extra_stats: Optional[dict] = None,
+              carry: Optional[dict] = None) -> dict:
     """Open-loop drive: submit each request when the wall clock crosses
     its arrival offset, tick the engine continuously, drain. Returns the
-    summary stats dict (also emitted as the ``serve-summary`` event)."""
+    summary stats dict (also emitted as the ``serve-summary`` event).
+
+    Resilience rails (docs/SERVING.md "Resilience"): a submission the
+    engine sheds (watermark backpressure) is counted, not retried — the
+    open-loop client models a router that took the hint elsewhere. When
+    the engine flips to ``draining`` (SIGTERM), submission stops,
+    in-flight requests run to completion or their deadlines, and the
+    loop exits cleanly with the unsubmitted tail counted.
+    ``tick_timeout_s > 0`` arms a tick-stall watchdog (the resilience
+    ``StepStallWatchdog``): a tick that stops beating dumps thread
+    stacks, logs a ``serve-stall`` event, and then SIGKILLs the process
+    — a wedged tick (hung device, dead mount) is unrecoverable
+    in-process, and dying loudly is what lets a ``--restarts``
+    supervisor replay the journal instead of hanging forever behind a
+    silent child. ``carry`` folds a crashed predecessor's terminal
+    tallies (completed/timeouts/shed, from the journal replay) into
+    the summary so the FINAL summary — the one the shed/timeout gates
+    read — describes the whole run dir, not just the last process."""
+    import os
+    import signal as _signal
+
     from ..logging import logger
     from ..obs import get_registry, span
+
+    watchdog = None
+    if tick_timeout_s > 0:
+        from ..resilience import StepStallWatchdog
+
+        def _on_stall(tick, elapsed):
+            logger.log_event(
+                "serve-stall", tick=tick, stalled_s=round(elapsed, 3)
+            )
+            os.kill(os.getpid(), _signal.SIGKILL)
+
+        watchdog = StepStallWatchdog(tick_timeout_s, on_stall=_on_stall)
+        watchdog.start()
 
     t0 = time.monotonic()
     start_ticks = engine.tick_index  # warmup ticks stay off the books
     pending = sorted(workload, key=lambda w: w[0])
     idx = 0
-    while idx < len(pending) or engine.scheduler.has_work:
-        now = time.monotonic() - t0
-        if now > max_wall_s:
-            raise RuntimeError(
-                f"bench exceeded --max-wall-s={max_wall_s}: "
-                f"{idx}/{len(pending)} submitted, "
-                f"{len(engine.finished)} finished"
-            )
-        while idx < len(pending) and pending[idx][0] * time_scale <= now:
-            arrival, prompt, olen = pending[idx]
-            engine.submit(prompt, olen, arrival_s=t0 + arrival * time_scale)
-            idx += 1
-        if engine.scheduler.has_work:
-            with span("serve.tick", step=engine.tick_index):
-                engine.tick()
-        elif idx < len(pending):
-            # idle until the next arrival (clamped: stay responsive)
-            wait = pending[idx][0] * time_scale - (time.monotonic() - t0)
-            if wait > 0:
-                time.sleep(min(wait, 0.05))
+    try:
+        while True:
+            now = time.monotonic() - t0
+            if now > max_wall_s:
+                raise RuntimeError(
+                    f"bench exceeded --max-wall-s={max_wall_s}: "
+                    f"{idx}/{len(pending)} submitted, "
+                    f"{len(engine.finished)} finished"
+                )
+            while not engine.draining and idx < len(pending) and \
+                    pending[idx][0] * time_scale <= now:
+                arrival, prompt, olen = pending[idx]
+                res = engine.submit(
+                    prompt, olen, arrival_s=t0 + arrival * time_scale
+                )
+                if isinstance(res, Backpressure) and res.draining:
+                    # SIGTERM raced this submission: it was never
+                    # offered to a live engine — unsubmitted, not shed
+                    break
+                idx += 1
+            if watchdog is not None:
+                # beat every loop pass, idle waits included — the
+                # watchdog watches for a WEDGED tick (the loop stuck
+                # inside engine.tick() stops beating), not for a
+                # healthy bench sleeping between Poisson arrivals
+                watchdog.beat(engine.tick_index)
+            if engine.scheduler.has_work:
+                with span("serve.tick", step=engine.tick_index):
+                    engine.tick()
+            elif engine.draining or idx >= len(pending):
+                break
+            else:
+                # idle until the next arrival (clamped: stay responsive)
+                wait = pending[idx][0] * time_scale - (time.monotonic() - t0)
+                if wait > 0:
+                    time.sleep(min(wait, 0.05))
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
 
     wall_s = time.monotonic() - t0
     seqs = engine.finished
-    ttfts = sorted(s.first_token_s - s.request.arrival_s for s in seqs)
+    completed = [s for s in seqs if s.finish_status == "completed"]
+    ttfts = sorted(
+        s.first_token_s - s.request.arrival_s for s in seqs
+        if s.first_token_s is not None
+    )
     itls: List[float] = []
     for s in seqs:
         itls.extend(b - a for a, b in zip(s.token_stamps, s.token_stamps[1:]))
@@ -152,8 +211,26 @@ def run_bench(engine: ServeEngine, workload, time_scale: float = 1.0,
     # bounded [0, 1] even when preemptions force re-prefills
     hit = engine.scheduler.prefix_hit_tokens
     prefilled = engine.prefilled_tokens
+    # cumulative across supervised relaunches: `carry` holds the
+    # crashed predecessor runs' terminal tallies from the journal
+    # replay, so the final summary — the one the shed/timeout gates
+    # read — describes the WHOLE run dir, not just this process
+    carry = carry or {}
+    c_completed = int(carry.get("completed", 0))
+    c_timeouts = int(carry.get("timeouts", 0))
+    c_shed = int(carry.get("shed", 0))
+    total_shed = engine.shed_count + c_shed
+    total_timeouts = engine.timeout_count + c_timeouts
+    attempts = total_shed + total_timeouts + len(completed) + c_completed
     stats = {
-        "requests": len(seqs),
+        "requests": len(completed) + c_completed,
+        "requests_timeout": total_timeouts,
+        "requests_shed": total_shed,
+        "shed_rate": (
+            round(total_shed / attempts, 4) if attempts else 0.0
+        ),
+        "drained": engine.draining,
+        "unsubmitted": len(pending) - idx,
         "wall_s": round(wall_s, 6),
         "output_tokens": total_tokens,
         "prompt_tokens": prompt_tokens,
@@ -180,9 +257,104 @@ def run_bench(engine: ServeEngine, workload, time_scale: float = 1.0,
             if engine.spec_accept_rate is not None else None
         ),
     }
+    if extra_stats:
+        stats.update(extra_stats)
     logger.log_event("serve-summary", **stats)
     get_registry().flush_step(engine.tick_index)
     return stats
+
+
+def run_supervised(argv: List[str], args) -> int:
+    """``--restarts N``: the serving counterpart of
+    ``resilience.run_with_resume`` — run the bench as a child process
+    and, when it dies (a ``serve.tick`` kill, an OOM, a wedged tick),
+    relaunch it with ``--resume`` so the request journal replays: every
+    incomplete request re-enqueues with its original id and regenerates
+    token-for-token. Exits 0 the moment a child drains cleanly;
+    re-raises the child's exit code once the budget is spent.
+
+    A ``SCALING_TPU_FAULTS`` chaos plan arms the FIRST launch only:
+    hit counters are per-process, so a persistent plan would kill every
+    replay at the same tick and turn a bounded-restart drill into
+    guaranteed budget exhaustion.
+
+    SIGTERM to the supervisor is RELAYED to the running child (whose
+    own drain handler finishes in-flight work and exits 0) and ends
+    the supervision loop — the graceful-drain contract holds in the
+    supervised deployment mode too, and no orphan keeps writing to the
+    run dir. A child that dies mid-drain is not relaunched (mirroring
+    the trainer supervisor's preemption rule)."""
+    import os
+    import signal
+    import subprocess
+
+    from ..logging import logger
+
+    child_argv: List[str] = []
+    skip = False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a == "--restarts":
+            skip = True
+            continue
+        if a.startswith("--restarts="):
+            continue
+        child_argv.append(a)
+    run_dir = Path(args.run_dir)
+    run_dir.mkdir(parents=True, exist_ok=True)
+    # the supervisor's own lifecycle events (serve-restart / give-up)
+    # land in the same run dir the children write to
+    os.environ.setdefault(
+        "SCALING_TPU_EVENTS_PATH", str(run_dir / "events.jsonl")
+    )
+    env = dict(os.environ)
+    state = {"child": None, "draining": False}
+
+    def _relay(signum, frame):
+        state["draining"] = True
+        child = state["child"]
+        if child is not None and child.poll() is None:
+            child.send_signal(signal.SIGTERM)
+
+    prev = signal.getsignal(signal.SIGTERM)
+    signal.signal(signal.SIGTERM, _relay)
+    attempts = 0
+    try:
+        while True:
+            if state["draining"]:
+                # SIGTERM landed while no child was running (e.g.
+                # between a crash and the relaunch): relaunching would
+                # serve the whole remaining workload with the drain
+                # request silently ignored — stop here instead
+                logger.log_event("serve-drain", supervisor=True)
+                return 0
+            cmd = [sys.executable, "-m", "scaling_tpu.serve", "bench",
+                   *child_argv]
+            if attempts > 0 and "--resume" not in child_argv:
+                cmd.append("--resume")
+            state["child"] = subprocess.Popen(cmd, env=env)
+            if state["draining"]:
+                # the signal raced the launch: the handler saw no child
+                state["child"].send_signal(signal.SIGTERM)
+            rc = state["child"].wait()
+            state["child"] = None
+            if rc == 0:
+                return 0
+            if state["draining"]:
+                logger.log_event("serve-drain-failed", rc=rc)
+                return rc if rc > 0 else 1
+            attempts += 1
+            if attempts > args.restarts:
+                logger.log_event(
+                    "serve-give-up", attempts=attempts - 1, rc=rc,
+                )
+                return rc if rc > 0 else 1
+            logger.log_event("serve-restart", attempt=attempts, rc=rc)
+            env.pop("SCALING_TPU_FAULTS", None)
+    finally:
+        signal.signal(signal.SIGTERM, prev)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -241,6 +413,41 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "stats) before the open-loop clock starts, so "
                         "first-tick jit compiles don't distort arrival "
                         "timing")
+    # resilience knobs (docs/SERVING.md "Resilience")
+    parser.add_argument("--deadline-ms", type=float, default=None,
+                        help="per-request total deadline (ms from "
+                        "arrival); expired requests are cancelled at the "
+                        "next tick boundary with status 'timeout'")
+    parser.add_argument("--ttft-deadline-ms", type=float, default=None,
+                        help="per-request first-token deadline (ms)")
+    parser.add_argument("--shed-high-watermark", type=float, default=None,
+                        help="pool-pressure fraction above which new "
+                        "submissions are shed with structured "
+                        "backpressure (hysteresis down to "
+                        "--shed-low-watermark); default: no shedding")
+    parser.add_argument("--shed-low-watermark", type=float, default=None,
+                        help="pool-pressure fraction at which shedding "
+                        "stops again (defaults to the high watermark)")
+    parser.add_argument("--max-waiting", type=int, default=None,
+                        help="hard waiting-queue depth cap; submissions "
+                        "beyond it are shed (default: unbounded)")
+    parser.add_argument("--no-journal", action="store_true",
+                        help="disable the crash-replay request journal "
+                        "(<run-dir>/journal.jsonl)")
+    parser.add_argument("--resume", action="store_true",
+                        help="replay <run-dir>/journal.jsonl first: "
+                        "re-enqueue incomplete requests (same req ids -> "
+                        "token-identical continuations) and skip the "
+                        "workload items already submitted")
+    parser.add_argument("--restarts", type=int, default=0,
+                        help="supervised mode: run the bench as child "
+                        "processes, relaunching with --resume after a "
+                        "crash, up to N restarts (the serving "
+                        "run_with_resume)")
+    parser.add_argument("--tick-timeout-s", type=float, default=0.0,
+                        help="tick-stall watchdog: dump thread stacks + "
+                        "log a serve-stall event when no tick completes "
+                        "for this long (0 = off)")
     # toy model knobs / real checkpoint
     parser.add_argument("--hidden", type=int, default=64)
     parser.add_argument("--layers", type=int, default=2)
@@ -259,7 +466,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--assert-ttft", type=float, metavar="CEIL",
                         help="fail (exit 1) when p99 time-to-first-token "
                         "exceeds CEIL seconds")
+    argv = list(sys.argv[1:] if argv is None else argv)
     args = parser.parse_args(argv)
+    if args.restarts > 0:
+        return run_supervised(argv, args)
     if args.requests < 1:
         parser.error("--requests must be >= 1")
     if args.rate <= 0:
@@ -317,7 +527,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         fused_tick=not args.no_fused_tick,
         enable_prefix_cache=not args.no_prefix_cache,
         spec_k=args.spec_k,
+        default_deadline_ms=args.deadline_ms,
+        default_ttft_deadline_ms=args.ttft_deadline_ms,
+        shed_high_watermark=args.shed_high_watermark,
+        shed_low_watermark=args.shed_low_watermark,
+        max_waiting=args.max_waiting,
     ))
+    # SIGTERM -> graceful drain: stop admitting, finish in-flight, flush
+    # telemetry, exit 0 with a parseable run dir
+    install_drain_handler(engine)
+    journal_path = run_dir / "journal.jsonl"
+    replay = None
+    if not args.no_journal:
+        from .journal import open_journal
+
+        # --resume folds the crashed run's journal first; a fresh run
+        # truncates any stale one from a previous drill in this dir
+        journal, replay = open_journal(journal_path, args.resume)
+        engine.attach_journal(journal)
+    elif args.resume:
+        from .journal import replay_journal
+
+        replay = replay_journal(journal_path)
     workload = sample_workload(
         args.requests, args.rate, tuple(args.prompt_len),
         tuple(args.output_len), vocab, args.seed,
@@ -334,12 +565,71 @@ def main(argv: Optional[List[str]] = None) -> int:
         engine.run_until_done()
         engine.warmup_mode = False
         engine.finished.clear()
-    stats = run_bench(engine, workload, max_wall_s=args.max_wall_s)
+    extra_stats = None
+    carry = None
+    if replay is not None and replay.offered_count:
+        from ..logging import logger
+
+        # crash-replay: re-enqueue every request without a terminal
+        # status under its ORIGINAL id (the sampler keys fold the id,
+        # so the regenerated tokens are the ones the crashed run would
+        # have emitted), then serve the workload tail the crashed run
+        # never reached. force=True: recovery work is never shed.
+        incomplete = replay.incomplete
+        engine._next_req_id = replay.next_req_id
+        for rec in incomplete:
+            engine.submit(
+                rec["prompt"], rec["max_new_tokens"],
+                eos_token_id=rec.get("eos_token_id"),
+                temperature=rec.get("temperature", 0.0),
+                top_k=rec.get("top_k"), top_p=rec.get("top_p"),
+                deadline_ms=rec.get("deadline_ms"),
+                ttft_deadline_ms=rec.get("ttft_deadline_ms"),
+                req_id=int(rec["req"]), force=True,
+            )
+        # skip every workload item the crashed run(s) CONSUMED — both
+        # admitted submissions and overload sheds (a shed offer was
+        # answered with Backpressure; re-offering it would double-serve
+        # the tail behind it)
+        done = replay.offered_count
+        workload = sorted(workload, key=lambda w: w[0])[done:]
+        if workload:
+            base = workload[0][0]  # the tail arrives from t=0 again
+            workload = [(a - base, p, o) for a, p, o in workload]
+        extra_stats = {
+            "resumed": True,
+            "replayed_incomplete": len(incomplete),
+            "replayed_completed": len(replay.completed),
+        }
+        # the crashed run(s)' terminal tallies fold into this run's
+        # summary so the gates judge the whole run dir
+        carry = {
+            "completed": len(replay.completed),
+            "timeouts": replay.timeout_count,
+            "shed": replay.shed_count,
+        }
+        logger.log_event(
+            "serve-resume", incomplete=len(incomplete),
+            completed=len(replay.completed),
+            remaining_workload=len(workload),
+        )
+    stats = run_bench(
+        engine, workload, max_wall_s=args.max_wall_s,
+        tick_timeout_s=args.tick_timeout_s, extra_stats=extra_stats,
+        carry=carry,
+    )
 
     print("== serve bench ==")
     print(f"  requests={stats['requests']} wall={stats['wall_s']:.3f}s "
           f"ticks={stats['ticks']} preemptions={stats['preemptions']} "
           f"prefill_compiles={stats['prefill_compiles']}")
+    if (stats["requests_shed"] or stats["requests_timeout"]
+            or stats["drained"]):
+        print(f"  resilience: shed={stats['requests_shed']} "
+              f"(rate {stats['shed_rate']:.1%}) "
+              f"timeouts={stats['requests_timeout']} "
+              f"drained={stats['drained']} "
+              f"unsubmitted={stats['unsubmitted']}")
     print(f"  hot path: paged_kernel={args.paged_kernel} "
           f"prefill_chunk={args.prefill_chunk or 'off'} "
           f"fused_tick={not args.no_fused_tick} "
@@ -356,8 +646,9 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"(accept rate {stats['spec_accept_rate']:.1%})")
     print(f"  output tokens/s: {stats['tokens_per_s']:.1f} "
           f"({stats['output_tokens']} tokens)")
-    print(f"  ttft: p50={stats['ttft_p50_s']:.4f}s "
-          f"p99={stats['ttft_p99_s']:.4f}s")
+    if stats["ttft_p50_s"] is not None:
+        print(f"  ttft: p50={stats['ttft_p50_s']:.4f}s "
+              f"p99={stats['ttft_p99_s']:.4f}s")
     if stats["itl_p50_s"] is not None:
         print(f"  itl:  p50={stats['itl_p50_s']:.4f}s "
               f"p99={stats['itl_p99_s']:.4f}s")

@@ -57,8 +57,10 @@ from typing import Dict, List, Optional
 
 from .. import obs
 from ..logging import logger
+from ..resilience.faults import get_fault_plan
 from .kvcache import PagedKVPools, build_layer_views, init_pools, write_prompt_kv
 from .scheduler import (
+    Backpressure,
     ContinuousBatchingScheduler,
     Request,
     SchedulerConfig,
@@ -107,6 +109,20 @@ class EngineConfig:
     spec_k: int = 0
     sample_seed: int = 0  # base key for per-request sampling
     flush_interval: int = 50  # registry flush cadence (ticks)
+    # ---- resilience (docs/SERVING.md "Resilience") ----
+    # per-request deadline defaults (milliseconds from arrival; None =
+    # unbounded). A request may carry its own; expiry is checked at
+    # every tick boundary and retires the request with terminal status
+    # 'timeout', recycling its slot and blocks immediately.
+    default_deadline_ms: Optional[float] = None
+    default_ttft_deadline_ms: Optional[float] = None
+    # overload shedding: watermark admission control over pool pressure
+    # (with hysteresis) and waiting-queue depth — above the high
+    # watermark `submit` returns a structured Backpressure instead of
+    # queueing. None disables (the seed behavior).
+    shed_high_watermark: Optional[float] = None
+    shed_low_watermark: Optional[float] = None
+    max_waiting: Optional[int] = None
 
     def __post_init__(self):
         if self.paged_kernel not in ("pallas", "xla"):
@@ -135,6 +151,17 @@ class EngineConfig:
         signature — the recompile key the serve_decode golden pins."""
         return max(self.prefill_chunk or 1, self.spec_k + 1)
 
+    @property
+    def sample_width(self) -> int:
+        """Positions per row the mixed program actually SAMPLES: a
+        decode row reads its last token's sample plus one per draft
+        (``spec_k + 1`` at most), a finishing chunk row exactly one.
+        The program gathers this window of trunk activations per row
+        BEFORE the vocab projection, so the lm_head prices
+        ``sample_width`` positions instead of all ``mixed_width`` — at
+        the default chunk 32 / spec off, a 32x cut in projection work."""
+        return min(self.mixed_width, self.spec_k + 1)
+
     def scheduler_config(self) -> SchedulerConfig:
         return SchedulerConfig(
             num_slots=self.num_slots, block_size=self.block_size,
@@ -144,6 +171,9 @@ class EngineConfig:
             prefill_chunk=self.prefill_chunk,
             prefix_cache=self.enable_prefix_cache,
             spec_k=self.spec_k if self.fused else 0,
+            shed_high_watermark=self.shed_high_watermark,
+            shed_low_watermark=self.shed_low_watermark,
+            max_waiting=self.max_waiting,
         )
 
 
@@ -195,6 +225,18 @@ class ServeEngine:
         self.prefilled_tokens = 0  # prompt tokens actually prefilled
         self.spec_drafted_tokens = 0
         self.spec_accepted_tokens = 0
+        # resilience state (docs/SERVING.md "Resilience"): graceful
+        # drain, overload-shed / deadline-timeout tallies, and the
+        # crash-replay request journal
+        self.draining = False
+        self.shed_count = 0
+        self.timeout_count = 0
+        self.journal = None
+        self._journal_pending: Dict[int, List[int]] = {}
+        # live requests carrying any deadline: the tick-boundary expiry
+        # sweep is skipped entirely while this is zero (the default
+        # no-deadline configuration must not pay O(live) per tick)
+        self._deadline_live = 0
 
     # ------------------------------------------------------------- intake
     def submit(self, prompt: List[int], max_new_tokens: int,
@@ -202,18 +244,97 @@ class ServeEngine:
                eos_token_id: Optional[int] = None,
                temperature: float = 0.0,
                top_k: Optional[int] = None,
-               top_p: Optional[float] = None) -> Sequence:
+               top_p: Optional[float] = None,
+               deadline_ms: Optional[float] = None,
+               ttft_deadline_ms: Optional[float] = None,
+               req_id: Optional[int] = None,
+               force: bool = False):
+        """Admit one request, or reject it with a structured
+        :class:`Backpressure` (draining, or over the shed watermarks) —
+        the signal a fleet router retries elsewhere on. Returns the
+        :class:`Sequence` on admission.
+
+        ``req_id`` pins the request's identity (crash-replay: the
+        sampler keys fold the id, so a journal replay MUST reuse it);
+        by default ids are assigned sequentially. ``deadline_ms`` /
+        ``ttft_deadline_ms`` override the EngineConfig defaults.
+        ``force`` bypasses drain/backpressure rejection — journal
+        replay re-enqueues recovery work, not new load, and must never
+        be shed by the very overload policy the crash left armed."""
+        get_fault_plan().fire("serve.admit")
+        if force:
+            bp = None
+        elif self.draining:
+            bp = Backpressure(
+                reason="draining",
+                pool_pressure=round(self.scheduler.pool_pressure(), 4),
+                waiting=len(self.scheduler.waiting), draining=True,
+            )
+        else:
+            bp = self.scheduler.admission_backpressure()
+        if bp is not None:
+            if not self.warmup_mode:
+                # a draining rejection is shutdown, not overload: it
+                # stays out of the shed rate the overload gates judge
+                # AND out of the journal (the bench does not consume
+                # the workload item — it stays unsubmitted)
+                if not bp.draining:
+                    self.shed_count += 1
+                    self._reg.counter("serve_requests_shed_total").inc()
+                    if self.journal is not None:
+                        self.journal.record_shed(bp.reason)
+                logger.log_event(
+                    "serve-shed", _level="debug", reason=bp.reason,
+                    pool_pressure=bp.pool_pressure, waiting=bp.waiting,
+                )
+            return bp
+        if req_id is None:
+            req_id = self._next_req_id
+        self._next_req_id = max(self._next_req_id, req_id + 1)
         req = Request(
-            req_id=self._next_req_id, prompt=list(prompt),
+            req_id=req_id, prompt=list(prompt),
             max_new_tokens=max_new_tokens,
             arrival_s=time.monotonic() if arrival_s is None else arrival_s,
             eos_token_id=eos_token_id,
             temperature=temperature, top_k=top_k, top_p=top_p,
+            deadline_ms=(
+                deadline_ms if deadline_ms is not None
+                else self.config.default_deadline_ms
+            ),
+            ttft_deadline_ms=(
+                ttft_deadline_ms if ttft_deadline_ms is not None
+                else self.config.default_ttft_deadline_ms
+            ),
         )
-        self._next_req_id += 1
+        seq = self.scheduler.add_request(req)
+        if req.deadline_ms is not None or req.ttft_deadline_ms is not None:
+            self._deadline_live += 1
         if not self.warmup_mode:
             self._reg.counter("serve_requests_admitted_total").inc()
-        return self.scheduler.add_request(req)
+            if self.journal is not None:
+                self.journal.record_submit(req)
+        return seq
+
+    def attach_journal(self, journal) -> None:
+        """Wire the crash-replay request journal (serve/journal.py):
+        every non-warmup submission, tick's emitted tokens, and terminal
+        status is appended so a supervised relaunch can replay."""
+        self.journal = journal
+
+    def begin_drain(self) -> None:
+        """Graceful drain (the serving mirror of the trainer's
+        coordinated preemption): admit nothing new — `submit` returns
+        Backpressure(reason='draining') — while in-flight requests run
+        to completion or their deadlines. The bench's tick loop stops
+        submitting and exits 0 once the scheduler empties."""
+        if self.draining:
+            return
+        self.draining = True
+        logger.log_event(
+            "serve-drain", tick=self.tick_index,
+            running=len(self.scheduler.running),
+            waiting=len(self.scheduler.waiting),
+        )
 
     # --------------------------------------------------- device programs
     def _pool_state(self):
@@ -383,9 +504,19 @@ class ServeEngine:
         EVERY position is sampled with its plain-decode key
         (``_sample_grid``): decode rows read positions ``0..new_len-1``
         for speculative acceptance, a chunk row that completes its
-        prompt reads position ``new_len - 1``. Compiles once per
-        (chunk, k) width signature — pinned in the serve_decode golden."""
+        prompt reads position ``new_len - 1``. Only ``sample_width``
+        (= min(width, spec_k+1)) positions per row are ever read, so the
+        program GATHERS each row's sampling window of trunk activations
+        before the vocab projection (ISSUE 13 satellite): row window =
+        positions ``g0 .. g0 + sample_width - 1`` with
+        ``g0 = clip(new_len - sample_width, 0)`` — covers positions
+        ``0..new_len-1`` for decode rows (new_len ≤ spec_k+1 ⇒ g0 = 0)
+        and position ``new_len - 1`` for chunk rows, while the lm_head
+        prices ``sample_width`` positions instead of all ``width``.
+        Compiles once per (chunk, k) width signature — pinned in the
+        serve_decode golden."""
         jnp = self._jax.numpy
+        sample_width = self.config.sample_width
 
         def mixed(params, state, tables, ctx_lens, tokens, new_lens,
                   temps, topps, topks, reqids, gen0, base_key):
@@ -395,12 +526,17 @@ class ServeEngine:
             batch = self.inf._make_batch(tokens, pos)
             views = self._views_from_state(state, tables, ctx_lens,
                                            new_lens)
+            g0 = jnp.clip(new_lens - sample_width, 0, width - sample_width)
             logits, new_views = self.inf._run_layers(
                 params, batch, views, None,
                 paged_kernel=self.config.paged_kernel,
+                gather_start=g0, gather_width=sample_width,
             )
+            # gathered index j is original position g0 + j: shift the
+            # per-row key-fold base so every sample still draws with the
+            # (request, position) key plain decode would use there
             sampled = self._sample_grid(
-                logits, temps, topps, topks, reqids, gen0, base_key
+                logits, temps, topps, topks, reqids, gen0 + g0, base_key
             )
             return sampled, new_views
 
@@ -639,6 +775,7 @@ class ServeEngine:
             sampled = np.asarray(sampled)
         self._absorb(new_views)
         now = time.monotonic()
+        sw = cfg.sample_width  # sampled grid covers positions g0..g0+sw-1
         for seq, start, n_real in chunk_rows:
             slot = seq.slot
             seq.num_cached = start + n_real
@@ -648,7 +785,9 @@ class ServeEngine:
                 self.prefilled_tokens += n_real
                 self._reg.counter("serve_prefill_tokens_total").inc(n_real)
             if seq.num_cached == seq.prefill_len:
-                tok = int(sampled[slot, n_real - 1])
+                # original position n_real - 1, gathered at index
+                # n_real - 1 - g0 with g0 = max(n_real - sw, 0)
+                tok = int(sampled[slot, min(n_real, sw) - 1])
                 self._tok[slot] = tok
                 self._emit_token(seq, tok, now)
         for seq in t.decodes:
@@ -707,6 +846,13 @@ class ServeEngine:
 
     def _emit_token(self, seq: Sequence, tok: int, now: float) -> None:
         seq.generated.append(tok)
+        if self.journal is not None and not self.warmup_mode:
+            # batched into one journal line per (request, tick) at the
+            # end of tick() — crash-replay regenerates anything a
+            # mid-tick kill loses before the flush
+            self._journal_pending.setdefault(
+                seq.request.req_id, []
+            ).append(tok)
         if seq.first_token_s is None:
             seq.first_token_s = now
             if not self.warmup_mode:
@@ -723,29 +869,84 @@ class ServeEngine:
 
     def _finish(self, seq: Sequence, now: float) -> None:
         self.scheduler.finish(seq)  # row reset rides the freed-slot drain
+        self._retire(seq, now, "completed")
+
+    def _retire(self, seq: Sequence, now: float, status: str) -> None:
+        """Shared terminal bookkeeping for every way a request ends:
+        journal + telemetry + the ``serve-request`` event whose
+        ``status`` field ('completed' | 'timeout') the analyzer and the
+        shed/timeout gates read."""
+        seq.finish_status = status
         seq.finished_s = now
         self.finished.append(seq)
+        req = seq.request
+        if req.deadline_ms is not None or req.ttft_deadline_ms is not None:
+            self._deadline_live -= 1
         if self.warmup_mode:
             return
-        self._reg.counter("serve_requests_completed_total").inc()
+        if self.journal is not None:
+            pending = self._journal_pending.pop(seq.request.req_id, None)
+            if pending:
+                self.journal.record_tokens(seq.request.req_id, pending)
+            self.journal.record_finish(seq.request.req_id, status)
+        if status == "completed":
+            self._reg.counter("serve_requests_completed_total").inc()
+        else:
+            self.timeout_count += 1
+            self._reg.counter("serve_requests_timeout_total").inc()
         itl = [
             b - a for a, b in zip(seq.token_stamps, seq.token_stamps[1:])
         ]
-        logger.log_event(
-            "serve-request", _level="debug",
+        fields = dict(
             req=seq.request.req_id,
+            status=status,
             prompt_tokens=len(seq.request.prompt),
             output_tokens=len(seq.generated),
-            ttft_s=round(seq.first_token_s - seq.request.arrival_s, 6),
             e2e_s=round(now - seq.request.arrival_s, 6),
             itl_mean_s=round(sum(itl) / len(itl), 6) if itl else 0.0,
             preemptions=seq.preemptions,
         )
+        if seq.first_token_s is not None:
+            # a TTFT-deadline timeout never produced a first token — the
+            # analyzer's percentiles must not see a fabricated sample
+            fields["ttft_s"] = round(
+                seq.first_token_s - seq.request.arrival_s, 6
+            )
+        logger.log_event("serve-request", _level="debug", **fields)
+
+    def _expire_deadlines(self, now: float) -> None:
+        """Tick-boundary deadline sweep: cancel every live request past
+        its total deadline, or past its TTFT deadline with no first
+        token yet. The scheduler releases slot + blocks (one reference
+        each — trie-shared prefix blocks stay cached for the next
+        requester), so the capacity is admissible THIS tick."""
+        if not self._deadline_live:
+            return
+        live = list(self.scheduler.running.values()) + list(
+            self.scheduler.waiting
+        )
+        for seq in live:
+            req = seq.request
+            waited_ms = (now - req.arrival_s) * 1000.0
+            expired = (
+                req.deadline_ms is not None and waited_ms > req.deadline_ms
+            ) or (
+                req.ttft_deadline_ms is not None
+                and seq.first_token_s is None
+                and waited_ms > req.ttft_deadline_ms
+            )
+            if not expired:
+                continue
+            self.scheduler.cancel(seq)
+            self._retire(seq, now, "timeout")
 
     def tick(self) -> Tick:
-        """One engine step: draft speculative candidates, schedule,
-        run the fused mixed program (or the legacy separate programs),
-        retire completions."""
+        """One engine step: expire deadlines, draft speculative
+        candidates, schedule, run the fused mixed program (or the
+        legacy separate programs), retire completions, flush the
+        request journal."""
+        get_fault_plan().fire("serve.tick")
+        self._expire_deadlines(time.monotonic())
         if self.config.spec_k > 0:
             with self._span("serve.draft", step=self.tick_index):
                 self.scheduler.propose_drafts()
@@ -779,6 +980,12 @@ class ServeEngine:
             if seq.done and seq.slot is not None:
                 self._finish(seq, now)
         self._reset_rows(self.scheduler.drain_freed_slots())
+        if self.journal is not None and self._journal_pending:
+            # one journal line per (request, tick); completions already
+            # flushed theirs inside _retire (tokens before status)
+            for rid in sorted(self._journal_pending):
+                self.journal.record_tokens(rid, self._journal_pending[rid])
+            self._journal_pending.clear()
         for name, value in self.scheduler.gauges().items():
             self._reg.gauge(name).set(value)
         if self.spec_drafted_tokens:
@@ -820,3 +1027,23 @@ class ServeEngine:
                 )
         self._reg.flush_step(self.tick_index)
         return self.finished
+
+
+def install_drain_handler(engine: ServeEngine) -> None:
+    """SIGTERM -> graceful drain, chaining any previously installed
+    handler exactly like the trainer's ``install_preemption_handler``
+    (launchers and cluster agents keep theirs): the engine flips to
+    draining — no new admissions, in-flight requests finish or hit
+    their deadlines — and the bench loop exits 0 with a complete,
+    parseable run dir. The serving mirror of the trainer's
+    coordinated-preemption contract (docs/RESILIENCE.md)."""
+    import signal
+
+    prev = signal.getsignal(signal.SIGTERM)
+
+    def handler(signum, frame):
+        engine.begin_drain()
+        if callable(prev):  # SIG_DFL/SIG_IGN are enum ints, skipped
+            prev(signum, frame)
+
+    signal.signal(signal.SIGTERM, handler)

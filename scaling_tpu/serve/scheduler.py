@@ -38,6 +38,8 @@ import enum
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
+from ..resilience.faults import get_fault_plan
+
 # block 0 is the TRASH block: never allocated, it absorbs the jitted
 # decode step's writes from inactive slots and padding (nn/attention.py
 # PagedKVCacheView). Allocators start handing out ids at 1.
@@ -68,6 +70,13 @@ class Request:
     temperature: float = 0.0
     top_k: Optional[int] = None
     top_p: Optional[float] = None
+    # request deadlines (milliseconds from arrival; None = unbounded):
+    # ``ttft_deadline_ms`` bounds the wait for the FIRST token,
+    # ``deadline_ms`` the whole request. An expired request is cancelled
+    # at the next tick boundary with terminal status 'timeout' — its
+    # slot and blocks recycle immediately (docs/SERVING.md "Resilience")
+    deadline_ms: Optional[float] = None
+    ttft_deadline_ms: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -94,6 +103,9 @@ class Sequence:
     first_token_s: Optional[float] = None
     finished_s: Optional[float] = None
     token_stamps: List[float] = dataclasses.field(default_factory=list)
+    # terminal status: 'completed' | 'timeout' (set at retirement; rides
+    # the serve-request event next to the preemption count)
+    finish_status: str = "completed"
 
     @property
     def resume_prompt(self) -> List[int]:
@@ -410,6 +422,16 @@ class SchedulerConfig:
     # decoding row per tick (0 = off); requires chunked prefill — the
     # drafts are scored through the mixed program's chunk-width rows
     spec_k: int = 0
+    # overload shedding (docs/SERVING.md "Resilience"): above the HIGH
+    # pool-pressure watermark new submissions are rejected with a
+    # structured Backpressure instead of queueing unboundedly, and keep
+    # being rejected until pressure falls back to the LOW watermark
+    # (hysteresis — admission must not flap at the boundary). None
+    # disables the pressure watermark. ``max_waiting`` is a hard cap on
+    # waiting-queue depth (no hysteresis; None = unbounded).
+    shed_high_watermark: Optional[float] = None
+    shed_low_watermark: Optional[float] = None
+    max_waiting: Optional[int] = None
 
     def __post_init__(self):
         cap = self.max_blocks_per_seq * self.block_size
@@ -427,6 +449,40 @@ class SchedulerConfig:
                 "speculative decoding (spec_k > 0) needs chunked prefill: "
                 "drafts are scored through the mixed program's s>1 rows"
             )
+        high, low = self.shed_high_watermark, self.shed_low_watermark
+        if high is not None and not 0.0 < high <= 1.0:
+            raise ValueError(
+                f"shed_high_watermark must be in (0, 1], got {high}"
+            )
+        if low is not None:
+            if high is None:
+                raise ValueError(
+                    "shed_low_watermark needs shed_high_watermark"
+                )
+            if not 0.0 <= low <= high:
+                raise ValueError(
+                    f"shed_low_watermark must be in [0, high={high}], "
+                    f"got {low}"
+                )
+        if self.max_waiting is not None and self.max_waiting < 1:
+            raise ValueError(
+                f"max_waiting must be >= 1 (or None), got {self.max_waiting}"
+            )
+
+
+@dataclasses.dataclass
+class Backpressure:
+    """Structured admission rejection — the overload signal a fleet
+    router consumes (retry elsewhere / retry later) instead of a request
+    silently queueing unboundedly. ``reason`` is one of
+    ``pool-pressure`` (above the high watermark, hysteresis engaged),
+    ``queue-depth`` (waiting queue at ``max_waiting``), or ``draining``
+    (the engine is shutting down gracefully and admits nothing new)."""
+
+    reason: str
+    pool_pressure: float
+    waiting: int
+    draining: bool = False
 
 
 @dataclasses.dataclass
@@ -461,6 +517,10 @@ class ContinuousBatchingScheduler:
         self._free_slots: Deque[int] = deque(range(config.num_slots))
         self.preemption_count = 0
         self.prefix_hit_tokens = 0  # prefill tokens skipped via the trie
+        # overload shedding hysteresis: True from the first admission
+        # rejected above the high watermark until pressure falls to the
+        # low watermark (admission must not flap at the boundary)
+        self._shedding = False
         # slots whose sequence left (finish/preempt) since the engine
         # last synced: their decode-batch rows must be zeroed before the
         # next device step, or stale block tables would write into blocks
@@ -511,10 +571,71 @@ class ContinuousBatchingScheduler:
         )
         return self.allocator.free_blocks + extra
 
+    def pool_pressure(self) -> float:
+        """Fraction of grantable pool capacity in use, in [0, 1] — the
+        overload gauge the shed watermarks compare against (and the
+        ``serve_pool_pressure`` gauge on the obs rails)."""
+        usable = self.config.num_blocks - 1  # minus the trash block
+        if usable <= 0:
+            return 1.0
+        return (usable - self.available_blocks()) / usable
+
+    def admission_backpressure(self) -> Optional[Backpressure]:
+        """The watermark admission decision for ONE new submission:
+        None admits; a :class:`Backpressure` rejects (the caller — the
+        engine's ``submit`` — returns it to the client/router instead
+        of queueing). Pool pressure sheds with hysteresis: above
+        ``shed_high_watermark`` shedding starts and it only stops once
+        pressure falls to ``shed_low_watermark``; queue depth is a hard
+        cap with no hysteresis (depth moves by whole requests, not
+        fractions of a block)."""
+        cfg = self.config
+        pressure = self.pool_pressure()
+        if cfg.max_waiting is not None and len(self.waiting) >= cfg.max_waiting:
+            return Backpressure(
+                reason="queue-depth", pool_pressure=round(pressure, 4),
+                waiting=len(self.waiting),
+            )
+        high = cfg.shed_high_watermark
+        if high is None:
+            return None
+        low = cfg.shed_low_watermark if cfg.shed_low_watermark is not None \
+            else high
+        if self._shedding and pressure <= low:
+            self._shedding = False
+        elif not self._shedding and pressure >= high:
+            self._shedding = True
+        if self._shedding:
+            return Backpressure(
+                reason="pool-pressure", pool_pressure=round(pressure, 4),
+                waiting=len(self.waiting),
+            )
+        return None
+
+    def cancel(self, seq: Sequence) -> None:
+        """Retire a live sequence before completion (deadline timeout):
+        a RUNNING sequence releases its slot and drops one reference per
+        block — private blocks return to the free list, trie-cached
+        blocks stay resident as LRU-evictable prefix nodes (the cache
+        outlives its requester by design); a WAITING sequence just
+        leaves the queue. Either way the capacity is admissible in the
+        very next tick."""
+        if seq.state is SequenceState.RUNNING:
+            self._evict(seq)
+        elif seq.state is SequenceState.WAITING:
+            self.waiting.remove(seq)
+        else:
+            raise ValueError(
+                f"cancel on request {seq.request.req_id} in state "
+                f"{seq.state} — only live sequences can be cancelled"
+            )
+        seq.state = SequenceState.FINISHED
+
     def _take(self, n: int) -> List[int]:
         """Allocate ``n`` blocks, evicting LRU refcount-free prefix
         blocks first when the free list is short (the cache yields to
         live sequences, never the reverse)."""
+        get_fault_plan().fire("serve.pool")
         short = n - self.allocator.free_blocks
         if short > 0 and self.prefix_cache is not None:
             self.prefix_cache.evict(short)
@@ -853,6 +974,9 @@ class ContinuousBatchingScheduler:
             "serve_free_blocks": float(free),
             "serve_pool_utilization": (usable - free) / usable if usable
             else 0.0,
+            # the admission watermarks' input — exported so a router (or
+            # a post-mortem) sees the same number the shed decision saw
+            "serve_pool_pressure": self.pool_pressure(),
         }
         if self.prefix_cache is not None:
             out["serve_prefix_cached_blocks"] = float(

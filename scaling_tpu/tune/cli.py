@@ -211,7 +211,14 @@ def main(argv=None) -> int:
     parser.add_argument("--mbs", type=int, default=8,
                         help="micro batch size (bench self-tunes this "
                         "per chip; the tuner searches layouts at a fixed "
-                        "one)")
+                        "one unless --mbs-ladder widens the search)")
+    parser.add_argument("--mbs-ladder", metavar="LIST",
+                        help="comma list of additional micro-batch sizes "
+                        "to enumerate and score alongside --mbs (global "
+                        "batch fixed, so gas scales inversely: smaller "
+                        "mbs buys thinner pipeline bubbles and less "
+                        "activation memory). Ignored under golden "
+                        "pinning so the pinned ranking stays single-mbs")
     parser.add_argument("--generation", default="tpu_v5e",
                         choices=["tpu_v4", "tpu_v5e", "tpu_v5p", "tpu_v6e"])
     parser.add_argument("--ici-domain", type=int, default=None,
@@ -283,9 +290,19 @@ def main(argv=None) -> int:
         Calibration.default() if pinning
         else resolve_calibration(args.run_dir, args.obs_root)
     )
+    ladder = None
+    if args.mbs_ladder and not pinning:
+        try:
+            ladder = [int(x) for x in args.mbs_ladder.split(",") if x.strip()]
+        except ValueError:
+            ladder = None
+        if not ladder or any(m < 1 for m in ladder):
+            print(f"error: bad --mbs-ladder {args.mbs_ladder!r} "
+                  "(want a comma list of ints >= 1)", file=sys.stderr)
+            return 2
     layouts = enumerate_layouts(
         args.devices, model, global_batch_size=args.global_batch,
-        micro_batch_size=args.mbs,
+        micro_batch_size=args.mbs, mbs_ladder=ladder,
     )
     if not layouts:
         print("error: no valid layouts for this model/device count",

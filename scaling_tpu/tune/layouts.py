@@ -128,6 +128,11 @@ class Layout:
     zero_stage: int = 1
     vpp: int = 1
     token_slices: int = 1
+    # set when this layout came from an mbs-ladder enumeration (several
+    # candidates differ ONLY in micro_batch_size): the label then names
+    # the mbs so ranked rows stay distinguishable. Off by default so
+    # single-mbs labels — and the pinned tune golden — are unchanged.
+    mbs_in_label: bool = False
 
     @property
     def world(self) -> int:
@@ -153,6 +158,8 @@ class Layout:
         parts.append(f"mp{self.mp}")
         if self.sp:
             parts.append("sp")
+        if self.mbs_in_label:
+            parts.append(f"mbs{self.micro_batch_size}")
         parts.append(f"z{self.zero_stage}")
         if self.vpp > 1:
             parts.append(f"v{self.vpp}")
@@ -227,36 +234,52 @@ def enumerate_layouts(
     micro_batch_size: int,
     virtual_options: Sequence[int] = (2,),
     slice_options: Sequence[int] = (2,),
+    mbs_ladder: Optional[Sequence[int]] = None,
 ) -> List[Layout]:
     """Every valid layout of ``model`` on ``n_devices`` at the given
     batch hierarchy. Candidates that any production rule rejects
     (TopologyConfig validation or layer-stack divisibility) are dropped;
-    the result is deterministic and sorted by ``key()``."""
+    the result is deterministic and sorted by ``key()`` (then mbs).
+
+    ``mbs_ladder`` additionally enumerates each listed micro-batch size
+    alongside ``micro_batch_size`` (duplicates collapse): the global
+    batch is fixed, so a smaller mbs means proportionally more
+    accumulation steps — cheaper activation memory and a thinner
+    pipeline bubble (more micro-batches fill the schedule), priced by
+    the same cost model. Ladder candidates carry the mbs in their label
+    so the ranked report stays readable; without a ladder labels (and
+    the pinned golden) are byte-identical to before."""
+    mbs_options = sorted({int(micro_batch_size), *(mbs_ladder or ())})
+    ladder = len(mbs_options) > 1
     out: List[Layout] = []
-    for pp, dp, cp, mp in _factorizations(n_devices):
-        if global_batch_size % (micro_batch_size * dp):
-            continue
-        gas = global_batch_size // (micro_batch_size * dp)
-        sp = mp > 1 and cp == 1 and not model.moe
-        cp_variants = ["ring", "ulysses"] if cp > 1 else ["ring"]
-        zero_stages = [1] + ([3] if dp > 1 else [])
-        schedules: List[Tuple[int, int]] = [(1, 1)]
-        if pp > 1:
-            schedules += [(v, 1) for v in virtual_options if v > 1]
-            schedules += [(1, s) for s in slice_options if s > 1]
-        for cpv in cp_variants:
-            for zero in zero_stages:
-                for vpp, slices in schedules:
-                    if not _model_fits(model, pp, dp, cp, mp, cpv, vpp, slices):
-                        continue
-                    layout = Layout(
-                        pp=pp, dp=dp, cp=cp, mp=mp,
-                        micro_batch_size=micro_batch_size,
-                        gradient_accumulation_steps=gas, sp=sp,
-                        cp_variant=cpv, zero_stage=zero, vpp=vpp,
-                        token_slices=slices,
-                    )
-                    if layout.validate() is None:
-                        out.append(layout)
-    out.sort(key=lambda l: l.key())
+    for mbs in mbs_options:
+        if mbs < 1:
+            raise ValueError(f"micro batch sizes must be >= 1, got {mbs}")
+        for pp, dp, cp, mp in _factorizations(n_devices):
+            if global_batch_size % (mbs * dp):
+                continue
+            gas = global_batch_size // (mbs * dp)
+            sp = mp > 1 and cp == 1 and not model.moe
+            cp_variants = ["ring", "ulysses"] if cp > 1 else ["ring"]
+            zero_stages = [1] + ([3] if dp > 1 else [])
+            schedules: List[Tuple[int, int]] = [(1, 1)]
+            if pp > 1:
+                schedules += [(v, 1) for v in virtual_options if v > 1]
+                schedules += [(1, s) for s in slice_options if s > 1]
+            for cpv in cp_variants:
+                for zero in zero_stages:
+                    for vpp, slices in schedules:
+                        if not _model_fits(model, pp, dp, cp, mp, cpv,
+                                           vpp, slices):
+                            continue
+                        layout = Layout(
+                            pp=pp, dp=dp, cp=cp, mp=mp,
+                            micro_batch_size=mbs,
+                            gradient_accumulation_steps=gas, sp=sp,
+                            cp_variant=cpv, zero_stage=zero, vpp=vpp,
+                            token_slices=slices, mbs_in_label=ladder,
+                        )
+                        if layout.validate() is None:
+                            out.append(layout)
+    out.sort(key=lambda l: l.key() + (l.micro_batch_size,))
     return out

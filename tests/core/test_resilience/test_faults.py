@@ -97,12 +97,48 @@ def test_host_selector_scopes_rule_to_one_host(monkeypatch):
 
 def test_host_selector_composes_with_windows():
     plan = FaultPlan("host.kill=kill@5@host=1,ckpt.write=corrupt@3x2@host=0")
-    r1 = plan._rules["host.kill"]
+    (r1,) = plan._rules["host.kill"]
     assert (r1.action, r1.first, r1.count, r1.host) == ("kill", 5, 1, 1)
-    r2 = plan._rules["ckpt.write"]
+    (r2,) = plan._rules["ckpt.write"]
     assert (r2.action, r2.first, r2.count, r2.host) == ("corrupt", 3, 2, 0)
     # hang parses as an executed action
-    assert FaultPlan("host.hang=hang@4")._rules["host.hang"].action == "hang"
+    assert FaultPlan("host.hang=hang@4")._rules["host.hang"][0].action == "hang"
+
+
+def test_multiple_rules_per_point_fire_per_host(monkeypatch):
+    """The chaos-drill grammar: the SAME point armed twice with different
+    host scopes — each host sees only its own rule, and both rules share
+    the point's single hit counter."""
+    spec = "data.read=fail@2@host=0,data.read=fail@3@host=1"
+    monkeypatch.setenv("SCALING_TPU_HOST_ID", "0")
+    plan = FaultPlan(spec)
+    assert plan.fire("data.read") is None
+    with pytest.raises(InjectedFault):
+        plan.fire("data.read")  # host 0's rule at hit 2
+    assert plan.fire("data.read") is None  # host 1's hit-3 rule: wrong host
+
+    monkeypatch.setenv("SCALING_TPU_HOST_ID", "1")
+    plan = FaultPlan(spec)
+    assert plan.fire("data.read") is None
+    assert plan.fire("data.read") is None  # host 0's rule: wrong host
+    with pytest.raises(InjectedFault):
+        plan.fire("data.read")  # host 1's rule at hit 3
+
+
+def test_epoch_selector_scopes_rule_to_one_supervisor_epoch(monkeypatch):
+    """@epoch=E fires only when SCALING_TPU_COORD_EPOCH matches at fire
+    time — the 3→2→1 downsize drill kills a host only in the epochs
+    where its world still contains it."""
+    monkeypatch.setenv("SCALING_TPU_HOST_ID", "1")
+    spec = "host.kill=fail@1x*@host=1@epoch=2"
+    monkeypatch.setenv("SCALING_TPU_COORD_EPOCH", "0")
+    plan = FaultPlan(spec)
+    assert plan.fire("host.kill") is None
+    monkeypatch.setenv("SCALING_TPU_COORD_EPOCH", "2")
+    with pytest.raises(InjectedFault):
+        plan.fire("host.kill")
+    monkeypatch.delenv("SCALING_TPU_COORD_EPOCH")
+    assert plan.fire("host.kill") is None  # unsupervised: scoped rule off
 
 
 # -------------------------------------------------------------- retry_io

@@ -412,6 +412,106 @@ def test_downsize_two_hosts_to_one_continues_loss_exact(baseline):
 
 
 @pytest.mark.slow
+def test_chaos_downsize_drill_three_to_two_to_one_loss_exact(baseline):
+    """Chaos downsize drill (ISSUE 13 satellite, ROADMAP elastic
+    follow-on): a 3-host pod downsize-LOOPS to 1 under continuous
+    ``SCALING_TPU_FAULTS`` injection. Host 2 dies at its 5th loop entry
+    in every epoch (its capacity never returns); after ``downsize_after
+    = 2`` consecutive losses the supervisor drops it and relaunches at
+    world 2 — where host 1 starts dying (``@epoch=`` scoped rules: the
+    same ``host.kill`` point armed per-epoch), forcing the second
+    downsize. A transient ``data.read`` fault also fires in every
+    worker process throughout (absorbed by the bounded-retry layer).
+    The surviving host completes all 12 steps LOSS-EXACT vs a golden
+    12-step run — capacity loss degraded service, never correctness
+    (ATP, arxiv 2301.08658) — and the run dir parses through ``obs
+    report`` with the full 3->2->1 transition timeline and
+    passes/fails ``--assert-max-downsizes`` at 2/1.
+
+    12 steps (not the module baseline's 8) so the world-2 epochs live
+    long enough to COMMIT a checkpoint of their own: the final epoch
+    then restores a world-2 save onto the 1-host mesh — both downsizes
+    exercise reshard-on-restore, not just the first.
+
+    Kill-window arithmetic (save_interval 3): epoch 0 kills host 2 at
+    entry 5 (latest=3), epoch 1 resumes from 3 and re-kills at entry 5
+    = step 8 (latest=6) -> downsize. Epoch 2 (world 2) resumes from 6
+    (reshard 3->2), saves step 9, host 1 dies at entry 4 (latest=9);
+    epoch 3 resumes from 9 and dies at entry 2 -> downsize. Epoch 4
+    (world 1) resumes from 9 (reshard 2->1) and completes.
+
+    Slow tier: six supervised epochs incl. the golden run at ~12s cold
+    compile each."""
+    tmp, _ = baseline
+    p0, golddir = run_supervised(
+        tmp, "chaos3_gold", num_hosts=1, steps=12,
+    )
+    assert p0.returncode == 0, p0.stdout[-3000:] + p0.stderr[-3000:]
+    gold = read_losses(golddir, 0)
+    assert sorted(gold) == list(range(1, 13))
+
+    p, workdir = run_supervised(
+        tmp, "chaos3", num_hosts=3, steps=12,
+        faults=(
+            "host.kill=kill@5x*@host=2,"
+            "host.kill=kill@4x*@host=1@epoch=2,"
+            "host.kill=kill@2x*@host=1@epoch=3,"
+            "data.read=fail@2"
+        ),
+        restart_budget=2, downsize_after=2, timeout=420,
+    )
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-3000:]
+    # the last survivor finished the run in the twice-downsized epoch
+    result = read_result(workdir, 0)
+    assert result["iterations"] == 12
+    assert result["epoch"] == 4  # 0,1 @ world 3; 2,3 @ world 2; 4 @ world 1
+    assert result["resumed_from"] == 9  # a checkpoint the WORLD-2 pod wrote
+    losses = read_losses(workdir, 0)
+    assert sorted(losses) == list(range(1, 13))
+    np.testing.assert_array_equal(
+        np.asarray([losses[s] for s in range(1, 13)]),
+        np.asarray([gold[s] for s in range(1, 13)]),
+    )
+    ckpt = workdir / "host0" / "ckpt"
+    assert (ckpt / "latest").read_text() == "global_step12"
+    assert verify_checkpoint(ckpt / "global_step12") == []
+
+    events = read_events(tmp, "chaos3")
+    downs = [e for e in events if e["event"] == "downsize"]
+    assert [(e["old_world"], e["new_world"]) for e in downs] == [
+        (3, 2), (2, 1),
+    ]
+    assert downs[0]["removed_hosts"] == [2]
+    assert downs[1]["removed_hosts"] == [1]
+    # each downsized epoch's restore crossed mesh shapes
+    reshards = [e for e in events if e["event"] == "ckpt-reshard"]
+    assert [(e["saved_hosts"], e["restoring_hosts"]) for e in reshards][-1] \
+        == (2, 1)
+    assert any(
+        (e["saved_hosts"], e["restoring_hosts"]) == (3, 2) for e in reshards
+    )
+    assert any(e["event"] == "epoch-clean-exit" for e in events)
+
+    # the full transition timeline through the real analyzer + gates
+    from scaling_tpu.obs.cli import main as obs_main
+    from scaling_tpu.obs.report import load_run_dir, render_report
+
+    telemetry = tmp / "chaos3_telemetry"
+    data = load_run_dir(telemetry)
+    assert data.bad_lines == 0, f"unparseable telemetry: {data.bad_lines}"
+    report = render_report(data, telemetry)
+    assert "downsizes=2" in report
+    assert "world-size transitions:" in report
+    assert "3->2" in report and "2->1" in report
+    assert obs_main(
+        ["report", str(telemetry), "--assert-max-downsizes", "2"]
+    ) == 0
+    assert obs_main(
+        ["report", str(telemetry), "--assert-max-downsizes", "1"]
+    ) == 1
+
+
+@pytest.mark.slow
 def test_hung_host_detected_by_stale_heartbeat_and_relaunched(baseline):
     """host.hang wedges host 0's loop without exiting — only the missing
     heartbeats give it away. The supervisor must declare it hung, SIGKILL

@@ -190,6 +190,222 @@ def test_spec_accept_rate_gate_fails_on_missing_data(bench_run, capsys):
     assert "no speculative-decoding telemetry" in out
 
 
+CHAOS_ARGS = [
+    "--requests", "6", "--rate", "50", "--seed", "3",
+    "--prompt-len", "4", "10", "--output-len", "3", "5",
+    "--num-slots", "4", "--block-size", "4", "--num-blocks", "64",
+    "--max-blocks-per-seq", "8", "--token-budget", "64",
+    "--prefill-chunk", "4",
+    "--hidden", "32", "--layers", "2", "--vocab", "64", "--heads", "4",
+]
+
+
+def _bench_cmd_env(run_dir, faults=None, extra=(), args=CHAOS_ARGS):
+    cmd = [
+        sys.executable, "-m", "scaling_tpu.serve", "bench",
+        *args, "--run-dir", str(run_dir),
+        "--json", str(run_dir / "stats.json"), *extra,
+    ]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "SCALING_TPU_TEST_CACHE": "off"}
+    env.pop("SCALING_TPU_EVENTS_PATH", None)
+    env.pop("SCALING_TPU_FAULTS", None)
+    if faults:
+        env["SCALING_TPU_FAULTS"] = faults
+    return cmd, env
+
+
+def _run_chaos_bench(run_dir, faults=None, extra=()):
+    cmd, env = _bench_cmd_env(run_dir, faults=faults, extra=extra)
+    return subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=420)
+
+
+# a slow open-loop tail (20 requests at 1/s) keeps the bench busy long
+# enough for an external SIGTERM to land demonstrably mid-workload
+DRAIN_ARGS = [
+    "--requests", "20", "--rate", "1", *CHAOS_ARGS[4:],
+]
+
+
+def _sigterm_mid_bench(run_dir, extra=()):
+    """Start the bench, wait for its first served request, SIGTERM it,
+    and return the exit code (killing the tree on timeout)."""
+    import signal as _signal
+    import time as _time
+
+    cmd, env = _bench_cmd_env(run_dir, extra=extra, args=DRAIN_ARGS)
+    p = subprocess.Popen(cmd, cwd=REPO, env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = _time.monotonic() + 360
+        events = run_dir / "events.jsonl"
+        while _time.monotonic() < deadline:
+            if events.is_file() and "serve-request" in events.read_text():
+                break
+            _time.sleep(0.2)
+        else:
+            pytest.fail("bench never served a request")
+        p.send_signal(_signal.SIGTERM)
+        return p.wait(timeout=120)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait(timeout=30)
+
+
+@pytest.fixture(scope="module")
+def chaos_runs(tmp_path_factory):
+    """The ISSUE 13 acceptance pair: a fault-free reference run, and a
+    chaos run killed mid-tick (``serve.tick=kill@6`` — SIGKILL, no
+    cleanup) under the supervised relaunch wrapper (``--restarts 2``),
+    which replays the request journal and serves the rest."""
+    tmp = tmp_path_factory.mktemp("serve_chaos")
+    clean_dir = tmp / "clean"
+    clean_dir.mkdir()
+    p_clean = _run_chaos_bench(clean_dir)
+    assert p_clean.returncode == 0, \
+        p_clean.stdout[-3000:] + p_clean.stderr[-3000:]
+    chaos_dir = tmp / "chaos"
+    chaos_dir.mkdir()
+    p_chaos = _run_chaos_bench(
+        chaos_dir, faults="serve.tick=kill@6", extra=("--restarts", "2"),
+    )
+    return clean_dir, chaos_dir, p_chaos
+
+
+def test_chaos_bench_supervised_restart_is_token_exact(chaos_runs):
+    """Kill-mid-tick via the ``serve.tick`` fault point, supervised
+    restart, journal replay: the wrapper exits 0, at least one restart
+    actually happened (the crashed child really died mid-run), and
+    EVERY request's final output is token-for-token identical to the
+    fault-free run — the deadline/shed-free chaos arm loses no request
+    and corrupts no output."""
+    from scaling_tpu.serve.journal import replay_journal
+
+    clean_dir, chaos_dir, p_chaos = chaos_runs
+    assert p_chaos.returncode == 0, \
+        p_chaos.stdout[-3000:] + p_chaos.stderr[-3000:]
+    events = [
+        json.loads(l)
+        for l in (chaos_dir / "events.jsonl").read_text().splitlines()
+    ]
+    restarts = [e for e in events if e["event"] == "serve-restart"]
+    resumes = [e for e in events if e["event"] == "serve-resume"]
+    assert restarts and resumes, events
+    clean = replay_journal(clean_dir / "journal.jsonl")
+    chaos = replay_journal(chaos_dir / "journal.jsonl")
+    assert len(clean.completed) == 6
+    assert chaos.completed == clean.completed  # token-for-token
+
+
+def test_chaos_run_dir_passes_shed_and_timeout_gates(chaos_runs, capsys):
+    """The resumed run dir parses through the real analyzer: restart
+    line rendered, shed/timeout gates PASS at 0 (nothing shed, nothing
+    timed out) and fail at impossible ceilings via missing-data-fails
+    elsewhere."""
+    from scaling_tpu.obs.cli import main
+
+    _, chaos_dir, _ = chaos_runs
+    rc = main(["report", str(chaos_dir),
+               "--assert-max-shed-rate", "0",
+               "--assert-max-serve-timeouts", "0"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "resilience: shed=0" in out
+    assert "restarts=1" in out
+    assert "PASS" in out
+
+
+def test_shed_timeout_gates_fail_on_missing_data(tmp_path, capsys):
+    """Missing data FAILS a requested gate: a run dir whose
+    serve-summary predates the resilience fields (or has none at all)
+    must not pass by silence."""
+    from scaling_tpu.obs.cli import main
+
+    (tmp_path / "events.jsonl").write_text(json.dumps({
+        "event": "serve-summary", "ts": 1.0, "requests": 2,
+        "tokens_per_s": 5.0, "output_tokens": 10, "wall_s": 2.0,
+    }) + "\n")
+    rc = main(["report", str(tmp_path),
+               "--assert-max-shed-rate", "1.0",
+               "--assert-max-serve-timeouts", "100"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "FAIL assert-max-shed-rate: no shed telemetry" in out
+    assert "FAIL assert-max-serve-timeouts: no timeout telemetry" in out
+
+
+def test_wedged_tick_watchdog_kills_and_supervisor_recovers(tmp_path):
+    """``serve.tick=hang`` wedges the engine mid-run; the tick-stall
+    watchdog must dump stacks, log serve-stall, and SIGKILL the child
+    so the ``--restarts`` supervisor actually recovers (relaunch +
+    journal replay) instead of hanging forever behind a silent
+    child."""
+    run_dir = tmp_path / "hang"
+    run_dir.mkdir()
+    p = _run_chaos_bench(
+        run_dir, faults="serve.tick=hang@4",
+        extra=("--restarts", "1", "--tick-timeout-s", "2"),
+    )
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-3000:]
+    events = [
+        json.loads(l)
+        for l in (run_dir / "events.jsonl").read_text().splitlines()
+    ]
+    assert any(e["event"] == "serve-stall" for e in events)
+    restarts = [e for e in events if e["event"] == "serve-restart"]
+    assert restarts and restarts[0]["rc"] == -9  # the watchdog's SIGKILL
+    from scaling_tpu.serve.journal import replay_journal
+
+    final = replay_journal(run_dir / "journal.jsonl")
+    assert len(final.completed) == 6 and not final.incomplete
+
+
+def test_sigterm_to_supervisor_relays_drain_to_child(tmp_path):
+    """The graceful-drain contract in SUPERVISED mode: SIGTERM to the
+    --restarts supervisor is relayed to the running child, the child
+    drains and exits 0, the supervisor exits 0, and no orphan keeps
+    writing to the run dir."""
+    run_dir = tmp_path / "supdrain"
+    run_dir.mkdir()
+    assert _sigterm_mid_bench(run_dir, extra=("--restarts", "2")) == 0
+    stats = json.loads((run_dir / "stats.json").read_text())
+    assert stats["drained"] is True and stats["unsubmitted"] > 0
+    evs = [
+        json.loads(l)
+        for l in (run_dir / "events.jsonl").read_text().splitlines()
+    ]
+    assert any(e["event"] == "serve-drain" for e in evs)
+    assert not any(e["event"] == "serve-restart" for e in evs)
+
+
+def test_sigterm_mid_bench_drains_and_exits_zero(tmp_path):
+    """The graceful-drain acceptance: SIGTERM mid-bench -> no new
+    admissions, in-flight requests finish, telemetry flushes, exit 0 —
+    and the run dir passes the shed/timeout gates with the drain noted
+    in the serving section."""
+    run_dir = tmp_path / "drain"
+    run_dir.mkdir()
+    assert _sigterm_mid_bench(run_dir) == 0
+    stats = json.loads((run_dir / "stats.json").read_text())
+    assert stats["drained"] is True
+    assert stats["unsubmitted"] > 0  # it really was mid-bench
+    assert stats["requests_timeout"] == 0
+    evs = [
+        json.loads(l)
+        for l in (run_dir / "events.jsonl").read_text().splitlines()
+    ]
+    assert any(e["event"] == "serve-drain" for e in evs)
+    assert any(e["event"] == "serve-summary" for e in evs)
+
+    from scaling_tpu.obs.cli import main
+
+    assert main(["report", str(run_dir),
+                 "--assert-max-shed-rate", "0",
+                 "--assert-max-serve-timeouts", "0"]) == 0
+
+
 def test_bench_registry_metrics_flushed(bench_run):
     """The engine's counters/gauges land in the metrics JSONL through
     obs.get_registry() — the same registry training flushes through."""

@@ -1,0 +1,291 @@
+"""Serving-resilience policy units (ISSUE 13) — the jax-free half.
+
+Watermark admission hysteresis, deadline-cancellation bookkeeping
+(slots / blocks / prefix-cache refcounts), journal record/replay
+semantics, and the serve.* fault points, all at the scheduler/journal
+layer — no backend, no engine. The engine-level behavior (expiry at
+tick boundaries, drain, token-exact replay) rides
+test_serve_resilience.py on the toy CPU engine, and the full
+crash-SIGKILL/SIGTERM story rides the bench e2e.
+"""
+
+import json
+
+import pytest
+
+from scaling_tpu.resilience.faults import (
+    FaultPlan,
+    InjectedFault,
+    set_fault_plan,
+)
+from scaling_tpu.serve.journal import RequestJournal, replay_journal
+from scaling_tpu.serve.scheduler import (
+    Backpressure,
+    ContinuousBatchingScheduler,
+    Request,
+    SchedulerConfig,
+    SequenceState,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    set_fault_plan(FaultPlan(""))
+    yield
+    set_fault_plan(None)
+
+
+def make_sched(**kw):
+    defaults = dict(num_slots=4, block_size=4, num_blocks=17,
+                    max_blocks_per_seq=8, token_budget=64, prefill_chunk=4)
+    defaults.update(kw)
+    return ContinuousBatchingScheduler(SchedulerConfig(**defaults))
+
+
+def req(i, prompt_len=6, out=4, **kw):
+    return Request(req_id=i, prompt=list(range(1, prompt_len + 1)),
+                   max_new_tokens=out, **kw)
+
+
+# ------------------------------------------------ watermark admission
+def test_shed_watermark_hysteresis():
+    """Above the high watermark admission sheds; it KEEPS shedding as
+    pressure falls until the low watermark is reached (no flapping in
+    the band), then admits again."""
+    s = make_sched(shed_high_watermark=0.5, shed_low_watermark=0.25)
+    usable = 16
+    held = s._take(9)  # pressure 9/16 > 0.5
+    bp = s.admission_backpressure()
+    assert isinstance(bp, Backpressure) and bp.reason == "pool-pressure"
+    assert bp.pool_pressure == round(9 / usable, 4)
+    # in the hysteresis band (0.25 < p < 0.5): still shedding
+    s.allocator.free(held[:3])
+    assert 0.25 < s.pool_pressure() < 0.5
+    assert s.admission_backpressure() is not None
+    # at/below the low watermark: admission resumes
+    s.allocator.free(held[3:7])
+    assert s.pool_pressure() <= 0.25
+    assert s.admission_backpressure() is None
+    # and pressure re-crossing high re-engages
+    s._take(12)
+    assert s.admission_backpressure() is not None
+
+
+def test_shed_low_watermark_defaults_to_high():
+    s = make_sched(shed_high_watermark=0.5)
+    held = s._take(9)
+    assert s.admission_backpressure() is not None
+    s.allocator.free(held[:2])  # 7/16 < 0.5
+    assert s.admission_backpressure() is None
+
+
+def test_queue_depth_cap_sheds_without_hysteresis():
+    s = make_sched(max_waiting=2)
+    s.add_request(req(0))
+    assert s.admission_backpressure() is None
+    s.add_request(req(1))
+    bp = s.admission_backpressure()
+    assert bp is not None and bp.reason == "queue-depth" and bp.waiting == 2
+    # a drained queue admits again immediately (hard cap, no band)
+    s.schedule()
+    assert s.admission_backpressure() is None
+
+
+def test_watermark_config_validation():
+    with pytest.raises(ValueError, match="shed_high_watermark"):
+        make_sched(shed_high_watermark=1.5)
+    with pytest.raises(ValueError, match="needs shed_high_watermark"):
+        make_sched(shed_low_watermark=0.5)
+    with pytest.raises(ValueError, match="shed_low_watermark"):
+        make_sched(shed_high_watermark=0.5, shed_low_watermark=0.6)
+    with pytest.raises(ValueError, match="max_waiting"):
+        make_sched(max_waiting=0)
+
+
+# ------------------------------------------------ cancel bookkeeping
+def test_cancel_running_recycles_slot_and_blocks():
+    s = make_sched()
+    seq = s.add_request(req(0, prompt_len=10))
+    s.schedule()
+    assert seq.state is SequenceState.RUNNING
+    free_before = s.allocator.free_blocks
+    assert seq.blocks and seq.slot is not None
+    s.cancel(seq)
+    assert seq.state is SequenceState.FINISHED
+    assert seq.slot is None and seq.blocks == []
+    assert s.allocator.free_blocks > free_before
+    assert s.drain_freed_slots()  # the engine zeroes the vacated row
+    # the freed capacity is admissible immediately
+    nxt = s.add_request(req(1))
+    t = s.schedule()
+    assert nxt in t.prefills
+
+
+def test_cancel_waiting_removes_from_queue():
+    s = make_sched()
+    a = s.add_request(req(0))
+    b = s.add_request(req(1))
+    s.cancel(a)
+    assert a.state is SequenceState.FINISHED
+    t = s.schedule()
+    assert a not in t.prefills and b in t.prefills
+    with pytest.raises(ValueError, match="cancel"):
+        s.cancel(a)  # already finished: loud, not silent
+
+
+def test_cancel_respects_prefix_cache_refcounts():
+    """A cancelled sequence drops ONE reference per block: blocks the
+    prefix trie still holds stay resident (evictable, not freed) and a
+    follower still prefix-hits them; private tail blocks return to the
+    free list."""
+    s = make_sched(num_blocks=33, prefix_cache=True)
+    seq = s.add_request(req(0, prompt_len=12, out=2))
+    # stream all chunks so the full prompt blocks register in the trie
+    for _ in range(4):
+        s.schedule()
+        for q in list(s.running.values()):
+            step = min(4, q.prefill_len - q.num_cached)
+            if step > 0:
+                q.num_cached += step
+    assert seq.cached_upto == 12  # 3 full blocks in the trie
+    cached = list(seq.blocks[:3])
+    s.cancel(seq)
+    # trie refs survive: blocks not on the free list, but evictable
+    for b in cached:
+        assert s.allocator.refcount(b) == 1
+    assert s.prefix_cache.evictable_count() == 3
+    follower = s.add_request(req(1, prompt_len=12, out=2))
+    s.schedule()
+    assert follower.prefix_cached == 8  # full blocks minus the last token's
+    assert s.prefix_hit_tokens == 8
+
+
+# ------------------------------------------------------ fault points
+def test_serve_pool_fault_point_fires_on_allocation():
+    set_fault_plan(FaultPlan("serve.pool=fail@2"))
+    s = make_sched()
+    s._take(1)
+    with pytest.raises(InjectedFault):
+        s._take(1)
+    assert s._take(1)  # window passed
+
+
+def test_serve_journal_fault_point_fires_on_append(tmp_path):
+    set_fault_plan(FaultPlan("serve.journal=fail@2"))
+    j = RequestJournal(tmp_path / "j.jsonl")
+    j.record_submit(req(0))
+    with pytest.raises(InjectedFault):
+        j.record_finish(0, "completed")
+    plan = FaultPlan("")
+    set_fault_plan(plan)
+    j.record_finish(0, "completed")
+    assert plan.hits("serve.journal") == 1
+
+
+# ---------------------------------------------------------- journal
+def test_journal_roundtrip_and_incomplete_detection(tmp_path):
+    j = RequestJournal(tmp_path / "j.jsonl")
+    r0 = req(0, temperature=0.7, top_k=8,
+             deadline_ms=500.0, ttft_deadline_ms=100.0)
+    r1 = req(1)
+    r2 = req(2)
+    j.record_submit(r0)
+    j.record_submit(r1)
+    j.record_submit(r2)
+    j.record_tokens(0, [5, 6])
+    j.record_tokens(1, [9])
+    j.record_tokens(0, [7])
+    j.record_finish(0, "completed")
+    j.record_finish(2, "timeout")
+    rep = replay_journal(tmp_path / "j.jsonl")
+    assert rep.submitted_count == 3 and rep.next_req_id == 3
+    assert rep.completed == {0: [5, 6, 7]}
+    # in-flight at crash -> replayed; timeout is terminal -> not
+    assert [r["req"] for r in rep.incomplete] == [1]
+    rec = rep.submits[0]
+    assert rec["temperature"] == 0.7 and rec["top_k"] == 8
+    assert rec["deadline_ms"] == 500.0 and rec["ttft_deadline_ms"] == 100.0
+    assert rec["prompt"] == r0.prompt
+
+
+def test_journal_resubmission_resets_token_tally(tmp_path):
+    """A request re-enqueued after a crash regenerates from scratch:
+    only tokens after its LATEST submit record count as output."""
+    j = RequestJournal(tmp_path / "j.jsonl")
+    j.record_submit(req(0, out=3))
+    j.record_tokens(0, [5, 6])  # pre-crash partial
+    j.record_submit(req(0, out=3))  # the resume's re-enqueue
+    j.record_tokens(0, [5, 6, 7])
+    j.record_finish(0, "completed")
+    rep = replay_journal(tmp_path / "j.jsonl")
+    assert rep.completed == {0: [5, 6, 7]}
+
+
+def test_journal_tolerates_torn_tail(tmp_path):
+    """The SIGKILL signature: a half-written last line parses around,
+    never fatally."""
+    j = RequestJournal(tmp_path / "j.jsonl")
+    j.record_submit(req(0))
+    j.record_tokens(0, [1, 2])
+    with open(tmp_path / "j.jsonl", "a") as f:
+        f.write('{"kind": "serve-tok')  # torn mid-append
+    rep = replay_journal(tmp_path / "j.jsonl")
+    assert rep.bad_lines == 1
+    assert [r["req"] for r in rep.incomplete] == [0]
+    assert rep.tokens[0] == [1, 2]
+
+
+def test_journal_counts_sheds_into_offered(tmp_path):
+    """Shed submissions consumed a workload offer without creating a
+    request: ``offered_count`` (what resume skips) = admitted + shed,
+    while ``submitted_count`` stays admitted-only — a crashed run that
+    shed under overload must not double-serve its workload tail on
+    resume, nor resurrect the rejections."""
+    j = RequestJournal(tmp_path / "j.jsonl")
+    j.record_submit(req(0))
+    j.record_shed("pool-pressure")
+    j.record_submit(req(1))
+    j.record_shed("queue-depth")
+    rep = replay_journal(tmp_path / "j.jsonl")
+    assert rep.submitted_count == 2
+    assert rep.shed_count == 2
+    assert rep.offered_count == 4
+    assert [r["req"] for r in rep.incomplete] == [0, 1]
+
+
+def test_journal_missing_file_is_empty_replay(tmp_path):
+    rep = replay_journal(tmp_path / "nope.jsonl")
+    assert rep.submitted_count == 0 and rep.incomplete == []
+    assert rep.next_req_id == 0
+
+
+def test_open_journal_truncates_stale_journal_on_fresh_run(tmp_path):
+    """A fresh (non-resume) run must NOT inherit a previous drill's
+    journal in the same run dir — the appender is O_APPEND by design,
+    so without truncation a later --resume would replay the OLD run's
+    request stream into the new workload."""
+    from scaling_tpu.serve.journal import open_journal
+
+    p = tmp_path / "journal.jsonl"
+    old = RequestJournal(p)
+    old.record_submit(req(0))
+    old.record_shed("pool-pressure")
+    # fresh run: stale records gone, new appends start clean
+    j, rep = open_journal(p, resume=False)
+    assert rep is None and not p.exists()
+    j.record_submit(req(0))
+    # resume run: folds the existing journal and keeps appending
+    j2, rep2 = open_journal(p, resume=True)
+    assert rep2 is not None and rep2.offered_count == 1
+    j2.record_finish(0, "completed")
+    assert replay_journal(p).completed == {0: []}
+
+
+def test_journal_lines_are_single_json_objects(tmp_path):
+    j = RequestJournal(tmp_path / "j.jsonl")
+    j.record_submit(req(0))
+    j.record_tokens(0, [1])
+    j.record_finish(0, "completed")
+    lines = (tmp_path / "j.jsonl").read_text().splitlines()
+    kinds = [json.loads(l)["kind"] for l in lines]
+    assert kinds == ["serve-submit", "serve-tokens", "serve-finish"]

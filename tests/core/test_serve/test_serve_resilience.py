@@ -1,0 +1,263 @@
+"""Engine-level serving resilience (ISSUE 13): deadline expiry at tick
+boundaries, overload shedding through ``submit``, graceful drain with
+in-flight work, and journal replay token-exactness — on the toy CPU
+engine (the jax-free policy units ride test_resilience_units.py; the
+SIGKILL/SIGTERM subprocess story rides test_bench_e2e.py)."""
+
+import time
+
+import pytest
+
+from scaling_tpu.resilience.faults import FaultPlan, set_fault_plan
+from scaling_tpu.serve.journal import RequestJournal, replay_journal
+from scaling_tpu.serve.scheduler import Backpressure
+
+PROMPTS = [[1, 2, 3, 4, 5], [7, 8, 9], [11, 12, 13, 14, 15, 16, 17, 18],
+           [3, 1, 4]]
+
+
+@pytest.fixture(scope="module")
+def toy_inference():
+    from scaling_tpu.serve.bench import build_toy_inference
+
+    return build_toy_inference(hidden=32, layers=2, vocab=64, heads=4)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    set_fault_plan(FaultPlan(""))
+    yield
+    set_fault_plan(None)
+
+
+def make_engine(toy_inference, **kw):
+    from scaling_tpu.serve.engine import EngineConfig, ServeEngine
+
+    defaults = dict(num_slots=2, block_size=4, num_blocks=64,
+                    max_blocks_per_seq=8, token_budget=64, prefill_chunk=4)
+    defaults.update(kw)
+    return ServeEngine(toy_inference, EngineConfig(**defaults))
+
+
+def outputs(engine):
+    return {s.request.req_id: list(s.generated) for s in engine.finished}
+
+
+def submit_all(engine, n=3, temp=0.7):
+    for i, p in enumerate(PROMPTS[:n]):
+        engine.submit(p, 6, temperature=temp if i % 2 else 0.0, top_k=8)
+
+
+# ------------------------------------------------------------ deadlines
+def test_total_deadline_expires_at_tick_boundary(toy_inference):
+    """A request past its total deadline is cancelled at the next tick:
+    terminal status 'timeout', slot + blocks recycled, pool fully free
+    afterwards."""
+    e = make_engine(toy_inference, default_deadline_ms=0.0)
+    e.submit([1, 2, 3], 5, arrival_s=time.monotonic() - 1.0)
+    e.run_until_done()
+    (s,) = e.finished
+    assert s.finish_status == "timeout"
+    assert e.timeout_count == 1
+    assert e.scheduler.allocator.free_blocks == 63
+    assert not e.scheduler.has_work
+
+
+def test_ttft_deadline_only_binds_before_first_token(toy_inference):
+    """The TTFT deadline expires a request still waiting for its first
+    token; one that already emitted it keeps running under the (absent)
+    total deadline."""
+    e = make_engine(toy_inference)
+    fast = e.submit([1, 2, 3], 4)  # no deadlines
+    e.run_until_done()
+    assert fast.finish_status == "completed"
+    # expired-on-arrival TTFT deadline: never runs, times out
+    late = e.submit([4, 5, 6], 4, ttft_deadline_ms=0.0,
+                    arrival_s=time.monotonic() - 1.0)
+    e.run_until_done()
+    assert late.finish_status == "timeout"
+    assert late.first_token_s is None and late.generated == []
+    # per-request override beats the engine default
+    e2 = make_engine(toy_inference, default_deadline_ms=0.0)
+    ok = e2.submit([1, 2, 3], 4, deadline_ms=60_000.0)
+    e2.run_until_done()
+    assert ok.finish_status == "completed"
+
+
+def test_mid_flight_deadline_recycles_capacity_to_waiting_peer(
+        toy_inference):
+    """A running request that expires mid-generation frees its slot and
+    blocks for the queue — degraded service, never a wedged pool."""
+    e = make_engine(toy_inference, num_slots=1)
+    doomed = e.submit([1, 2, 3, 4], 24,
+                      deadline_ms=1.0, arrival_s=time.monotonic())
+    waiting = e.submit([5, 6, 7], 3)
+    e.tick()  # admits `doomed` (first chunk)
+    time.sleep(0.01)  # the 1ms deadline lapses
+    e.run_until_done()
+    assert doomed.finish_status == "timeout"
+    assert waiting.finish_status == "completed"
+    assert len(waiting.generated) == 3
+
+
+# ------------------------------------------------------------- shedding
+def test_submit_returns_structured_backpressure_and_counts(toy_inference):
+    e = make_engine(toy_inference, max_waiting=1)
+    assert not isinstance(e.submit(PROMPTS[0], 4), Backpressure)
+    bp = e.submit(PROMPTS[1], 4)
+    assert isinstance(bp, Backpressure)
+    assert bp.reason == "queue-depth" and not bp.draining
+    assert e.shed_count == 1
+    e.run_until_done()
+    assert len(e.finished) == 1  # the shed request never existed
+
+
+# -------------------------------------------------------------- drain
+def test_drain_finishes_in_flight_and_rejects_new(toy_inference):
+    e = make_engine(toy_inference)
+    submit_all(e, n=2)
+    e.tick()
+    e.begin_drain()
+    bp = e.submit(PROMPTS[3], 4)
+    assert isinstance(bp, Backpressure)
+    assert bp.reason == "draining" and bp.draining
+    e.run_until_done()
+    assert sorted(outputs(e)) == [0, 1]
+    assert all(s.finish_status == "completed" for s in e.finished)
+    assert not e.scheduler.has_work
+
+
+def test_drain_with_deadlines_bounds_the_tail(toy_inference):
+    """Draining requests still honor their deadlines: a drain never
+    waits longer than the longest live deadline."""
+    e = make_engine(toy_inference)
+    slow = e.submit([1, 2, 3], 24, deadline_ms=1.0,
+                    arrival_s=time.monotonic())
+    e.tick()
+    e.begin_drain()
+    time.sleep(0.01)
+    e.run_until_done()
+    assert slow.finish_status == "timeout"
+
+
+def test_install_drain_handler_chains_prior_sigterm(toy_inference):
+    import signal
+
+    e = make_engine(toy_inference)
+    seen = []
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+        from scaling_tpu.serve.engine import install_drain_handler
+
+        install_drain_handler(e)
+        signal.raise_signal(signal.SIGTERM)
+        assert e.draining
+        assert seen == [signal.SIGTERM]  # the prior handler still ran
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+# ------------------------------------------------------------- journal
+def test_journal_replay_is_token_exact_after_abandoned_engine(
+        toy_inference, tmp_path):
+    """The crash-replay contract at the engine layer: run with a
+    journal, 'crash' after a few ticks (abandon the engine), replay
+    incomplete requests into a FRESH engine with their original ids —
+    final outputs are token-for-token what an uninterrupted run
+    produces, including the sampled (temperature > 0) rows, and every
+    pre-crash journaled token is a prefix of the replayed output."""
+    jp = tmp_path / "journal.jsonl"
+    crashed = make_engine(toy_inference)
+    crashed.attach_journal(RequestJournal(jp))
+    submit_all(crashed)
+    for _ in range(4):  # partial progress, then the "SIGKILL"
+        crashed.tick()
+    pre = replay_journal(jp)
+    assert pre.submitted_count == 3
+
+    resumed = make_engine(toy_inference)
+    resumed.attach_journal(RequestJournal(jp))
+    resumed._next_req_id = pre.next_req_id
+    for rec in pre.incomplete:
+        resumed.submit(rec["prompt"], rec["max_new_tokens"],
+                       temperature=rec.get("temperature", 0.0),
+                       top_k=rec.get("top_k"), top_p=rec.get("top_p"),
+                       req_id=int(rec["req"]), force=True)
+    resumed.run_until_done()
+    final = replay_journal(jp)
+
+    reference = make_engine(toy_inference)
+    submit_all(reference)
+    reference.run_until_done()
+    assert final.completed == outputs(reference)
+    for rid, toks in pre.tokens.items():
+        assert final.completed[rid][:len(toks)] == toks
+
+
+def test_warmup_traffic_stays_out_of_the_journal(toy_inference, tmp_path):
+    jp = tmp_path / "journal.jsonl"
+    e = make_engine(toy_inference)
+    e.attach_journal(RequestJournal(jp))
+    e.warmup_mode = True
+    e.submit([1], 2)
+    e.run_until_done()
+    e.warmup_mode = False
+    assert not jp.exists()
+
+
+# --------------------------------------------------------- fault points
+def test_serve_tick_and_admit_fault_points_fire_deterministically(
+        toy_inference):
+    plan = FaultPlan("")
+    set_fault_plan(plan)
+    e = make_engine(toy_inference)
+    e.submit(PROMPTS[0], 3)
+    e.run_until_done()
+    assert plan.hits("serve.admit") == 1
+    assert plan.hits("serve.tick") == e.tick_index
+    assert plan.hits("serve.pool") > 0
+
+
+def test_serve_admit_fail_action_raises_out_of_submit(toy_inference):
+    from scaling_tpu.resilience.faults import InjectedFault
+
+    set_fault_plan(FaultPlan("serve.admit=fail@2"))
+    e = make_engine(toy_inference)
+    e.submit(PROMPTS[0], 3)
+    with pytest.raises(InjectedFault):
+        e.submit(PROMPTS[1], 3)
+    e.run_until_done()
+
+
+def test_run_bench_carry_makes_the_summary_cumulative(toy_inference):
+    """A resumed run's summary must describe the WHOLE run dir: the
+    crashed predecessors' terminal tallies (journal replay) fold into
+    the final summary's completed/timeout/shed fields — the numbers
+    the --assert-max-shed-rate / --assert-max-serve-timeouts gates
+    read."""
+    from scaling_tpu.serve.bench import run_bench
+
+    e = make_engine(toy_inference)
+    stats = run_bench(
+        e, [(0.0, PROMPTS[0], 3)],
+        carry={"completed": 2, "timeouts": 3, "shed": 4},
+    )
+    assert stats["requests"] == 1 + 2
+    assert stats["requests_timeout"] == 3
+    assert stats["requests_shed"] == 4
+    # rate over ALL attempts: 4 shed of (4 + 3 + 1 + 2)
+    assert stats["shed_rate"] == round(4 / 10, 4)
+
+
+def test_timeout_counter_rides_the_registry(toy_inference):
+    from scaling_tpu import obs
+
+    e = make_engine(toy_inference, default_deadline_ms=0.0)
+    before = obs.get_registry().counter(
+        "serve_requests_timeout_total"
+    ).value
+    e.submit([1, 2, 3], 4, arrival_s=time.monotonic() - 1.0)
+    e.run_until_done()
+    after = obs.get_registry().counter("serve_requests_timeout_total").value
+    assert after == before + 1

@@ -115,3 +115,66 @@ def test_modelspec_from_arch_reads_config_objects():
     }
     spec = ModelSpec.from_arch(arch)
     assert spec.num_kv_heads == 2 and spec.glu and not spec.moe
+
+
+# -------------------------------------------------------- mbs ladder
+def test_mbs_ladder_enumerates_each_size_with_labeled_candidates(space):
+    """The mbs ladder (ISSUE 13 satellite): each listed micro-batch size
+    that divides the batch hierarchy yields its own scored candidates,
+    labels name the mbs so ranked rows stay distinguishable, and gas
+    scales inversely (global batch fixed)."""
+    ladder = enumerate_layouts(
+        8, MODEL, global_batch_size=64, micro_batch_size=8,
+        mbs_ladder=(2, 4),
+    )
+    by_mbs = {}
+    for l in ladder:
+        by_mbs.setdefault(l.micro_batch_size, []).append(l)
+    assert sorted(by_mbs) == [2, 4, 8]
+    for l in ladder:
+        assert f"mbs{l.micro_batch_size}" in l.label
+        assert l.global_batch_size == 64  # gas absorbed the mbs change
+    # every rung holds the same mesh factorizations as the single-mbs
+    # space (64 % (mbs * dp) == 0 for dp <= 8 at mbs 2/4/8)
+    assert len(by_mbs[2]) == len(space) and len(by_mbs[4]) == len(space)
+
+
+def test_mbs_ladder_off_keeps_labels_and_space_identical(space):
+    """No ladder -> byte-identical labels and keys (the pinned tune
+    golden must not move)."""
+    plain = enumerate_layouts(8, MODEL, global_batch_size=64,
+                              micro_batch_size=8, mbs_ladder=None)
+    assert [l.label for l in plain] == [l.label for l in space]
+    assert all("mbs" not in l.label for l in plain)
+    # a ladder of only the base mbs collapses to the plain space too
+    same = enumerate_layouts(8, MODEL, global_batch_size=64,
+                             micro_batch_size=8, mbs_ladder=(8,))
+    assert [l.label for l in same] == [l.label for l in space]
+
+
+def test_mbs_ladder_scores_thinner_bubbles_at_pp(space):
+    """The ladder is not cosmetic: at pp > 1 a smaller mbs means more
+    micro-batches through the same pipe, so the schedule simulator
+    prices a thinner fill/drain bubble — and memory shrinks with it."""
+    from scaling_tpu.tune.costmodel import (
+        Calibration,
+        SliceTopology,
+        score_layout,
+    )
+
+    topo = SliceTopology(chips=8)
+    cal = Calibration.default()
+    ladder = enumerate_layouts(
+        8, MODEL, global_batch_size=64, micro_batch_size=8,
+        mbs_ladder=(2,),
+    )
+    pp2 = {
+        l.micro_batch_size: l for l in ladder
+        if l.pp == 2 and l.dp == 4 and l.mp == 1 and l.cp == 1
+        and l.zero_stage == 1 and l.vpp == 1 and l.token_slices == 1
+    }
+    assert sorted(pp2) == [2, 8]
+    small = score_layout(MODEL, pp2[2], topo, cal)
+    big = score_layout(MODEL, pp2[8], topo, cal)
+    assert small.bubble_fraction < big.bubble_fraction
+    assert small.memory_gb < big.memory_gb
