@@ -466,7 +466,7 @@ def _count_pallas_custom_calls(text: str) -> int:
 
 def audit_serve_decode_section(num_slots=2, block_size=4,
                                max_blocks=4, prefill_chunk=8,
-                               spec_k=3) -> dict:
+                               spec_k=3, mp=1) -> dict:
     """The serving engine's single MIXED program (serve/engine.py,
     ISSUE 11): ONE jitted step per tick covers the whole slot set —
     decode rows (last token + up to ``spec_k`` speculative drafts) and
@@ -481,7 +481,14 @@ def audit_serve_decode_section(num_slots=2, block_size=4,
     hash even though legacy prefill lowers per bucket), and
     ``pallas_custom_calls`` counts the paged-attention kernel's custom
     calls in the lowered HLO (0 off-TPU where the kernel runs
-    interpreted)."""
+    interpreted).
+
+    ``mp > 1`` lowers the SHARDED mixed program (ISSUE 14): the engine's
+    KV pools shard over the model axis, the program partitions SPMD over
+    the serving mesh, and the collective inventory pins the model-axis
+    activation all-reduces the sharded tick pays — plus the recompile
+    key grows an ``mp`` entry (only when sharded, so the mp=1 section's
+    pinned hash stays byte-identical)."""
     import jax
     import jax.numpy as jnp
 
@@ -493,29 +500,38 @@ def audit_serve_decode_section(num_slots=2, block_size=4,
         MIN_PREFILL_BUCKET, EngineConfig, ServeEngine,
     )
 
-    config = make_train_config()
-    module = init_model(config, None)
+    config = make_train_config(mp=mp)
+    topology = None
+    if mp > 1:
+        from scaling_tpu.topology import Topology
+
+        topology = Topology(config.topology)
+    module = init_model(config, topology)
     params = module.init_params(jax.random.PRNGKey(0))
+    if topology is not None:
+        params = module.shard_params(params)
     inf = TransformerInferenceModule(config, module, params)
     engine = ServeEngine(inf, EngineConfig(
         num_slots=num_slots, block_size=block_size,
         num_blocks=2 * max_blocks + 1, max_blocks_per_seq=max_blocks,
         token_budget=64, prefill_chunk=prefill_chunk, spec_k=spec_k,
     ))
-    base_key = jax.random.PRNGKey(0)
+    base_key = engine._dev(jax.random.PRNGKey(0))
     width = engine.config.mixed_width
     mixed = engine._build_mixed_fn(width)
     args = (
         params, engine._pool_state(),
-        jnp.zeros((num_slots, max_blocks), jnp.int32),  # block tables
-        jnp.zeros((num_slots,), jnp.int32),             # context lengths
-        jnp.zeros((num_slots, width), jnp.int32),       # tokens
-        jnp.ones((num_slots,), jnp.int32),              # real per row
-        jnp.zeros((num_slots,), jnp.float32),           # temperatures
-        jnp.zeros((num_slots,), jnp.float32),           # top-ps
-        jnp.zeros((num_slots,), jnp.int32),             # top-ks
-        jnp.zeros((num_slots,), jnp.int32),             # request ids
-        jnp.zeros((num_slots,), jnp.int32),             # key-fold bases
+        *engine._dev((
+            jnp.zeros((num_slots, max_blocks), jnp.int32),  # block tables
+            jnp.zeros((num_slots,), jnp.int32),     # context lengths
+            jnp.zeros((num_slots, width), jnp.int32),  # tokens
+            jnp.ones((num_slots,), jnp.int32),      # real per row
+            jnp.zeros((num_slots,), jnp.float32),   # temperatures
+            jnp.zeros((num_slots,), jnp.float32),   # top-ps
+            jnp.zeros((num_slots,), jnp.int32),     # top-ks
+            jnp.zeros((num_slots,), jnp.int32),     # request ids
+            jnp.zeros((num_slots,), jnp.int32),     # key-fold bases
+        )),
         base_key,
     )
     lowered = mixed.lower(*args)
@@ -533,8 +549,19 @@ def audit_serve_decode_section(num_slots=2, block_size=4,
         # up as golden drift, not a quiet FLOPs regression
         "sample_width": engine.config.sample_width,
     }
-    report = _audit_lowered(lowered, args, static, mesh=None)
-    report["mesh"] = {}
+    mesh = None
+    if mp > 1:
+        # mp joins the recompile key ONLY when sharded: the mp=1
+        # section's pinned hash stays byte-identical
+        static["mp"] = mp
+        mesh = MeshAxes(
+            topology.mesh.axis_names, topology.mesh.devices.shape
+        )
+    report = _audit_lowered(lowered, args, static, mesh=mesh)
+    report["mesh"] = (
+        dict(zip(topology.mesh.axis_names, topology.mesh.devices.shape))
+        if mp > 1 else {}
+    )
     report["pallas_custom_calls"] = _count_pallas_custom_calls(
         lowered.as_text()
     )
@@ -558,6 +585,10 @@ SECTIONS = {
     "decode_fused": lambda: audit_decode_section(),
     # continuous-batching serving: the paged decode step (ISSUE 9)
     "serve_decode": lambda: audit_serve_decode_section(),
+    # mp=2 sharded serving: the SAME mixed program partitioned over the
+    # model axis — per-axis collective inventory + mp in the recompile
+    # key (ISSUE 14; the mp=1 section above stays byte-identical)
+    "serve_decode_mp2": lambda: audit_serve_decode_section(mp=2),
 }
 
 

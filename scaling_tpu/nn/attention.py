@@ -646,15 +646,62 @@ class ParallelSelfAttention(BaseLayer):
         valid_len = ctx_len + new_len  # written slots per row
         kernel = getattr(ctx, "paged_kernel", "xla")
         if kernel == "pallas":
-            from .paged_attention import paged_decode_attention
+            import functools
 
-            out = paged_decode_attention(
-                q, new_view.pool_k, new_view.pool_v,
-                view.block_table, valid_len, ctx_len,
+            from .paged_attention import paged_decode_attention
+            from ..topology.topology import MODEL_AXIS
+
+            mp = (
+                ctx.mesh.shape[MODEL_AXIS]
+                if ctx.mesh is not None and MODEL_AXIS in ctx.mesh.axis_names
+                else 1
+            )
+            call = functools.partial(
+                paged_decode_attention,
                 sm_scale=self.scaling_factor,
                 num_repeat_kv=self.num_repeat_kv,
-                scale_k=new_view.scale_k, scale_v=new_view.scale_v,
             )
+            if mp > 1:
+                # mp>1 sharded serving: pallas calls are opaque to GSPMD
+                # (which would gather the whole pool to every device), so
+                # partition the kernel itself — each model shard streams
+                # its OWN (num_blocks, block_size, n_kv/mp, h) pool slice
+                # under its n/mp query heads. Addressing state (tables,
+                # lengths) is replicated; the GQA repeat factor is
+                # unchanged per shard because q and kv heads divide mp
+                # together (enforced at pool init, serve/kvcache.py).
+                from jax.sharding import PartitionSpec as P
+
+                from ..parallel.sharding import shard_map
+
+                heads = P(None, None, MODEL_AXIS, None)
+                rep2, rep1 = P(None, None), P(None)
+                quant = view.quantized
+                in_specs = [heads, heads, heads, rep2, rep1, rep1]
+                if quant:
+                    in_specs += [P(None, None, MODEL_AXIS)] * 2
+
+                def run_shard(qq, pk, pv, tab, vl, qb, *scales):
+                    sk, sv = scales if quant else (None, None)
+                    return call(qq, pk, pv, tab, vl, qb,
+                                scale_k=sk, scale_v=sv)
+
+                operands = [
+                    q, new_view.pool_k, new_view.pool_v,
+                    view.block_table, valid_len, ctx_len,
+                ]
+                if quant:
+                    operands += [new_view.scale_k, new_view.scale_v]
+                out = shard_map(
+                    run_shard, mesh=ctx.mesh, in_specs=tuple(in_specs),
+                    out_specs=heads, check_vma=False,
+                )(*operands)
+            else:
+                out = call(
+                    q, new_view.pool_k, new_view.pool_v,
+                    view.block_table, valid_len, ctx_len,
+                    scale_k=new_view.scale_k, scale_v=new_view.scale_v,
+                )
             return out, new_view
         assert kernel == "xla", (
             f"unknown paged_kernel {kernel!r} (expected 'pallas' or 'xla') "

@@ -68,6 +68,12 @@ def main(argv=None) -> int:
                         help="fail (exit 1) when more than CEIL serving "
                         "requests hit their deadline, or the run dir "
                         "holds no timeout telemetry at all")
+    parser.add_argument("--assert-max-replica-skew", type=float,
+                        metavar="CEIL",
+                        help="fail (exit 1) when the fleet's per-replica "
+                        "completed-request skew (max/min) exceeds CEIL, "
+                        "or the run dir holds no replica telemetry at "
+                        "all (docs/SERVING.md the fleet)")
     args = parser.parse_args(argv)
 
     run_dir = Path(args.run_dir)
@@ -98,6 +104,7 @@ def main(argv=None) -> int:
         assert_max_downsizes=args.assert_max_downsizes,
         assert_max_shed_rate=args.assert_max_shed_rate,
         assert_max_serve_timeouts=args.assert_max_serve_timeouts,
+        assert_max_replica_skew=args.assert_max_replica_skew,
     )
     if (args.assert_mfu is not None or args.assert_step_time is not None
             or args.assert_tuner_calibration is not None
@@ -106,7 +113,8 @@ def main(argv=None) -> int:
             or args.assert_spec_accept_rate is not None
             or args.assert_max_downsizes is not None
             or args.assert_max_shed_rate is not None
-            or args.assert_max_serve_timeouts is not None):
+            or args.assert_max_serve_timeouts is not None
+            or args.assert_max_replica_skew is not None):
         print("== gates ==")
         if failures:
             for f in failures:

@@ -541,6 +541,59 @@ def serving_section(data: RunData) -> Tuple[List[str], Dict[str, float]]:
                 line += (f" [drained; {int(s.get('unsubmitted', 0))} "
                          "unsubmitted]")
             lines.append(line)
+        # fleet rows (docs/SERVING.md "The fleet"): per-replica load /
+        # completion split plus the router's dispatch-policy stats —
+        # what the --assert-max-replica-skew gate reads. Only rendered
+        # when the summary carries replica_stats, so single-engine run
+        # dirs (and committed goldens) are unchanged.
+        reps = s.get("replica_stats")
+        if isinstance(reps, list) and reps:
+            router = s.get("router") or {}
+            counts = [int(r.get("requests", 0)) for r in reps]
+            if min(counts) > 0:
+                skew = max(counts) / min(counts)
+            elif max(counts) > 0:
+                skew = math.inf
+            else:
+                skew = 1.0
+            stats["serve_replicas"] = float(len(reps))
+            stats["serve_replica_skew"] = skew
+            affinity = int(router.get("affinity_dispatches", 0))
+            dispatches = int(router.get("dispatches", 0))
+            stats["serve_affinity_hit_rate"] = float(
+                router.get("affinity_hit_rate") or 0.0
+            )
+            lines.append(
+                f"  fleet: replicas={len(reps)} dispatches={dispatches} "
+                f"affinity_hits={affinity} "
+                f"({stats['serve_affinity_hit_rate']:.1%}) "
+                f"retries_elsewhere={int(router.get('retries_elsewhere', 0))}"
+                f" rejected={int(router.get('rejected', 0))} "
+                f"skew={'inf' if skew == math.inf else format(skew, '.2f')}"
+            )
+            for r in reps:
+                row = (
+                    f"    replica {r.get('replica')}: "
+                    f"requests={int(r.get('requests', 0))} "
+                    f"tokens={int(r.get('output_tokens', 0))} "
+                    f"dispatches={int(r.get('dispatches', 0))} "
+                    f"timeouts={int(r.get('timeouts', 0))} "
+                    f"pressure={float(r.get('pool_pressure', 0.0)):.2f}"
+                )
+                if not r.get("alive", True):
+                    row += " [FAILED]"
+                lines.append(row)
+        if s.get("spec_k_sweep"):
+            # the --spec-k-sweep arm: every draft length's measured
+            # tokens/s + accept rate, best-k first-class
+            lines.append(
+                f"  spec-k sweep: best k={s.get('spec_k_best')} of "
+                + ", ".join(
+                    f"k={row.get('spec_k')}:"
+                    f"{float(row.get('tokens_per_s', 0.0)):.1f}t/s"
+                    for row in s["spec_k_sweep"]
+                )
+            )
     elif reqs:
         # crashed/partial run: derive throughput from what finished
         tokens = sum(int(e.get("output_tokens", 0)) for e in reqs)
@@ -714,7 +767,8 @@ def check_gates(data: RunData, assert_mfu: Optional[float] = None,
                 assert_spec_accept_rate: Optional[float] = None,
                 assert_max_downsizes: Optional[int] = None,
                 assert_max_shed_rate: Optional[float] = None,
-                assert_max_serve_timeouts: Optional[int] = None
+                assert_max_serve_timeouts: Optional[int] = None,
+                assert_max_replica_skew: Optional[float] = None
                 ) -> List[str]:
     """CI-style regression gates; returns failure messages (empty ==
     pass). Missing data FAILS a requested gate — a run that recorded no
@@ -727,7 +781,8 @@ def check_gates(data: RunData, assert_mfu: Optional[float] = None,
                      or assert_ttft is not None
                      or assert_spec_accept_rate is not None
                      or assert_max_shed_rate is not None
-                     or assert_max_serve_timeouts is not None)
+                     or assert_max_serve_timeouts is not None
+                     or assert_max_replica_skew is not None)
     if serving_gates:
         _, sstats = serving_section(data)
         if assert_max_shed_rate is not None:
@@ -756,6 +811,21 @@ def check_gates(data: RunData, assert_mfu: Optional[float] = None,
                     f"assert-max-serve-timeouts: {int(timeouts)} "
                     f"deadline timeout(s) > ceiling "
                     f"{assert_max_serve_timeouts}"
+                )
+        if assert_max_replica_skew is not None:
+            skew = sstats.get("serve_replica_skew")
+            if skew is None:
+                failures.append(
+                    "assert-max-replica-skew: no fleet telemetry in the "
+                    "run dir (serve-summary carries no replica_stats — "
+                    "single-engine bench, or no summary at all?)"
+                )
+            elif skew > assert_max_replica_skew:
+                failures.append(
+                    f"assert-max-replica-skew: completed-request skew "
+                    f"{'inf' if math.isinf(skew) else format(skew, '.2f')}"
+                    f" > ceiling {assert_max_replica_skew:.2f} (a replica "
+                    "is starved or dead — check the router rows)"
                 )
         if assert_spec_accept_rate is not None:
             rate = sstats.get("serve_spec_accept_rate")

@@ -30,6 +30,16 @@ The "millions of users" half of the north star: turns the single-request
   training MFU gates; ``--assert-spec-accept-rate`` /
   ``--assert-max-shed-rate`` / ``--assert-max-serve-timeouts`` ride
   the analyzer).
+- :mod:`.router` — the FLEET (docs/SERVING.md "The fleet"): N
+  data-parallel engine replicas behind ``FleetRouter`` — least-loaded
+  + hash-based prefix-affinity dispatch, retry-elsewhere on
+  ``Backpressure``, SIGTERM drain fan-out, per-replica journal
+  namespaces (``journal_path``) with token-exact replica-kill
+  journal-resume; ``serve bench --replicas N [--mp K]`` drives the
+  fleet through one Poisson stream (mp>1 shards every KV pool over
+  the model axis — ``kvcache.init_pools`` — so big models fit and
+  the mixed tick runs SPMD; ``tune --serve`` plans the (mp, replicas,
+  block_size, token_budget) split and ``--config`` runs its pick).
 - resilience (docs/SERVING.md "Resilience"): per-request TTFT/total
   deadlines cancelled at tick boundaries (terminal status
   ``timeout``), watermark overload shedding with hysteresis
@@ -49,9 +59,11 @@ without paying backend init.
 from .journal import (
     JournalReplay,
     RequestJournal,
+    journal_path,
     open_journal,
     replay_journal,
 )
+from .router import FleetRouter, ReplicaHandle, ReplicaStats
 from .scheduler import (
     Backpressure,
     BlockAllocator,
@@ -68,13 +80,17 @@ __all__ = [
     "Backpressure",
     "BlockAllocator",
     "ContinuousBatchingScheduler",
+    "FleetRouter",
     "JournalReplay",
     "PrefixCache",
+    "ReplicaHandle",
+    "ReplicaStats",
     "Request",
     "RequestJournal",
     "SchedulerConfig",
     "Sequence",
     "SequenceState",
+    "journal_path",
     "ngram_propose",
     "open_journal",
     "replay_journal",

@@ -29,13 +29,30 @@ import time
 from pathlib import Path
 from typing import List, Optional
 
-from .engine import EngineConfig, ServeEngine, install_drain_handler
+# NOTE: keep this module jax-light at import — the engine (and so jax)
+# loads inside main() AFTER _ensure_devices has set the virtual device
+# count for --replicas/--mp; an eager engine import would pin the
+# process to however many devices the environment happened to have
 from .scheduler import Backpressure
 
 
 def build_toy_inference(hidden: int = 64, layers: int = 2, vocab: int = 128,
-                        heads: int = 4, seq_len: int = 256):
-    """Random-init tiny model wrapped for inference (no checkpoint)."""
+                        heads: int = 4, seq_len: int = 256, mp: int = 1,
+                        device_offset: int = 0):
+    """Random-init tiny model wrapped for inference (no checkpoint).
+
+    ``mp > 1`` builds the model on a model-parallel serving mesh (needs
+    that many jax devices): params shard over the model axis, and the
+    engine's pools and programs follow (docs/SERVING.md "The fleet").
+    Weights are init-key deterministic, so the mp=1 and mp=2 builds of
+    the same shape hold the SAME weights — the mp parity tests rely on
+    that.
+
+    ``device_offset`` places this instance's params (and mesh, at
+    mp > 1) starting at that jax device: fleet replica ``r`` builds at
+    offset ``r * mp``, so every replica owns its own device group and
+    their tick programs genuinely run concurrently instead of queueing
+    on device 0."""
     import jax
 
     from ..models.transformer import TransformerConfig
@@ -44,7 +61,7 @@ def build_toy_inference(hidden: int = 64, layers: int = 2, vocab: int = 128,
 
     config = TransformerConfig.from_dict({
         "topology": {
-            "model_parallel_size": 1, "pipe_parallel_size": 1,
+            "model_parallel_size": mp, "pipe_parallel_size": 1,
             "data_parallel_size": 1, "micro_batch_size": 1,
             "gradient_accumulation_steps": 1,
         },
@@ -62,8 +79,28 @@ def build_toy_inference(hidden: int = 64, layers: int = 2, vocab: int = 128,
         "trainer": {"train_iterations": 1, "seed": 0},
         "data": {}, "logger": {"log_dir": None},
     })
-    module = init_model(config, None)
+    topo = None
+    if mp > 1 or device_offset > 0:
+        if len(jax.devices()) < device_offset + mp:
+            raise RuntimeError(
+                f"mp={mp} at device offset {device_offset} needs "
+                f"{device_offset + mp} jax devices, found "
+                f"{len(jax.devices())} (off-TPU: set XLA_FLAGS="
+                f"--xla_force_host_platform_device_count=N)"
+            )
+    if mp > 1:
+        from ..topology import Topology
+
+        topo = Topology(
+            config.topology,
+            devices=jax.devices()[device_offset:device_offset + mp],
+        )
+    module = init_model(config, topo)
     params = module.init_params(jax.random.PRNGKey(0))
+    if topo is not None:
+        params = module.shard_params(params)
+    elif device_offset > 0:
+        params = jax.device_put(params, jax.devices()[device_offset])
     return TransformerInferenceModule(config, module, params)
 
 
@@ -96,7 +133,7 @@ def sample_workload(n_requests: int, rate: float, prompt_len, output_len,
     return work
 
 
-def run_bench(engine: ServeEngine, workload, time_scale: float = 1.0,
+def run_bench(engine, workload, time_scale: float = 1.0,
               max_wall_s: float = 600.0, tick_timeout_s: float = 0.0,
               extra_stats: Optional[dict] = None,
               carry: Optional[dict] = None) -> dict:
@@ -256,11 +293,228 @@ def run_bench(engine: ServeEngine, workload, time_scale: float = 1.0,
             round(engine.spec_accept_rate, 4)
             if engine.spec_accept_rate is not None else None
         ),
+        "engine": engine_shape_stats(engine),
     }
     if extra_stats:
         stats.update(extra_stats)
     logger.log_event("serve-summary", **stats)
     get_registry().flush_step(engine.tick_index)
+    return stats
+
+
+def engine_shape_stats(engine, replicas: int = 1) -> dict:
+    """The engine-shape facts the serve-summary carries so the tuner's
+    serving cost model can calibrate against this run's measured spans
+    (tune/serving.py ``ServeCalibration``)."""
+    cfg = engine.config
+    return {
+        "mp": engine.model_parallel,
+        "replicas": replicas,
+        "num_slots": cfg.num_slots,
+        "block_size": cfg.block_size,
+        "num_blocks": cfg.num_blocks,
+        "token_budget": cfg.token_budget,
+        "prefill_chunk": cfg.prefill_chunk,
+        "spec_k": cfg.spec_k,
+    }
+
+
+def run_fleet_bench(router, workload, time_scale: float = 1.0,
+                    max_wall_s: float = 600.0,
+                    extra_stats: Optional[dict] = None,
+                    carry: Optional[dict] = None,
+                    fleet_journal=None) -> dict:
+    """Open-loop drive of the FLEET (docs/SERVING.md "The fleet"): one
+    Poisson arrival stream submits through the router (prefix-affinity /
+    least-loaded / retry-elsewhere), while one tick thread per replica
+    runs its engine's event loop — replicas tick CONCURRENTLY (each owns
+    its own device group; the jitted tick releases the GIL), which is
+    what makes fleet tokens/s scale with replicas instead of queueing N
+    engines on one device.
+
+    A submission the WHOLE fleet sheds is counted (and journaled into
+    the fleet-level journal — replica journals only see their own
+    admissions) and not retried; SIGTERM drains every replica and the
+    loop exits cleanly once the last in-flight request finishes."""
+    import threading
+
+    from ..logging import logger
+    from ..obs import get_registry, span
+    from ..obs.report import percentile
+
+    handles = list(router.replicas)
+    engines = [h.engine for h in handles]
+    start_ticks = {h.replica_id: h.engine.tick_index for h in handles}
+    stop = threading.Event()
+    # a replica thread dying must surface as THE bench error, not as a
+    # silent hang until --max-wall-s (the survivors keep router.has_work
+    # true forever for the dead replica's stranded requests)
+    errors: List[BaseException] = []
+
+    def tick_loop(handle):
+        eng = handle.engine
+        try:
+            while not stop.is_set():
+                if eng.scheduler.has_work:
+                    with handle.lock:
+                        if not eng.scheduler.has_work:
+                            continue
+                        with span("serve.tick", step=eng.tick_index,
+                                  replica=handle.replica_id):
+                            eng.tick()
+                else:
+                    time.sleep(0.001)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            errors.append(e)
+            stop.set()
+
+    threads = [
+        threading.Thread(target=tick_loop, args=(h,), daemon=True,
+                         name=f"serve-replica-{h.replica_id}")
+        for h in handles if h.alive
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    pending = sorted(workload, key=lambda w: w[0])
+    idx = 0
+    shed = 0
+    try:
+        while True:
+            if errors:
+                raise RuntimeError(
+                    "a replica tick thread died"
+                ) from errors[0]
+            now = time.monotonic() - t0
+            if now > max_wall_s:
+                raise RuntimeError(
+                    f"fleet bench exceeded --max-wall-s={max_wall_s}: "
+                    f"{idx}/{len(pending)} submitted, "
+                    f"{sum(len(e.finished) for e in engines)} finished"
+                )
+            draining = any(h.engine.draining for h in handles if h.alive)
+            while not draining and idx < len(pending) and \
+                    pending[idx][0] * time_scale <= now:
+                arrival, prompt, olen = pending[idx]
+                res = router.submit(
+                    prompt, olen, arrival_s=t0 + arrival * time_scale
+                )
+                if isinstance(res, Backpressure):
+                    if res.draining:
+                        # SIGTERM raced this submission: unsubmitted
+                        draining = True
+                        break
+                    # the WHOLE fleet shed this offer: consumed,
+                    # journaled at fleet level (so --resume skip math
+                    # maps 1:1 onto workload items), AND counted on the
+                    # unlabeled serve_requests_shed_total counter — the
+                    # documented overload signal dashboards watch
+                    # (replicas skip their counters via count_shed)
+                    shed += 1
+                    get_registry().counter(
+                        "serve_requests_shed_total"
+                    ).inc()
+                    if fleet_journal is not None:
+                        fleet_journal.record_shed(res.reason)
+                idx += 1
+            if (draining or idx >= len(pending)) and not router.has_work:
+                break
+            time.sleep(0.002)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+
+    wall_s = time.monotonic() - t0
+    seqs = [s for e in engines for s in e.finished]
+    completed = [s for s in seqs if s.finish_status == "completed"]
+    ttfts = sorted(
+        s.first_token_s - s.request.arrival_s for s in seqs
+        if s.first_token_s is not None
+    )
+    itls: List[float] = []
+    for s in seqs:
+        itls.extend(b - a for a, b in zip(s.token_stamps, s.token_stamps[1:]))
+    itls.sort()
+    total_tokens = sum(len(s.generated) for s in seqs)
+
+    def pct(vals, q):
+        return percentile(vals, q) if vals else None
+
+    carry = carry or {}
+    c_completed = int(carry.get("completed", 0))
+    c_timeouts = int(carry.get("timeouts", 0))
+    c_shed = int(carry.get("shed", 0))
+    total_shed = shed + c_shed
+    total_timeouts = sum(e.timeout_count for e in engines) + c_timeouts
+    attempts = total_shed + total_timeouts + len(completed) + c_completed
+    hit = sum(e.scheduler.prefix_hit_tokens for e in engines)
+    prefilled = sum(e.prefilled_tokens for e in engines)
+    drafted = sum(e.spec_drafted_tokens for e in engines)
+    accepted = sum(e.spec_accepted_tokens for e in engines)
+    rstats = router.stats()
+    replica_rows = []
+    for h in handles:
+        e = h.engine
+        per = rstats["per_replica"].get(h.replica_id, {})
+        replica_rows.append({
+            "replica": h.replica_id,
+            "alive": h.alive,
+            "requests": sum(
+                1 for s in e.finished if s.finish_status == "completed"
+            ),
+            "output_tokens": sum(len(s.generated) for s in e.finished),
+            "timeouts": e.timeout_count,
+            "ticks": e.tick_index - start_ticks[h.replica_id],
+            "preemptions": e.scheduler.preemption_count,
+            "pool_pressure": round(e.scheduler.pool_pressure(), 4),
+            **per,
+        })
+    stats = {
+        "requests": len(completed) + c_completed,
+        "requests_timeout": total_timeouts,
+        "requests_shed": total_shed,
+        "shed_rate": (
+            round(total_shed / attempts, 4) if attempts else 0.0
+        ),
+        "drained": any(e.draining for e in engines),
+        "unsubmitted": len(pending) - idx,
+        "wall_s": round(wall_s, 6),
+        "output_tokens": total_tokens,
+        "prompt_tokens": sum(len(s.request.prompt) for s in seqs),
+        "tokens_per_s": round(total_tokens / wall_s, 3) if wall_s > 0 else 0.0,
+        "ttft_p50_s": pct(ttfts, 50),
+        "ttft_p99_s": pct(ttfts, 99),
+        "itl_p50_s": pct(itls, 50),
+        "itl_p99_s": pct(itls, 99),
+        "preemptions": sum(e.scheduler.preemption_count for e in engines),
+        "ticks": sum(
+            e.tick_index - start_ticks[h.replica_id]
+            for h, e in zip(handles, engines)
+        ),
+        "prefill_compiles": sum(e.prefill_program_count for e in engines),
+        "max_concurrent_prefills": max(
+            e.max_concurrent_prefills for e in engines
+        ),
+        "prefix_hit_tokens": hit,
+        "prefix_hit_rate": (
+            round(hit / (hit + prefilled), 4) if hit + prefilled else 0.0
+        ),
+        "prefilled_tokens": prefilled,
+        "spec_drafted_tokens": drafted,
+        "spec_accepted_tokens": accepted,
+        "spec_accept_rate": (
+            round(accepted / drafted, 4) if drafted else None
+        ),
+        "replicas": len(handles),
+        "replica_stats": replica_rows,
+        "router": rstats,
+        "engine": engine_shape_stats(engines[0], replicas=len(handles)),
+    }
+    if extra_stats:
+        stats.update(extra_stats)
+    logger.log_event("serve-summary", **stats)
+    get_registry().flush_step(max(e.tick_index for e in engines))
     return stats
 
 
@@ -357,10 +611,191 @@ def run_supervised(argv: List[str], args) -> int:
         signal.signal(signal.SIGTERM, prev)
 
 
+def _run_fleet(args, infs, workload, journal_base, make_engine,
+               warmup_engine) -> dict:
+    """Fleet mode (``--replicas N``): N engines behind the
+    prefix-affinity router, per-replica journal namespaces, SIGTERM
+    drain fan-out, one Poisson stream through ``run_fleet_bench``."""
+    from ..logging import logger
+    from .journal import journal_path, open_journal, replay_journal
+    from .router import FleetRouter, install_fleet_drain_handler
+
+    engines = [
+        make_engine(replica_id=r, inf_override=infs[r])
+        for r in range(args.replicas)
+    ]
+    router = FleetRouter(engines)
+    install_fleet_drain_handler(router)
+    fleet_journal = None
+    fleet_replay = None
+    replays = {}
+    if not args.no_journal:
+        for r, eng in enumerate(engines):
+            jr, rep = open_journal(journal_base, args.resume, replica_id=r)
+            eng.attach_journal(jr)
+            replays[r] = rep
+        # the fleet-level journal records only whole-fleet sheds (every
+        # replica said Backpressure): the resume skip math needs one
+        # record per CONSUMED workload item, and a shed offer produced
+        # no submit record in any replica journal
+        fleet_journal, fleet_replay = open_journal(journal_base, args.resume)
+    elif args.resume:
+        for r in range(args.replicas):
+            replays[r] = replay_journal(journal_path(journal_base, r))
+        fleet_replay = replay_journal(journal_base)
+    if args.warmup > 0:
+        for eng in engines:
+            warmup_engine(eng)
+    extra_stats = None
+    carry = None
+    offered = sum(
+        rep.offered_count for rep in replays.values() if rep is not None
+    ) + (fleet_replay.shed_count if fleet_replay is not None else 0)
+    if args.resume and offered:
+        incomplete_total = completed_total = timeout_total = 0
+        for r in sorted(replays):
+            rep = replays[r]
+            if rep is None:
+                continue
+            eng = engines[r]
+            eng._next_req_id = rep.next_req_id
+            # each replica replays its OWN journal namespace: original
+            # req_ids keep the sampler-key fold, so the regenerated
+            # tokens are the ones the crashed replica would have emitted
+            for rec in rep.incomplete:
+                eng.submit(
+                    rec["prompt"], rec["max_new_tokens"],
+                    eos_token_id=rec.get("eos_token_id"),
+                    temperature=rec.get("temperature", 0.0),
+                    top_k=rec.get("top_k"), top_p=rec.get("top_p"),
+                    deadline_ms=rec.get("deadline_ms"),
+                    ttft_deadline_ms=rec.get("ttft_deadline_ms"),
+                    req_id=int(rec["req"]), force=True,
+                )
+            incomplete_total += len(rep.incomplete)
+            completed_total += len(rep.completed)
+            timeout_total += rep.timeout_count
+        router.sync_next_req_id()
+        workload = sorted(workload, key=lambda w: w[0])[offered:]
+        if workload:
+            base = workload[0][0]
+            workload = [(a - base, p, o) for a, p, o in workload]
+        extra_stats = {
+            "resumed": True,
+            "replayed_incomplete": incomplete_total,
+            "replayed_completed": completed_total,
+        }
+        carry = {
+            "completed": completed_total,
+            "timeouts": timeout_total,
+            "shed": (
+                fleet_replay.shed_count if fleet_replay is not None else 0
+            ),
+        }
+        logger.log_event(
+            "serve-resume", incomplete=incomplete_total,
+            completed=completed_total, remaining_workload=len(workload),
+        )
+    return run_fleet_bench(
+        router, workload, max_wall_s=args.max_wall_s,
+        extra_stats=extra_stats, carry=carry, fleet_journal=fleet_journal,
+    )
+
+
+def _run_spec_sweep(args, sweep_ks, workload, make_engine,
+                    warmup_engine) -> dict:
+    """``--spec-k-sweep``: the SAME workload once per draft length k on
+    a fresh engine each, then a FINAL serve-summary (the last one in the
+    run dir — the one the analyzer and gates read) carrying the winning
+    arm's stats plus the whole sweep table. The tokens/s-optimal k is
+    the answer the ROADMAP raw-speed follow-on asked for; accept rate
+    per k rides along so the ``--assert-spec-accept-rate`` gate judges
+    the winner."""
+    from ..logging import logger
+    from .engine import install_drain_handler
+
+    arms = []
+    for k in sweep_ks:
+        eng = make_engine(spec_k=k)
+        install_drain_handler(eng)  # chains: SIGTERM drains current arm
+        if args.warmup > 0:
+            warmup_engine(eng)
+        arm = run_bench(
+            eng, list(workload), max_wall_s=args.max_wall_s,
+            tick_timeout_s=args.tick_timeout_s,
+            extra_stats={"spec_k": k},
+        )
+        arms.append(arm)
+        if eng.draining:
+            break  # SIGTERM mid-sweep: don't start another arm
+    best = max(arms, key=lambda a: a["tokens_per_s"])
+    stats = dict(best)
+    stats["spec_k_best"] = best["spec_k"]
+    stats["spec_k_sweep"] = [
+        {
+            "spec_k": a["spec_k"],
+            "tokens_per_s": a["tokens_per_s"],
+            "spec_accept_rate": a["spec_accept_rate"],
+            "ttft_p99_s": a["ttft_p99_s"],
+        }
+        for a in arms
+    ]
+    logger.log_event("serve-summary", **stats)
+    return stats
+
+
+def _apply_serving_config(args, argv: List[str], parser) -> None:
+    """Fold a tuner-emitted serving config (``tune --serve
+    --emit-config``) into the parsed args as DEFAULTS: any knob the user
+    passed explicitly on the command line wins over the file."""
+    try:
+        cfg = json.loads(Path(args.config).read_text())
+    except (OSError, ValueError) as e:
+        parser.error(f"--config {args.config}: unreadable ({e})")
+    passed = {
+        a[2:].split("=", 1)[0].replace("-", "_")
+        for a in argv if a.startswith("--")
+    }
+    for key in ("mp", "replicas", "block_size", "token_budget",
+                "num_slots", "num_blocks", "max_blocks_per_seq"):
+        if key in cfg and key not in passed:
+            setattr(args, key, int(cfg[key]))
+
+
+def _ensure_devices(need: int) -> None:
+    """The fleet needs ``replicas * mp`` jax devices. Off-TPU, force the
+    virtual host-platform device count BEFORE the first jax import (the
+    flag is inert after backend init — if jax is already up with too few
+    devices, fail actionably instead of queueing every replica on
+    device 0)."""
+    import os
+
+    if need <= 1:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if ("jax" not in sys.modules
+            and "--xla_force_host_platform_device_count" not in flags):
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={need}"
+        ).strip()
+    import jax
+
+    if len(jax.devices()) < need:
+        raise SystemExit(
+            f"error: --replicas x --mp needs {need} devices, found "
+            f"{len(jax.devices())}; off-TPU set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={need} before launch"
+        )
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m scaling_tpu.serve bench",
         description="continuous-batching serving benchmark (docs/SERVING.md)",
+        # no prefix abbreviations: _apply_serving_config decides which
+        # knobs the user passed explicitly by scanning argv, and an
+        # abbreviated flag would dodge the scan and lose to --config
+        allow_abbrev=False,
     )
     parser.add_argument("--requests", type=int, default=16)
     parser.add_argument("--rate", type=float, default=8.0,
@@ -394,6 +829,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="self-drafting speculative decoding: n-gram "
                         "draft tokens scored per decode row per tick "
                         "(0 = off)")
+    parser.add_argument("--spec-k-sweep", metavar="LIST",
+                        help="A/B the draft length: comma list of k "
+                        "values; the SAME workload runs once per k on a "
+                        "fresh engine, the serve-summary reports every "
+                        "arm plus the tokens/s-optimal k, and the "
+                        "--assert-spec-accept-rate gate judges the "
+                        "winning arm (single-replica; disables the "
+                        "journal — a sweep is a measurement drill)")
+    # ---- the fleet (docs/SERVING.md "The fleet") ----
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="data-parallel engine replicas behind the "
+                        "prefix-affinity router; ONE Poisson stream "
+                        "drives the fleet, each replica ticks on its own "
+                        "device group (toy model only off-chip)")
+    parser.add_argument("--mp", type=int, default=1,
+                        help="model-parallel shards per replica: KV "
+                        "pools shard over the model axis (each chip "
+                        "holds n_kv/mp heads) and the tick programs run "
+                        "SPMD; needs replicas*mp devices")
+    parser.add_argument("--config", metavar="FILE",
+                        help="tuner-emitted serving config (python -m "
+                        "scaling_tpu.tune --serve --emit-config): its "
+                        "mp/replicas/block_size/token_budget/num_slots/"
+                        "num_blocks become defaults; explicit flags win")
     parser.add_argument("--shared-prefix-len", type=int, default=0,
                         help="prefix-cache arm: every request shares one "
                         "of --prefix-families system prompts of this "
@@ -468,6 +927,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "exceeds CEIL seconds")
     argv = list(sys.argv[1:] if argv is None else argv)
     args = parser.parse_args(argv)
+    if args.config:
+        _apply_serving_config(args, argv, parser)
     if args.restarts > 0:
         return run_supervised(argv, args)
     if args.requests < 1:
@@ -478,6 +939,37 @@ def main(argv: Optional[List[str]] = None) -> int:
                                   ("--output-len", args.output_len, 1)):
         if lo < floor or hi < lo:
             parser.error(f"{flag} needs {floor} <= MIN <= MAX, got {lo} {hi}")
+    if args.replicas < 1 or args.mp < 1:
+        parser.error("--replicas and --mp must be >= 1")
+    fleet = args.replicas > 1
+    sweep_ks: Optional[List[int]] = None
+    if args.spec_k_sweep:
+        try:
+            sweep_ks = sorted({
+                int(x) for x in args.spec_k_sweep.split(",") if x.strip()
+            })
+        except ValueError:
+            sweep_ks = None
+        if not sweep_ks or any(k < 0 for k in sweep_ks):
+            parser.error(
+                f"bad --spec-k-sweep {args.spec_k_sweep!r} "
+                "(want a comma list of ints >= 0)"
+            )
+        if fleet:
+            parser.error("--spec-k-sweep is single-replica (the sweep "
+                         "measures the engine, not the router)")
+        if args.resume:
+            parser.error("--spec-k-sweep runs without a journal (it is "
+                         "a measurement drill); --resume has nothing to "
+                         "replay")
+    if args.checkpoint and fleet:
+        parser.error(
+            "--replicas > 1 serves the toy model only (an in-process "
+            "fleet of checkpoint-sized replicas is a dev harness, not a "
+            "deployment; production runs one process per replica)"
+        )
+    _ensure_devices(args.replicas * args.mp)
+    from .engine import EngineConfig, ServeEngine, install_drain_handler
 
     import os
 
@@ -495,13 +987,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.checkpoint:
         from ..models.transformer.inference import TransformerInferenceModule
 
-        inf = TransformerInferenceModule.from_checkpoint(args.checkpoint)
+        topology = (
+            {"model_parallel_size": args.mp} if args.mp > 1 else None
+        )
+        inf = TransformerInferenceModule.from_checkpoint(
+            args.checkpoint, topology=topology
+        )
+        infs = [inf]
         vocab = inf.architecture.vocab_size
     else:
-        inf = build_toy_inference(
-            hidden=args.hidden, layers=args.layers, vocab=args.vocab,
-            heads=args.heads,
-        )
+        # one model instance per replica, each on its own device group
+        # (offset r*mp): deterministic init keys mean every replica holds
+        # the SAME weights — a data-parallel serving fleet
+        infs = [
+            build_toy_inference(
+                hidden=args.hidden, layers=args.layers, vocab=args.vocab,
+                heads=args.heads, mp=args.mp, device_offset=r * args.mp,
+            )
+            for r in range(args.replicas)
+        ]
+        inf = infs[0]
         vocab = args.vocab
 
     cap = args.max_blocks_per_seq * args.block_size
@@ -517,45 +1022,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.shared_prefix_len > 0 and args.prefix_families < 1:
         parser.error("--prefix-families must be >= 1")
 
-    engine = ServeEngine(inf, EngineConfig(
-        num_slots=args.num_slots, block_size=args.block_size,
-        num_blocks=args.num_blocks,
-        max_blocks_per_seq=args.max_blocks_per_seq,
-        token_budget=args.token_budget, kv_dtype=args.kv_dtype,
-        prefill_chunk=args.prefill_chunk or None,
-        paged_kernel=args.paged_kernel,
-        fused_tick=not args.no_fused_tick,
-        enable_prefix_cache=not args.no_prefix_cache,
-        spec_k=args.spec_k,
-        default_deadline_ms=args.deadline_ms,
-        default_ttft_deadline_ms=args.ttft_deadline_ms,
-        shed_high_watermark=args.shed_high_watermark,
-        shed_low_watermark=args.shed_low_watermark,
-        max_waiting=args.max_waiting,
-    ))
-    # SIGTERM -> graceful drain: stop admitting, finish in-flight, flush
-    # telemetry, exit 0 with a parseable run dir
-    install_drain_handler(engine)
-    journal_path = run_dir / "journal.jsonl"
-    replay = None
-    if not args.no_journal:
-        from .journal import open_journal
+    def make_engine(replica_id=None, inf_override=None, spec_k=None):
+        return ServeEngine(inf_override or inf, EngineConfig(
+            num_slots=args.num_slots, block_size=args.block_size,
+            num_blocks=args.num_blocks,
+            max_blocks_per_seq=args.max_blocks_per_seq,
+            token_budget=args.token_budget, kv_dtype=args.kv_dtype,
+            prefill_chunk=args.prefill_chunk or None,
+            paged_kernel=args.paged_kernel,
+            fused_tick=not args.no_fused_tick,
+            enable_prefix_cache=not args.no_prefix_cache,
+            spec_k=args.spec_k if spec_k is None else spec_k,
+            default_deadline_ms=args.deadline_ms,
+            default_ttft_deadline_ms=args.ttft_deadline_ms,
+            shed_high_watermark=args.shed_high_watermark,
+            shed_low_watermark=args.shed_low_watermark,
+            max_waiting=args.max_waiting,
+            replica_id=replica_id,
+        ))
 
-        # --resume folds the crashed run's journal first; a fresh run
-        # truncates any stale one from a previous drill in this dir
-        journal, replay = open_journal(journal_path, args.resume)
-        engine.attach_journal(journal)
-    elif args.resume:
-        from .journal import replay_journal
-
-        replay = replay_journal(journal_path)
-    workload = sample_workload(
-        args.requests, args.rate, tuple(args.prompt_len),
-        tuple(args.output_len), vocab, args.seed,
-        shared_prefix_len=args.shared_prefix_len,
-        prefix_families=args.prefix_families,
-    )
-    if args.warmup > 0:
+    def warmup_engine(engine):
         # compile the tick programs off the clock: the first mixed-step
         # call jit-compiles for seconds, and an open-loop workload that
         # arrives during it measures the compiler, not the engine
@@ -565,59 +1051,93 @@ def main(argv: Optional[List[str]] = None) -> int:
         engine.run_until_done()
         engine.warmup_mode = False
         engine.finished.clear()
-    extra_stats = None
-    carry = None
-    if replay is not None and replay.offered_count:
-        from ..logging import logger
 
-        # crash-replay: re-enqueue every request without a terminal
-        # status under its ORIGINAL id (the sampler keys fold the id,
-        # so the regenerated tokens are the ones the crashed run would
-        # have emitted), then serve the workload tail the crashed run
-        # never reached. force=True: recovery work is never shed.
-        incomplete = replay.incomplete
-        engine._next_req_id = replay.next_req_id
-        for rec in incomplete:
-            engine.submit(
-                rec["prompt"], rec["max_new_tokens"],
-                eos_token_id=rec.get("eos_token_id"),
-                temperature=rec.get("temperature", 0.0),
-                top_k=rec.get("top_k"), top_p=rec.get("top_p"),
-                deadline_ms=rec.get("deadline_ms"),
-                ttft_deadline_ms=rec.get("ttft_deadline_ms"),
-                req_id=int(rec["req"]), force=True,
-            )
-        # skip every workload item the crashed run(s) CONSUMED — both
-        # admitted submissions and overload sheds (a shed offer was
-        # answered with Backpressure; re-offering it would double-serve
-        # the tail behind it)
-        done = replay.offered_count
-        workload = sorted(workload, key=lambda w: w[0])[done:]
-        if workload:
-            base = workload[0][0]  # the tail arrives from t=0 again
-            workload = [(a - base, p, o) for a, p, o in workload]
-        extra_stats = {
-            "resumed": True,
-            "replayed_incomplete": len(incomplete),
-            "replayed_completed": len(replay.completed),
-        }
-        # the crashed run(s)' terminal tallies fold into this run's
-        # summary so the gates judge the whole run dir
-        carry = {
-            "completed": len(replay.completed),
-            "timeouts": replay.timeout_count,
-            "shed": replay.shed_count,
-        }
-        logger.log_event(
-            "serve-resume", incomplete=len(incomplete),
-            completed=len(replay.completed),
-            remaining_workload=len(workload),
-        )
-    stats = run_bench(
-        engine, workload, max_wall_s=args.max_wall_s,
-        tick_timeout_s=args.tick_timeout_s, extra_stats=extra_stats,
-        carry=carry,
+    workload = sample_workload(
+        args.requests, args.rate, tuple(args.prompt_len),
+        tuple(args.output_len), vocab, args.seed,
+        shared_prefix_len=args.shared_prefix_len,
+        prefix_families=args.prefix_families,
     )
+    journal_base = run_dir / "journal.jsonl"
+
+    if fleet:
+        stats = _run_fleet(args, infs, workload, journal_base, make_engine,
+                           warmup_engine)
+    elif sweep_ks is not None:
+        stats = _run_spec_sweep(args, sweep_ks, workload, make_engine,
+                                warmup_engine)
+    else:
+        engine = make_engine()
+        # SIGTERM -> graceful drain: stop admitting, finish in-flight,
+        # flush telemetry, exit 0 with a parseable run dir
+        install_drain_handler(engine)
+        replay = None
+        if not args.no_journal:
+            from .journal import open_journal
+
+            # --resume folds the crashed run's journal first; a fresh run
+            # truncates any stale one from a previous drill in this dir
+            journal, replay = open_journal(journal_base, args.resume)
+            engine.attach_journal(journal)
+        elif args.resume:
+            from .journal import replay_journal
+
+            replay = replay_journal(journal_base)
+        if args.warmup > 0:
+            warmup_engine(engine)
+        extra_stats = None
+        carry = None
+        if replay is not None and replay.offered_count:
+            from ..logging import logger
+
+            # crash-replay: re-enqueue every request without a terminal
+            # status under its ORIGINAL id (the sampler keys fold the id,
+            # so the regenerated tokens are the ones the crashed run would
+            # have emitted), then serve the workload tail the crashed run
+            # never reached. force=True: recovery work is never shed.
+            incomplete = replay.incomplete
+            engine._next_req_id = replay.next_req_id
+            for rec in incomplete:
+                engine.submit(
+                    rec["prompt"], rec["max_new_tokens"],
+                    eos_token_id=rec.get("eos_token_id"),
+                    temperature=rec.get("temperature", 0.0),
+                    top_k=rec.get("top_k"), top_p=rec.get("top_p"),
+                    deadline_ms=rec.get("deadline_ms"),
+                    ttft_deadline_ms=rec.get("ttft_deadline_ms"),
+                    req_id=int(rec["req"]), force=True,
+                )
+            # skip every workload item the crashed run(s) CONSUMED — both
+            # admitted submissions and overload sheds (a shed offer was
+            # answered with Backpressure; re-offering it would double-serve
+            # the tail behind it)
+            done = replay.offered_count
+            workload = sorted(workload, key=lambda w: w[0])[done:]
+            if workload:
+                base = workload[0][0]  # the tail arrives from t=0 again
+                workload = [(a - base, p, o) for a, p, o in workload]
+            extra_stats = {
+                "resumed": True,
+                "replayed_incomplete": len(incomplete),
+                "replayed_completed": len(replay.completed),
+            }
+            # the crashed run(s)' terminal tallies fold into this run's
+            # summary so the gates judge the whole run dir
+            carry = {
+                "completed": len(replay.completed),
+                "timeouts": replay.timeout_count,
+                "shed": replay.shed_count,
+            }
+            logger.log_event(
+                "serve-resume", incomplete=len(incomplete),
+                completed=len(replay.completed),
+                remaining_workload=len(workload),
+            )
+        stats = run_bench(
+            engine, workload, max_wall_s=args.max_wall_s,
+            tick_timeout_s=args.tick_timeout_s, extra_stats=extra_stats,
+            carry=carry,
+        )
 
     print("== serve bench ==")
     print(f"  requests={stats['requests']} wall={stats['wall_s']:.3f}s "
@@ -634,13 +1154,41 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"prefill_chunk={args.prefill_chunk or 'off'} "
           f"fused_tick={not args.no_fused_tick} "
           f"max_concurrent_prefills={stats['max_concurrent_prefills']}")
+    if args.mp > 1:
+        print(f"  sharding: mp={args.mp} (KV pools sharded over the "
+              f"model axis, {args.mp}x less pool memory per chip)")
+    if stats.get("replicas", 1) > 1:
+        r = stats["router"]
+        print(f"  fleet: replicas={stats['replicas']} "
+              f"affinity_hits={r['affinity_dispatches']}/{r['dispatches']} "
+              f"({r['affinity_hit_rate']:.1%}) "
+              f"retries_elsewhere={r['retries_elsewhere']} "
+              f"rejected={r['rejected']}")
+        for row in stats["replica_stats"]:
+            print(f"    replica {row['replica']}: "
+                  f"requests={row['requests']} "
+                  f"tokens={row['output_tokens']} "
+                  f"dispatches={row.get('dispatches', 0)} "
+                  f"ticks={row['ticks']} "
+                  f"pressure={row['pool_pressure']:.2f}"
+                  + ("" if row.get("alive", True) else " [FAILED]"))
+    if stats.get("spec_k_sweep"):
+        print(f"  spec-k sweep (best k={stats['spec_k_best']}):")
+        for row in stats["spec_k_sweep"]:
+            ar = row["spec_accept_rate"]
+            mark = " <- best" if row["spec_k"] == stats["spec_k_best"] else ""
+            print(f"    k={row['spec_k']}: {row['tokens_per_s']:.1f} tok/s "
+                  f"accept="
+                  f"{'n/a' if ar is None else format(ar, '.1%')}{mark}")
     if stats["prefix_hit_tokens"]:
         print(f"  prefix cache: {stats['prefix_hit_tokens']} tokens hit, "
               f"{stats['prefilled_tokens']} prefilled "
               f"({stats['prompt_tokens']} prompt tokens submitted; "
               f"hit rate {stats['prefix_hit_rate']:.1%})")
     if stats["spec_accept_rate"] is not None:
-        print(f"  speculation: k={args.spec_k} accepted "
+        # a sweep's final stats describe the WINNING arm, not --spec-k
+        spec_k = stats.get("engine", {}).get("spec_k", args.spec_k)
+        print(f"  speculation: k={spec_k} accepted "
               f"{stats['spec_accepted_tokens']}/"
               f"{stats['spec_drafted_tokens']} drafts "
               f"(accept rate {stats['spec_accept_rate']:.1%})")

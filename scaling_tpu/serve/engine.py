@@ -58,7 +58,13 @@ from typing import Dict, List, Optional
 from .. import obs
 from ..logging import logger
 from ..resilience.faults import get_fault_plan
-from .kvcache import PagedKVPools, build_layer_views, init_pools, write_prompt_kv
+from .kvcache import (
+    PagedKVPools,
+    build_layer_views,
+    init_pools,
+    serving_mesh,
+    write_prompt_kv,
+)
 from .scheduler import (
     Backpressure,
     ContinuousBatchingScheduler,
@@ -123,6 +129,12 @@ class EngineConfig:
     shed_high_watermark: Optional[float] = None
     shed_low_watermark: Optional[float] = None
     max_waiting: Optional[int] = None
+    # fleet identity (docs/SERVING.md "The fleet"): set by the router /
+    # fleet bench so this replica's metrics carry a ``replica`` label,
+    # its serve-request events a ``replica`` field, and its journal a
+    # per-replica namespace. None = the single-engine deployment (all
+    # telemetry names unchanged).
+    replica_id: Optional[int] = None
 
     def __post_init__(self):
         if self.paged_kernel not in ("pallas", "xla"):
@@ -188,6 +200,20 @@ class ServeEngine:
         self.scheduler = ContinuousBatchingScheduler(
             self.config.scheduler_config()
         )
+        # mp>1 sharded serving: the pools shard over the model axis and
+        # every program runs SPMD over the serving mesh (one mixed
+        # program, now partitioned; activation all-reduces come from the
+        # same GSPMD constraints training's model axis uses)
+        self.mesh = serving_mesh(inference_module)
+        self.model_parallel = (
+            1 if self.mesh is None
+            else int(self.mesh.shape.get("model", 1))
+        )
+        self._replicated = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._replicated = NamedSharding(self.mesh, P())
         self.pools: PagedKVPools = init_pools(
             inference_module, self.config.num_blocks, self.config.block_size,
             kv_dtype=self.config.kv_dtype,
@@ -206,7 +232,9 @@ class ServeEngine:
         self._topp = np.zeros((n,), np.float32)
         self._reqid = np.zeros((n,), np.int32)
         self._gen = np.zeros((n,), np.int32)
-        self._base_key = jax.random.PRNGKey(self.config.sample_seed)
+        self._base_key = self._dev(
+            jax.random.PRNGKey(self.config.sample_seed)
+        )
         self._decode_fn = None
         self._prefill_fns: Dict[int, object] = {}  # whole-prompt buckets
         self._chunk_fns: Dict[int, object] = {}  # chunk-size -> program
@@ -221,6 +249,19 @@ class ServeEngine:
         # workload, not the off-the-clock compile traffic)
         self.warmup_mode = False
         self._reg = obs.get_registry()
+        # fleet mode: every metric this replica records carries a
+        # ``replica`` label so per-replica pressure/shed/timeout rows
+        # stay separable in the obs report (single-engine: no label, so
+        # pre-fleet metric names — and their tests — are unchanged)
+        self.replica_id = self.config.replica_id
+        self._labels = (
+            {"replica": str(self.replica_id)}
+            if self.replica_id is not None else None
+        )
+        self._replica_fields = (
+            {"replica": self.replica_id}
+            if self.replica_id is not None else {}
+        )
         self._prefix_hits_flushed = 0  # scheduler counter already mirrored
         self.prefilled_tokens = 0  # prompt tokens actually prefilled
         self.spec_drafted_tokens = 0
@@ -235,8 +276,14 @@ class ServeEngine:
         self._journal_pending: Dict[int, List[int]] = {}
         # live requests carrying any deadline: the tick-boundary expiry
         # sweep is skipped entirely while this is zero (the default
-        # no-deadline configuration must not pay O(live) per tick)
+        # no-deadline configuration must not pay O(live) per tick).
+        # Guarded by its own lock: in a fleet the router's submit thread
+        # increments while the replica's tick thread decrements, and a
+        # lost update that read 0 would silently skip live deadlines.
+        import threading
+
         self._deadline_live = 0
+        self._deadline_lock = threading.Lock()
 
     # ------------------------------------------------------------- intake
     def submit(self, prompt: List[int], max_new_tokens: int,
@@ -248,7 +295,8 @@ class ServeEngine:
                deadline_ms: Optional[float] = None,
                ttft_deadline_ms: Optional[float] = None,
                req_id: Optional[int] = None,
-               force: bool = False):
+               force: bool = False,
+               count_shed: bool = True):
         """Admit one request, or reject it with a structured
         :class:`Backpressure` (draining, or over the shed watermarks) —
         the signal a fleet router retries elsewhere on. Returns the
@@ -260,7 +308,12 @@ class ServeEngine:
         ``ttft_deadline_ms`` override the EngineConfig defaults.
         ``force`` bypasses drain/backpressure rejection — journal
         replay re-enqueues recovery work, not new load, and must never
-        be shed by the very overload policy the crash left armed."""
+        be shed by the very overload policy the crash left armed.
+        ``count_shed=False`` returns the Backpressure WITHOUT counting
+        or journaling it: the fleet router passes it because a rejection
+        it retries on another replica is not a client-visible shed (the
+        router counts the fleet-level rejection itself, and the journal
+        shed records must map 1:1 onto consumed workload items)."""
         get_fault_plan().fire("serve.admit")
         if force:
             bp = None
@@ -273,19 +326,20 @@ class ServeEngine:
         else:
             bp = self.scheduler.admission_backpressure()
         if bp is not None:
-            if not self.warmup_mode:
+            if not self.warmup_mode and count_shed:
                 # a draining rejection is shutdown, not overload: it
                 # stays out of the shed rate the overload gates judge
                 # AND out of the journal (the bench does not consume
                 # the workload item — it stays unsubmitted)
                 if not bp.draining:
                     self.shed_count += 1
-                    self._reg.counter("serve_requests_shed_total").inc()
+                    self._counter("serve_requests_shed_total").inc()
                     if self.journal is not None:
                         self.journal.record_shed(bp.reason)
                 logger.log_event(
                     "serve-shed", _level="debug", reason=bp.reason,
                     pool_pressure=bp.pool_pressure, waiting=bp.waiting,
+                    **self._replica_fields,
                 )
             return bp
         if req_id is None:
@@ -308,9 +362,10 @@ class ServeEngine:
         )
         seq = self.scheduler.add_request(req)
         if req.deadline_ms is not None or req.ttft_deadline_ms is not None:
-            self._deadline_live += 1
+            with self._deadline_lock:
+                self._deadline_live += 1
         if not self.warmup_mode:
-            self._reg.counter("serve_requests_admitted_total").inc()
+            self._counter("serve_requests_admitted_total").inc()
             if self.journal is not None:
                 self.journal.record_submit(req)
         return seq
@@ -334,9 +389,32 @@ class ServeEngine:
             "serve-drain", tick=self.tick_index,
             running=len(self.scheduler.running),
             waiting=len(self.scheduler.waiting),
+            **self._replica_fields,
         )
 
     # --------------------------------------------------- device programs
+    def _dev(self, x):
+        """Host array(s) -> device operand(s). On a serving mesh the
+        host-side addressing state (tables, lengths, tokens, sampler
+        rows) is device_put REPLICATED so every program call mixes
+        cleanly with the mesh-sharded pools and params; off-mesh it is a
+        plain transfer to the engine's device. Accepts a tuple and moves
+        it as ONE batched device_put — the mixed program's nine per-tick
+        operands cost one dispatch, not nine (the host-side tick
+        overhead is what caps fleet thread overlap)."""
+        if self._replicated is None:
+            return self._jax.device_put(x)
+        return self._jax.device_put(x, self._replicated)
+
+    def _counter(self, name: str):
+        return self._reg.counter(name, self._labels)
+
+    def _gauge(self, name: str):
+        return self._reg.gauge(name, self._labels)
+
+    def _histogram(self, name: str):
+        return self._reg.histogram(name, self._labels)
+
     def _pool_state(self):
         p = self.pools
         return (p.pool_k, p.pool_v, p.scale_k, p.scale_v)
@@ -588,12 +666,13 @@ class ServeEngine:
         self._admit_slot(seq)
         with self._span("serve.prefill", step=self.tick_index,
                       tokens=len(prompt)):
+            operands = self._dev((
+                tokens, block_row, np.int32(len(prompt)),
+                *self._scalar_sample_args(seq),
+            ))
             next_tok, new_views = self._prefill_fns[bucket](
-                self.inf.params, self._pool_state(),
-                self._jax.numpy.asarray(tokens),
-                self._jax.numpy.asarray(block_row),
-                self._jax.numpy.int32(len(prompt)),
-                *self._scalar_sample_args(seq), self._base_key,
+                self.inf.params, self._pool_state(), *operands,
+                self._base_key,
             )
             tok = int(np.asarray(next_tok)[0])
         self._absorb(new_views)
@@ -606,7 +685,7 @@ class ServeEngine:
         self._emit_token(seq, tok, now)
         if not self.warmup_mode:
             self.prefilled_tokens += len(prompt)
-            self._reg.counter("serve_prefill_tokens_total").inc(len(prompt))
+            self._counter("serve_prefill_tokens_total").inc(len(prompt))
 
     def _run_prefill_chunk(self, seq: Sequence) -> None:
         """One fixed-size chunk of ``seq``'s prompt: scatter its KV into
@@ -630,13 +709,14 @@ class ServeEngine:
         finishing = start + n_real == len(prompt)
         with self._span("serve.prefill_chunk", step=self.tick_index,
                       tokens=n_real, start=start):
+            operands = self._dev((
+                tokens, block_row, np.asarray([start], np.int32),
+                np.asarray([n_real], np.int32),
+                *self._scalar_sample_args(seq),
+            ))
             next_tok, new_views = self._chunk_fns[chunk](
-                self.inf.params, self._pool_state(),
-                self._jax.numpy.asarray(tokens),
-                self._jax.numpy.asarray(block_row),
-                self._jax.numpy.asarray([start], np.int32),
-                self._jax.numpy.asarray([n_real], np.int32),
-                *self._scalar_sample_args(seq), self._base_key,
+                self.inf.params, self._pool_state(), *operands,
+                self._base_key,
             )
             tok = int(np.asarray(next_tok)[0])
         self._absorb(new_views)
@@ -646,7 +726,7 @@ class ServeEngine:
         seq.num_cached = start + n_real
         if not self.warmup_mode:
             self.prefilled_tokens += n_real
-            self._reg.counter("serve_prefill_tokens_total").inc(n_real)
+            self._counter("serve_prefill_tokens_total").inc(n_real)
         if finishing:
             self._tok[slot] = tok
             self._emit_token(seq, tok, time.monotonic())
@@ -672,16 +752,12 @@ class ServeEngine:
         ctx = np.where(active, self._ctx, 0)
         with self._span("serve.decode", step=self.tick_index,
                       batch=len(decodes)):
+            operands = self._dev((
+                tables, ctx, self._tok, self._temp, self._topp,
+                self._topk, self._reqid, self._gen,
+            ))
             next_tok, new_views = self._decode_fn(
-                self.inf.params, self._pool_state(),
-                self._jax.numpy.asarray(tables),
-                self._jax.numpy.asarray(ctx),
-                self._jax.numpy.asarray(self._tok),
-                self._jax.numpy.asarray(self._temp),
-                self._jax.numpy.asarray(self._topp),
-                self._jax.numpy.asarray(self._topk),
-                self._jax.numpy.asarray(self._reqid),
-                self._jax.numpy.asarray(self._gen),
+                self.inf.params, self._pool_state(), *operands,
                 self._base_key,
             )
             toks = np.asarray(next_tok)
@@ -711,7 +787,7 @@ class ServeEngine:
                     continue
                 for i in range(len(arrs)):
                     arrs[i] = arrs[i].at[dst].set(arrs[i][src])
-        self._reg.counter("serve_cow_forks_total").inc(len(pairs))
+        self._counter("serve_cow_forks_total").inc(len(pairs))
 
     def _run_mixed(self, t: Tick) -> None:
         """The fused tick (Sarathi piggybacking): ONE program call
@@ -764,13 +840,13 @@ class ServeEngine:
         # land in the trash block and they expose zero visible slots
         with self._span("serve.mixed", step=self.tick_index,
                       decodes=len(t.decodes), chunks=len(t.prefills)):
+            operands = self._dev((
+                tables, ctx, tokens, new_lens, self._temp, self._topp,
+                self._topk, self._reqid, gen0,
+            ))
             sampled, new_views = self._mixed_fns[width](
-                self.inf.params, self._pool_state(),
-                jnp.asarray(tables), jnp.asarray(ctx),
-                jnp.asarray(tokens), jnp.asarray(new_lens),
-                jnp.asarray(self._temp), jnp.asarray(self._topp),
-                jnp.asarray(self._topk), jnp.asarray(self._reqid),
-                jnp.asarray(gen0), self._base_key,
+                self.inf.params, self._pool_state(), *operands,
+                self._base_key,
             )
             sampled = np.asarray(sampled)
         self._absorb(new_views)
@@ -783,7 +859,7 @@ class ServeEngine:
             self._ctx[slot] = seq.num_cached
             if not self.warmup_mode:
                 self.prefilled_tokens += n_real
-                self._reg.counter("serve_prefill_tokens_total").inc(n_real)
+                self._counter("serve_prefill_tokens_total").inc(n_real)
             if seq.num_cached == seq.prefill_len:
                 # original position n_real - 1, gathered at index
                 # n_real - 1 - g0 with g0 = max(n_real - sw, 0)
@@ -825,11 +901,11 @@ class ServeEngine:
         self.spec_drafted_tokens += len(draft)
         self.spec_accepted_tokens += accepted if draft else 0
         if draft:
-            self._reg.counter("serve_spec_drafted_tokens_total").inc(
+            self._counter("serve_spec_drafted_tokens_total").inc(
                 len(draft)
             )
             if accepted:
-                self._reg.counter("serve_spec_accepted_tokens_total").inc(
+                self._counter("serve_spec_accepted_tokens_total").inc(
                     accepted
                 )
         seq.draft = []
@@ -856,16 +932,16 @@ class ServeEngine:
         if seq.first_token_s is None:
             seq.first_token_s = now
             if not self.warmup_mode:
-                self._reg.histogram("serve_ttft_seconds").observe(
+                self._histogram("serve_ttft_seconds").observe(
                     now - seq.request.arrival_s
                 )
         elif seq.token_stamps and not self.warmup_mode:
-            self._reg.histogram("serve_itl_seconds").observe(
+            self._histogram("serve_itl_seconds").observe(
                 now - seq.token_stamps[-1]
             )
         seq.token_stamps.append(now)
         if not self.warmup_mode:
-            self._reg.counter("serve_tokens_generated_total").inc()
+            self._counter("serve_tokens_generated_total").inc()
 
     def _finish(self, seq: Sequence, now: float) -> None:
         self.scheduler.finish(seq)  # row reset rides the freed-slot drain
@@ -881,19 +957,22 @@ class ServeEngine:
         self.finished.append(seq)
         req = seq.request
         if req.deadline_ms is not None or req.ttft_deadline_ms is not None:
-            self._deadline_live -= 1
+            with self._deadline_lock:
+                self._deadline_live -= 1
         if self.warmup_mode:
             return
         if self.journal is not None:
             pending = self._journal_pending.pop(seq.request.req_id, None)
-            if pending:
-                self.journal.record_tokens(seq.request.req_id, pending)
-            self.journal.record_finish(seq.request.req_id, status)
+            # final tokens + terminal status ride ONE append (tokens
+            # strictly before status within it)
+            self.journal.record_finish(
+                seq.request.req_id, status, tokens=pending
+            )
         if status == "completed":
-            self._reg.counter("serve_requests_completed_total").inc()
+            self._counter("serve_requests_completed_total").inc()
         else:
             self.timeout_count += 1
-            self._reg.counter("serve_requests_timeout_total").inc()
+            self._counter("serve_requests_timeout_total").inc()
         itl = [
             b - a for a, b in zip(seq.token_stamps, seq.token_stamps[1:])
         ]
@@ -905,6 +984,7 @@ class ServeEngine:
             e2e_s=round(now - seq.request.arrival_s, 6),
             itl_mean_s=round(sum(itl) / len(itl), 6) if itl else 0.0,
             preemptions=seq.preemptions,
+            **self._replica_fields,
         )
         if seq.first_token_s is not None:
             # a TTFT-deadline timeout never produced a first token — the
@@ -952,10 +1032,10 @@ class ServeEngine:
                 self.scheduler.propose_drafts()
         t = self.scheduler.schedule()
         if t.preempted:
-            self._reg.counter("serve_preemptions_total").inc(len(t.preempted))
+            self._counter("serve_preemptions_total").inc(len(t.preempted))
         sched = self.scheduler
         if sched.prefix_hit_tokens > self._prefix_hits_flushed:
-            self._reg.counter("serve_prefix_hit_tokens_total").inc(
+            self._counter("serve_prefix_hit_tokens_total").inc(
                 sched.prefix_hit_tokens - self._prefix_hits_flushed
             )
             self._prefix_hits_flushed = sched.prefix_hit_tokens
@@ -981,15 +1061,16 @@ class ServeEngine:
                 self._finish(seq, now)
         self._reset_rows(self.scheduler.drain_freed_slots())
         if self.journal is not None and self._journal_pending:
-            # one journal line per (request, tick); completions already
-            # flushed theirs inside _retire (tokens before status)
-            for rid in sorted(self._journal_pending):
-                self.journal.record_tokens(rid, self._journal_pending[rid])
+            # ONE append for every row's tick tokens (completions
+            # already flushed theirs inside _retire, tokens before
+            # status): per-row appends convoyed the fleet's tick
+            # threads on the GIL
+            self.journal.record_tokens_batch(self._journal_pending)
             self._journal_pending.clear()
         for name, value in self.scheduler.gauges().items():
-            self._reg.gauge(name).set(value)
+            self._gauge(name).set(value)
         if self.spec_drafted_tokens:
-            self._reg.gauge("serve_spec_accept_rate").set(
+            self._gauge("serve_spec_accept_rate").set(
                 self.spec_accepted_tokens / self.spec_drafted_tokens
             )
         self.tick_index += 1

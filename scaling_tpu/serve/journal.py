@@ -85,8 +85,43 @@ class RequestJournal:
             "toks": [int(t) for t in tokens],
         })
 
-    def record_finish(self, req_id: int, status: str) -> None:
-        self._append({"kind": FINISH, "req": req_id, "status": status})
+    def record_tokens_batch(self, batches: Dict[int, List[int]]) -> None:
+        """EVERY row's tick tokens in ONE append: same per-request
+        record lines, one ``write(2)``. A tick that appended 12 separate
+        lines paid 12 GIL release/re-acquire round-trips — in the
+        threaded fleet each re-acquire can wait a whole switch interval
+        behind a peer replica's tick, and the convoy quadrupled tick
+        counts. One syscall keeps the journal off the critical path."""
+        lines = [
+            json.dumps(
+                {"kind": TOKENS, "req": int(rid),
+                 "toks": [int(t) for t in toks]},
+                sort_keys=True,
+            )
+            for rid, toks in sorted(batches.items()) if toks
+        ]
+        if not lines:
+            return
+        get_fault_plan().fire("serve.journal", path=self.path)
+        append_jsonl_line(self.path, "\n".join(lines))
+
+    def record_finish(self, req_id: int, status: str,
+                      tokens: Optional[List[int]] = None) -> None:
+        """Terminal status — with ``tokens``, the request's final token
+        batch rides the SAME append (one write per retirement, not
+        two)."""
+        recs = []
+        if tokens:
+            recs.append({
+                "kind": TOKENS, "req": req_id,
+                "toks": [int(t) for t in tokens],
+            })
+        recs.append({"kind": FINISH, "req": req_id, "status": status})
+        get_fault_plan().fire("serve.journal", path=self.path)
+        append_jsonl_line(
+            self.path,
+            "\n".join(json.dumps(r, sort_keys=True) for r in recs),
+        )
 
     def record_shed(self, reason: str) -> None:
         """An overload-shed submission consumed a client offer without
@@ -154,7 +189,20 @@ class JournalReplay:
         }
 
 
-def open_journal(path, resume: bool):
+def journal_path(base_path, replica_id: Optional[int] = None) -> Path:
+    """The journal file for one engine: the base path itself for a
+    single-engine run, ``<stem>_r<id><suffix>`` for fleet replica
+    ``id``. Namespacing per replica is what lets a fleet ``--resume``
+    replay each replica's incomplete requests from its OWN stream —
+    one shared file would interleave N writers (torn lines beyond the
+    single-writer O_APPEND guarantee) and collide their tallies."""
+    p = Path(base_path)
+    if replica_id is None:
+        return p
+    return p.with_name(f"{p.stem}_r{int(replica_id)}{p.suffix}")
+
+
+def open_journal(path, resume: bool, replica_id: Optional[int] = None):
     """The bench's journal lifecycle: returns ``(journal, replay)``.
 
     ``resume=True`` folds the existing journal FIRST (the crashed
@@ -162,8 +210,11 @@ def open_journal(path, resume: bool):
     FRESH run: any stale journal from a previous drill in the same run
     dir is truncated — the appender is O_APPEND by design (SIGKILL
     safety), so without this a later ``--resume`` would replay the
-    previous run's request stream into the new workload."""
-    p = Path(path)
+    previous run's request stream into the new workload.
+
+    ``replica_id`` namespaces the file per fleet replica
+    (:func:`journal_path`) so N engine writers never share a stream."""
+    p = journal_path(path, replica_id)
     replay = None
     if resume:
         replay = replay_journal(p)

@@ -16,6 +16,17 @@ choices can never drift from the attention that fills the pool.
 scales; the quantizer lives in ``nn/attention.py`` (``kv_quantize_int8``)
 so the prefill writer here and the decode-step write inside
 ``ParallelSelfAttention`` round identically.
+
+**mp > 1 (sharded serving, docs/SERVING.md "The fleet"):** when the
+inference module rides a mesh with ``model_parallel_size > 1``, each
+pool is SHARDED over the model axis on its kv-head dim — every mp shard
+owns the ``(num_blocks, block_size, n_kv/mp, h)`` slice matching the
+attention heads it computes, so pool memory per chip drops mp-fold and
+big models' caches fit. Block tables / context lengths stay replicated
+host state (they are addressing, not content), the engine's jitted
+programs run SPMD over the serving mesh, and the Pallas paged kernel
+runs per-shard on its slice (nn/attention.py wraps it in shard_map —
+pallas calls are opaque to GSPMD).
 """
 
 from __future__ import annotations
@@ -30,6 +41,16 @@ from ..nn.attention import (
     paged_flat_slots,
     paged_scatter_kv,
 )
+
+
+def serving_mesh(inference_module):
+    """The inference module's mesh when it is model-parallel, else None —
+    the ONE predicate the engine, the pool allocator and the audit
+    section use to decide whether serving state must be mesh-placed."""
+    topo = getattr(inference_module.module, "topology", None)
+    if topo is None or topo.model_parallel_size <= 1:
+        return None
+    return topo.mesh
 
 
 def build_layer_views(
@@ -112,7 +133,12 @@ def init_pools(inference_module, num_blocks: int, block_size: int,
     """Allocate zeroed pools shaped by probing the real layer stack.
 
     ``kv_dtype``: ``'native'`` keeps the probe's KV dtype (the model's
-    compute dtype); ``'int8'`` stores int8 values + float32 scales."""
+    compute dtype); ``'int8'`` stores int8 values + float32 scales.
+
+    On a model-parallel mesh each pool is sharded over the model axis on
+    its kv-head dim (shape stays the GLOBAL ``(num_blocks, block_size,
+    n_kv, h)``; every shard holds ``n_kv/mp`` heads) — the jitted
+    programs compile SPMD and per-chip pool memory drops mp-fold."""
     if kv_dtype not in ("native", "int8"):
         raise ValueError(f"kv_dtype must be 'native' or 'int8', got {kv_dtype!r}")
     params = inference_module.params
@@ -123,15 +149,47 @@ def init_pools(inference_module, num_blocks: int, block_size: int,
         return inference_module.prefill_forward(p, t, po)[1]
 
     kv_shapes = jax.eval_shape(probe, params, probe_tokens, probe_pos)
-    # commit the fresh pools to the device the programs will run on: an
-    # uncommitted zeros-array keys a SECOND executable-cache entry for
+    # commit the fresh pools to the device(s) the programs will run on:
+    # an uncommitted zeros-array keys a SECOND executable-cache entry for
     # the engine's very first program call (every later call sees the
     # committed jit outputs absorb_views hands back) — a silent 2x
     # compile of the largest serving programs
-    device = jax.local_devices()[0]
+    mesh = serving_mesh(inference_module)
+    if mesh is None:
+        # co-locate the pools with the params: the fleet bench places
+        # each replica's params on its own device, and the pools (and so
+        # every jitted program) must follow — mixed placements would pin
+        # every replica back onto device 0
+        device = jax.local_devices()[0]
+        leaves = jax.tree_util.tree_leaves(params)
+        if leaves and hasattr(leaves[0], "devices"):
+            leaf_devices = leaves[0].devices()
+            if len(leaf_devices) == 1:
+                device = next(iter(leaf_devices))
 
-    def zeros(shape, dtype):
-        return jax.device_put(jnp.zeros(shape, dtype), device)
+        def placed(shape, dtype, head_dim):
+            del head_dim
+            return jax.device_put(jnp.zeros(shape, dtype), device)
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..topology.topology import MODEL_AXIS
+
+        mp = mesh.shape[MODEL_AXIS]
+
+        def placed(shape, dtype, head_dim):
+            n_kv = shape[head_dim]
+            if n_kv % mp:
+                raise ValueError(
+                    f"mp={mp} sharded serving needs kv heads divisible by "
+                    f"the model axis; this stack has n_kv={n_kv} — pick an "
+                    f"mp that divides it (docs/SERVING.md)"
+                )
+            spec = [None] * len(shape)
+            spec[head_dim] = MODEL_AXIS
+            return jax.device_put(
+                jnp.zeros(shape, dtype), NamedSharding(mesh, P(*spec))
+            )
 
     pool_k: List[jax.Array] = []
     pool_v: List[jax.Array] = []
@@ -140,11 +198,15 @@ def init_pools(inference_module, num_blocks: int, block_size: int,
     for k_aval, v_aval in kv_shapes:
         n_kv, h = k_aval.shape[2], k_aval.shape[3]
         store = jnp.int8 if kv_dtype == "int8" else k_aval.dtype
-        pool_k.append(zeros((num_blocks, block_size, n_kv, h), store))
-        pool_v.append(zeros((num_blocks, block_size, n_kv, h), store))
+        pool_k.append(placed((num_blocks, block_size, n_kv, h), store, 2))
+        pool_v.append(placed((num_blocks, block_size, n_kv, h), store, 2))
         if kv_dtype == "int8":
-            scale_k.append(zeros((num_blocks, block_size, n_kv), jnp.float32))
-            scale_v.append(zeros((num_blocks, block_size, n_kv), jnp.float32))
+            scale_k.append(
+                placed((num_blocks, block_size, n_kv), jnp.float32, 2)
+            )
+            scale_v.append(
+                placed((num_blocks, block_size, n_kv), jnp.float32, 2)
+            )
     return PagedKVPools(pool_k, pool_v, scale_k, scale_v, block_size)
 
 

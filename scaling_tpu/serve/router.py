@@ -1,0 +1,322 @@
+"""The fleet router: N data-parallel engine replicas behind one dispatcher.
+
+The scale-OUT half of serving (docs/SERVING.md "The fleet"): one engine
+was pushed to 45k tokens/s/chip; the next multiplicative win is N
+engines. Every signal this router consumes landed with the resilience
+work — structured :class:`~.scheduler.Backpressure` from watermark
+admission, the ``serve_pool_pressure`` gauge, SIGTERM drain, and the
+per-replica crash-replay journal — so the router is pure dispatch
+policy over :class:`~.engine.ServeEngine` replicas:
+
+- **least-loaded dispatch**: a new request goes to the live replica
+  with the smallest (queue depth, pool pressure) — exactly the numbers
+  the ``serve_waiting_seqs`` / ``serve_pool_pressure`` gauges export,
+  so the router and a post-mortem read the same load signal;
+- **prefix-affinity dispatch**: the router hashes the prompt's leading
+  FULL blocks (the prefix trie's sharing granularity — a partial block
+  can never be reused, scheduler.PrefixCache) and remembers which
+  replica last served each block-chain; a prompt whose longest hashed
+  chain maps to a live replica goes there, so a prompt family's shared
+  system prefix is prefilled once per REPLICA instead of once per
+  request-shuffle. Hash-based rather than trie-introspecting on
+  purpose: the policy needs nothing but the prompt bytes, so it holds
+  across process boundaries when replicas move out-of-process;
+- **retry-elsewhere**: a replica answering ``submit`` with
+  :class:`Backpressure` is not the fleet saying no — the router retries
+  the remaining live replicas in load order and only surfaces
+  Backpressure when EVERY replica shed (the client-visible overload
+  signal);
+- **drain fan-out**: ``begin_drain`` drains every replica (the fleet
+  mirror of single-engine SIGTERM drain — the bench wires the handler);
+- **replica failure**: ``fail_replica`` drops a crashed replica from
+  dispatch (its in-flight work is recoverable from its OWN journal
+  namespace — serve/journal.py ``journal_path``); ``restore_replica``
+  re-registers a relaunched engine under the same id.
+
+Pure host-side policy, jax-free at import: the engines own the device
+programs. Thread-safety: all dispatch state mutates under one router
+lock; per-replica engine calls are serialized by the per-replica locks
+the fleet bench's tick threads share (``ReplicaHandle.lock``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..logging import logger
+from .scheduler import Backpressure
+
+# bound on the remembered prefix chains: LRU beyond this (a router that
+# never forgets would grow with every distinct prompt ever served)
+PREFIX_MAP_CAP = 4096
+
+
+@dataclasses.dataclass
+class ReplicaStats:
+    """Per-replica dispatch accounting (rendered by ``obs report``'s
+    fleet rows and the ``serve-summary``'s ``replica_stats``)."""
+
+    dispatches: int = 0
+    affinity_dispatches: int = 0
+    retries_taken: int = 0  # dispatches received as someone's retry
+    sheds: int = 0  # Backpressure answers this replica returned
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class ReplicaHandle:
+    """One engine replica as the router sees it: the engine, its id,
+    a liveness flag, and the lock the fleet bench's tick thread and the
+    router's submit path share (engine state is single-writer)."""
+
+    def __init__(self, engine, replica_id: int):
+        if engine.replica_id != replica_id:
+            raise ValueError(
+                f"engine carries replica_id {engine.replica_id!r} but the "
+                f"router registers it as {replica_id} — set "
+                "EngineConfig.replica_id so telemetry and journal "
+                "namespaces agree with dispatch"
+            )
+        self.engine = engine
+        self.replica_id = replica_id
+        self.alive = True
+        self.lock = threading.Lock()
+        self.stats = ReplicaStats()
+
+    def load(self) -> Tuple[int, float]:
+        """(queue depth, pool pressure) — the least-loaded sort key.
+        Queue depth counts waiting AND running (a replica with free
+        slots but a deep backlog is not 'less loaded' than an idle
+        one); pool pressure breaks ties the way the shed watermarks
+        would."""
+        sched = self.engine.scheduler
+        depth = len(sched.waiting) + len(sched.running)
+        return depth, sched.pool_pressure()
+
+
+class FleetRouter:
+    """Dispatch policy over N :class:`ServeEngine` replicas."""
+
+    def __init__(self, engines: List, block_size: Optional[int] = None):
+        if not engines:
+            raise ValueError("a fleet needs at least one replica")
+        self.replicas: List[ReplicaHandle] = [
+            ReplicaHandle(e, e.replica_id if e.replica_id is not None else i)
+            for i, e in enumerate(engines)
+        ]
+        ids = [r.replica_id for r in self.replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(
+                f"duplicate replica ids {ids} — journal namespaces and "
+                "telemetry labels would collide"
+            )
+        sizes = {r.engine.config.block_size for r in self.replicas}
+        if block_size is None:
+            if len(sizes) != 1:
+                raise ValueError(
+                    f"replicas disagree on block_size ({sorted(sizes)}); "
+                    "prefix-affinity hashes full blocks and needs ONE "
+                    "granularity"
+                )
+            block_size = sizes.pop()
+        self.block_size = block_size
+        self._lock = threading.Lock()
+        # prefix-chain hash -> replica id, insertion-ordered for LRU
+        self._prefix_owner: Dict[int, int] = {}
+        self._next_req_id = 0
+        self.retries_elsewhere = 0
+        self.rejected = 0  # submissions every live replica shed
+
+    # ---------------------------------------------------------- plumbing
+    @property
+    def live(self) -> List[ReplicaHandle]:
+        return [r for r in self.replicas if r.alive]
+
+    def replica(self, replica_id: int) -> ReplicaHandle:
+        for r in self.replicas:
+            if r.replica_id == replica_id:
+                return r
+        raise KeyError(f"no replica {replica_id}")
+
+    def _chain_hashes(self, prompt: List[int]) -> List[int]:
+        """One hash per leading FULL block chain of ``prompt`` (chain i
+        covers tokens [0, i*block_size)), longest last — mirroring the
+        trie's path-from-root sharing rule, including its 'always leave
+        one token to prefill' cap. Chains fold INCREMENTALLY (chain i =
+        hash of (chain i-1, block i)) so a long prompt costs O(len),
+        not O(len^2) rehashing on the dispatch path; int-tuple hashes
+        are PYTHONHASHSEED-independent, so the mapping still holds
+        across processes."""
+        bs = self.block_size
+        full = max(0, (len(prompt) - 1) // bs)
+        chains: List[int] = []
+        acc = 0
+        for i in range(full):
+            acc = hash((acc, tuple(prompt[i * bs:(i + 1) * bs])))
+            chains.append(acc)
+        return chains
+
+    def _remember(self, chains: List[int], replica_id: int) -> None:
+        for h in chains:
+            self._prefix_owner.pop(h, None)  # re-insert = LRU refresh
+            self._prefix_owner[h] = replica_id
+        while len(self._prefix_owner) > PREFIX_MAP_CAP:
+            self._prefix_owner.pop(next(iter(self._prefix_owner)))
+
+    def affinity_replica(self, prompt: List[int]) -> Optional[int]:
+        """The live replica whose trie most plausibly holds this
+        prompt's longest leading block chain, or None (no affinity)."""
+        alive = {r.replica_id for r in self.live}
+        for h in reversed(self._chain_hashes(prompt)):
+            rid = self._prefix_owner.get(h)
+            if rid is not None and rid in alive:
+                return rid
+        return None
+
+    # ------------------------------------------------------------ policy
+    def submit(self, prompt: List[int], max_new_tokens: int, **kwargs):
+        """Dispatch one request: prefix-affinity first, then least
+        loaded; on Backpressure retry the remaining live replicas in
+        load order. Returns the admitted :class:`Sequence` (its engine's
+        replica id is on ``seq.request``'s serve events) or the LAST
+        :class:`Backpressure` when the whole fleet shed. ``req_id`` is
+        router-assigned (globally unique across replicas) unless the
+        caller pins one (journal replay)."""
+        with self._lock:
+            req_id = kwargs.pop("req_id", None)
+            if req_id is None:
+                req_id = self._next_req_id
+            self._next_req_id = max(self._next_req_id, req_id + 1)
+            chains = self._chain_hashes(prompt)
+            affinity = self.affinity_replica(prompt)
+            by_load = sorted(
+                self.live, key=lambda r: r.load() + (r.replica_id,)
+            )
+            if not by_load:
+                raise RuntimeError("no live replicas in the fleet")
+            order = list(by_load)
+            if affinity is not None:
+                order.sort(key=lambda r: r.replica_id != affinity)
+        bp = None
+        for attempt, handle in enumerate(order):
+            # NOT under handle.lock: ``ServeEngine.submit`` only appends
+            # to the scheduler's waiting deque and reads load state —
+            # safe against a concurrent tick under the GIL (the deadline
+            # counter has its own lock). Serializing submits behind the
+            # replica's tick lock starved admission so badly that fleet
+            # batches never filled (4x the ticks for the same tokens).
+            # count_shed=False: a rejection the router retries is not a
+            # client-visible shed — fleet-level rejections are counted
+            # (and journaled) by the fleet bench instead.
+            res = handle.engine.submit(
+                prompt, max_new_tokens, req_id=req_id,
+                count_shed=False, **kwargs
+            )
+            if isinstance(res, Backpressure):
+                bp = res
+                with self._lock:
+                    handle.stats.sheds += 1
+                    if not res.draining and attempt + 1 < len(order):
+                        self.retries_elsewhere += 1
+                continue
+            with self._lock:
+                handle.stats.dispatches += 1
+                if affinity is not None and handle.replica_id == affinity:
+                    handle.stats.affinity_dispatches += 1
+                if attempt > 0:
+                    handle.stats.retries_taken += 1
+                self._remember(chains, handle.replica_id)
+            return res
+        with self._lock:
+            self.rejected += 1
+        return bp
+
+    def begin_drain(self) -> None:
+        """Drain the whole fleet (the SIGTERM handler's target): every
+        live replica stops admitting and finishes in-flight work."""
+        for handle in self.live:
+            with handle.lock:
+                handle.engine.begin_drain()
+
+    def fail_replica(self, replica_id: int) -> None:
+        """A replica crashed (or was killed): drop it from dispatch.
+        Its incomplete requests are NOT rerouted here — they live in its
+        journal namespace, and recovery is the same journal replay a
+        single-engine crash uses (``restore_replica`` + re-submission
+        with original req_ids keeps them token-exact)."""
+        handle = self.replica(replica_id)
+        handle.alive = False
+        logger.log_event(
+            "serve-replica-failed", replica=replica_id,
+            running=len(handle.engine.scheduler.running),
+            waiting=len(handle.engine.scheduler.waiting),
+        )
+
+    def restore_replica(self, replica_id: int, engine) -> ReplicaHandle:
+        """Re-register a relaunched engine under a failed replica's id
+        (stats continue; the caller replays the replica's journal into
+        the fresh engine before opening it to new dispatch)."""
+        handle = self.replica(replica_id)
+        if handle.alive:
+            raise ValueError(f"replica {replica_id} is still live")
+        handle.engine = engine
+        handle.alive = True
+        logger.log_event("serve-replica-restored", replica=replica_id)
+        return handle
+
+    # --------------------------------------------------------- telemetry
+    @property
+    def has_work(self) -> bool:
+        return any(r.engine.scheduler.has_work for r in self.live)
+
+    def sync_next_req_id(self) -> None:
+        """After journal replay seeded engines with historical ids, the
+        router's id counter must move past every engine's (ids are the
+        sampler-key fold — a collision would alias two requests)."""
+        with self._lock:
+            for r in self.replicas:
+                self._next_req_id = max(
+                    self._next_req_id, r.engine._next_req_id
+                )
+
+    def stats(self) -> dict:
+        """Router dispatch stats for the ``serve-summary`` /
+        ``obs report`` fleet section."""
+        with self._lock:
+            per = {
+                r.replica_id: r.stats.to_dict() for r in self.replicas
+            }
+            dispatches = sum(s["dispatches"] for s in per.values())
+            affinity = sum(s["affinity_dispatches"] for s in per.values())
+            return {
+                "replicas": len(self.replicas),
+                "live_replicas": len(self.live),
+                "dispatches": dispatches,
+                "affinity_dispatches": affinity,
+                "affinity_hit_rate": (
+                    round(affinity / dispatches, 4) if dispatches else 0.0
+                ),
+                "retries_elsewhere": self.retries_elsewhere,
+                "rejected": self.rejected,
+                "per_replica": per,
+            }
+
+
+def install_fleet_drain_handler(router: FleetRouter) -> None:
+    """SIGTERM -> drain the WHOLE fleet, chaining any prior handler —
+    the fleet mirror of ``engine.install_drain_handler``: every replica
+    stops admitting, in-flight requests finish or hit their deadlines,
+    and the fleet bench exits 0 with a complete run dir."""
+    import signal
+
+    prev = signal.getsignal(signal.SIGTERM)
+
+    def handler(signum, frame):
+        router.begin_drain()
+        if callable(prev):  # SIG_DFL/SIG_IGN are enum ints, skipped
+            prev(signum, frame)
+
+    signal.signal(signal.SIGTERM, handler)

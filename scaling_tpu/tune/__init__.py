@@ -49,6 +49,11 @@ _LAZY = {
     "rank_layouts": "costmodel", "analytic_collectives": "costmodel",
     "link_for_axis": "costmodel",
     "token_slice_attention_factor": "costmodel",
+    # serving layouts (docs/TUNING.md "Serving layouts")
+    "ServingPoint": "serving", "ServingScore": "serving",
+    "ServeCalibration": "serving",
+    "enumerate_serving_points": "serving",
+    "score_serving_point": "serving", "rank_serving_points": "serving",
 }
 
 __all__ = sorted(_LAZY) + [

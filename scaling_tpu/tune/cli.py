@@ -197,6 +197,155 @@ def _lowered_crosscheck(scores, top: int) -> list:
     return out
 
 
+def _parse_model(name: str):
+    """Resolve --model to a ModelSpec (shared by the training and
+    serving modes); returns (model, model_name) or (None, error)."""
+    from .layouts import BENCH_MODELS, ModelSpec
+
+    if name in BENCH_MODELS:
+        return BENCH_MODELS[name], name
+    try:
+        parts = [float(x) for x in name.split(",")]
+        model = ModelSpec(
+            hidden_size=int(parts[0]), num_layers=int(parts[1]),
+            num_attention_heads=int(parts[2]), num_kv_heads=int(parts[3]),
+            sequence_length=int(parts[4]), vocab_size=int(parts[5]),
+            mlp_factor=parts[6] if len(parts) > 6 else 2.75,
+        )
+        return model, "custom"
+    except (ValueError, IndexError):
+        return None, name
+
+
+def serve_main(args) -> int:
+    """``--serve``: rank (mp, replicas, block_size, token_budget) serving
+    points by predicted fleet tokens/s; golden-pinned like the training
+    ranking, ``--emit-config`` writes a dict ``serve bench --config``
+    runs directly (docs/TUNING.md "Serving layouts")."""
+    from .costmodel import Calibration, SliceTopology
+    from .serving import (
+        ServeCalibration,
+        check_serve_golden,
+        enumerate_serving_points,
+        rank_serving_points,
+        serve_golden_path,
+    )
+
+    model, model_name = _parse_model(args.model)
+    if model is None:
+        print(f"error: unknown --model {model_name!r}", file=sys.stderr)
+        return 2
+    try:
+        block_sizes = [
+            int(x) for x in args.serve_block_sizes.split(",") if x.strip()
+        ]
+        budgets = [
+            int(x) for x in args.serve_token_budgets.split(",") if x.strip()
+        ]
+    except ValueError:
+        block_sizes = budgets = []
+    if (not block_sizes or not budgets
+            or any(v < 1 for v in block_sizes + budgets)):
+        print("error: bad --serve-block-sizes / --serve-token-budgets "
+              "(want comma lists of ints >= 1)", file=sys.stderr)
+        return 2
+    topo = SliceTopology(
+        chips=args.devices, ici_domain=args.ici_domain,
+        generation=args.generation,
+    )
+    pinning = args.check_golden or args.repin_golden
+    calibration = (
+        Calibration.default() if pinning
+        else resolve_calibration(args.run_dir, args.obs_root)
+    )
+    serve_cal = None
+    if args.serve_calibrate_from and not pinning:
+        serve_cal = ServeCalibration.from_run_dir(
+            args.serve_calibrate_from, model, topo, calibration
+        )
+        if serve_cal is None:
+            print(
+                f"# tune: {args.serve_calibrate_from} has no serve spans "
+                "or engine facts; predictions uncalibrated",
+                file=sys.stderr,
+            )
+    points = enumerate_serving_points(
+        args.devices, model, block_sizes=block_sizes,
+        token_budgets=budgets, num_slots=args.serve_num_slots,
+    )
+    if not points:
+        print("error: no valid serving point (does any mp divide both "
+              "the chip count and the q/kv heads?)", file=sys.stderr)
+        return 2
+    ranked = rank_serving_points(model, points, topo, calibration,
+                                 serve_cal)
+    if not ranked:
+        print(f"error: no serving point fits {args.generation} HBM for "
+              "this model", file=sys.stderr)
+        return 2
+    cal = calibration or Calibration.default()
+    best = ranked[0]
+    payload = {
+        "mode": "serve",
+        "devices": args.devices,
+        "model": model_name,
+        "slice_topology": topo.to_dict(),
+        "calibration": cal.to_dict(),
+        "serve_calibration": serve_cal.to_dict() if serve_cal else None,
+        "ranked": [s.to_dict() for s in ranked],
+        "serving_config": best.point.to_config(model),
+        "dropped_over_hbm": len(points) - len(ranked),
+    }
+    print(f"tune --serve: {len(ranked)} feasible serving point(s) of "
+          f"{model_name} on {args.devices} chip(s) [{topo.generation}, "
+          f"ici_domain={topo.domain}; {payload['dropped_over_hbm']} "
+          f"dropped over HBM]")
+    print(f"calibration: efficiency={cal.compute_efficiency:.3f} "
+          f"({cal.source})"
+          + (f"; serve tick factor {serve_cal.factor:.3f} "
+             f"({serve_cal.source})" if serve_cal else ""))
+    header = (f"{'rank':>4} {'layout':<24} {'tokens/s':>10} {'tick_s':>9} "
+              f"{'comm_s':>9} {'mem_GB':>7} link")
+    print(header)
+    for i, s in enumerate(ranked[: args.top]):
+        print(
+            f"{i + 1:>4} {s.point.label:<24} {s.tokens_per_s:>10.0f} "
+            f"{s.tick_s:>9.5f} {s.comm_s:>9.5f} {s.memory_gb:>7.2f} "
+            f"{s.link}"
+        )
+    print(f"best: {best.point.label} predicted {best.tokens_per_s:.0f} "
+          f"fleet tokens/s (run: python -m scaling_tpu.serve bench "
+          f"--config <emitted>)")
+    if args.emit_config:
+        Path(args.emit_config).write_text(
+            json.dumps(payload["serving_config"], indent=1) + "\n"
+        )
+    if args.json:
+        Path(args.json).write_text(json.dumps(payload, indent=1) + "\n")
+    gpath = serve_golden_path(args.devices, model_name)
+    if args.repin_golden:
+        gpath.parent.mkdir(parents=True, exist_ok=True)
+        gpath.write_text(json.dumps(
+            {
+                "calibration": "pinned-default",
+                "ranked": [
+                    {"label": s.to_dict()["label"],
+                     "tokens_per_s": s.to_dict()["tokens_per_s"]}
+                    for s in ranked
+                ],
+            },
+            indent=1,
+        ) + "\n")
+        print(f"serving golden repinned -> {gpath}")
+    elif args.check_golden:
+        drift = check_serve_golden(payload, gpath)
+        for line in drift:
+            print(f"DRIFT: {line}")
+        print(f"golden: {'OK' if not drift else 'DRIFT'}")
+        return 1 if drift else 0
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m scaling_tpu.tune",
@@ -253,7 +402,30 @@ def main(argv=None) -> int:
     parser.add_argument("--repin-golden", action="store_true",
                         help="rewrite the pinned ranking from this run "
                         "(forces the default calibration)")
+    # ---- serving layouts (docs/TUNING.md "Serving layouts") ----
+    parser.add_argument("--serve", action="store_true",
+                        help="rank SERVING layouts instead of training "
+                        "ones: (mp, replicas=devices/mp, block_size, "
+                        "token_budget) points scored by fleet tokens/s — "
+                        "mp activation all-reduces priced ICI-vs-DCN like "
+                        "training, KV pool memory per chip gated against "
+                        "the generation's HBM")
+    parser.add_argument("--serve-block-sizes", default="8,16,32",
+                        metavar="LIST", help="KV block sizes to sweep")
+    parser.add_argument("--serve-token-budgets", default="128,256,512",
+                        metavar="LIST",
+                        help="per-tick token budgets to sweep")
+    parser.add_argument("--serve-num-slots", type=int, default=8,
+                        help="decode slots per replica (fixed across the "
+                        "sweep; the jitted batch size)")
+    parser.add_argument("--serve-calibrate-from", metavar="RUN_DIR",
+                        help="scale predicted tick time by the measured "
+                        "serve.mixed/serve.decode spans of this serve "
+                        "bench run dir (its serve-summary must carry the "
+                        "engine shape facts)")
     args = parser.parse_args(argv)
+    if args.serve:
+        return serve_main(args)
 
     from .costmodel import (
         AxisCorrection,
@@ -261,25 +433,13 @@ def main(argv=None) -> int:
         SliceTopology,
         rank_layouts,
     )
-    from .layouts import BENCH_MODELS, ModelSpec, enumerate_layouts
+    from .layouts import BENCH_MODELS, enumerate_layouts
 
-    if args.model in BENCH_MODELS:
-        model = BENCH_MODELS[args.model]
-        model_name = args.model
-    else:
-        try:
-            parts = [float(x) for x in args.model.split(",")]
-            model = ModelSpec(
-                hidden_size=int(parts[0]), num_layers=int(parts[1]),
-                num_attention_heads=int(parts[2]), num_kv_heads=int(parts[3]),
-                sequence_length=int(parts[4]), vocab_size=int(parts[5]),
-                mlp_factor=parts[6] if len(parts) > 6 else 2.75,
-            )
-            model_name = "custom"
-        except (ValueError, IndexError):
-            print(f"error: unknown --model {args.model!r} "
-                  f"(names: {sorted(BENCH_MODELS)})", file=sys.stderr)
-            return 2
+    model, model_name = _parse_model(args.model)
+    if model is None:
+        print(f"error: unknown --model {model_name!r} "
+              f"(names: {sorted(BENCH_MODELS)})", file=sys.stderr)
+        return 2
 
     topo = SliceTopology(
         chips=args.devices, ici_domain=args.ici_domain,

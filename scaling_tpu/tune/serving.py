@@ -1,0 +1,387 @@
+"""Serving-layout search: (mp, replicas, block_size, token_budget) points.
+
+The training tuner (costmodel.py) answers "where do I place a TRAINING
+step"; this module answers the serving twin: given ``D`` chips and a
+model, how should the serving fleet slice them — how many model-parallel
+shards per engine replica (mp), how many data-parallel replicas behind
+the router (replicas = D / mp), what KV block size, and what per-tick
+token budget? The scoring reuses the training tuner's machinery
+wholesale (docs/TUNING.md):
+
+- **compute**: a serving tick prices ``token_budget`` tokens at the
+  inference FLOP rate (2 FLOPs per parameter per token — forward only,
+  vs training's 6; plus the attention window term), divided over the
+  replica's mp shards at the calibrated efficiency of the generation's
+  peak. Small blocks pay a per-block streaming overhead in the paged
+  kernel (one grid step per block: ``1 + PAGED_BLOCK_OVERHEAD /
+  block_size``); large blocks pay internal fragmentation instead (a
+  sequence wastes half a block on average), priced in memory.
+- **comm**: mp > 1 costs the SAME Megatron activation all-reduces
+  training's model axis pays — 2 per layer forward (no backward at
+  serving) over the tick's activations — priced ICI-vs-DCN by the very
+  ``link_for_axis`` rule the training tuner uses (the serving layout is
+  a Layout with dp = replicas, so the mp axis's stride/domain math is
+  identical).
+- **memory**: bf16 params / mp + the sharded KV pool
+  (``layers x 2 x pool_tokens x (kv/mp) x head x 2B``, fragmentation
+  included) must fit the generation's HBM; infeasible points are
+  dropped, not ranked.
+- **calibration**: the analytic tick time is scaled by a measured
+  factor from real serve run dirs (:class:`ServeCalibration` — mean
+  ``serve.mixed``/``serve.decode`` span seconds vs the model's
+  prediction for THAT run's engine shape, read from the serve-summary's
+  ``engine`` facts), exactly like the training tuner's MFU calibration.
+
+``python -m scaling_tpu.tune --serve`` ranks the space, pins a golden
+(``tune/goldens/tune_serve_8dev_0.5b.json``), and ``--emit-config``
+writes a dict ``serve bench --config`` runs directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from .costmodel import (
+    BF16,
+    Calibration,
+    LinkClass,
+    SliceTopology,
+    collective_seconds,
+    link_for_axis,
+)
+from .layouts import Layout, ModelSpec
+
+# paged-kernel streaming overhead: one grid step per KV block — fixed
+# per-block cost (DMA issue, mask math) expressed in token-equivalents,
+# so cost multiplies by (1 + OVERHEAD / block_size). Small blocks pack
+# the pool tighter but pay more grid steps; the sweep prices the trade.
+PAGED_BLOCK_OVERHEAD = 4.0
+
+# steady-state KV residency per slot of token budget: the pool must hold
+# the CONTEXTS of every in-flight sequence, not just the tick's new
+# tokens. Derived from the engine defaults (num_slots * max context /
+# token_budget at bench shapes); the emitted config scales num_blocks
+# from it.
+POOL_TOKENS_PER_BUDGET_TOKEN = 16.0
+
+# generation -> usable HBM per chip (GiB); public cloud.google.com specs
+HBM_GB = {
+    "tpu_v4": 32.0,
+    "tpu_v5e": 16.0,
+    "tpu_v5p": 95.0,
+    "tpu_v6e": 32.0,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingPoint:
+    """One serving-layout candidate for ``mp * replicas`` chips."""
+
+    mp: int
+    replicas: int
+    block_size: int
+    token_budget: int
+    num_slots: int = 8
+
+    @property
+    def world(self) -> int:
+        return self.mp * self.replicas
+
+    @property
+    def label(self) -> str:
+        return (f"mp{self.mp}·r{self.replicas}·bs{self.block_size}"
+                f"·tb{self.token_budget}")
+
+    def layout(self, mbs: int = 1) -> Layout:
+        """The serving point as a training-tuner Layout (dp = replicas):
+        what makes ``link_for_axis`` price the mp axis with the SAME
+        stride/ICI-domain rules training placement uses."""
+        return Layout(pp=1, dp=self.replicas, cp=1, mp=self.mp,
+                      micro_batch_size=mbs, gradient_accumulation_steps=1)
+
+    def to_config(self, model: Optional[ModelSpec] = None) -> dict:
+        """A runnable serving config: the dict ``serve bench --config``
+        consumes (and a deployment template for the real fleet)."""
+        pool_tokens = int(self.token_budget * POOL_TOKENS_PER_BUDGET_TOKEN)
+        num_blocks = max(2, pool_tokens // self.block_size + 1)
+        cfg = {
+            "mp": self.mp,
+            "replicas": self.replicas,
+            "block_size": self.block_size,
+            "token_budget": self.token_budget,
+            "num_slots": self.num_slots,
+            "num_blocks": num_blocks,
+        }
+        if model is not None:
+            cfg["model"] = {
+                "hidden_size": model.hidden_size,
+                "num_layers": model.num_layers,
+                "num_kv_heads": model.num_kv_heads,
+            }
+        return cfg
+
+
+def enumerate_serving_points(
+    n_devices: int,
+    model: ModelSpec,
+    block_sizes: Sequence[int] = (8, 16, 32),
+    token_budgets: Sequence[int] = (128, 256, 512),
+    num_slots: int = 8,
+) -> List[ServingPoint]:
+    """Every (mp, replicas=D/mp, block_size, token_budget) the model
+    shape admits: mp must divide the chip count AND the q/kv heads (the
+    pool shards kv heads over the model axis — serve/kvcache.py raises
+    on anything else, so the tuner never ranks an unbuildable point)."""
+    points: List[ServingPoint] = []
+    for mp in range(1, n_devices + 1):
+        if n_devices % mp:
+            continue
+        if model.num_attention_heads % mp or model.num_kv_heads % mp:
+            continue
+        replicas = n_devices // mp
+        for bs in block_sizes:
+            for tb in token_budgets:
+                points.append(ServingPoint(
+                    mp=mp, replicas=replicas, block_size=bs,
+                    token_budget=tb, num_slots=num_slots,
+                ))
+    points.sort(key=lambda p: (p.mp, p.block_size, p.token_budget))
+    return points
+
+
+def serve_flops_per_token(model: ModelSpec, avg_context: float) -> float:
+    """Inference FLOPs per generated/prefilled token: 2 per parameter
+    (one forward MAC each) plus the attention window reads —
+    ``4 * layers * hidden * context`` (QK^T and PV over the cached
+    context), the forward third of PaLM appendix-B's 12 L H S."""
+    return (
+        2.0 * model.parameter_count
+        + 4.0 * model.num_layers * model.hidden_size * avg_context
+    )
+
+
+def predict_tick_seconds(
+    model: ModelSpec,
+    point: ServingPoint,
+    topo: SliceTopology,
+    calibration: Optional[Calibration] = None,
+) -> Dict[str, float]:
+    """Analytic seconds for ONE engine tick of ``token_budget`` tokens
+    on one replica: compute over the mp shards + the mp activation
+    all-reduces, the comm priced by the link class the slice topology
+    assigns to the model axis (ICI inside a domain, DCN across)."""
+    cal = calibration or Calibration.default()
+    avg_context = point.token_budget * POOL_TOKENS_PER_BUDGET_TOKEN / (
+        2.0 * point.num_slots
+    )  # half the steady-state per-slot residency
+    flops = point.token_budget * serve_flops_per_token(model, avg_context)
+    rate = topo.peak_tflops * 1e12 * cal.compute_efficiency
+    block_factor = 1.0 + PAGED_BLOCK_OVERHEAD / point.block_size
+    compute_s = flops * block_factor / (rate * point.mp)
+    comm_s = 0.0
+    link: LinkClass = topo.ici
+    if point.mp > 1:
+        link = link_for_axis(point.layout(), topo, "model")
+        # Megatron TP inference forward: 2 activation ARs per layer over
+        # the tick's activations (no backward at serving)
+        count = 2 * model.num_layers
+        payload = count * point.token_budget * model.hidden_size * BF16
+        comm_s = collective_seconds(
+            "all-reduce", float(payload), count, point.mp, link
+        )
+    return {
+        "compute_s": compute_s,
+        "comm_s": comm_s,
+        "tick_s": compute_s + comm_s,
+        "link": link.name,
+    }
+
+
+def serving_memory_gb(model: ModelSpec, point: ServingPoint) -> float:
+    """Per-chip HBM: bf16 params / mp + the kv-head-sharded pool.
+    Fragmentation: each in-flight sequence wastes ~half a block."""
+    params = model.parameter_count * BF16 / point.mp
+    head = model.hidden_size // model.num_attention_heads
+    pool_tokens = point.token_budget * POOL_TOKENS_PER_BUDGET_TOKEN
+    pool_tokens += point.num_slots * point.block_size / 2.0  # fragmentation
+    pool = (
+        model.num_layers * 2.0 * pool_tokens
+        * (model.num_kv_heads / point.mp) * head * BF16
+    )
+    return (params + pool) / 1e9
+
+
+@dataclasses.dataclass
+class ServingScore:
+    point: ServingPoint
+    tokens_per_s: float
+    tick_s: float
+    compute_s: float
+    comm_s: float
+    memory_gb: float
+    link: str
+
+    def to_dict(self) -> dict:
+        return {
+            "label": self.point.label,
+            "mp": self.point.mp,
+            "replicas": self.point.replicas,
+            "block_size": self.point.block_size,
+            "token_budget": self.point.token_budget,
+            "tokens_per_s": round(self.tokens_per_s, 1),
+            "tick_s": round(self.tick_s, 6),
+            "compute_s": round(self.compute_s, 6),
+            "comm_s": round(self.comm_s, 6),
+            "memory_gb_per_chip": round(self.memory_gb, 3),
+            "link": self.link,
+        }
+
+
+def score_serving_point(
+    model: ModelSpec,
+    point: ServingPoint,
+    topo: SliceTopology,
+    calibration: Optional[Calibration] = None,
+    serve_calibration: Optional["ServeCalibration"] = None,
+) -> Optional[ServingScore]:
+    """Fleet tokens/s for one point, or None when it does not fit the
+    generation's HBM (an unrankable point, not a slow one)."""
+    memory = serving_memory_gb(model, point)
+    if memory > HBM_GB.get(topo.generation, 16.0):
+        return None
+    pred = predict_tick_seconds(model, point, topo, calibration)
+    tick_s = pred["tick_s"]
+    if serve_calibration is not None:
+        tick_s *= serve_calibration.factor
+    tokens_per_s = point.replicas * point.token_budget / tick_s
+    return ServingScore(
+        point=point, tokens_per_s=tokens_per_s, tick_s=tick_s,
+        compute_s=pred["compute_s"], comm_s=pred["comm_s"],
+        memory_gb=memory, link=pred["link"],
+    )
+
+
+def rank_serving_points(
+    model: ModelSpec,
+    points: Sequence[ServingPoint],
+    topo: SliceTopology,
+    calibration: Optional[Calibration] = None,
+    serve_calibration: Optional["ServeCalibration"] = None,
+) -> List[ServingScore]:
+    scored = [
+        s for p in points
+        if (s := score_serving_point(model, p, topo, calibration,
+                                     serve_calibration)) is not None
+    ]
+    scored.sort(key=lambda s: (-s.tokens_per_s, s.point.label))
+    return scored
+
+
+# ---------------------------------------------------------- calibration
+@dataclasses.dataclass(frozen=True)
+class ServeCalibration:
+    """Measured-vs-analytic tick-time factor from real serve run dirs.
+
+    A serve bench run leaves ``serve.mixed`` / ``serve.decode`` spans
+    (the device tick) and a serve-summary carrying the engine SHAPE it
+    ran (``engine``: mp/num_slots/block_size/token_budget...). The
+    factor is measured mean tick seconds over the analytic prediction
+    for that exact shape — applied multiplicatively to every candidate,
+    the serving twin of the training tuner's
+    prediction-vs-span-measured loop (docs/TUNING.md)."""
+
+    factor: float
+    source: str
+    ticks: int = 0
+
+    @classmethod
+    def identity(cls) -> "ServeCalibration":
+        return cls(1.0, "identity")
+
+    @classmethod
+    def from_run_dir(cls, run_dir, model: ModelSpec,
+                     topo: SliceTopology,
+                     calibration: Optional[Calibration] = None,
+                     ) -> Optional["ServeCalibration"]:
+        """None when the run dir has no serve spans or no engine facts
+        in its serve-summary (pre-fleet bench)."""
+        from ..obs.report import load_run_dir  # stdlib-only
+
+        data = load_run_dir(run_dir)
+        spans = [
+            sp for sp in data.spans
+            if sp.get("span") in ("serve.mixed", "serve.decode")
+            and sp.get("dur_s") is not None
+        ]
+        summaries = [
+            e for e in data.lifecycle if e.get("event") == "serve-summary"
+        ]
+        if not spans or not summaries:
+            return None
+        eng = summaries[-1].get("engine")
+        if not isinstance(eng, dict):
+            return None
+        try:
+            point = ServingPoint(
+                mp=int(eng.get("mp", 1)),
+                replicas=int(eng.get("replicas", 1)),
+                block_size=int(eng["block_size"]),
+                token_budget=int(eng["token_budget"]),
+                num_slots=int(eng["num_slots"]),
+            )
+        except (KeyError, TypeError, ValueError):
+            return None
+        measured = sum(float(sp["dur_s"]) for sp in spans) / len(spans)
+        predicted = predict_tick_seconds(
+            model, point, topo, calibration
+        )["tick_s"]
+        if predicted <= 0 or measured <= 0:
+            return None
+        return cls(
+            factor=measured / predicted,
+            source=f"serve-spans:{run_dir}",
+            ticks=len(spans),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "factor": round(self.factor, 6),
+            "source": self.source,
+            "ticks": self.ticks,
+        }
+
+
+# -------------------------------------------------------------- golden
+GOLDEN_DIR = Path(__file__).resolve().parent / "goldens"
+GOLDEN_RTOL = 0.02
+
+
+def serve_golden_path(devices: int, model_name: str) -> Path:
+    return GOLDEN_DIR / f"tune_serve_{devices}dev_{model_name}.json"
+
+
+def check_serve_golden(payload: dict, path: Path) -> List[str]:
+    """Ranking drift vs the pinned serving golden (labels exact,
+    tokens/s within the band) — mirrors the training tuner's pin."""
+    import json
+
+    if not path.is_file():
+        return [f"no serving golden at {path} (run --repin-golden)"]
+    golden = json.loads(path.read_text())
+    drift: List[str] = []
+    g = [(r["label"], r["tokens_per_s"]) for r in golden["ranked"]]
+    c = [(r["label"], r["tokens_per_s"]) for r in payload["ranked"]]
+    if [l for l, _ in g] != [l for l, _ in c]:
+        drift.append(
+            f"serving ranking changed: golden {[l for l, _ in g][:4]}... "
+            f"!= current {[l for l, _ in c][:4]}..."
+        )
+    for (gl, gs), (cl, cs) in zip(g, c):
+        if gl == cl and gs and abs(cs - gs) > GOLDEN_RTOL * gs:
+            drift.append(
+                f"{gl}: tokens/s {gs:.1f} -> {cs:.1f} "
+                f"(> {GOLDEN_RTOL:.0%} band)"
+            )
+    return drift

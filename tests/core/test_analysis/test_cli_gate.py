@@ -90,7 +90,8 @@ def test_audit_gate_serve_decode_matches_golden(tmp_path):
     no-recompile-storm contract for the continuous-batching scheduler's
     shape bucketing."""
     out = tmp_path / "serve.json"
-    p = run_cli("audit", "--sections", "serve_decode", "--json", str(out))
+    p = run_cli("audit", "--sections", "serve_decode,serve_decode_mp2",
+                "--json", str(out))
     assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-2000:]
     payload = json.loads(out.read_text())
     assert payload["audit"]["drift"] == []
@@ -114,6 +115,22 @@ def test_audit_gate_serve_decode_matches_golden(tmp_path):
     # off-TPU the paged kernel runs interpreted (inlined HLO, 0 custom
     # calls); an on-chip repin records the real custom-call count
     assert sec["pallas_custom_calls"] == 0
+
+    # the mp=2 SHARDED section (ISSUE 14): same program family, now
+    # SPMD over the serving mesh — model-axis activation all-reduces in
+    # the inventory, mp in the recompile key, per-shard flops roughly
+    # halved; and the mp=1 section's key hash must be UNCHANGED by the
+    # sharding work (its static config never grew an mp entry)
+    mp2 = payload["audit"]["sections"]["serve_decode_mp2"]
+    assert mp2["recompile_key"]["static"]["mp"] == 2
+    assert "mp" not in static
+    assert mp2["mesh"] == {"pipe": 1, "data": 1, "context": 1, "model": 2}
+    assert any(
+        r["op"] == "all-reduce" and r["axis"] == "model"
+        for r in mp2["collectives"]
+    ), mp2["collectives"]
+    assert mp2["host_callbacks"] == 0
+    assert mp2["flops"] < sec["flops"]  # compute genuinely sharded
 
 
 def test_audit_gate_detects_seeded_drift(tmp_path):
@@ -147,7 +164,8 @@ def test_full_cli_all_clean(tmp_path):
     assert payload["exit_code"] == 0
     assert set(payload["audit"]["sections"]) == {
         "train_single", "train_pp2_mp2", "train_pp2_vpp2",
-        "train_pp2_tokenslice", "decode_fused", "serve_decode"
+        "train_pp2_tokenslice", "decode_fused", "serve_decode",
+        "serve_decode_mp2",
     }
     pp2 = payload["audit"]["sections"]["train_pp2_mp2"]
     axes = {(r["op"], r["axis"]) for r in pp2["collectives"]}

@@ -1,0 +1,255 @@
+"""Fleet router units (ISSUE 14): dispatch policy over fake replicas.
+
+The router is pure host policy (serve/router.py), so these units drive
+it with jax-free fake engines exposing exactly the surface it consumes —
+``submit`` returning a Sequence or Backpressure, the scheduler's queue
+depths and ``pool_pressure``, ``begin_drain``. The real-engine
+integration (token exactness, journal replay, device programs) lives in
+test_mp_fleet.py; the policy matrix lives here where it is cheap.
+"""
+
+import pytest
+
+from scaling_tpu.serve.journal import journal_path, open_journal
+from scaling_tpu.serve.router import (
+    FleetRouter,
+    install_fleet_drain_handler,
+)
+from scaling_tpu.serve.scheduler import Backpressure
+
+
+class FakeScheduler:
+    def __init__(self):
+        self.waiting = []
+        self.running = {}
+        self.pressure = 0.0
+
+    def pool_pressure(self):
+        return self.pressure
+
+    @property
+    def has_work(self):
+        return bool(self.waiting or self.running)
+
+
+class FakeSeq:
+    def __init__(self, req_id, prompt):
+        self.req_id = req_id
+        self.prompt = prompt
+
+
+class FakeEngineConfig:
+    def __init__(self, block_size=4, replica_id=None):
+        self.block_size = block_size
+        self.replica_id = replica_id
+
+
+class FakeEngine:
+    """The engine surface the router consumes, nothing else."""
+
+    def __init__(self, replica_id, block_size=4, shed=False):
+        self.config = FakeEngineConfig(block_size, replica_id)
+        self.replica_id = replica_id
+        self.scheduler = FakeScheduler()
+        self.shed = shed
+        self.draining = False
+        self.submitted = []
+        self._next_req_id = 0
+
+    def submit(self, prompt, max_new_tokens, req_id=None,
+               count_shed=True, **kwargs):
+        if self.draining:
+            return Backpressure("draining", self.scheduler.pool_pressure(),
+                                len(self.scheduler.waiting), draining=True)
+        if self.shed:
+            return Backpressure("pool-pressure",
+                                self.scheduler.pool_pressure(),
+                                len(self.scheduler.waiting))
+        seq = FakeSeq(req_id, prompt)
+        self.submitted.append((req_id, list(prompt)))
+        self._next_req_id = max(self._next_req_id, (req_id or 0) + 1)
+        self.scheduler.waiting.append(seq)
+        return seq
+
+    def begin_drain(self):
+        self.draining = True
+
+
+def fleet(n=2, **kw):
+    engines = [FakeEngine(i, **kw) for i in range(n)]
+    return FleetRouter(engines), engines
+
+
+def test_least_loaded_dispatch_picks_emptiest_replica():
+    router, engines = fleet(3)
+    engines[0].scheduler.waiting = [object()] * 3
+    engines[1].scheduler.running = {0: object()}
+    # replica 2 is empty -> first dispatch lands there
+    seq = router.submit([1, 2, 3], 4)
+    assert engines[2].submitted and not isinstance(seq, Backpressure)
+    # pressure breaks queue-depth ties: 1 and 2 now both hold one seq,
+    # but replica 1 is under higher pool pressure
+    engines[1].scheduler.pressure = 0.9
+    router.submit([9, 9, 9], 4)
+    assert len(engines[2].submitted) == 2
+
+
+def test_prefix_affinity_routes_family_to_warm_replica():
+    router, engines = fleet(2, block_size=4)
+    family = list(range(1, 13))  # 3 full blocks at bs=4
+    first = family + [50, 51]
+    router.submit(first, 4)
+    (owner,) = [e for e in engines if e.submitted]
+    other = engines[1 - owner.replica_id]
+    # load the warm replica MORE than the cold one: affinity must still
+    # win over least-loaded for a family member...
+    owner.scheduler.waiting = [object()] * 4
+    router.submit(family + [60, 61, 62], 4)
+    assert len(owner.submitted) == 2
+    # ...while an unrelated prompt goes least-loaded to the cold replica
+    router.submit([90, 91, 92, 93, 94], 4)
+    assert len(other.submitted) == 1
+    stats = router.stats()
+    assert stats["affinity_dispatches"] == 1
+    assert stats["per_replica"][owner.replica_id]["affinity_dispatches"] == 1
+
+
+def test_affinity_matches_longest_cached_chain():
+    router, engines = fleet(2, block_size=4)
+    short = [1, 2, 3, 4, 9, 9]          # one full block [1..4]
+    long = [1, 2, 3, 4, 5, 6, 7, 8, 9]  # two full blocks [1..8]
+    router.submit(short, 4)
+    a = [e for e in engines if e.submitted][0]
+    router.submit(long, 4)
+    # the long prompt shares block [1..4] with `short`: longest cached
+    # chain maps to a's replica
+    assert router.affinity_replica(long + [70]) == a.replica_id
+
+
+def test_no_affinity_below_one_full_block():
+    router, _ = fleet(2, block_size=4)
+    router.submit([1, 2, 3, 4, 5], 4)
+    # a 4-token prompt never yields a full shareable block (the trie
+    # always leaves >= 1 token to prefill) -> no affinity claim
+    assert router.affinity_replica([1, 2, 3, 4]) is None
+
+
+def test_backpressure_retries_elsewhere_then_rejects():
+    router, engines = fleet(3)
+    engines[0].shed = engines[1].shed = True
+    seq = router.submit([1, 2, 3], 4)
+    assert not isinstance(seq, Backpressure)
+    assert engines[2].submitted
+    stats = router.stats()
+    assert stats["retries_elsewhere"] >= 1
+    assert stats["per_replica"][2]["retries_taken"] == 1
+    # the whole fleet sheds -> the LAST Backpressure surfaces
+    engines[2].shed = True
+    bp = router.submit([4, 5, 6], 4)
+    assert isinstance(bp, Backpressure) and bp.reason == "pool-pressure"
+    assert router.stats()["rejected"] == 1
+
+
+def test_drain_fans_out_to_every_replica():
+    router, engines = fleet(3)
+    router.begin_drain()
+    assert all(e.draining for e in engines)
+    bp = router.submit([1, 2, 3], 4)
+    assert isinstance(bp, Backpressure) and bp.draining
+
+
+def test_sigterm_handler_drains_fleet_and_chains():
+    import signal
+
+    router, engines = fleet(2)
+    seen = []
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+        install_fleet_drain_handler(router)
+        signal.raise_signal(signal.SIGTERM)
+        assert all(e.draining for e in engines)
+        assert seen == [signal.SIGTERM]  # prior handler chained
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_failed_replica_leaves_dispatch_until_restored():
+    router, engines = fleet(2)
+    router.submit([1, 2, 3, 4, 5], 4)  # lands on replica 0 (tie order)
+    n0 = len(engines[0].submitted)
+    router.fail_replica(0)
+    for i in range(4):
+        router.submit([10 + i] * 5, 4)
+    assert len(engines[0].submitted) == n0  # nothing new on the corpse
+    assert len(engines[1].submitted) + n0 == 5
+    # affinity to a dead replica is ignored, not honored
+    assert router.affinity_replica([1, 2, 3, 4, 5, 6]) in (None, 1)
+    with pytest.raises(ValueError, match="still live"):
+        router.restore_replica(1, FakeEngine(1))
+    fresh = FakeEngine(0)
+    router.restore_replica(0, fresh)
+    assert router.replica(0).alive and router.replica(0).engine is fresh
+
+
+def test_all_replicas_failed_raises():
+    router, _ = fleet(1)
+    router.fail_replica(0)
+    with pytest.raises(RuntimeError, match="no live replicas"):
+        router.submit([1, 2, 3], 4)
+
+
+def test_router_req_ids_are_globally_unique():
+    router, engines = fleet(2)
+    for i in range(6):
+        router.submit([1 + i, 2, 3, 4, 5, 6], 4)
+    ids = [r for e in engines for r, _ in e.submitted]
+    assert sorted(ids) == list(range(6))
+
+
+def test_duplicate_replica_ids_rejected():
+    with pytest.raises(ValueError, match="duplicate replica ids"):
+        FleetRouter([FakeEngine(1), FakeEngine(1)])
+
+
+# ------------------------------------------------- journal namespacing
+def test_journal_path_namespaces_per_replica(tmp_path):
+    base = tmp_path / "journal.jsonl"
+    assert journal_path(base) == base
+    assert journal_path(base, 0).name == "journal_r0.jsonl"
+    assert journal_path(base, 7).name == "journal_r7.jsonl"
+
+
+def test_open_journal_per_replica_streams_do_not_collide(tmp_path):
+    """Two replicas journal the same req-id space into DISTINCT files;
+    each replica's resume replays only its own stream (the fleet
+    ``--resume`` contract)."""
+    base = tmp_path / "journal.jsonl"
+
+    class Req:
+        def __init__(self, rid):
+            self.req_id = rid
+            self.prompt = [1, 2, 3]
+            self.max_new_tokens = 4
+            self.eos_token_id = None
+            self.temperature = 0.0
+            self.top_k = None
+            self.top_p = None
+            self.deadline_ms = None
+            self.ttft_deadline_ms = None
+
+    j0, _ = open_journal(base, resume=False, replica_id=0)
+    j1, _ = open_journal(base, resume=False, replica_id=1)
+    j0.record_submit(Req(0))
+    j0.record_tokens(0, [7, 8])
+    j0.record_finish(0, "completed")
+    j1.record_submit(Req(1))  # crashed before finishing
+    _, r0 = open_journal(base, resume=True, replica_id=0)
+    _, r1 = open_journal(base, resume=True, replica_id=1)
+    assert r0.completed == {0: [7, 8]} and not r0.incomplete
+    assert [rec["req"] for rec in r1.incomplete] == [1]
+    # a fresh (non-resume) open truncates ONLY its own namespace
+    open_journal(base, resume=False, replica_id=0)
+    _, r0b = open_journal(base, resume=True, replica_id=0)
+    _, r1b = open_journal(base, resume=True, replica_id=1)
+    assert not r0b.submits and [rec["req"] for rec in r1b.incomplete] == [1]
