@@ -17,7 +17,7 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 
 
 def _lint(args) -> tuple[int, dict]:
-    from .lint import lint_paths
+    from .lint import RULES, lint_paths
 
     paths = [Path(p) for p in (args.paths or [REPO_ROOT / "scaling_tpu"])]
     findings = lint_paths(paths, root=args.root or REPO_ROOT)
@@ -28,8 +28,21 @@ def _lint(args) -> tuple[int, dict]:
         f"lint: {len(active)} finding(s) "
         f"({len(findings) - len(active)} suppressed) over {len(paths)} path(s)"
     )
+    # per-rule summary in STABLE rule-id order (a list, so JSON keeps the
+    # ordering): the tier-1 gate diffs this structurally — every rule the
+    # analyzer knows appears exactly once, clean rules at zero
+    rules_summary = [
+        {
+            "rule": rule,
+            "severity": RULES[rule][0],
+            "findings": sum(1 for f in findings if f.rule == rule),
+            "unsuppressed": sum(1 for f in active if f.rule == rule),
+        }
+        for rule in sorted(RULES)
+    ]
     payload = {
         "findings": [f.to_dict() for f in findings],
+        "rules": rules_summary,
         "unsuppressed": len(active),
     }
     return (1 if active else 0), payload
@@ -125,7 +138,10 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     rc = 0
-    payload: dict = {}
+    # bumped whenever the JSON report's structure changes (ISSUE 15:
+    # version 2 added schema_version itself + the ordered lint["rules"]
+    # per-rule summary); consumers diff structurally against this
+    payload: dict = {"schema_version": 2}
     if args.command in ("lint", "all"):
         lint_rc, lint_payload = _lint(args)
         rc = max(rc, lint_rc)

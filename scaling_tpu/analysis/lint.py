@@ -38,11 +38,35 @@ STA008    error     stage-shift ``jnp.concatenate`` in a traced context:
                     ~11 vs sequential). Use roll-then-overwrite
                     (``jnp.roll(s, 1, 0).at[0].set(inp)``) instead —
                     exact, and partitions correctly.
+STA009    error     lock-discipline race: an instance attribute mutated
+                    on one thread (a ``threading.Thread(target=...)``
+                    entry point's reachable set) and read/written on
+                    another (the class's main-thread public API)
+                    without a common ``with self.<lock>:`` guard on
+                    both paths. Whole-program rule (concurrency.py);
+                    ``# sta: lock(<attr>)`` declares deliberate
+                    lock-free fields.
+STA010    error     device sync on the hot path: ``block_until_ready``
+                    / ``device_get`` / ``effects_barrier`` / ``.item()``
+                    / ``float()``/``np.asarray()`` on device values in
+                    code reachable from the trainer step dispatch, the
+                    serve tick, or the fleet router dispatch. The
+                    static complement of test_step_path.py's runtime
+                    booby-trap. Whole-program rule (concurrency.py).
+STA011    error     raw I/O (``open``/``os.replace``/``os.write``/
+                    sockets/``Path.read_text``-family) in the gated
+                    subsystems (resilience/, serve/, runner/, obs/,
+                    checkpoint/) not reachable under ``retry_io`` or a
+                    ``FaultPlan`` point — the ROADMAP's "new I/O paths
+                    take a fault point + retry" contract, enforced
+                    mechanically. Whole-program rule (concurrency.py).
 ========  ========  ==========================================================
 
-Suppress a finding on its line with ``# sta: disable=STA003`` (comma list)
-or a bare ``# sta: disable``. Suppressed findings are still reported (with
-``suppressed: true``) but do not fail the gate.
+Suppress a finding on its line with ``# sta: disable=STA003`` (a comma
+rule list, ``# sta: disable=STA009,STA011``, suppresses exactly those
+rules) or a bare ``# sta: disable`` (every rule on the line). Suppressed
+findings are still reported (with ``suppressed: true``) but do not fail
+the gate.
 
 *Traced context* (where STA001-STA003 apply) is detected structurally:
 functions decorated with ``jax.jit`` / ``jax.checkpoint`` / ``jax.vmap`` /
@@ -73,6 +97,12 @@ RULES = {
                         "re-raise/logging/use)"),
     "STA008": ("error", "stage-shift concatenate (expand + partial slice) "
                         "in a traced context — XLA SPMD miscompile hazard"),
+    "STA009": ("error", "cross-thread attribute access without a common "
+                        "lock guard on both paths"),
+    "STA010": ("error", "device sync reachable from the trainer step / "
+                        "serve tick hot path"),
+    "STA011": ("error", "raw I/O in a gated subsystem outside every "
+                        "retry_io / FaultPlan guard"),
 }
 
 # Module allowlist for traced-context rules (ISSUE 2: nn/, parallel/, ops/;
@@ -101,6 +131,10 @@ SWALLOW_SCOPE_DIRS = (
     # scheduler/pool/device error here is a request that silently never
     # completes (the exact failure mode the TTFT gates exist to catch)
     "serve",
+    # ISSUE 15: the tuner grew CLI/serving-layout I/O (stale-capture
+    # records, emitted configs, goldens) — a swallowed read there turns
+    # a corrupt calibration file into a silently wrong placement
+    "tune",
 )
 
 # calls that count as "the handler surfaced the problem"
@@ -143,6 +177,24 @@ _KEY_CONSUMERS = {
 }
 
 _SUPPRESS_RE = re.compile(r"#\s*sta:\s*disable(?:=([A-Za-z0-9_, ]+))?")
+
+
+def parse_suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
+    """line -> suppressed rule set (None = bare disable, every rule).
+    Shared by the per-file pass and the whole-program rules
+    (concurrency.py) so ``# sta: disable=STA009,STA011`` means the same
+    thing everywhere."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        if m.group(1):
+            out[i] = {r.strip().upper() for r in m.group(1).split(",")
+                      if r.strip()}
+        else:
+            out[i] = None  # bare disable: every rule
+    return out
 
 
 @dataclasses.dataclass
@@ -267,16 +319,7 @@ class _ModuleLint:
 
     @staticmethod
     def _parse_suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
-        out: Dict[int, Optional[Set[str]]] = {}
-        for i, text in enumerate(source.splitlines(), start=1):
-            m = _SUPPRESS_RE.search(text)
-            if not m:
-                continue
-            if m.group(1):
-                out[i] = {r.strip().upper() for r in m.group(1).split(",") if r.strip()}
-            else:
-                out[i] = None  # bare disable: every rule
-        return out
+        return parse_suppressions(source)
 
     def _emit(self, rule: str, node: ast.AST, message: str) -> None:
         line = getattr(node, "lineno", 0)
@@ -813,15 +856,28 @@ def lint_file(path: Path, root: Optional[Path] = None) -> List[Finding]:
 
 
 def lint_paths(
-    paths: Iterable[Path | str], root: Optional[Path] = None
+    paths: Iterable[Path | str],
+    root: Optional[Path] = None,
+    program: bool = True,
 ) -> List[Finding]:
-    """Lint every ``.py`` under ``paths`` (files or directories)."""
+    """Lint every ``.py`` under ``paths`` (files or directories).
+
+    Runs the per-file AST rules (STA001-STA008) plus — unless
+    ``program=False`` — the whole-program call-graph rules
+    (STA009-STA011, concurrency.py) over the same path set as one
+    analysis unit. Ordering is stable: (path, line, col, rule)."""
     root = Path(root) if root else Path.cwd()
+    # materialize once: a generator argument would be exhausted by the
+    # per-file loop and silently hand check_program an EMPTY path set
+    paths = [Path(p) for p in paths]
     findings: List[Finding] = []
     for p in paths:
-        p = Path(p)
         files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
         for f in files:
             findings.extend(lint_file(f, root))
+    if program:
+        from .concurrency import check_program
+
+        findings.extend(check_program(paths, root=root))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

@@ -348,7 +348,13 @@ def save_optimizer_checkpoint(
             "no_overflow_steps": int(opt_state.loss_scaler.no_overflow_steps),
         },
     }
-    (path / "optimizer_state.json").write_text(json.dumps(scalars))
+    from ..resilience.guards import retry_io
+
+    scalars_text = json.dumps(scalars)
+    retry_io(
+        lambda: (path / "optimizer_state.json").write_text(scalars_text),
+        what="optimizer scalar state write",
+    )
 
 
 def load_optimizer_checkpoint(dir: Path | str, opt_state, metas: Any):

@@ -78,16 +78,22 @@ def export_reference_checkpoint(src_dir: Path | str, dst_dir: Path | str) -> int
     ``latest`` pointer) or a ``global_step{N}`` directory."""
     import torch
 
+    from ..resilience.guards import retry_io
+
     src = Path(src_dir)
     latest = src / "latest"
     if latest.is_file():
-        src = src / latest.read_text().strip()
+        src = src / retry_io(
+            latest.read_text, what="latest pointer read"
+        ).strip()
     dst = Path(dst_dir)
     dst.mkdir(parents=True, exist_ok=True)
 
     config_file = src / "config.yml"
     cfg = (
-        yaml.safe_load(config_file.read_text()) or {}
+        yaml.safe_load(retry_io(
+            config_file.read_text, what="export config read"
+        )) or {}
         if config_file.is_file()
         else {}
     )

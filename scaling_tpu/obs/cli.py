@@ -136,7 +136,12 @@ def main(argv=None) -> int:
             "stats": stats,
             "gate_failures": failures,
         }
-        Path(args.json).write_text(json.dumps(payload, indent=1) + "\n")
+        # stays raw: obs cannot import resilience's retry_io without
+        # inverting the layering (resilience wraps its I/O in obs spans),
+        # and a failed report write already fails the CLI loudly
+        Path(args.json).write_text(  # sta: disable=STA011
+            json.dumps(payload, indent=1) + "\n"
+        )
     return 1 if failures else 0
 
 

@@ -240,8 +240,12 @@ class MetricsRegistry:
         path = Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)
         tmp = path.with_name(f".{path.name}.tmp{os.getpid()}")
-        tmp.write_text(self.render_textfile())
-        os.replace(tmp, path)
+        # stays raw: obs cannot import resilience's retry_io without
+        # inverting the layering, and telemetry is best-effort by
+        # contract — a retry loop in the scrape render would stall the
+        # step it is measuring (flush callers catch and warn instead)
+        tmp.write_text(self.render_textfile())  # sta: disable=STA011
+        os.replace(tmp, path)  # sta: disable=STA011
 
     # ------------------------------------------------------------- flush
     def flush_step(self, step: int) -> None:

@@ -93,10 +93,11 @@ def span(name: str, *, step: Optional[int] = None, level: str = "debug",
         yield sp
         if sp._wait_for is not None:
             # drain INSIDE the measured window: the caller explicitly
-            # asked for SynchronizedTimer semantics on this span
+            # asked for SynchronizedTimer semantics on this span —
+            # opt-in via sp.wait_for(x), never the default
             import jax
 
-            jax.block_until_ready(sp._wait_for)
+            jax.block_until_ready(sp._wait_for)  # sta: disable=STA010
     except BaseException as e:
         ok = False
         error = type(e).__name__

@@ -355,7 +355,12 @@ class TcpControlPlaneServer:
         self._barriers: Dict[str, set] = {}
         self._flags: Dict[str, str] = {}
         self._lock = threading.Lock()
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        # stays raw: one-time server bind at startup — a port conflict
+        # or bad address is a config error that must abort loudly, not
+        # retry (client REQUESTS ride retry_io; see _request)
+        self._sock = socket.socket(  # sta: disable=STA011
+            socket.AF_INET, socket.SOCK_STREAM
+        )
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self._sock.listen(64)

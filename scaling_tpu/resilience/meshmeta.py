@@ -126,7 +126,10 @@ def write_mesh_meta(stage_dir: Path | str, meta: dict) -> Path:
     commit's manifest scan digests it like every other staged file, so
     it is covered by restore verification)."""
     out = Path(stage_dir) / MESH_NAME
-    out.write_text(json.dumps(meta, indent=1, sort_keys=True))
+    from .guards import retry_io
+
+    text = json.dumps(meta, indent=1, sort_keys=True)
+    retry_io(lambda: out.write_text(text), what="MESH.json stage write")
     return out
 
 

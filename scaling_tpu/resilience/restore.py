@@ -43,7 +43,11 @@ def checkpoint_candidates(base: Path | str) -> List[Path]:
     pointed: Optional[Path] = None
     latest = base / "latest"
     if latest.is_file():
-        pointed = base / latest.read_text().strip()
+        from .guards import retry_io
+
+        pointed = base / retry_io(
+            latest.read_text, what="latest pointer read"
+        ).strip()
         if pointed.is_dir():
             cands.append(pointed)
         else:

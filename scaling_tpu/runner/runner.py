@@ -32,9 +32,15 @@ def get_resource_pool(config: RunnerConfig) -> Dict[str, int]:
     and strands the rendezvous."""
     pool: Dict[str, int] = {}
     if config.hostsfile is not None:
-        for lineno, raw in enumerate(
-            open(config.hostsfile).read().splitlines(), start=1
-        ):
+        from pathlib import Path
+
+        from ..resilience.guards import retry_io
+
+        hosts_text = retry_io(
+            Path(config.hostsfile).read_text,
+            what=f"hostsfile read {config.hostsfile!r}",
+        )
+        for lineno, raw in enumerate(hosts_text.splitlines(), start=1):
             line = raw.split("#")[0].strip()
             if not line:
                 continue

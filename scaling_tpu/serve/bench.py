@@ -748,8 +748,12 @@ def _apply_serving_config(args, argv: List[str], parser) -> None:
     """Fold a tuner-emitted serving config (``tune --serve
     --emit-config``) into the parsed args as DEFAULTS: any knob the user
     passed explicitly on the command line wins over the file."""
+    from ..resilience.guards import retry_io
+
     try:
-        cfg = json.loads(Path(args.config).read_text())
+        cfg = json.loads(retry_io(
+            Path(args.config).read_text, what="serving config read"
+        ))
     except (OSError, ValueError) as e:
         parser.error(f"--config {args.config}: unreadable ({e})")
     passed = {
@@ -1204,7 +1208,13 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"report {run_dir})")
 
     if args.json:
-        Path(args.json).write_text(json.dumps(stats, indent=1) + "\n")
+        from ..resilience.guards import retry_io
+
+        stats_text = json.dumps(stats, indent=1) + "\n"
+        retry_io(
+            lambda: Path(args.json).write_text(stats_text),
+            what="bench stats write",
+        )
 
     failures = []
     if (args.assert_serve_throughput is not None

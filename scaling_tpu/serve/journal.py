@@ -230,7 +230,13 @@ def replay_journal(path) -> JournalReplay:
     p = Path(path)
     if not p.is_file():
         return replay
-    for line in p.read_text().splitlines():
+    from ..resilience.guards import retry_io
+
+    # the crash-recovery read itself rides the bounded-retry layer: a
+    # flaky shared mount at relaunch time must not turn a recoverable
+    # crash into a lost request stream
+    journal_text = retry_io(p.read_text, what="request journal replay read")
+    for line in journal_text.splitlines():
         if not line.strip():
             continue
         try:

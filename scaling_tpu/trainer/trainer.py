@@ -403,6 +403,11 @@ class BaseTrainer:
         self._prefetch_thread = None
         self._prefetch_stop = None
 
+    # Deliberately lock-free: ``dataloader`` is assigned here BEFORE
+    # ``_start_prefetch`` spawns the worker (thread-start happens-before
+    # publishes it) and never reassigned while the worker is live
+    # (``stop_prefetch`` joins the thread first).
+    # sta: lock(dataloader)
     def _build_dataloaders(self) -> None:
         if self.dataset is not None:
             self.dataloader = DataLoader(
@@ -451,7 +456,7 @@ class BaseTrainer:
         ):
             # the profiled window must open with a drained device queue or
             # its first step_time absorbs the unfetched backlog
-            jax.block_until_ready(self.opt_state.step)
+            jax.block_until_ready(self.opt_state.step)  # sta: disable=STA010
             self._unfetched_steps = 0
             self._last_fetch_wall = time.time()
         if self.profiler is not None:
@@ -505,7 +510,9 @@ class BaseTrainer:
                 fetched=False,
             )
         with span("step.sync", step=step_idx):
-            loss = float(loss)  # host sync: the step's device work is drained
+            # THE deliberate per-log-interval host sync, inside its own
+            # measured span (docs/OBSERVABILITY.md step.sync)
+            loss = float(loss)  # sta: disable=STA010
         # a fetch after unfetched steps drains their whole device backlog,
         # so this step's wall time covers several steps of device work;
         # report the amortized per-step time (what tokens/s and the TFLOPs
@@ -973,7 +980,7 @@ class BaseTrainer:
                 # pins the unfetched backlog's device work inside the train
                 # window, so the aux-time exclusion below can't swallow
                 # real step time that would have drained during the aux work
-                jax.block_until_ready(self.opt_state.step)
+                jax.block_until_ready(self.opt_state.step)  # sta: disable=STA010
             if (will_save or will_eval) and self._control_plane is not None:
                 # the save/eval window publishes no step heartbeats (a
                 # long eval can exceed heartbeat_timeout on its own);

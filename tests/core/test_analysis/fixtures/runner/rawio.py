@@ -1,0 +1,37 @@
+"""Seeded STA011 violation: raw I/O in a ``runner/`` path (an I/O-gated
+subsystem) outside every ``retry_io``/FaultPlan guard — the ROADMAP's
+"new I/O paths take a fault point + retry" contract. Line numbers are
+asserted by tests/core/test_analysis/test_lint.py; keep edits additive
+at the bottom.
+
+Also seeds the guard shapes that must stay CLEAN: a lambda passed to
+``retry_io`` (lexically guarded), a named helper passed to ``retry_io``
+(transitively guarded), and a per-line ``# sta: disable=STA011``
+suppression (reported suppressed).
+"""
+
+from pathlib import Path
+
+from scaling_tpu.resilience.guards import retry_io
+
+
+def publish_state(path, text):
+    Path(path).write_text(text)  # STA011: raw write, no guard
+
+
+def publish_pid(path, pid):
+    # best-effort operator breadcrumb; losing it only degrades debugging
+    Path(path).write_text(str(pid))  # sta: disable=STA011
+
+
+def guarded_publish(path, text):
+    retry_io(lambda: Path(path).write_text(text), what="state write")
+
+
+def _raw_write(path, text):
+    # clean: only ever invoked under retry_io (guarded_by_name below)
+    Path(path).write_text(text)
+
+
+def guarded_by_name(path, text):
+    retry_io(lambda: _raw_write(path, text), what="state write")

@@ -1,0 +1,30 @@
+"""Seeded STA010 violation: a device sync planted on the serve tick
+path — the static complement of tests/core/test_obs/test_step_path.py's
+runtime booby-trap. The class name/method match the hot-path root spec
+(``ServeEngine.tick``); the sync hides one call level down to prove the
+rule walks the graph, not just the root's own body. Line numbers are
+asserted by tests/core/test_analysis/test_lint.py; keep edits additive
+at the bottom.
+"""
+
+import jax
+
+
+class ServeEngine:
+    """A toy engine whose tick dispatches device work and then — the
+    seeded bug — drains it for telemetry."""
+
+    def tick(self, state):
+        out = self._dispatch(state)
+        self._probe_telemetry(out)
+        return self._land_tokens(out)
+
+    def _dispatch(self, state):
+        return jax.device_put(state)
+
+    def _probe_telemetry(self, out):
+        jax.block_until_ready(out)  # STA010: sync one level below tick
+
+    def _land_tokens(self, out):
+        # the tick's deliberate token landing, per-line suppressed
+        return jax.device_get(out)  # sta: disable=STA010

@@ -1,0 +1,51 @@
+"""Seeded STA009 violation: the PR 14 tick-lock-serialization idiom —
+a serving replica driven by a background tick thread whose bookkeeping
+attribute is mutated on the tick thread and on the submitting caller's
+thread with no common lock. Line numbers are asserted by
+tests/core/test_analysis/test_lint.py; keep edits additive at the
+bottom (the class's attribute sides are part of the contract).
+
+The class also seeds the two NON-findings the rule must honor: an
+attribute guarded by the same ``with self._lock:`` on both sides stays
+clean, a field declared deliberately lock-free via ``# sta: lock(...)``
+stays clean, and a second race whose flagged write carries a per-line
+``# sta: disable=STA009`` is reported suppressed.
+"""
+
+import threading
+
+
+class ReplicaHandle:
+    """A replica with a background tick loop (the PR 14 shape: public
+    ``submit`` races the tick thread over shared bookkeeping)."""
+
+    # ``tick_count`` is a GIL-atomic monotonically increasing int only
+    # ever used for coarse progress logging — deliberately lock-free:
+    # sta: lock(tick_count)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+        self._inflight = 0
+        self._draining = False
+        self.tick_count = 0
+        self._thread = threading.Thread(target=self._tick_loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _tick_loop(self):
+        while not self._draining:
+            with self._lock:
+                batch = list(self._queue)
+                self._queue.clear()
+            self._inflight -= len(batch)  # STA009: tick-thread write, no lock
+            self.tick_count += 1  # annotated lock-free: clean
+
+    def submit(self, req):
+        with self._lock:
+            self._queue.append(req)  # same lock both sides: clean
+        self._inflight += 1  # the racing main-thread side
+
+    def drain(self):
+        self._draining = True  # sta: disable=STA009 (latching bool flag)

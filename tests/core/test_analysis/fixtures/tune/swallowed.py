@@ -1,0 +1,104 @@
+"""Seeded STA007 violations in a ``tune/`` path (the scope dir ISSUE 15
+added: the tuner grew CLI/serving-layout I/O in PRs 8/12/14 — a
+swallowed read there turns a corrupt calibration file into a silently
+wrong placement). Line numbers are asserted by
+tests/core/test_analysis/test_lint.py and chosen NOT to collide with
+the other STA007 fixtures' lines (trainer: 14/21/28/63, runner:
+17/24/38, obs: 33/40/54, serve: 49/59/73); keep edits additive at the
+bottom."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+# padding so the first handler lands on line 82 and the second on 89 —
+# line numbers no other STA007 fixture uses.
+#
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+# .
+
+
+def swallow_calibration_read(load):
+    try:
+        return load()
+    except Exception:  # STA007: a corrupt calibration silently ignored
+        return None
+
+
+def swallow_layout_emit(emit, layout):
+    try:
+        emit(layout)
+    except:  # noqa: E722  # STA007: bare except around config emit
+        pass
+
+
+def ok_logged_stale_capture(read):
+    try:
+        return read()
+    except Exception as e:
+        logger.warning(f"stale-capture read failed: {e}")
+
+
+def suppressed_golden_probe(probe):
+    try:
+        return probe()
+    except Exception:  # sta: disable=STA007
+        return None

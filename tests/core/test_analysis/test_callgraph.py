@@ -1,0 +1,194 @@
+"""Call-graph engine units (ISSUE 15): the resolution surface the
+whole-program rules stand on — ``self.method`` and module-function
+edges, imported and re-exported names, attribute-type inference,
+``threading.Thread(target=...)`` spawn sites (methods AND closures),
+reachability with stop specs, and the contract that unresolvable
+dynamic calls degrade to "unknown" instead of crashing."""
+
+from pathlib import Path
+
+from scaling_tpu.analysis.callgraph import CallGraph, module_dotted_name
+
+
+def build(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return CallGraph.build([tmp_path], root=tmp_path)
+
+
+def edges_of(graph, qual):
+    return sorted(graph.edges.get(qual, ()))
+
+
+def test_module_dotted_name():
+    assert module_dotted_name("scaling_tpu/serve/engine.py") == \
+        "scaling_tpu.serve.engine"
+    assert module_dotted_name("pkg/__init__.py") == "pkg"
+
+
+def test_resolves_module_functions_and_self_methods(tmp_path):
+    g = build(tmp_path, {"pkg/mod.py": (
+        "def helper():\n"
+        "    return 1\n"
+        "\n"
+        "def top():\n"
+        "    return helper()\n"
+        "\n"
+        "class Engine:\n"
+        "    def tick(self):\n"
+        "        return self._step()\n"
+        "    def _step(self):\n"
+        "        return helper()\n"
+    )})
+    assert edges_of(g, "pkg.mod:top") == ["pkg.mod:helper"]
+    assert edges_of(g, "pkg.mod:Engine.tick") == ["pkg.mod:Engine._step"]
+    assert edges_of(g, "pkg.mod:Engine._step") == ["pkg.mod:helper"]
+
+
+def test_resolves_imports_and_package_reexports(tmp_path):
+    g = build(tmp_path, {
+        "pkg/__init__.py": "from .impl import work\n",
+        "pkg/impl.py": "def work():\n    return 1\n",
+        "app.py": (
+            "from pkg import work\n"
+            "from pkg.impl import work as w2\n"
+            "import pkg.impl\n"
+            "\n"
+            "def a():\n"
+            "    return work()\n"
+            "def b():\n"
+            "    return w2()\n"
+            "def c():\n"
+            "    return pkg.impl.work()\n"
+        ),
+    })
+    for fn in ("a", "b", "c"):
+        assert edges_of(g, f"app:{fn}") == ["pkg.impl:work"], fn
+
+
+def test_attribute_type_inference_routes_method_calls(tmp_path):
+    g = build(tmp_path, {
+        "pkg/sched.py": (
+            "class Scheduler:\n"
+            "    def plan(self):\n"
+            "        return []\n"
+        ),
+        "pkg/engine.py": (
+            "from .sched import Scheduler\n"
+            "\n"
+            "class Engine:\n"
+            "    def __init__(self):\n"
+            "        self.scheduler = Scheduler()\n"
+            "    def tick(self):\n"
+            "        local = Scheduler()\n"
+            "        local.plan()\n"
+            "        return self.scheduler.plan()\n"
+        ),
+    })
+    assert "pkg.sched:Scheduler.plan" in edges_of(g, "pkg.engine:Engine.tick")
+
+
+def test_thread_spawn_targets_methods_and_closures(tmp_path):
+    g = build(tmp_path, {"pkg/mod.py": (
+        "import threading\n"
+        "\n"
+        "class Loop:\n"
+        "    def start(self):\n"
+        "        def worker():\n"
+        "            return self.beat()\n"
+        "        t1 = threading.Thread(target=self._run)\n"
+        "        t2 = threading.Thread(target=worker)\n"
+        "        t3 = threading.Thread(target=some_dynamic())\n"
+        "        return t1, t2, t3\n"
+        "    def _run(self):\n"
+        "        pass\n"
+        "    def beat(self):\n"
+        "        pass\n"
+    )})
+    spawns = {s.target.dotted if s.target else None
+              for s in g.thread_spawns}
+    assert spawns == {"Loop._run", "Loop.start.worker", None}
+    # the closure is a graph node of its own, with its self-call edge
+    assert edges_of(g, "pkg.mod:Loop.start.worker") == ["pkg.mod:Loop.beat"]
+
+
+def test_unresolvable_dynamic_calls_do_not_crash(tmp_path):
+    g = build(tmp_path, {"pkg/mod.py": (
+        "def dispatch(table, fn, obj):\n"
+        "    table['k']()\n"
+        "    fn()\n"
+        "    obj.method().chain()\n"
+        "    (lambda: 1)()\n"
+        "    return getattr(obj, 'x')()\n"
+    )})
+    assert edges_of(g, "pkg.mod:dispatch") == []
+    assert len(g.unresolved["pkg.mod:dispatch"]) >= 4
+
+
+def test_reachability_with_stops(tmp_path):
+    g = build(tmp_path, {"pkg/mod.py": (
+        "def root():\n"
+        "    mid()\n"
+        "    save_checkpoint()\n"
+        "def mid():\n"
+        "    leaf()\n"
+        "def leaf():\n"
+        "    pass\n"
+        "def save_checkpoint():\n"
+        "    inside()\n"
+        "def inside():\n"
+        "    pass\n"
+    )})
+    roots = g.find("root")
+    assert [f.dotted for f in roots] == ["root"]
+    names = {f.dotted for f in g.reachable(roots,
+                                           stops=("save_checkpoint",))}
+    assert names == {"root", "mid", "leaf"}
+    all_names = {f.dotted for f in g.reachable(roots)}
+    assert all_names == {"root", "mid", "leaf", "save_checkpoint", "inside"}
+
+
+def test_find_matches_dotted_suffix_at_boundary(tmp_path):
+    g = build(tmp_path, {"pkg/mod.py": (
+        "class ServeEngine:\n"
+        "    def tick(self):\n"
+        "        pass\n"
+        "class Mock:\n"
+        "    def untick(self):\n"
+        "        pass\n"
+        "def tick():\n"
+        "    pass\n"
+    )})
+    hits = {f.dotted for f in g.find("ServeEngine.tick")}
+    assert hits == {"ServeEngine.tick"}
+    # bare name finds both the method and the module function; the
+    # boundary rule keeps 'untick' out
+    assert {f.dotted for f in g.find("tick")} == {"ServeEngine.tick", "tick"}
+
+
+def test_syntax_error_files_are_skipped_not_fatal(tmp_path):
+    g = build(tmp_path, {
+        "pkg/bad.py": "def broken(:\n",
+        "pkg/good.py": "def ok():\n    pass\n",
+    })
+    assert "pkg.good" in g.modules and "pkg.bad" not in g.modules
+
+
+def test_module_alias_attribute_resolves(tmp_path):
+    """``self._jax = jax`` then ``self._jax.block_until_ready`` must
+    resolve to the real dotted name (the obs/spans idiom)."""
+    g = build(tmp_path, {"pkg/mod.py": (
+        "import jax\n"
+        "\n"
+        "class T:\n"
+        "    def __init__(self):\n"
+        "        self._jax = jax\n"
+        "    def probe(self, x):\n"
+        "        return self._jax.block_until_ready(x)\n"
+    )})
+    fn = g.functions["pkg.mod:T.probe"]
+    call = [n for n in __import__("ast").walk(fn.node)
+            if n.__class__.__name__ == "Call"][0]
+    assert g.resolve_name(fn, call.func) == "jax.block_until_ready"

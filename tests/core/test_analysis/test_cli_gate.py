@@ -30,10 +30,25 @@ def run_cli(*args, timeout=600):
     )
 
 
-def test_lint_gate_clean_tree_exits_zero():
-    p = run_cli("lint", timeout=120)
+def test_lint_gate_clean_tree_exits_zero(tmp_path):
+    """The clean tree is the enforced baseline — INCLUDING the
+    whole-program rules (ISSUE 15): the JSON report carries its schema
+    version and a stable per-rule summary the gate diffs structurally,
+    with STA009-STA011 present and pinned at zero unsuppressed."""
+    out = tmp_path / "lint.json"
+    p = run_cli("lint", "--json", str(out), timeout=300)
     assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
     assert "lint: 0 finding(s)" in p.stdout
+    payload = json.loads(out.read_text())
+    assert payload["schema_version"] == 2
+    summary = payload["lint"]["rules"]
+    ids = [r["rule"] for r in summary]
+    # stable ordering: sorted rule ids, every known rule exactly once
+    assert ids == sorted(ids) and len(ids) == len(set(ids))
+    assert {"STA009", "STA010", "STA011"} <= set(ids)
+    for rec in summary:
+        assert rec["unsuppressed"] == 0, rec
+        assert rec["severity"] in ("error", "warning")
 
 
 def test_lint_gate_seeded_violations_exit_nonzero(tmp_path):
@@ -42,10 +57,19 @@ def test_lint_gate_seeded_violations_exit_nonzero(tmp_path):
                 timeout=120)
     assert p.returncode != 0
     payload = json.loads(out.read_text())
+    assert payload["schema_version"] == 2
     rules = {f["rule"] for f in payload["lint"]["findings"]}
-    assert {"STA001", "STA002", "STA003", "STA004", "STA005", "STA006"} <= rules
+    assert {"STA001", "STA002", "STA003", "STA004", "STA005", "STA006",
+            "STA007", "STA008", "STA009", "STA010", "STA011"} <= rules
     assert payload["lint"]["unsuppressed"] > 0
     assert payload["exit_code"] != 0
+    # the per-rule summary counts agree with the findings list
+    by_rule = {r["rule"]: r for r in payload["lint"]["rules"]}
+    for rule in ("STA009", "STA010", "STA011"):
+        assert by_rule[rule]["findings"] == sum(
+            1 for f in payload["lint"]["findings"] if f["rule"] == rule
+        )
+        assert by_rule[rule]["unsuppressed"] >= 1
 
 
 def test_audit_gate_matches_golden(tmp_path):

@@ -1,0 +1,421 @@
+"""Whole-program rule units (ISSUE 15): STA009 lock-discipline,
+STA010 device-sync-on-hot-path, STA011 unguarded-I/O — each rule driven
+over small synthetic trees so every modeling decision (lock inheritance
+through call sites, taint through returns, guard transitivity, stop
+subtrees, annotations) is pinned by itself."""
+
+from pathlib import Path
+
+import pytest
+
+from scaling_tpu.analysis.concurrency import (
+    HOT_PATH_STOPS,
+    SYNC_PRIMITIVES,
+    check_program,
+)
+
+
+def run(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return check_program([tmp_path], root=tmp_path)
+
+
+def active(findings, rule=None):
+    return [f for f in findings
+            if not f.suppressed and (rule is None or f.rule == rule)]
+
+
+# ================================================================ STA009
+RACE = (
+    "import threading\n"
+    "\n"
+    "class Pool:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._count = 0\n"
+    "        threading.Thread(target=self._loop).start()\n"
+    "    def _loop(self):\n"
+    "        self._count += 1\n"
+    "    def submit(self):\n"
+    "        {main_body}\n"
+)
+
+
+def test_sta009_unlocked_cross_thread_write_fires(tmp_path):
+    f = active(run(tmp_path, {
+        "m.py": RACE.format(main_body="self._count -= 1")
+    }), "STA009")
+    assert len(f) == 1 and "_count" in f[0].message
+    assert f[0].line == 9  # the earliest racing write
+
+
+def test_sta009_common_lock_on_both_sides_is_clean(tmp_path):
+    src = (
+        "import threading\n"
+        "\n"
+        "class Pool:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._count = 0\n"
+        "        threading.Thread(target=self._loop).start()\n"
+        "    def _loop(self):\n"
+        "        with self._lock:\n"
+        "            self._count += 1\n"
+        "    def submit(self):\n"
+        "        with self._lock:\n"
+        "            self._count -= 1\n"
+    )
+    assert active(run(tmp_path, {"m.py": src}), "STA009") == []
+
+
+def test_sta009_lock_inherited_through_call_site(tmp_path):
+    """A private helper only ever invoked inside ``with self._lock:``
+    inherits the guard (meet-over-paths, the PR 14 fix shape)."""
+    src = (
+        "import threading\n"
+        "\n"
+        "class Pool:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._count = 0\n"
+        "        threading.Thread(target=self._loop).start()\n"
+        "    def _loop(self):\n"
+        "        with self._lock:\n"
+        "            self._bump()\n"
+        "    def _bump(self):\n"
+        "        self._count += 1\n"
+        "    def submit(self):\n"
+        "        with self._lock:\n"
+        "            self._count -= 1\n"
+    )
+    assert active(run(tmp_path, {"m.py": src}), "STA009") == []
+
+
+def test_sta009_lockfree_annotation_and_safe_containers(tmp_path):
+    """``# sta: lock(attr)`` silences the field entirely; queue.Queue /
+    deque attributes are thread-safe by construction and never flagged."""
+    src = (
+        "import queue\n"
+        "import threading\n"
+        "\n"
+        "class Pool:\n"
+        "    # sta: lock(_beat)\n"
+        "    def __init__(self):\n"
+        "        self._q = queue.Queue()\n"
+        "        self._beat = 0.0\n"
+        "        threading.Thread(target=self._loop).start()\n"
+        "    def _loop(self):\n"
+        "        self._beat = 1.0\n"
+        "        self._q.put(1)\n"
+        "    def submit(self):\n"
+        "        self._beat = 2.0\n"
+        "        return self._q.get()\n"
+    )
+    assert active(run(tmp_path, {"m.py": src}), "STA009") == []
+
+
+def test_sta009_thread_onto_closure_is_a_side(tmp_path):
+    """The PR 4/5 idiom: the thread target is a closure inside a
+    method — its self-attr writes still race the public API."""
+    src = (
+        "import threading\n"
+        "\n"
+        "class Writer:\n"
+        "    def start(self):\n"
+        "        def worker():\n"
+        "            self._pending = 1\n"
+        "        threading.Thread(target=worker).start()\n"
+        "    def flush(self):\n"
+        "        return self._pending\n"
+    )
+    f = active(run(tmp_path, {"m.py": src}), "STA009")
+    assert len(f) == 1 and "_pending" in f[0].message
+
+
+def test_sta009_thread_exclusive_helpers_are_one_side(tmp_path):
+    """Review regression: a helper reachable ONLY through the spawn
+    target belongs to the thread's side — a field touched exclusively
+    there must not read as a race of the worker against itself. A
+    helper shared by BOTH a main-side path and the thread still
+    races."""
+    exclusive = (
+        "import threading\n"
+        "\n"
+        "class Worker:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._worker).start()\n"
+        "    def _worker(self):\n"
+        "        self._flush()\n"
+        "    def _flush(self):\n"
+        "        self._n = 1\n"  # thread-only field: clean
+    )
+    assert active(run(tmp_path / "t1", {"m.py": exclusive}),
+                  "STA009") == []
+    shared = (
+        "import threading\n"
+        "\n"
+        "class Worker:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._worker).start()\n"
+        "    def _worker(self):\n"
+        "        self._flush()\n"
+        "    def _flush(self):\n"
+        "        self._n = 1\n"
+        "    def force_flush(self):\n"  # main-side path into the helper
+        "        self._flush()\n"
+    )
+    f = active(run(tmp_path / "t2", {"m.py": shared}), "STA009")
+    assert len(f) == 1 and "_n" in f[0].message
+
+
+def test_sta009_no_threads_no_findings(tmp_path):
+    src = (
+        "class Plain:\n"
+        "    def a(self):\n"
+        "        self._x = 1\n"
+        "    def b(self):\n"
+        "        return self._x\n"
+    )
+    assert run(tmp_path, {"m.py": src}) == []
+
+
+# ================================================================ STA010
+def _step_path(sync_stmt: str) -> str:
+    return (
+        "import jax\n"
+        "import numpy as np\n"
+        "\n"
+        "def run_training(model, batches):\n"
+        "    for b in batches:\n"
+        "        _dispatch(model, b)\n"
+        "\n"
+        "def _dispatch(model, b):\n"
+        "    out = jax.jit(model)(b)\n"
+        f"    {sync_stmt}\n"
+        "    return out\n"
+    )
+
+
+def _tick_path(sync_stmt: str) -> str:
+    return (
+        "import jax\n"
+        "import numpy as np\n"
+        "\n"
+        "class ServeEngine:\n"
+        "    def tick(self, state):\n"
+        "        out = jax.device_put(state)\n"
+        f"        {sync_stmt}\n"
+        "        return out\n"
+    )
+
+
+# every primitive the runtime booby-trap monkeypatches to explode
+# (tests/core/test_obs/test_step_path.py) plus the taint-driven host
+# conversions the rule adds on top
+_PLANTED = [
+    "jax.block_until_ready(out)",
+    "jax.device_get(out)",
+    "jax.effects_barrier(out)",
+    "x = out.item()",
+    "x = float(out)",
+    "x = int(out)",
+    "x = bool(out)",
+    "x = np.asarray(out)",
+]
+
+
+def test_sync_primitive_set_matches_runtime_booby_trap():
+    """The static rule names EXACTLY the jax attributes the runtime
+    booby-trap patches (test_step_path.py's no_syncs fixture) — the two
+    gates must never drift apart."""
+    import re
+
+    trap = Path(__file__).resolve().parents[1] / "test_obs" / \
+        "test_step_path.py"
+    patched = set(re.findall(
+        r'monkeypatch\.setattr\(jax,\s*"(\w+)"', trap.read_text()
+    ))
+    assert {f"jax.{name}" for name in patched} == SYNC_PRIMITIVES
+
+
+@pytest.mark.parametrize("stmt", _PLANTED)
+@pytest.mark.parametrize("shape", [_step_path, _tick_path])
+def test_sta010_flags_every_planted_sync(tmp_path, shape, stmt):
+    """ISSUE 15 acceptance: each booby-trapped primitive, planted on the
+    step path OR the tick path, is statically flagged."""
+    f = active(run(tmp_path, {"m.py": shape(stmt)}), "STA010")
+    assert len(f) == 1, (stmt, shape.__name__, f)
+
+
+def test_sta010_clean_hot_path_and_untainted_conversions(tmp_path):
+    """float() of host data on the hot path is fine; syncs behind the
+    documented stop subtrees (save_checkpoint) are policy, not
+    regressions; traced functions are out of scope (STA003 territory)."""
+    src = (
+        "import jax\n"
+        "\n"
+        "def run_training(batches):\n"
+        "    n = 0\n"
+        "    for b in batches:\n"
+        "        n += float(len(b))\n"  # host value: clean
+        "        save_checkpoint(b)\n"
+        "    return n\n"
+        "\n"
+        "def save_checkpoint(state):\n"
+        "    jax.block_until_ready(state)\n"  # documented sync window
+        "\n"
+        "@jax.jit\n"
+        "def traced_helper(x):\n"
+        "    return float(x)\n"  # traced: STA010 skips it
+    )
+    assert active(run(tmp_path, {"m.py": src}), "STA010") == []
+    assert "save_checkpoint" in HOT_PATH_STOPS
+
+
+def test_sta010_taint_flows_through_returns(tmp_path):
+    """A helper returning a device value taints its caller's name —
+    the conversion two hops from the jax call still fires."""
+    src = (
+        "import jax\n"
+        "\n"
+        "def run_training(b):\n"
+        "    out = _produce(b)\n"
+        "    return float(out)\n"
+        "\n"
+        "def _produce(b):\n"
+        "    return jax.device_put(b)\n"
+    )
+    f = active(run(tmp_path, {"m.py": src}), "STA010")
+    assert len(f) == 1 and "float" in f[0].message
+
+
+def test_sta010_unresolved_program_handle_taints(tmp_path):
+    """The engine idiom: calling a jitted program HANDLE (dict-of-fns,
+    unresolvable statically) with device operands yields device results
+    — conservatively tainted."""
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "\n"
+        "class ServeEngine:\n"
+        "    def tick(self, state):\n"
+        "        dev = jax.device_put(state)\n"
+        "        out = self._fns['decode'](dev)\n"
+        "        return np.asarray(out)\n"
+    )
+    f = active(run(tmp_path, {"m.py": src}), "STA010")
+    assert len(f) == 1 and "asarray" in f[0].message
+
+
+# ================================================================ STA011
+def test_sta011_raw_io_fires_only_in_scope_dirs(tmp_path):
+    src = (
+        "from pathlib import Path\n"
+        "\n"
+        "def publish(p, text):\n"
+        "    Path(p).write_text(text)\n"
+    )
+    assert active(run(tmp_path / "t1", {"serve/m.py": src}), "STA011")
+    assert active(run(tmp_path / "t2", {"nn/m.py": src}), "STA011") == []
+
+
+def test_sta011_retry_io_guards_lambda_and_named_callable(tmp_path):
+    src = (
+        "from pathlib import Path\n"
+        "from scaling_tpu.resilience.guards import retry_io\n"
+        "\n"
+        "def guarded_inline(p, text):\n"
+        "    retry_io(lambda: Path(p).write_text(text), what='w')\n"
+        "\n"
+        "def _writer(p, text):\n"
+        "    Path(p).write_text(text)\n"
+        "\n"
+        "def guarded_named(p, text):\n"
+        "    retry_io(lambda: _writer(p, text), what='w')\n"
+    )
+    assert active(run(tmp_path, {"runner/m.py": src}), "STA011") == []
+
+
+def test_sta011_fault_point_guards_but_process_points_do_not(tmp_path):
+    guarded = (
+        "def save(plan, p, data):\n"
+        "    plan.fire('ckpt.write')\n"
+        "    open(p, 'wb').write(data)\n"
+    )
+    assert active(run(tmp_path / "t1", {"resilience/a.py": guarded}),
+                  "STA011") == []
+    # a loop-top process fault (host.kill) is NOT I/O coverage for the
+    # writes the function transitively reaches
+    process = (
+        "def epoch(plan, p, data):\n"
+        "    plan.fire('host.kill')\n"
+        "    _write(p, data)\n"
+        "\n"
+        "def _write(p, data):\n"
+        "    open(p, 'wb').write(data)\n"
+    )
+    f = active(run(tmp_path / "t2", {"runner/b.py": process}), "STA011")
+    assert len(f) == 1 and "open" in f[0].message
+
+
+def test_lambda_bodies_are_not_a_blind_spot(tmp_path):
+    """Review regression: lambdas are never graph nodes of their own, so
+    their bodies must belong to the ENCLOSING function — raw I/O behind
+    a callback lambda still violates STA011, a sync hidden in a lambda
+    on the tick path still violates STA010 (while retry_io's own lambda
+    stays guarded via its lexical region)."""
+    io_src = (
+        "def via_lambda(p):\n"
+        "    cb = lambda: open(p).read()\n"
+        "    return cb()\n"
+    )
+    f = active(run(tmp_path / "t1", {"serve/m.py": io_src}), "STA011")
+    assert len(f) == 1 and "open" in f[0].message
+    sync_src = (
+        "import jax\n"
+        "\n"
+        "class ServeEngine:\n"
+        "    def tick(self, state):\n"
+        "        drain = lambda x: jax.block_until_ready(x)\n"
+        "        return drain(jax.device_put(state))\n"
+    )
+    f = active(run(tmp_path / "t2", {"serve/n.py": sync_src}), "STA010")
+    assert len(f) == 1, f
+
+
+def test_lint_paths_accepts_a_generator(tmp_path):
+    """Review regression: lint_paths materializes its paths once — a
+    generator argument must not be exhausted by the per-file pass and
+    silently hand the whole-program rules an empty tree."""
+    from scaling_tpu.analysis.lint import lint_paths
+
+    d = tmp_path / "serve"
+    d.mkdir(parents=True)
+    (d / "m.py").write_text(
+        "from pathlib import Path\n"
+        "\n"
+        "def publish(p, text):\n"
+        "    Path(p).write_text(text)\n"
+    )
+    findings = lint_paths((p for p in [tmp_path]), root=tmp_path)
+    assert [f.rule for f in findings] == ["STA011"]
+
+
+def test_sta011_guard_is_transitive_through_calls(tmp_path):
+    src = (
+        "from pathlib import Path\n"
+        "\n"
+        "def commit(plan, p, text):\n"
+        "    plan.fire('ckpt.rename')\n"
+        "    _stage(p, text)\n"
+        "\n"
+        "def _stage(p, text):\n"
+        "    _leaf(p, text)\n"
+        "\n"
+        "def _leaf(p, text):\n"
+        "    Path(p).write_text(text)\n"
+    )
+    assert active(run(tmp_path, {"checkpoint/m.py": src}), "STA011") == []

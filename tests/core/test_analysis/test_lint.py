@@ -13,12 +13,12 @@ REPO = Path(__file__).resolve().parents[3]
 FIXTURES = Path(__file__).resolve().parent / "fixtures"
 
 # (rule, line) pairs seeded in fixtures/nn/violations.py,
-# fixtures/trainer/swallowed.py, fixtures/runner/swallowed.py,
-# fixtures/obs/swallowed.py and fixtures/serve/swallowed.py — line
-# numbers are part of the fixtures'
-# contract (edits there stay additive at the bottom; each fixture's
-# lines deliberately avoid the others' so every (rule, line) pair
-# stays unique)
+# fixtures/{trainer,runner,obs,serve,tune}/swallowed.py,
+# fixtures/serve/raceclass.py (STA009), fixtures/serve/hotsync.py
+# (STA010) and fixtures/runner/rawio.py (STA011) — line numbers are
+# part of the fixtures' contract (edits there stay additive at the
+# bottom; each fixture's lines deliberately avoid the others' so every
+# (rule, line) pair stays unique)
 EXPECTED = [
     ("STA001", 17),   # if jnp.any(...)
     ("STA002", 24),   # np.tanh on traced
@@ -39,6 +39,11 @@ EXPECTED = [
     ("STA007", 40),   # obs: bare except around span emit
     ("STA007", 49),   # serve: swallowed scheduling tick
     ("STA007", 59),   # serve: bare except around block free
+    ("STA007", 82),   # tune: swallowed calibration read (ISSUE 15)
+    ("STA007", 89),   # tune: bare except around config emit
+    ("STA009", 42),   # raceclass: tick-thread write races submit (PR 14 idiom)
+    ("STA010", 26),   # hotsync: block_until_ready one level below tick
+    ("STA011", 19),   # rawio: raw write_text outside every guard
 ]
 SUPPRESSED = [
     ("STA003", 60),  # sta: disable=STA003
@@ -46,6 +51,10 @@ SUPPRESSED = [
     ("STA007", 38),  # runner: sta: disable=STA007
     ("STA007", 54),  # obs: sta: disable=STA007
     ("STA007", 73),  # serve: sta: disable=STA007
+    ("STA007", 103),  # tune: sta: disable=STA007
+    ("STA009", 51),  # raceclass: latching drain flag, sta: disable=STA009
+    ("STA010", 30),  # hotsync: deliberate token landing, sta: disable=STA010
+    ("STA011", 24),  # rawio: best-effort pid breadcrumb, sta: disable=STA011
 ]
 
 
@@ -136,7 +145,7 @@ def test_rule_table_is_stable():
     golden reports reference them)."""
     assert set(RULES) == {
         "STA001", "STA002", "STA003", "STA004", "STA005", "STA006", "STA007",
-        "STA008",
+        "STA008", "STA009", "STA010", "STA011",
     }
     for rule, (severity, _) in RULES.items():
         assert severity in ("error", "warning"), rule
@@ -144,9 +153,10 @@ def test_rule_table_is_stable():
 
 def test_swallowed_exception_only_flagged_in_scope_dirs(tmp_path):
     """STA007 is scoped to the fault-surfacing layers (trainer/,
-    checkpoint/, data/, resilience/, and — since ISSUE 4 — runner/, so
-    supervisor error paths can't silently eat worker failures); the
-    same code outside them is legal."""
+    checkpoint/, data/, resilience/, runner/ since ISSUE 4, and tune/
+    since ISSUE 15 — the tuner's CLI/serving-layout I/O must surface
+    corrupt calibration reads, not eat them); the same code outside
+    them is legal."""
     from scaling_tpu.analysis.lint import lint_file
 
     src = (
@@ -157,7 +167,7 @@ def test_swallowed_exception_only_flagged_in_scope_dirs(tmp_path):
         "        pass\n"
     )
     assert _lint_source(tmp_path, src) == []  # not under a scope dir
-    for scope in ("trainer", "runner"):
+    for scope in ("trainer", "runner", "tune"):
         d = tmp_path / scope
         d.mkdir()
         f2 = d / "mod.py"
@@ -222,6 +232,43 @@ def test_findings_are_json_serializable(fixture_findings):
 
     payload = json.dumps([f.to_dict() for f in fixture_findings])
     assert "STA004" in payload
+
+
+def test_per_rule_suppression_list(tmp_path):
+    """ISSUE 15 satellite: ``# sta: disable=RULE,RULE`` suppresses
+    exactly the listed rules on the line — a different rule firing on
+    the same line stays live; a bare ``# sta: disable`` blankets every
+    rule; the shared parser drives both the per-file and the
+    whole-program passes."""
+    # the listed rule is suppressed, an unlisted one on the SAME line
+    # is not (STA003's float() with only STA004 disabled)
+    live = _lint_source(tmp_path, (
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)  # sta: disable=STA004\n"
+    ))
+    assert [(f.rule, f.suppressed) for f in live] == [("STA003", False)]
+    listed = _lint_source(tmp_path, (
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)  # sta: disable=STA003,STA004\n"
+    ))
+    assert [(f.rule, f.suppressed) for f in listed] == [("STA003", True)]
+    blanket = _lint_source(tmp_path, (
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)  # sta: disable\n"
+    ))
+    assert [(f.rule, f.suppressed) for f in blanket] == [("STA003", True)]
+
+    # the shared parser: rule lists normalize (case, spaces), bare is None
+    from scaling_tpu.analysis.lint import parse_suppressions
+
+    sup = parse_suppressions(
+        "a = 1  # sta: disable=sta009, STA011\n"
+        "b = 2  # sta: disable\n"
+    )
+    assert sup == {1: {"STA009", "STA011"}, 2: None}
 
 
 def test_reshard_modules_are_swallow_scoped_and_clean(tmp_path):
