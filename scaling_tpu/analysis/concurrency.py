@@ -124,6 +124,10 @@ _RAW_IO_ATTRS = {"write_text", "write_bytes", "read_text", "read_bytes"}
 # reaches "guarded" (the whole save tree hangs off the train loop).
 PROCESS_FAULT_POINTS = {
     "signal.sigterm", "host.kill", "host.hang", "step.nan_grads",
+    # the serve worker's mid-tick SIGKILL drill: fired at the replica
+    # tick-loop top, same class of point as host.kill — the engine tick
+    # tree hanging off it is compute, not I/O
+    "serve.replica.kill",
 }
 
 # lock-free-field annotation: ``# sta: lock(attr_a, attr_b)`` in a class

@@ -74,6 +74,12 @@ def main(argv=None) -> int:
                         "completed-request skew (max/min) exceeds CEIL, "
                         "or the run dir holds no replica telemetry at "
                         "all (docs/SERVING.md the fleet)")
+    parser.add_argument("--assert-max-replica-restarts", type=int,
+                        metavar="CEIL",
+                        help="fail (exit 1) when the process fleet's "
+                        "supervisor performed more than CEIL relaunches, "
+                        "or the run dir holds no fleet supervision "
+                        "telemetry at all (docs/SERVING.md process mode)")
     args = parser.parse_args(argv)
 
     run_dir = Path(args.run_dir)
@@ -105,6 +111,7 @@ def main(argv=None) -> int:
         assert_max_shed_rate=args.assert_max_shed_rate,
         assert_max_serve_timeouts=args.assert_max_serve_timeouts,
         assert_max_replica_skew=args.assert_max_replica_skew,
+        assert_max_replica_restarts=args.assert_max_replica_restarts,
     )
     if (args.assert_mfu is not None or args.assert_step_time is not None
             or args.assert_tuner_calibration is not None
@@ -114,7 +121,8 @@ def main(argv=None) -> int:
             or args.assert_max_downsizes is not None
             or args.assert_max_shed_rate is not None
             or args.assert_max_serve_timeouts is not None
-            or args.assert_max_replica_skew is not None):
+            or args.assert_max_replica_skew is not None
+            or args.assert_max_replica_restarts is not None):
         print("== gates ==")
         if failures:
             for f in failures:

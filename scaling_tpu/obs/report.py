@@ -614,6 +614,58 @@ def serving_section(data: RunData) -> Tuple[List[str], Dict[str, float]]:
                 f"  throughput: ({tokens} tokens over {len(reqs)} "
                 "request(s); too few events to derive a rate)"
             )
+    # process-fleet supervision timeline (docs/SERVING.md "Process
+    # mode"): every replica lifecycle event — readiness, deaths,
+    # relaunches, autoscale spawns/drains, give-ups — in wall order,
+    # plus the restart tally the --assert-max-replica-restarts gate
+    # reads. Rendered only when replica lifecycle events exist, so
+    # non-fleet run dirs (and committed goldens) are unchanged.
+    fleet_events = sorted(
+        (
+            e for e in data.lifecycle
+            if str(e.get("event", "")).startswith("serve-replica-")
+            and e.get("ts") is not None
+        ),
+        key=lambda e: float(e["ts"]),
+    )
+    if fleet_events:
+        def count(name):
+            return sum(1 for e in fleet_events if e["event"] == name)
+
+        restarts = count("serve-replica-restart")
+        stats["serve_replica_restarts"] = float(restarts)
+        stats["serve_replica_spawns"] = float(count("serve-replica-spawn"))
+        stats["serve_replica_drains"] = float(count("serve-replica-drain"))
+        lines.append(
+            f"  fleet timeline: restarts={restarts} "
+            f"spawns={int(stats['serve_replica_spawns'])} "
+            f"drains={int(stats['serve_replica_drains'])} "
+            f"dead={count('serve-replica-dead')} "
+            f"hung={count('serve-replica-hung')} "
+            f"gave_up={count('serve-replica-give-up')}"
+        )
+        t0 = float(fleet_events[0]["ts"])
+        shown = fleet_events[:30]
+        for e in shown:
+            what = e["event"][len("serve-replica-"):]
+            who = e.get("replica")
+            detail = " ".join(
+                f"{k}={e[k]}" for k in (
+                    "rc", "attempt", "budget", "backoff_s", "recovered",
+                    "redispatch", "redispatched", "stranded", "attempts",
+                    "hb_age_s", "loop_age_s", "restarts",
+                )
+                if e.get(k) is not None
+            )
+            lines.append(
+                f"    +{float(e['ts']) - t0:7.3f}s "
+                + (f"replica {who}" if who is not None else "fleet")
+                + f" {what}" + (f" ({detail})" if detail else "")
+            )
+        if len(fleet_events) > len(shown):
+            lines.append(
+                f"    ... {len(fleet_events) - len(shown)} more event(s)"
+            )
     if ttfts:
         stats["serve_ttft_p50_s"] = percentile(ttfts, 50)
         stats["serve_ttft_p99_s"] = percentile(ttfts, 99)
@@ -771,7 +823,8 @@ def check_gates(data: RunData, assert_mfu: Optional[float] = None,
                 assert_max_downsizes: Optional[int] = None,
                 assert_max_shed_rate: Optional[float] = None,
                 assert_max_serve_timeouts: Optional[int] = None,
-                assert_max_replica_skew: Optional[float] = None
+                assert_max_replica_skew: Optional[float] = None,
+                assert_max_replica_restarts: Optional[int] = None
                 ) -> List[str]:
     """CI-style regression gates; returns failure messages (empty ==
     pass). Missing data FAILS a requested gate — a run that recorded no
@@ -785,7 +838,8 @@ def check_gates(data: RunData, assert_mfu: Optional[float] = None,
                      or assert_spec_accept_rate is not None
                      or assert_max_shed_rate is not None
                      or assert_max_serve_timeouts is not None
-                     or assert_max_replica_skew is not None)
+                     or assert_max_replica_skew is not None
+                     or assert_max_replica_restarts is not None)
     if serving_gates:
         _, sstats = serving_section(data)
         if assert_max_shed_rate is not None:
@@ -829,6 +883,22 @@ def check_gates(data: RunData, assert_mfu: Optional[float] = None,
                     f"{'inf' if math.isinf(skew) else format(skew, '.2f')}"
                     f" > ceiling {assert_max_replica_skew:.2f} (a replica "
                     "is starved or dead — check the router rows)"
+                )
+        if assert_max_replica_restarts is not None:
+            restarts = sstats.get("serve_replica_restarts")
+            if restarts is None:
+                failures.append(
+                    "assert-max-replica-restarts: no fleet supervision "
+                    "telemetry in the run dir (no serve-replica-* "
+                    "lifecycle events — was the bench run with "
+                    "--replicas-proc?)"
+                )
+            elif restarts > assert_max_replica_restarts:
+                failures.append(
+                    f"assert-max-replica-restarts: {int(restarts)} "
+                    f"supervised relaunch(es) > ceiling "
+                    f"{assert_max_replica_restarts} (replicas are "
+                    "crash-looping — check the fleet timeline)"
                 )
         if assert_spec_accept_rate is not None:
             rate = sstats.get("serve_spec_accept_rate")

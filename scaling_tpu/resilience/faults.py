@@ -70,6 +70,21 @@ point               fired
 ``serve.pool``      once per KV-block allocation batch
                     (``serve.scheduler.ContinuousBatchingScheduler``'s
                     block grants — admission, growth, CoW forks)
+``serve.replica.spawn``  HOST-side, once per replica subprocess launch
+                    (``serve.replica_proc`` — initial spawns, supervised
+                    relaunches, autoscale spawns); ``fail`` here is an
+                    OSError the fleet supervisor's budgeted backoff
+                    absorbs
+``serve.replica.rpc``  WORKER-side, at the top of every handled RPC
+                    request (submit/poll/stats/drain); ``fail`` drops
+                    that reply — the host's ``retry_io`` layer retries,
+                    which is exactly the at-least-once window the
+                    idempotent ops are designed for
+``serve.replica.kill``  WORKER-side, before each engine tick while the
+                    replica has work; ``kill@N@host=K`` (workers export
+                    ``SCALING_TPU_HOST_ID=<replica_id>``) SIGKILLs
+                    exactly one replica mid-stream — the chaos e2e's
+                    journal-exact failover drill
 ==================  =====================================================
 
 Spec grammar (comma list): ``point=action[@N][xM][@host=K][@epoch=E]``

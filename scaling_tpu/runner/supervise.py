@@ -134,6 +134,16 @@ def classify_workers(
     return {"dead": dead, "hung": hung, "alive": alive}
 
 
+def restart_backoff(attempt: int, base_s: float,
+                    cap_s: float = 60.0) -> float:
+    """Relaunch delay for restart ``attempt`` (1-based): exponential
+    from ``base_s``, capped — the ONE backoff curve every supervisor in
+    the tree uses (trainer relaunches here, serving replica relaunches
+    in ``serve.replica_proc``), so a chaos drill's restart timeline
+    reads the same in both."""
+    return min(base_s * (2 ** (max(attempt, 1) - 1)), cap_s)
+
+
 def _signal_local(p: subprocess.Popen, sig: str) -> None:
     """SIGTERM/SIGKILL a local worker Popen, logging instead of raising
     (signal delivery races process exit benignly)."""
@@ -635,7 +645,8 @@ def supervise_main(config: RunnerConfig, payload: Any) -> int:
                 f"({config.restart_budget}); giving up"
             )
             return rc
-        delay = config.restart_backoff_seconds * (2 ** (restarts - 1))
+        delay = restart_backoff(restarts, config.restart_backoff_seconds,
+                                cap_s=float("inf"))
         epoch += 1
         logger.log_event(
             "relaunch", epoch=epoch, restarts=restarts,

@@ -702,6 +702,323 @@ def _run_fleet(args, infs, workload, journal_base, make_engine,
     )
 
 
+def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
+    """Process-isolated fleet mode (``--replicas-proc N``,
+    docs/SERVING.md "Process mode"): every replica is a SUBPROCESS
+    behind the same router policy, supervised by
+    ``replica_proc.FleetSupervisor`` — a SIGKILLed replica's journal is
+    harvested, its incomplete requests re-dispatch to survivors
+    token-exactly, and the process relaunches on budgeted backoff; with
+    ``--autoscale`` the supervisor also spawns under sustained pressure
+    and drains at sustained idle.
+
+    The HOST stays jax-free and single-threaded: submissions, polling,
+    and supervision all run on this loop (each worker process owns its
+    own devices, so nothing here needs the threaded fleet's per-replica
+    tick threads or their lock discipline). Finished requests ship back
+    via cursor-based ``poll`` RPCs; the summary's ``outputs`` map
+    (req_id -> tokens) is what the chaos drill diffs against a
+    fault-free run."""
+    import os
+    import signal
+    import subprocess
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..logging import logger
+    from ..obs import get_registry
+    from ..obs.report import percentile
+    from .journal import RequestJournal
+    from .replica_proc import FleetSupervisor, spawn_replica_proc
+    from .router import AutoscalePolicy, FleetRouter, ReplicaUnreachable
+
+    # fresh run: stale journals from a previous drill in this dir (ANY
+    # replica id — an earlier run may have autoscaled further) would
+    # poison failover harvests
+    for stale in run_dir.glob(f"{journal_base.stem}*{journal_base.suffix}"):
+        stale.unlink()
+    fleet_journal = RequestJournal(journal_base)
+    worker_cfg = {
+        "journal_base": str(journal_base),
+        "metrics_path": str(run_dir / "metrics.jsonl"),
+        "warmup": args.warmup,
+        "toy": {"hidden": args.hidden, "layers": args.layers,
+                "vocab": args.vocab, "heads": args.heads},
+        "engine": {
+            "num_slots": args.num_slots, "block_size": args.block_size,
+            "num_blocks": args.num_blocks,
+            "max_blocks_per_seq": args.max_blocks_per_seq,
+            "token_budget": args.token_budget, "kv_dtype": args.kv_dtype,
+            "prefill_chunk": args.prefill_chunk or None,
+            "paged_kernel": args.paged_kernel,
+            "fused_tick": not args.no_fused_tick,
+            "enable_prefix_cache": not args.no_prefix_cache,
+            "spec_k": args.spec_k,
+            "default_deadline_ms": args.deadline_ms,
+            "default_ttft_deadline_ms": args.ttft_deadline_ms,
+            "shed_high_watermark": args.shed_high_watermark,
+            "shed_low_watermark": args.shed_low_watermark,
+            "max_waiting": args.max_waiting,
+        },
+    }
+    chaos_env = dict(os.environ)
+    clean_env = dict(os.environ)
+    # a chaos plan arms the INITIAL spawns only: hit counters are
+    # per-process, so a relaunched or autoscaled worker re-armed with
+    # the same plan would die at the same hit forever
+    # (run_supervised's rule)
+    clean_env.pop("SCALING_TPU_FAULTS", None)
+
+    def spawn(replica_id, env=None):
+        return spawn_replica_proc(
+            replica_id, worker_cfg, run_dir,
+            env=clean_env if env is None else env,
+        )
+
+    # parallel launch: every worker pays its cold jit warmup at once
+    with ThreadPoolExecutor(max_workers=args.replicas_proc) as ex:
+        handles = list(ex.map(
+            lambda r: spawn(r, chaos_env), range(args.replicas_proc)
+        ))
+    router = FleetRouter(handles=handles, block_size=args.block_size)
+    policy = None
+    if args.autoscale:
+        policy = AutoscalePolicy(
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            sustain_s=args.autoscale_sustain_s,
+            idle_sustain_s=args.autoscale_idle_s,
+            cooldown_s=args.autoscale_sustain_s,
+        )
+    recs: dict = {}  # req_id -> finished record, newest wins
+
+    def harvest(handle):
+        try:
+            for rec in handle.poll_finished():
+                recs[int(rec["req"])] = rec
+        except ReplicaUnreachable:
+            pass  # dead replica: the journal harvest owns its outputs
+
+    sup = FleetSupervisor(
+        router, spawn, journal_base,
+        restart_budget=args.restart_budget,
+        policy=policy, on_drain=harvest,
+    )
+    drain_req = {"flag": False}
+
+    def _drain_sig(signum, frame):
+        # flag only: RPC fan-out happens on the loop, not in the handler
+        drain_req["flag"] = True
+
+    prev = signal.signal(signal.SIGTERM, _drain_sig)
+    pending = sorted(workload, key=lambda w: w[0])
+    idx = 0
+    shed = 0
+    draining = False
+    t0 = time.monotonic()
+    last_sup = -1.0
+    try:
+        while True:
+            now = time.monotonic() - t0
+            if now > args.max_wall_s:
+                raise RuntimeError(
+                    f"proc fleet bench exceeded --max-wall-s="
+                    f"{args.max_wall_s}: {idx}/{len(pending)} submitted, "
+                    f"{len(recs)} finished"
+                )
+            if drain_req["flag"] and not draining:
+                draining = True
+                logger.log_event(
+                    "serve-drain", fleet=True, replicas=len(router.live),
+                )
+                router.begin_drain()
+            if now - last_sup >= 0.05:
+                last_sup = now
+                sup.tick()
+                for h in router.replicas:
+                    if h.alive and not h.retired:
+                        harvest(h)
+            if sup.gave_up and not router.live:
+                raise RuntimeError(
+                    "every replica exhausted its restart budget; "
+                    f"{len(sup.orphans)} request(s) stranded"
+                )
+            while not draining and idx < len(pending) \
+                    and pending[idx][0] <= now:
+                arrival, prompt, olen = pending[idx]
+                res = router.submit(prompt, olen)
+                if isinstance(res, Backpressure):
+                    if res.draining:
+                        draining = True  # SIGTERM raced this submission
+                        break
+                    shed += 1
+                    get_registry().counter(
+                        "serve_requests_shed_total"
+                    ).inc()
+                    fleet_journal.record_shed(res.reason)
+                idx += 1
+            if (draining or idx >= len(pending)) and not router.has_work \
+                    and not sup.pending_recovery():
+                break
+            time.sleep(0.002)
+        # autoscale settle: hold the fleet at idle long enough for the
+        # policy's idle-drain to fire (the drill pins "drains at idle
+        # within budget") — bounded by the wall clock
+        if policy is not None and not draining:
+            deadline = min(
+                time.monotonic()
+                + (policy.idle_sustain_s + policy.cooldown_s) * 2 + 1.0,
+                t0 + args.max_wall_s,
+            )
+            while (sum(1 for h in router.replicas
+                       if h.alive and not h.retired) > policy.min_replicas
+                   and policy.drains < policy.drain_budget
+                   and time.monotonic() < deadline):
+                sup.tick()
+                time.sleep(0.02)
+        wall_s = time.monotonic() - t0
+        for h in router.replicas:
+            if h.alive and not h.retired:
+                try:
+                    h.refresh()
+                except ReplicaUnreachable:
+                    pass
+                harvest(h)
+                h.request_shutdown()
+        for h in router.replicas:
+            if h.proc.poll() is None:
+                try:
+                    h.proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    logger.warning(
+                        f"replica {h.replica_id} ignored shutdown; killing"
+                    )
+                    h.proc.kill()
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        for h in router.replicas:
+            if h.proc.poll() is None:
+                h.proc.kill()  # no orphan keeps writing to the run dir
+
+    completed = {
+        r: rec for r, rec in recs.items() if rec["status"] == "completed"
+    }
+    # outputs = polled records, with the failover harvest filling in
+    # requests whose serving replica died after finishing them
+    outputs = {int(r): list(t) for r, t in sup.recovered.items()}
+    outputs.update({r: list(rec["toks"]) for r, rec in completed.items()})
+    timeouts = sup.recovered_timeouts + sum(
+        1 for rec in recs.values() if rec["status"] == "timeout"
+    )
+    attempts = shed + timeouts + len(outputs)
+    output_tokens = sum(len(rec["toks"]) for rec in recs.values()) + sum(
+        len(t) for r, t in sup.recovered.items() if r not in recs
+    )
+    ttfts = sorted(
+        rec["ttft_s"] for rec in recs.values()
+        if rec.get("ttft_s") is not None
+    )
+    itls = sorted(g for rec in recs.values() for g in rec.get("itls", ()))
+
+    def pct(vals, q):
+        return percentile(vals, q) if vals else None
+
+    rstats = router.stats()
+    agg_keys = ("preemptions", "prefix_hit_tokens", "prefilled_tokens",
+                "spec_drafted_tokens", "spec_accepted_tokens",
+                "prefill_compiles")
+    agg = dict.fromkeys(agg_keys, 0)
+    ticks = 0
+    max_prefills = 0
+    replica_rows = []
+    for h in router.replicas:
+        s = h.last_stats
+        h_ticks = h.ticks_banked + int(s.get("tick", 0))
+        ticks += h_ticks
+        for k in agg:
+            agg[k] += int(s.get(k, 0))
+        max_prefills = max(
+            max_prefills, int(s.get("max_concurrent_prefills", 0))
+        )
+        replica_rows.append({
+            "replica": h.replica_id,
+            "alive": h.alive,
+            "retired": h.retired,
+            "restarts": h.restarts,
+            "requests": int(s.get("completed", 0)),
+            "output_tokens": int(s.get("output_tokens", 0)),
+            "timeouts": int(s.get("timeout_count", 0)),
+            "ticks": h_ticks,
+            "preemptions": int(s.get("preemptions", 0)),
+            "pool_pressure": round(float(s.get("pool_pressure", 0.0)), 4),
+            **rstats["per_replica"].get(h.replica_id, {}),
+        })
+    hit = agg["prefix_hit_tokens"]
+    prefilled = agg["prefilled_tokens"]
+    drafted = agg["spec_drafted_tokens"]
+    stats = {
+        "requests": len(outputs),
+        "requests_timeout": timeouts,
+        "requests_shed": shed,
+        "shed_rate": round(shed / attempts, 4) if attempts else 0.0,
+        "drained": draining,
+        "unsubmitted": len(pending) - idx,
+        "wall_s": round(wall_s, 6),
+        "output_tokens": output_tokens,
+        "prompt_tokens": sum(
+            int(rec.get("prompt_len", 0)) for rec in recs.values()
+        ),
+        "tokens_per_s": (
+            round(output_tokens / wall_s, 3) if wall_s > 0 else 0.0
+        ),
+        "ttft_p50_s": pct(ttfts, 50),
+        "ttft_p99_s": pct(ttfts, 99),
+        "itl_p50_s": pct(itls, 50),
+        "itl_p99_s": pct(itls, 99),
+        "preemptions": agg["preemptions"],
+        "ticks": ticks,
+        "prefill_compiles": agg["prefill_compiles"],
+        "max_concurrent_prefills": max_prefills,
+        "prefix_hit_tokens": hit,
+        "prefix_hit_rate": (
+            round(hit / (hit + prefilled), 4) if hit + prefilled else 0.0
+        ),
+        "prefilled_tokens": prefilled,
+        "spec_drafted_tokens": drafted,
+        "spec_accepted_tokens": agg["spec_accepted_tokens"],
+        "spec_accept_rate": (
+            round(agg["spec_accepted_tokens"] / drafted, 4)
+            if drafted else None
+        ),
+        "replicas": len(router.replicas),
+        "replica_stats": replica_rows,
+        "router": rstats,
+        "engine": {
+            "mp": 1, "replicas": len(router.replicas),
+            "num_slots": args.num_slots, "block_size": args.block_size,
+            "num_blocks": args.num_blocks,
+            "token_budget": args.token_budget,
+            "prefill_chunk": args.prefill_chunk or None,
+            "spec_k": args.spec_k,
+        },
+        # the process-fleet story (obs report's fleet section + the
+        # --assert-max-replica-restarts gate read these)
+        "proc_fleet": True,
+        "replica_restarts": sup.restarts,
+        "replica_spawns": policy.spawns if policy else 0,
+        "replica_drains": policy.drains if policy else 0,
+        "recovered_requests": len(sup.recovered),
+        "redispatched_requests": sup.redispatched,
+        "replicas_gave_up": len(sup.gave_up),
+    }
+    # the event rides WITHOUT the raw outputs map (events.jsonl is for
+    # telemetry, not payloads); the returned stats / --json carry it for
+    # the chaos drill's token-exact diff
+    logger.log_event("serve-summary", **stats)
+    stats["outputs"] = {str(r): outputs[r] for r in sorted(outputs)}
+    get_registry().flush_step(ticks)
+    return stats
+
+
 def _run_spec_sweep(args, sweep_ks, workload, make_engine,
                     warmup_engine) -> dict:
     """``--spec-k-sweep``: the SAME workload once per draft length k on
@@ -852,6 +1169,34 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "pools shard over the model axis (each chip "
                         "holds n_kv/mp heads) and the tick programs run "
                         "SPMD; needs replicas*mp devices")
+    # ---- process mode (docs/SERVING.md "Process mode") ----
+    parser.add_argument("--replicas-proc", type=int, default=0,
+                        metavar="N",
+                        help="process-isolated fleet: N replica "
+                        "SUBPROCESSES behind the router, supervised "
+                        "in-run (SIGKILL a replica -> journal-exact "
+                        "failover to survivors + budgeted relaunch); "
+                        "replaces --replicas, toy model only, mp=1")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="with --replicas-proc: spawn a replica "
+                        "under sustained fleet-wide pressure, drain one "
+                        "at sustained idle (budgeted, never below "
+                        "--min-replicas)")
+    parser.add_argument("--min-replicas", type=int, default=1,
+                        help="autoscale floor (drains stop here)")
+    parser.add_argument("--max-replicas", type=int, default=4,
+                        help="autoscale ceiling (spawns stop here)")
+    parser.add_argument("--autoscale-sustain-s", type=float, default=2.0,
+                        help="seconds the whole fleet must stay above "
+                        "the high watermark before a spawn (also the "
+                        "action cooldown)")
+    parser.add_argument("--autoscale-idle-s", type=float, default=5.0,
+                        help="seconds the whole fleet must stay idle "
+                        "before a drain")
+    parser.add_argument("--restart-budget", type=int, default=3,
+                        help="with --replicas-proc: supervised "
+                        "relaunches allowed per replica before the "
+                        "supervisor gives it up")
     parser.add_argument("--config", metavar="FILE",
                         help="tuner-emitted serving config (python -m "
                         "scaling_tpu.tune --serve --emit-config): its "
@@ -933,6 +1278,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
     if args.config:
         _apply_serving_config(args, argv, parser)
+    if args.replicas_proc and args.restarts:
+        parser.error("--replicas-proc supervises its replicas in-run "
+                     "(relaunch + journal failover); --restarts "
+                     "supervises the in-process bench — pick one")
     if args.restarts > 0:
         return run_supervised(argv, args)
     if args.requests < 1:
@@ -972,8 +1321,39 @@ def main(argv: Optional[List[str]] = None) -> int:
             "fleet of checkpoint-sized replicas is a dev harness, not a "
             "deployment; production runs one process per replica)"
         )
-    _ensure_devices(args.replicas * args.mp)
-    from .engine import EngineConfig, ServeEngine, install_drain_handler
+    proc_fleet = args.replicas_proc > 0
+    if proc_fleet:
+        if args.replicas_proc < 1:
+            parser.error("--replicas-proc must be >= 1")
+        if fleet:
+            parser.error("--replicas-proc IS the fleet (subprocess "
+                         "replicas); drop --replicas")
+        if args.mp > 1:
+            parser.error("--replicas-proc serves mp=1 replicas (each "
+                         "worker process owns its own devices)")
+        if args.checkpoint:
+            parser.error("--replicas-proc serves the toy model only "
+                         "(workers rebuild the model from the config "
+                         "they are handed)")
+        if sweep_ks is not None:
+            parser.error("--spec-k-sweep is single-replica")
+        if args.resume:
+            parser.error("--replicas-proc recovers in-run (the "
+                         "supervisor harvests dead replicas' journals); "
+                         "--resume is the in-process replay path")
+        if args.no_journal:
+            parser.error("--replicas-proc needs the journal — failover "
+                         "replays it")
+        if args.autoscale and args.min_replicas > args.replicas_proc:
+            parser.error("--min-replicas exceeds --replicas-proc")
+        if args.autoscale and args.max_replicas < args.min_replicas:
+            parser.error("--max-replicas < --min-replicas")
+    else:
+        _ensure_devices(args.replicas * args.mp)
+    # the proc-fleet HOST never builds an engine: the jax-importing
+    # modules load only in the worker subprocesses
+    if not proc_fleet:
+        from .engine import EngineConfig, ServeEngine, install_drain_handler
 
     import os
 
@@ -988,7 +1368,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     get_registry().configure(metrics_path=str(run_dir / "metrics.jsonl"))
 
-    if args.checkpoint:
+    if proc_fleet:
+        # workers build their own toy model from the handed config
+        infs = []
+        inf = None
+        vocab = args.vocab
+    elif args.checkpoint:
         from ..models.transformer.inference import TransformerInferenceModule
 
         topology = (
@@ -1064,7 +1449,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     journal_base = run_dir / "journal.jsonl"
 
-    if fleet:
+    if proc_fleet:
+        stats = _run_fleet_proc(args, workload, run_dir, journal_base)
+    elif fleet:
         stats = _run_fleet(args, infs, workload, journal_base, make_engine,
                            warmup_engine)
     elif sweep_ks is not None:
@@ -1169,13 +1556,26 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"retries_elsewhere={r['retries_elsewhere']} "
               f"rejected={r['rejected']}")
         for row in stats["replica_stats"]:
+            if row.get("retired"):
+                mark = " [drained]"
+            elif not row.get("alive", True):
+                mark = " [FAILED]"
+            else:
+                mark = ""
+            if row.get("restarts"):
+                mark = f" restarts={row['restarts']}" + mark
             print(f"    replica {row['replica']}: "
                   f"requests={row['requests']} "
                   f"tokens={row['output_tokens']} "
                   f"dispatches={row.get('dispatches', 0)} "
                   f"ticks={row['ticks']} "
-                  f"pressure={row['pool_pressure']:.2f}"
-                  + ("" if row.get("alive", True) else " [FAILED]"))
+                  f"pressure={row['pool_pressure']:.2f}" + mark)
+    if stats.get("proc_fleet"):
+        print(f"  supervision: restarts={stats['replica_restarts']} "
+              f"spawns={stats['replica_spawns']} "
+              f"drains={stats['replica_drains']} "
+              f"recovered={stats['recovered_requests']} "
+              f"redispatched={stats['redispatched_requests']}")
     if stats.get("spec_k_sweep"):
         print(f"  spec-k sweep (best k={stats['spec_k_best']}):")
         for row in stats["spec_k_sweep"]:

@@ -1102,6 +1102,43 @@ class ServeEngine:
         return (len(self._prefill_fns) + len(self._chunk_fns)
                 + len(self._mixed_fns))
 
+    def stats_snapshot(self) -> dict:
+        """One JSON-safe dict of the engine's load + lifetime tallies —
+        the ``stats`` RPC reply a subprocess replica answers with
+        (``serve.replica_proc``), which doubles as its heartbeat: every
+        field the router's least-loaded sort, the supervisor's liveness
+        pass, and the proc-fleet serve-summary read. Reads are plain
+        attribute/len reads (GIL-atomic against a concurrent tick), so
+        this is safe to call from an RPC handler thread without the
+        tick lock."""
+        sched = self.scheduler
+        finished = list(self.finished)
+        return {
+            "replica": self.replica_id,
+            "queue_depth": len(sched.waiting) + len(sched.running),
+            "waiting": len(sched.waiting),
+            "running": len(sched.running),
+            "pool_pressure": sched.pool_pressure(),
+            "has_work": sched.has_work,
+            "draining": self.draining,
+            "next_req_id": self._next_req_id,
+            "tick": self.tick_index,
+            "shed_count": self.shed_count,
+            "timeout_count": self.timeout_count,
+            "finished": len(finished),
+            "completed": sum(
+                1 for s in finished if s.finish_status == "completed"
+            ),
+            "output_tokens": sum(len(s.generated) for s in finished),
+            "preemptions": sched.preemption_count,
+            "prefix_hit_tokens": sched.prefix_hit_tokens,
+            "prefilled_tokens": self.prefilled_tokens,
+            "spec_drafted_tokens": self.spec_drafted_tokens,
+            "spec_accepted_tokens": self.spec_accepted_tokens,
+            "prefill_compiles": self.prefill_program_count,
+            "max_concurrent_prefills": self.max_concurrent_prefills,
+        }
+
     def run_until_done(self, max_ticks: int = 100_000) -> List[Sequence]:
         """Drain every submitted request; returns finished sequences in
         completion order."""

@@ -223,6 +223,19 @@ def open_journal(path, resume: bool, replica_id: Optional[int] = None):
     return RequestJournal(p), replay
 
 
+def failover_split(path):
+    """Harvest a DEAD replica's journal for fleet failover: returns
+    ``(completed, incomplete, timeout_count)`` — delivered outputs
+    (req_id -> tokens) the supervisor folds straight into the run's
+    results, submit records to re-dispatch to SURVIVORS (original
+    req_ids + ``force=True`` keep the (request, position) sampler keys,
+    so any replica regenerates the same tokens), and the dead replica's
+    terminal timeouts (counted, never replayed). One named seam so the
+    failover policy is unit-testable against a literal journal file."""
+    rep = replay_journal(path)
+    return rep.completed, rep.incomplete, rep.timeout_count
+
+
 def replay_journal(path) -> JournalReplay:
     """Parse a journal (tolerant of one torn tail line — the SIGKILL
     signature) into :class:`JournalReplay`."""

@@ -1,0 +1,893 @@
+"""Process-isolated serving replicas (docs/SERVING.md "Process mode").
+
+The threaded fleet (PR 14) scales out to N replicas but they share ONE
+Python process: a crash, a poisoned request, or an OOM in any replica
+takes down the whole fleet. This module moves each replica into its own
+supervised subprocess and makes the fleet survive anything a process
+can do to you:
+
+- **Worker** (``python -m scaling_tpu.serve.replica_proc --config f``):
+  one :class:`~.engine.ServeEngine` behind a line-JSON RPC loop —
+  the SAME newline-JSON-over-TCP idioms as
+  ``resilience.controlplane.TcpControlPlaneServer`` (serial accept
+  loop, one short-lived thread per connection, 64 KiB request cap,
+  catch-all handler), not ad-hoc sockets. Ops: ``submit`` (idempotent
+  by req_id — a retried submit never double-enqueues), ``poll``
+  (cursor-based, so a lost reply re-ships instead of dropping
+  records), ``stats`` (the engine's :meth:`stats_snapshot`, doubling
+  as the heartbeat: the reply carries the tick loop's age so a wedged
+  loop is visible even while the RPC threads still answer), ``drain``,
+  ``shutdown``. The worker journals to the same ``journal_r<id>``
+  namespace the threaded fleet uses and warms up BEFORE its address
+  file appears — readiness is the address file, atomically replaced.
+
+- **Host**: :class:`ProcReplicaHandle` answers the exact
+  :class:`~.router.ReplicaHandle` surface over RPC, so the router's
+  policy (least-loaded, hash-based prefix affinity, retry-elsewhere)
+  is untouched; every call rides ``retry_io`` with per-call timeouts
+  and raises :class:`~.router.ReplicaUnreachable` when the process is
+  gone. :func:`classify_replicas` is the ``runner.supervise``
+  dead/hung split over (exit code, heartbeat age, loop age):
+  non-zero exit -> dead, stale heartbeat past the startup grace ->
+  hung (SIGKILLed into dead).
+
+- **Failover** (:class:`FleetSupervisor`): a dead replica's journal is
+  harvested (:func:`~.journal.failover_split`) — completed outputs
+  fold straight into the run's results, incomplete requests
+  re-dispatch to SURVIVORS with their original req_ids + ``force=True``
+  (the (request, position) sampler keys make the regenerated tokens
+  identical on any replica), and the replica relaunches on the shared
+  ``runner.supervise.restart_backoff`` curve under a per-replica
+  budget. kill -9 any replica mid-tick and the bench completes with
+  every request's tokens identical to a fault-free run.
+
+- **Autoscaling**: the supervisor feeds each tick's stats snapshot to
+  :class:`~.router.AutoscalePolicy`; sustained fleet-wide pressure
+  spawns a replica (fresh id, fresh journal namespace), sustained
+  idle drains the youngest — both budgeted and emitted as structured
+  events (``serve-replica-{spawn,drain,restart,give-up}``) that
+  ``obs report`` renders in the fleet timeline.
+
+Fault points (docs in :mod:`..resilience.faults`):
+``serve.replica.spawn`` (host, per launch), ``serve.replica.rpc``
+(worker, per handled request), ``serve.replica.kill`` (worker, before
+each tick while it has work — the mid-stream SIGKILL drill).
+
+Host side is jax-free; only the worker imports the engine (each
+process owns its devices, so the GIL lessons from PR 14 disappear by
+construction).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..logging import logger
+from ..resilience.faults import get_fault_plan
+from ..resilience.guards import retry_io
+from ..runner.supervise import restart_backoff
+from .journal import failover_split, journal_path
+from .router import (
+    AutoscalePolicy,
+    FleetRouter,
+    ReplicaStats,
+    ReplicaUnreachable,
+)
+from .scheduler import Backpressure
+
+# worker startup can sit inside a cold jit compile for minutes off-TPU;
+# the grace both bounds the host's ready-wait and suppresses hung
+# verdicts while the first programs build (runner.supervise's rule)
+DEFAULT_STARTUP_GRACE_S = 180.0
+DEFAULT_HEARTBEAT_TIMEOUT_S = 10.0
+# a drained worker without a shutdown op exits on its own after this
+# (the host died — don't serve a dead fleet forever)
+DEFAULT_LINGER_S = 60.0
+
+
+def _atomic_write(path, text: str) -> None:
+    """tmp + rename so a reader never observes a torn file (the
+    control plane's address-file idiom)."""
+    p = Path(path)
+    tmp = p.with_name(p.name + ".tmp")
+    tmp.write_text(text)
+    os.replace(tmp, p)
+
+
+# ======================================================== worker side
+class ReplicaRpcServer:
+    """Line-JSON RPC server for ONE replica worker — the
+    ``TcpControlPlaneServer`` idioms verbatim: serial accept loop with
+    a short timeout, one short-lived daemon thread per connection (an
+    idle prober must not park the accept loop for its full read
+    timeout), bounded request lines, and a catch-all handler (a
+    malformed request logs a warning and drops the reply; the host's
+    retry layer owns the recovery)."""
+
+    MAX_REQUEST_BYTES = 64 * 1024
+
+    def __init__(self, handler: Callable[[dict], dict],
+                 host: str = "127.0.0.1", port: int = 0):
+        self._handler = handler
+        # stays raw: one-time server bind at worker startup — a port
+        # conflict or bad address is a config error that must abort the
+        # worker loudly, not retry (host REQUESTS ride retry_io)
+        self._sock = socket.socket(  # sta: disable=STA011
+            socket.AF_INET, socket.SOCK_STREAM
+        )
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        # short accept timeout set BEFORE the thread starts: a close()
+        # racing the loop's first line must find the timeout installed,
+        # not a raw settimeout on an already-closed fd
+        self._sock.settimeout(0.2)
+        self.address = f"{host}:{self._sock.getsockname()[1]}"
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, name="replica-rpc-server", daemon=True
+        )
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # socket closed under us during shutdown
+            threading.Thread(
+                target=self._handle_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                conn.settimeout(5.0)
+                data = conn.makefile("r").readline(self.MAX_REQUEST_BYTES)
+                if len(data) >= self.MAX_REQUEST_BYTES \
+                        and not data.endswith("\n"):
+                    raise ValueError(
+                        f"request line exceeds "
+                        f"{self.MAX_REQUEST_BYTES} bytes"
+                    )
+                reply = self._handler(json.loads(data))
+                conn.sendall((json.dumps(reply) + "\n").encode())
+        except Exception as e:
+            # survive ANY malformed request or injected rpc fault: an
+            # uncaught error kills the thread silently and drops the
+            # reply — the host retries, which is the designed window
+            logger.warning(f"replica rpc request failed: {e!r}")
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError as e:
+            logger.debug(f"replica rpc server close: {e!r}")
+        self._thread.join(timeout=5)
+
+
+class _ReplicaWorker:
+    """One engine + its RPC surface; ``run`` is the tick loop."""
+
+    # _loop_wall is written by the tick loop and read by RPC handler
+    # threads with no lock ON PURPOSE: it is a monotonic float beat
+    # (GIL-atomic store), and taking the tick lock to read it would
+    # make the heartbeat blind to exactly the wedged-tick state it
+    # exists to expose.
+    # sta: lock(_loop_wall)
+
+    def __init__(self, engine, linger_s: float = DEFAULT_LINGER_S):
+        self.engine = engine
+        self.linger_s = linger_s
+        self.tick_lock = threading.Lock()
+        self.shutdown = threading.Event()
+        self._loop_wall = time.monotonic()
+
+    # ------------------------------------------------------------ ops
+    def _knows(self, req_id: int) -> bool:
+        sched = self.engine.scheduler
+        seqs = list(sched.running.values()) + list(sched.waiting) \
+            + list(self.engine.finished)
+        return any(s.request.req_id == req_id for s in seqs)
+
+    @staticmethod
+    def _record(seq) -> dict:
+        stamps = seq.token_stamps
+        return {
+            "req": seq.request.req_id,
+            "status": seq.finish_status,
+            "toks": [int(t) for t in seq.generated],
+            "prompt_len": len(seq.request.prompt),
+            "ttft_s": (
+                seq.first_token_s - seq.request.arrival_s
+                if seq.first_token_s is not None else None
+            ),
+            "itls": [round(b - a, 6) for a, b in zip(stamps, stamps[1:])],
+        }
+
+    def handle(self, req: dict) -> dict:
+        get_fault_plan().fire("serve.replica.rpc")
+        op = req.get("op")
+        if op == "submit":
+            kw = dict(req.get("kw") or {})
+            rid = kw.get("req_id")
+            if rid is not None and self._knows(int(rid)):
+                # at-least-once made exactly-once: the first attempt's
+                # reply was lost; re-enqueueing would serve the request
+                # twice (identical tokens — same sampler keys — but
+                # double the compute and inflated counts)
+                return {"ok": True, "admitted": True, "req": int(rid),
+                        "dup": True}
+            # NOT under tick_lock: ServeEngine.submit only appends to
+            # the waiting deque and reads load state (the PR 14 rule —
+            # serializing submits behind the tick starved admission)
+            res = self.engine.submit(
+                req["prompt"], int(req["max_new_tokens"]), **kw
+            )
+            if isinstance(res, Backpressure):
+                return {"ok": True, "admitted": False, "bp": {
+                    "reason": res.reason,
+                    "pool_pressure": res.pool_pressure,
+                    "waiting": res.waiting,
+                    "draining": res.draining,
+                }}
+            return {"ok": True, "admitted": True,
+                    "req": res.request.req_id}
+        if op == "stats":
+            return {"ok": True, "stats": self.engine.stats_snapshot(),
+                    "loop_age_s": time.monotonic() - self._loop_wall}
+        if op == "poll":
+            # cursor-based and read-only: a reply lost to a retry
+            # re-ships the same suffix instead of dropping it
+            fin = list(self.engine.finished)
+            start = max(0, int(req.get("from", 0)))
+            return {"ok": True,
+                    "finished": [self._record(s) for s in fin[start:]],
+                    "total": len(fin)}
+        if op == "drain":
+            with self.tick_lock:
+                self.engine.begin_drain()
+            return {"ok": True}
+        if op == "shutdown":
+            self.shutdown.set()
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    # ------------------------------------------------------ tick loop
+    def run(self) -> int:
+        idle_since: Optional[float] = None
+        while True:
+            self._loop_wall = time.monotonic()
+            if self.engine.scheduler.has_work:
+                idle_since = None
+                # the chaos drill's SIGKILL lands here: requests are in
+                # flight, tokens are mid-stream, the journal has submit
+                # records with no terminal status
+                get_fault_plan().fire("serve.replica.kill")
+                with self.tick_lock:
+                    if self.engine.scheduler.has_work:
+                        self.engine.tick()
+                continue
+            if self.shutdown.is_set():
+                return 0
+            if self.engine.draining:
+                if idle_since is None:
+                    idle_since = time.monotonic()
+                elif time.monotonic() - idle_since > self.linger_s:
+                    # drained and the host never said shutdown: the
+                    # host is gone — don't serve a dead fleet forever
+                    logger.warning(
+                        "replica drained and host silent for "
+                        f"{self.linger_s:.0f}s; exiting"
+                    )
+                    return 0
+            time.sleep(0.001)
+
+
+def worker_main(argv: Optional[List[str]] = None) -> int:
+    """Entry point of one replica subprocess: build the engine, warm it
+    up, start the RPC server, publish the address file (the readiness
+    signal — LAST, so the host never routes to a replica still inside
+    its cold jit compile), then run the tick loop."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m scaling_tpu.serve.replica_proc"
+    )
+    parser.add_argument("--config", required=True,
+                        help="worker config JSON written by the host")
+    args = parser.parse_args(argv)
+    cfg = json.loads(retry_io(
+        Path(args.config).read_text, what="replica config read"
+    ))
+    replica_id = int(cfg["replica_id"])
+
+    from ..obs import get_registry
+
+    if cfg.get("metrics_path"):
+        # the sink appends whole lines via one O_APPEND write, so N
+        # worker processes sharing the host's file never tear records
+        get_registry().configure(metrics_path=cfg["metrics_path"])
+
+    from .bench import build_toy_inference
+    from .engine import EngineConfig, ServeEngine, install_drain_handler
+    from .journal import RequestJournal
+
+    inf = build_toy_inference(**cfg["toy"])
+    engine = ServeEngine(
+        inf, EngineConfig(replica_id=replica_id, **cfg["engine"])
+    )
+    install_drain_handler(engine)  # direct SIGTERM drains this replica
+    warmup = int(cfg.get("warmup", 0))
+    if warmup > 0:
+        engine.warmup_mode = True
+        for _ in range(warmup):
+            engine.submit([1], 2)
+        engine.run_until_done()
+        engine.warmup_mode = False
+        engine.finished.clear()
+    # attach AFTER warmup: the journal stream starts at the first real
+    # request (warmup_mode guards too — this is belt and braces)
+    engine.attach_journal(RequestJournal(cfg["journal"]))
+
+    worker = _ReplicaWorker(
+        engine, linger_s=float(cfg.get("linger_s", DEFAULT_LINGER_S))
+    )
+    server = ReplicaRpcServer(worker.handle)
+    # readiness signal LAST: warmup is done, the server is accepting
+    retry_io(
+        lambda: _atomic_write(cfg["addr_path"], server.address + "\n"),
+        what="replica address publish",
+    )
+    logger.log_event(
+        "serve-replica-ready", replica=replica_id, address=server.address,
+    )
+    try:
+        return worker.run()
+    finally:
+        server.close()
+
+
+# ========================================================== host side
+class ReplicaProcClient:
+    """RPC client for one replica worker — the ``TcpControlPlane``
+    client idioms: a fresh connection per request, bounded retries for
+    transport errors, protocol errors (``ok=false``) never retried."""
+
+    def __init__(self, address: str, timeout_s: float = 5.0):
+        host, port = address.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._timeout = timeout_s
+
+    def _request_once(self, req: dict) -> dict:
+        with socket.create_connection(self._addr, self._timeout) as conn:
+            conn.sendall((json.dumps(req) + "\n").encode())
+            line = conn.makefile("r").readline()
+            if not line:
+                # the worker's catch-all dropped our reply (injected
+                # rpc fault, malformed frame): transport-level, retried
+                raise OSError("empty rpc reply (connection closed)")
+            return json.loads(line)
+
+    def request(self, req: dict, attempts: int = 3) -> dict:
+        try:
+            reply = retry_io(
+                lambda: self._request_once(req),
+                attempts=attempts,
+                retry_on=(OSError, ValueError),
+                what=f"replica rpc {req.get('op')!r}",
+            )
+        except (OSError, ValueError) as e:
+            raise ReplicaUnreachable(
+                f"replica at {self._addr[0]}:{self._addr[1]} "
+                f"unreachable for {req.get('op')!r}: {e!r}"
+            ) from e
+        if not reply.get("ok"):
+            raise RuntimeError(f"replica rpc {req} failed: {reply}")
+        return reply
+
+
+class RemoteAdmit:
+    """A submit admitted by a subprocess replica — the process-mode
+    stand-in for the in-process :class:`~.scheduler.Sequence` (the
+    router only needs "not Backpressure"; outputs ship via ``poll``)."""
+
+    __slots__ = ("req_id", "replica_id")
+
+    def __init__(self, req_id: int, replica_id: int):
+        self.req_id = req_id
+        self.replica_id = replica_id
+
+
+class ProcReplicaHandle:
+    """The :class:`~.router.ReplicaHandle` surface over a subprocess
+    replica: same attributes the router dispatches through
+    (``replica_id`` / ``alive`` / ``lock`` / ``stats`` /
+    ``block_size``), RPC behind each method. Load answers come from
+    the newest ``stats`` snapshot (refreshed every supervisor tick) —
+    dispatch reads a cache instead of paying an RPC round-trip per
+    submit attempt."""
+
+    def __init__(self, replica_id: int, proc, client: ReplicaProcClient,
+                 block_size: int):
+        self.engine = None  # no in-process engine behind this handle
+        self.replica_id = replica_id
+        self.alive = True
+        self.lock = threading.Lock()
+        self.stats = ReplicaStats()
+        self.proc = proc
+        self.client = client
+        self.block_size = block_size
+        self.spawn_wall = time.monotonic()
+        self.last_ok_wall = self.spawn_wall
+        self.last_loop_age_s = 0.0
+        self.last_stats: dict = {}
+        self.restarts = 0
+        self.retired = False  # drained away by the autoscaler
+        self.poll_cursor = 0
+        self.ticks_banked = 0  # ticks from incarnations since replaced
+
+    # ---------------------------------------------------------- rpc
+    def _rpc(self, req: dict, attempts: int = 3) -> dict:
+        reply = self.client.request(req, attempts=attempts)
+        self.last_ok_wall = time.monotonic()
+        return reply
+
+    def refresh(self) -> dict:
+        """``stats`` RPC — the heartbeat: a successful reply refreshes
+        ``last_ok_wall`` and the load cache; the reported loop age
+        exposes a wedged tick loop whose RPC threads still answer."""
+        reply = self._rpc({"op": "stats"})
+        self.last_stats = reply["stats"]
+        self.last_loop_age_s = float(reply.get("loop_age_s", 0.0))
+        return self.last_stats
+
+    def poll_finished(self) -> List[dict]:
+        """Ship finished-request records the host has not seen yet
+        (cursor-based: a lost reply re-ships, never drops)."""
+        reply = self._rpc({"op": "poll", "from": self.poll_cursor})
+        recs = reply["finished"]
+        self.poll_cursor = int(
+            reply.get("total", self.poll_cursor + len(recs))
+        )
+        return recs
+
+    def request_shutdown(self) -> None:
+        try:
+            self._rpc({"op": "shutdown"}, attempts=1)
+        except (ReplicaUnreachable, RuntimeError):
+            pass  # already gone — that's what shutdown wanted anyway
+
+    def rebind(self, fresh: "ProcReplicaHandle") -> None:
+        """Point this handle at a relaunched worker process (the router
+        identity — id, dispatch stats — survives the relaunch)."""
+        # bank the dead incarnation's tick count (best effort: as of its
+        # last heartbeat) so the summary's fleet tick total survives
+        self.ticks_banked += int(self.last_stats.get("tick", 0))
+        self.proc = fresh.proc
+        self.client = fresh.client
+        self.spawn_wall = fresh.spawn_wall
+        self.last_ok_wall = fresh.last_ok_wall
+        self.last_stats = {}
+        self.last_loop_age_s = 0.0
+        self.poll_cursor = 0
+        self.restarts += 1
+
+    # ------------------------------------------- ReplicaHandle surface
+    def load(self) -> Tuple[int, float]:
+        s = self.last_stats
+        return (int(s.get("queue_depth", 0)),
+                float(s.get("pool_pressure", 0.0)))
+
+    def submit(self, prompt: List[int], max_new_tokens: int, **kwargs):
+        # arrival_s is the HOST's monotonic clock — meaningless in the
+        # worker process; the worker stamps admission itself
+        kwargs.pop("arrival_s", None)
+        reply = self._rpc({
+            "op": "submit",
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(max_new_tokens),
+            "kw": kwargs,
+        })
+        if not reply.get("admitted"):
+            bp = reply["bp"]
+            return Backpressure(
+                reason=bp["reason"],
+                pool_pressure=float(bp["pool_pressure"]),
+                waiting=int(bp["waiting"]),
+                draining=bool(bp["draining"]),
+            )
+        # optimistic: the fleet loop's exit check reads cached
+        # has_work, and the next stats refresh may be a tick away
+        self.last_stats["has_work"] = True
+        return RemoteAdmit(int(reply["req"]), self.replica_id)
+
+    def begin_drain(self) -> None:
+        try:
+            self._rpc({"op": "drain"})
+        except ReplicaUnreachable:
+            pass  # dead replica: the supervisor's liveness pass owns it
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.last_stats.get("has_work", False))
+
+    def next_req_id(self) -> int:
+        return int(self.last_stats.get("next_req_id", 0))
+
+    def queue_sizes(self) -> Tuple[int, int]:
+        s = self.last_stats
+        return int(s.get("running", 0)), int(s.get("waiting", 0))
+
+
+def spawn_replica_proc(replica_id: int, worker_cfg: dict, run_dir,
+                       *, env: Optional[dict] = None,
+                       ready_timeout_s: float = DEFAULT_STARTUP_GRACE_S,
+                       ) -> ProcReplicaHandle:
+    """Launch ONE replica worker and wait for its readiness signal.
+
+    Writes the worker config, unlinks any stale address file, spawns
+    the subprocess (``SCALING_TPU_HOST_ID=<replica_id>`` so ``@host=K``
+    fault selectors target one replica), and blocks until the address
+    file appears. Raises OSError when the worker dies during startup or
+    the grace expires — the supervisor's budgeted backoff absorbs it.
+    """
+    get_fault_plan().fire("serve.replica.spawn")
+    run_dir = Path(run_dir)
+    addr_path = run_dir / f"replica_{replica_id}.addr"
+    cfg_path = run_dir / f"replica_{replica_id}.json"
+    addr_path.unlink(missing_ok=True)
+    cfg = dict(
+        worker_cfg, replica_id=replica_id, addr_path=str(addr_path),
+        journal=str(journal_path(worker_cfg["journal_base"], replica_id)),
+    )
+    cfg.pop("journal_base", None)
+    text = json.dumps(cfg, indent=1)
+    retry_io(lambda: cfg_path.write_text(text),
+             what="replica config write")
+    child_env = dict(os.environ if env is None else env)
+    child_env["SCALING_TPU_HOST_ID"] = str(replica_id)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "scaling_tpu.serve.replica_proc",
+         "--config", str(cfg_path)],
+        env=child_env,
+    )
+    deadline = time.monotonic() + ready_timeout_s
+    while True:
+        if addr_path.exists():
+            addr = retry_io(
+                addr_path.read_text, what="replica address read"
+            ).strip()
+            if addr:
+                break
+        rc = proc.poll()
+        if rc is not None:
+            raise OSError(
+                f"replica {replica_id} died during startup (rc={rc})"
+            )
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise OSError(
+                f"replica {replica_id} not ready within "
+                f"{ready_timeout_s:.0f}s"
+            )
+        time.sleep(0.05)
+    return ProcReplicaHandle(
+        replica_id, proc, ReplicaProcClient(addr),
+        int(cfg["engine"]["block_size"]),
+    )
+
+
+# ---------------------------------------------------------- liveness
+def classify_replicas(
+    rows: List[dict],
+    *,
+    heartbeat_timeout_s: float = DEFAULT_HEARTBEAT_TIMEOUT_S,
+    startup_grace_s: float = DEFAULT_STARTUP_GRACE_S,
+    now: Optional[float] = None,
+) -> Dict[str, List[int]]:
+    """Split the fleet's replicas into dead / hung / alive — the
+    ``runner.supervise.classify_workers`` policy over per-replica rows
+    ``{replica, exit_code, spawn_wall, last_ok_wall, loop_age_s,
+    retired, draining}``.
+
+    *dead*: the process exited non-zero (SIGKILL is negative).
+    *hung*: still running but the heartbeat is stale — age is the MAX
+    of (time since the last successful RPC) and (the worker's own
+    reported tick-loop age), so a wedged tick loop whose RPC threads
+    still answer cannot hide — and the startup grace has passed (cold
+    jit compiles legitimately go silent for minutes). An exit-0 or
+    retired (autoscale-drained) replica is neither alive nor dead.
+    Pure function: the detection policy is unit-testable with literal
+    timestamps."""
+    now = time.monotonic() if now is None else now
+    dead: List[int] = []
+    hung: List[int] = []
+    alive: List[int] = []
+    for r in rows:
+        if r.get("retired"):
+            continue  # drained on purpose: winding down, never hung
+        rc = r.get("exit_code")
+        if rc is not None:
+            if rc != 0:
+                dead.append(r["replica"])
+            continue  # exited 0: finished/drained, not alive, not dead
+        age = max(now - r["last_ok_wall"], float(r.get("loop_age_s", 0.0)))
+        in_grace = now - r["spawn_wall"] <= startup_grace_s
+        if age > heartbeat_timeout_s and not in_grace \
+                and not r.get("draining"):
+            hung.append(r["replica"])
+        else:
+            alive.append(r["replica"])
+    return {"dead": dead, "hung": hung, "alive": alive}
+
+
+class FleetSupervisor:
+    """Liveness + failover + relaunch + autoscaling for a fleet of
+    :class:`ProcReplicaHandle` replicas.
+
+    ``tick(now)`` runs one supervision pass on the host thread (the
+    proc-mode bench is single-threaded by design — no tick threads, no
+    cross-thread router state): refresh heartbeats, classify, SIGKILL
+    the hung, fail over the dead (journal harvest + re-dispatch to
+    survivors + budgeted relaunch on the shared backoff curve), launch
+    due relaunches, and execute the autoscale policy's decision."""
+
+    def __init__(self, router: FleetRouter,
+                 spawn_fn: Callable[[int], ProcReplicaHandle],
+                 journal_base,
+                 *,
+                 restart_budget: int = 3,
+                 restart_backoff_s: float = 0.5,
+                 heartbeat_timeout_s: float = DEFAULT_HEARTBEAT_TIMEOUT_S,
+                 startup_grace_s: float = DEFAULT_STARTUP_GRACE_S,
+                 policy: Optional[AutoscalePolicy] = None,
+                 on_drain: Optional[Callable[
+                     [ProcReplicaHandle], None]] = None):
+        self.router = router
+        self.spawn_fn = spawn_fn
+        self.journal_base = journal_base
+        self.restart_budget = restart_budget
+        self.restart_backoff_s = restart_backoff_s
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.startup_grace_s = startup_grace_s
+        self.policy = policy
+        # called with a replica about to be autoscale-drained, while it
+        # still answers RPCs — the bench's last-poll hook (finished
+        # records past the caller's cursor would vanish with the worker)
+        self.on_drain = on_drain
+        # failover harvest: outputs delivered by dead replicas before
+        # they died (completed terminal status in their journal)
+        self.recovered: Dict[int, List[int]] = {}
+        self.recovered_timeouts = 0
+        # incomplete submit records awaiting a live replica (non-empty
+        # only when the WHOLE fleet was down at failover time)
+        self.orphans: List[dict] = []
+        self.restarts = 0  # relaunches performed (fleet-wide)
+        self.redispatched = 0  # orphans re-served by survivors
+        self._attempts: Dict[int, int] = {}  # per-replica restart count
+        self._relaunch_due: Dict[int, dict] = {}
+        self.gave_up: List[int] = []
+
+    # ------------------------------------------------------ liveness
+    def _snapshot_rows(self) -> List[dict]:
+        rows = []
+        for h in self.router.replicas:
+            rows.append({
+                "replica": h.replica_id,
+                "exit_code": h.proc.poll(),
+                "spawn_wall": h.spawn_wall,
+                "last_ok_wall": h.last_ok_wall,
+                "loop_age_s": h.last_loop_age_s,
+                "retired": h.retired,
+                "draining": bool(h.last_stats.get("draining", False)),
+            })
+        return rows
+
+    def tick(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        for h in self.router.replicas:
+            if not h.alive or h.retired:
+                continue
+            try:
+                h.refresh()
+            except ReplicaUnreachable:
+                pass  # classified below from exit code / heartbeat age
+            except RuntimeError as e:
+                logger.warning(f"replica {h.replica_id} stats: {e!r}")
+        cls = classify_replicas(
+            self._snapshot_rows(),
+            heartbeat_timeout_s=self.heartbeat_timeout_s,
+            startup_grace_s=self.startup_grace_s,
+            now=now,
+        )
+        for rid in cls["hung"]:
+            h = self.router.replica(rid)
+            logger.log_event(
+                "serve-replica-hung", replica=rid,
+                hb_age_s=round(now - h.last_ok_wall, 3),
+                loop_age_s=round(h.last_loop_age_s, 3),
+            )
+            # a hung process holds its journal namespace hostage:
+            # SIGKILL promotes it to dead and the failover below owns it
+            try:
+                h.proc.kill()
+                h.proc.wait(timeout=10)
+            except OSError as e:
+                logger.warning(f"SIGKILL replica {rid} failed: {e!r}")
+            cls["dead"].append(rid)
+        for rid in cls["dead"]:
+            self._failover(rid, now)
+        for rid, rec in sorted(self._relaunch_due.items()):
+            if rec["due"] <= now:
+                self._relaunch(rid, rec["attempt"], now)
+        self._dispatch_orphans()
+        if self.policy is not None:
+            self._autoscale(now)
+
+    # ------------------------------------------------------ failover
+    def _failover(self, replica_id: int, now: float) -> None:
+        handle = self.router.replica(replica_id)
+        if not handle.alive:
+            return  # already failed over; relaunch is pending/given up
+        self.router.fail_replica(replica_id)
+        completed, incomplete, timeouts = failover_split(
+            journal_path(self.journal_base, replica_id)
+        )
+        self.recovered.update(
+            {int(k): list(v) for k, v in completed.items()}
+        )
+        self.recovered_timeouts += timeouts
+        self.orphans.extend(incomplete)
+        logger.log_event(
+            "serve-replica-dead", replica=replica_id,
+            rc=handle.proc.poll(), recovered=len(completed),
+            redispatch=len(incomplete), timeouts=timeouts,
+        )
+        attempt = self._attempts.get(replica_id, 0) + 1
+        if attempt > self.restart_budget:
+            logger.log_event(
+                "serve-replica-give-up", replica=replica_id,
+                attempts=attempt - 1, budget=self.restart_budget,
+            )
+            self.gave_up.append(replica_id)
+            return
+        self._attempts[replica_id] = attempt
+        delay = restart_backoff(attempt, self.restart_backoff_s)
+        self._relaunch_due[replica_id] = {
+            "due": now + delay, "attempt": attempt,
+        }
+        logger.log_event(
+            "serve-replica-restart", replica=replica_id,
+            attempt=attempt, budget=self.restart_budget,
+            backoff_s=round(delay, 3),
+        )
+
+    def _relaunch(self, replica_id: int, attempt: int, now: float) -> None:
+        self._relaunch_due.pop(replica_id, None)
+        handle = self.router.replica(replica_id)
+        # the dead stream was harvested at failover; the relaunched
+        # worker starts a FRESH journal in the same namespace (single
+        # writer per file holds: the old process is gone)
+        journal_path(self.journal_base, replica_id).unlink(missing_ok=True)
+        try:
+            fresh = self.spawn_fn(replica_id)
+        except OSError as e:
+            logger.warning(
+                f"replica {replica_id} relaunch attempt {attempt} "
+                f"failed: {e!r}"
+            )
+            next_attempt = self._attempts.get(replica_id, attempt) + 1
+            if next_attempt > self.restart_budget:
+                logger.log_event(
+                    "serve-replica-give-up", replica=replica_id,
+                    attempts=next_attempt - 1, budget=self.restart_budget,
+                )
+                self.gave_up.append(replica_id)
+                return
+            self._attempts[replica_id] = next_attempt
+            delay = restart_backoff(next_attempt, self.restart_backoff_s)
+            self._relaunch_due[replica_id] = {
+                "due": now + delay, "attempt": next_attempt,
+            }
+            return
+        handle.rebind(fresh)
+        self.router.restore_replica(replica_id)
+        self.restarts += 1
+
+    def _dispatch_orphans(self) -> None:
+        if not self.orphans or not self.router.live:
+            return
+        still: List[dict] = []
+        for rec in self.orphans:
+            # original req_id + force=True: any replica regenerates the
+            # same tokens (the (request, position) sampler-key fold),
+            # and recovery work is never shed
+            res = self.router.submit(
+                rec["prompt"], rec["max_new_tokens"],
+                eos_token_id=rec.get("eos_token_id"),
+                temperature=rec.get("temperature", 0.0),
+                top_k=rec.get("top_k"), top_p=rec.get("top_p"),
+                deadline_ms=rec.get("deadline_ms"),
+                ttft_deadline_ms=rec.get("ttft_deadline_ms"),
+                req_id=int(rec["req"]), force=True,
+            )
+            if isinstance(res, Backpressure):
+                still.append(rec)  # every replica unreachable: retry
+        if len(still) < len(self.orphans):
+            self.redispatched += len(self.orphans) - len(still)
+            logger.log_event(
+                "serve-replica-failover",
+                redispatched=len(self.orphans) - len(still),
+                stranded=len(still),
+            )
+        self.orphans = still
+
+    def pending_recovery(self) -> bool:
+        """Work the bench loop must not exit under: stranded incomplete
+        requests, or a relaunch still owed (the drill's contract is the
+        replica COMES BACK, not just that its work moved)."""
+        return bool(self.orphans) or bool(self._relaunch_due)
+
+    # ----------------------------------------------------- autoscale
+    def _autoscale(self, now: float) -> None:
+        rows = []
+        for h in self.router.replicas:
+            s = h.last_stats
+            rows.append({
+                "replica": h.replica_id,
+                "queue_depth": int(s.get("waiting", 0)),
+                "pool_pressure": float(s.get("pool_pressure", 0.0)),
+                "in_flight": int(s.get("running", 0))
+                + int(s.get("waiting", 0)),
+                "alive": h.alive and not h.retired,
+            })
+        decision = self.policy.decide(now, rows)
+        if decision is None:
+            return
+        action, target = decision
+        if action == "spawn":
+            new_id = max(h.replica_id for h in self.router.replicas) + 1
+            try:
+                fresh = self.spawn_fn(new_id)
+            except OSError as e:
+                logger.warning(f"autoscale spawn failed: {e!r}")
+                return
+            self.router.add_replica(fresh)  # logs serve-replica-spawn
+            try:
+                fresh.refresh()
+            except ReplicaUnreachable:
+                pass
+        elif action == "drain":
+            handle = self.router.replica(target)
+            logger.log_event(
+                "serve-replica-drain", replica=target,
+                restarts=handle.restarts,
+            )
+            if self.on_drain is not None:
+                self.on_drain(handle)  # last poll while it still answers
+            # the policy only drains a replica with zero in-flight
+            # work, so drain + shutdown is an immediate clean exit
+            handle.begin_drain()
+            handle.request_shutdown()
+            handle.retired = True
+            handle.alive = False
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return worker_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

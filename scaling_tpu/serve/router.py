@@ -37,6 +37,19 @@ Pure host-side policy, jax-free at import: the engines own the device
 programs. Thread-safety: all dispatch state mutates under one router
 lock; per-replica engine calls are serialized by the per-replica locks
 the fleet bench's tick threads share (``ReplicaHandle.lock``).
+
+Since PR 16 the router dispatches through the HANDLE surface
+(``submit`` / ``begin_drain`` / ``has_work`` / ``queue_sizes`` /
+``next_req_id``) instead of reaching into ``handle.engine`` — the seam
+that lets :mod:`.replica_proc`'s subprocess replicas slot in behind the
+same policy: a process-backed handle answers the same calls over
+line-JSON RPC, and the hash-based prefix affinity (PYTHONHASHSEED-
+independent int-tuple hashes) holds across the process boundary by
+construction. A handle whose replica process died mid-call raises
+:class:`ReplicaUnreachable`; the router treats that exactly like
+Backpressure — try the next live replica — and leaves the
+dead/hung/relaunch decision to the fleet supervisor
+(``replica_proc.FleetSupervisor``).
 """
 
 from __future__ import annotations
@@ -51,6 +64,13 @@ from .scheduler import Backpressure
 # bound on the remembered prefix chains: LRU beyond this (a router that
 # never forgets would grow with every distinct prompt ever served)
 PREFIX_MAP_CAP = 4096
+
+
+class ReplicaUnreachable(OSError):
+    """A replica's RPC channel is gone (process dead, socket refused,
+    retries exhausted). Raised by process-backed handles; the router's
+    dispatch loop skips the replica like a Backpressure answer and the
+    supervisor's liveness pass owns the failover."""
 
 
 @dataclasses.dataclass
@@ -96,24 +116,66 @@ class ReplicaHandle:
         depth = len(sched.waiting) + len(sched.running)
         return depth, sched.pool_pressure()
 
+    # -- the engine-facing surface the router dispatches through; a
+    # -- process-backed handle (replica_proc.ProcReplicaHandle)
+    # -- overrides exactly these with RPC calls
+    @property
+    def block_size(self) -> int:
+        return self.engine.config.block_size
+
+    def submit(self, prompt: List[int], max_new_tokens: int, **kwargs):
+        """Engine admission — Sequence on admit, Backpressure on shed.
+        NOT under ``self.lock``: ``ServeEngine.submit`` only appends to
+        the scheduler's waiting deque and reads load state, safe
+        against a concurrent tick under the GIL (serializing submits
+        behind the tick lock starved fleet admission — PR 14)."""
+        return self.engine.submit(prompt, max_new_tokens, **kwargs)
+
+    def begin_drain(self) -> None:
+        with self.lock:
+            self.engine.begin_drain()
+
+    @property
+    def has_work(self) -> bool:
+        return self.engine.scheduler.has_work
+
+    def next_req_id(self) -> int:
+        return self.engine._next_req_id
+
+    def queue_sizes(self) -> Tuple[int, int]:
+        """(running, waiting) — the failure event's context fields."""
+        sched = self.engine.scheduler
+        return len(sched.running), len(sched.waiting)
+
 
 class FleetRouter:
     """Dispatch policy over N :class:`ServeEngine` replicas."""
 
-    def __init__(self, engines: List, block_size: Optional[int] = None):
-        if not engines:
+    def __init__(self, engines: Optional[List] = None,
+                 block_size: Optional[int] = None,
+                 handles: Optional[List] = None):
+        """Build from in-process ``engines`` (the PR 14 threaded fleet)
+        or from pre-built ``handles`` (process-backed replicas — any
+        object answering the :class:`ReplicaHandle` surface)."""
+        if handles is None:
+            if not engines:
+                raise ValueError("a fleet needs at least one replica")
+            handles = [
+                ReplicaHandle(
+                    e, e.replica_id if e.replica_id is not None else i
+                )
+                for i, e in enumerate(engines)
+            ]
+        if not handles:
             raise ValueError("a fleet needs at least one replica")
-        self.replicas: List[ReplicaHandle] = [
-            ReplicaHandle(e, e.replica_id if e.replica_id is not None else i)
-            for i, e in enumerate(engines)
-        ]
+        self.replicas: List[ReplicaHandle] = list(handles)
         ids = [r.replica_id for r in self.replicas]
         if len(set(ids)) != len(ids):
             raise ValueError(
                 f"duplicate replica ids {ids} — journal namespaces and "
                 "telemetry labels would collide"
             )
-        sizes = {r.engine.config.block_size for r in self.replicas}
+        sizes = {r.block_size for r in self.replicas}
         if block_size is None:
             if len(sizes) != 1:
                 raise ValueError(
@@ -202,19 +264,26 @@ class FleetRouter:
                 order.sort(key=lambda r: r.replica_id != affinity)
         bp = None
         for attempt, handle in enumerate(order):
-            # NOT under handle.lock: ``ServeEngine.submit`` only appends
-            # to the scheduler's waiting deque and reads load state —
-            # safe against a concurrent tick under the GIL (the deadline
-            # counter has its own lock). Serializing submits behind the
-            # replica's tick lock starved admission so badly that fleet
-            # batches never filled (4x the ticks for the same tokens).
             # count_shed=False: a rejection the router retries is not a
             # client-visible shed — fleet-level rejections are counted
             # (and journaled) by the fleet bench instead.
-            res = handle.engine.submit(
-                prompt, max_new_tokens, req_id=req_id,
-                count_shed=False, **kwargs
-            )
+            try:
+                res = handle.submit(
+                    prompt, max_new_tokens, req_id=req_id,
+                    count_shed=False, **kwargs
+                )
+            except ReplicaUnreachable:
+                # the process died under us mid-dispatch: skip it like a
+                # shed (the supervisor's liveness pass will classify it
+                # and run the journal failover) and try the next replica
+                bp = Backpressure(
+                    reason="replica-unreachable", pool_pressure=1.0,
+                    waiting=0, draining=False,
+                )
+                with self._lock:
+                    if attempt + 1 < len(order):
+                        self.retries_elsewhere += 1
+                continue
             if isinstance(res, Backpressure):
                 bp = res
                 with self._lock:
@@ -238,8 +307,7 @@ class FleetRouter:
         """Drain the whole fleet (the SIGTERM handler's target): every
         live replica stops admitting and finishes in-flight work."""
         for handle in self.live:
-            with handle.lock:
-                handle.engine.begin_drain()
+            handle.begin_drain()
 
     def fail_replica(self, replica_id: int) -> None:
         """A replica crashed (or was killed): drop it from dispatch.
@@ -249,28 +317,53 @@ class FleetRouter:
         with original req_ids keeps them token-exact)."""
         handle = self.replica(replica_id)
         handle.alive = False
+        try:
+            running, waiting = handle.queue_sizes()
+        except (ReplicaUnreachable, OSError):
+            running = waiting = -1  # dead process: last-known is gone
         logger.log_event(
             "serve-replica-failed", replica=replica_id,
-            running=len(handle.engine.scheduler.running),
-            waiting=len(handle.engine.scheduler.waiting),
+            running=running, waiting=waiting,
         )
 
-    def restore_replica(self, replica_id: int, engine) -> ReplicaHandle:
-        """Re-register a relaunched engine under a failed replica's id
+    def restore_replica(self, replica_id: int,
+                        engine=None) -> ReplicaHandle:
+        """Re-register a relaunched replica under a failed replica's id
         (stats continue; the caller replays the replica's journal into
-        the fresh engine before opening it to new dispatch)."""
+        the fresh engine before opening it to new dispatch). In-process
+        fleets pass the fresh ``engine``; process fleets rebind the
+        handle's RPC channel themselves and pass None."""
         handle = self.replica(replica_id)
         if handle.alive:
             raise ValueError(f"replica {replica_id} is still live")
-        handle.engine = engine
+        if engine is not None:
+            handle.engine = engine
         handle.alive = True
         logger.log_event("serve-replica-restored", replica=replica_id)
+        return handle
+
+    def add_replica(self, handle: ReplicaHandle) -> ReplicaHandle:
+        """Register a NEW replica (autoscale spawn) — the id must be
+        fresh; journal namespaces and telemetry labels key on it."""
+        with self._lock:
+            if handle.replica_id in {r.replica_id for r in self.replicas}:
+                raise ValueError(
+                    f"replica id {handle.replica_id} already registered"
+                )
+            if handle.block_size != self.block_size:
+                raise ValueError(
+                    f"new replica block_size {handle.block_size} != fleet "
+                    f"{self.block_size} — prefix affinity needs ONE "
+                    "granularity"
+                )
+            self.replicas.append(handle)
+        logger.log_event("serve-replica-spawn", replica=handle.replica_id)
         return handle
 
     # --------------------------------------------------------- telemetry
     @property
     def has_work(self) -> bool:
-        return any(r.engine.scheduler.has_work for r in self.live)
+        return any(r.has_work for r in self.live)
 
     def sync_next_req_id(self) -> None:
         """After journal replay seeded engines with historical ids, the
@@ -278,8 +371,10 @@ class FleetRouter:
         sampler-key fold — a collision would alias two requests)."""
         with self._lock:
             for r in self.replicas:
+                if not r.alive:
+                    continue
                 self._next_req_id = max(
-                    self._next_req_id, r.engine._next_req_id
+                    self._next_req_id, r.next_req_id()
                 )
 
     def stats(self) -> dict:
@@ -303,6 +398,111 @@ class FleetRouter:
                 "rejected": self.rejected,
                 "per_replica": per,
             }
+
+
+class AutoscalePolicy:
+    """Pure host-side autoscaling policy: watermark hysteresis over the
+    fleet's load snapshot, budgeted like supervisor relaunches.
+
+    ``decide(now, replicas)`` consumes a snapshot — one dict per replica
+    with ``replica`` (id), ``queue_depth``, ``pool_pressure``,
+    ``in_flight``, ``alive`` — and returns ``None`` (hold),
+    ``("spawn", None)``, or ``("drain", replica_id)``. No clocks, no
+    I/O: the caller stamps ``now`` (``time.monotonic()``), so every
+    branch is unit-testable with literal timestamps.
+
+    - **spawn** when EVERY live replica is above the high watermark
+      (``pool_pressure >= high_watermark`` or ``queue_depth >=
+      queue_high``) sustained for ``sustain_s`` — one hot replica is a
+      dispatch-imbalance problem, not a capacity problem;
+    - **drain** when the fleet is idle (zero queue, zero in-flight,
+      pressure at/below ``low_watermark`` everywhere) sustained for
+      ``idle_sustain_s`` — the highest-id live replica goes first
+      (spawned last, coldest trie). A drain NEVER fires while any
+      request is in flight and NEVER takes the fleet below
+      ``min_replicas``;
+    - both actions are budgeted (``spawn_budget`` / ``drain_budget``
+      per run) and separated by ``cooldown_s`` so a noisy load signal
+      can't flap the fleet.
+    """
+
+    def __init__(self, *, min_replicas: int = 1, max_replicas: int = 4,
+                 high_watermark: float = 0.8, queue_high: int = 8,
+                 low_watermark: float = 0.2, sustain_s: float = 2.0,
+                 idle_sustain_s: float = 5.0, spawn_budget: int = 2,
+                 drain_budget: int = 2, cooldown_s: float = 5.0):
+        if min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if max_replicas < min_replicas:
+            raise ValueError("max_replicas < min_replicas")
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.high_watermark = high_watermark
+        self.queue_high = queue_high
+        self.low_watermark = low_watermark
+        self.sustain_s = sustain_s
+        self.idle_sustain_s = idle_sustain_s
+        self.spawn_budget = spawn_budget
+        self.drain_budget = drain_budget
+        self.cooldown_s = cooldown_s
+        self.spawns = 0
+        self.drains = 0
+        self._high_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._last_action: Optional[float] = None
+
+    def _hot(self, r: dict) -> bool:
+        return (r["pool_pressure"] >= self.high_watermark
+                or r["queue_depth"] >= self.queue_high)
+
+    def decide(self, now: float,
+               replicas: List[dict]) -> Optional[Tuple[str, Optional[int]]]:
+        live = [r for r in replicas if r.get("alive", True)]
+        if not live:
+            return None
+        in_cooldown = (self._last_action is not None
+                       and now - self._last_action < self.cooldown_s)
+
+        overloaded = all(self._hot(r) for r in live)
+        if overloaded:
+            if self._high_since is None:
+                self._high_since = now
+        else:
+            self._high_since = None
+
+        idle = all(
+            r["queue_depth"] == 0 and r["in_flight"] == 0
+            and r["pool_pressure"] <= self.low_watermark
+            for r in live
+        )
+        if idle:
+            if self._idle_since is None:
+                self._idle_since = now
+        else:
+            self._idle_since = None
+
+        if in_cooldown:
+            return None
+        if (self._high_since is not None
+                and now - self._high_since >= self.sustain_s
+                and len(live) < self.max_replicas
+                and self.spawns < self.spawn_budget):
+            self.spawns += 1
+            self._last_action = now
+            self._high_since = None
+            return ("spawn", None)
+        if (self._idle_since is not None
+                and now - self._idle_since >= self.idle_sustain_s
+                and len(live) > self.min_replicas
+                and self.drains < self.drain_budget):
+            # in_flight == 0 everywhere is part of `idle` — an idle
+            # drain can never abandon a running request
+            self.drains += 1
+            self._last_action = now
+            self._idle_since = None
+            target = max(r["replica"] for r in live)
+            return ("drain", target)
+        return None
 
 
 def install_fleet_drain_handler(router: FleetRouter) -> None:

@@ -35,3 +35,27 @@ def _raw_write(path, text):
 
 def guarded_by_name(path, text):
     retry_io(lambda: _raw_write(path, text), what="state write")
+
+
+import socket
+
+
+def rpc_once(address, payload):
+    # the PR 16 replica-RPC client shape, unguarded
+    host, port = address.rsplit(":", 1)
+    conn = socket.create_connection((host, int(port)))  # STA011: raw dial
+    try:
+        conn.sendall(payload)
+    finally:
+        conn.close()
+
+
+def _rpc_raw(address, payload):
+    # clean: only ever dialed under retry_io (rpc_with_retry below)
+    host, port = address.rsplit(":", 1)
+    with socket.create_connection((host, int(port))) as conn:
+        conn.sendall(payload)
+
+
+def rpc_with_retry(address, payload):
+    retry_io(lambda: _rpc_raw(address, payload), what="replica rpc")

@@ -28,3 +28,15 @@ class ServeEngine:
     def _land_tokens(self, out):
         # the tick's deliberate token landing, per-line suppressed
         return jax.device_get(out)  # sta: disable=STA010
+
+
+class FleetRouter:
+    """The PR 16 RPC dispatch shape: the router's submit builds its
+    reply payload one helper down — where the seeded bug drains the
+    device for it."""
+
+    def submit(self, handle, toks):
+        return self._reply_payload(toks)
+
+    def _reply_payload(self, toks):
+        return jax.device_get(toks)  # STA010: sync under FleetRouter.submit

@@ -49,3 +49,28 @@ class ReplicaHandle:
 
     def drain(self):
         self._draining = True  # sta: disable=STA009 (latching bool flag)
+
+
+class RpcReplicaWorker:
+    """The PR 16 shape: per-connection RPC handler threads race the
+    tick loop over shared bookkeeping (submits land on RPC threads,
+    ticks land on the loop thread)."""
+
+    # ``loop_wall`` is the heartbeat beat: a single float store read by
+    # the stats RPC for hung-loop detection — deliberately lock-free:
+    # sta: lock(loop_wall)
+
+    def __init__(self):
+        self.tick_lock = threading.Lock()
+        self.admitted = 0
+        self.loop_wall = 0.0
+        self._thread = threading.Thread(target=self._tick_loop, daemon=True)
+
+    def _tick_loop(self):
+        while True:
+            self.loop_wall += 1.0  # annotated lock-free: clean
+            with self.tick_lock:
+                self.admitted = 0
+
+    def handle_rpc(self, req):
+        self.admitted += 1  # STA009: RPC-thread write, no lock

@@ -42,8 +42,11 @@ EXPECTED = [
     ("STA007", 82),   # tune: swallowed calibration read (ISSUE 15)
     ("STA007", 89),   # tune: bare except around config emit
     ("STA009", 42),   # raceclass: tick-thread write races submit (PR 14 idiom)
+    ("STA009", 73),   # raceclass: RPC-thread write races tick (PR 16 idiom)
     ("STA010", 26),   # hotsync: block_until_ready one level below tick
+    ("STA010", 42),   # hotsync: device_get under FleetRouter.submit (PR 16)
     ("STA011", 19),   # rawio: raw write_text outside every guard
+    ("STA011", 46),   # rawio: raw replica-RPC dial outside retry_io (PR 16)
 ]
 SUPPRESSED = [
     ("STA003", 60),  # sta: disable=STA003

@@ -1,0 +1,269 @@
+"""Process-fleet policy units (ISSUE 16): the supervisor's pure
+liveness classifier, the autoscaler's watermark hysteresis + budgets,
+journal failover harvesting, the shared restart-backoff curve, and the
+replica RPC transport's retry discipline — each driven with literal
+timestamps / literal journal lines / loopback sockets. No engines, no
+subprocesses: the chaos e2e (test_proc_fleet_e2e.py) owns those."""
+
+import json
+
+import pytest
+
+from scaling_tpu.runner.supervise import restart_backoff
+from scaling_tpu.serve.journal import failover_split
+from scaling_tpu.serve.replica_proc import (
+    ReplicaProcClient,
+    ReplicaRpcServer,
+    classify_replicas,
+)
+from scaling_tpu.serve.router import AutoscalePolicy, ReplicaUnreachable
+
+NOW = 100.0
+
+
+def row(rid, **kw):
+    base = {
+        "replica": rid, "exit_code": None, "spawn_wall": 0.0,
+        "last_ok_wall": NOW - 1.0, "loop_age_s": 0.0,
+        "retired": False, "draining": False,
+    }
+    base.update(kw)
+    return base
+
+
+def classify(rows, **kw):
+    kw.setdefault("heartbeat_timeout_s", 10.0)
+    kw.setdefault("startup_grace_s", 30.0)
+    kw.setdefault("now", NOW)
+    return classify_replicas(rows, **kw)
+
+
+# ========================================================= classifier
+def test_nonzero_exit_is_dead_sigkill_included():
+    got = classify([row(0), row(1, exit_code=-9), row(2, exit_code=1)])
+    assert got == {"dead": [1, 2], "hung": [], "alive": [0]}
+
+
+def test_clean_exit_and_retired_are_neither_alive_nor_dead():
+    got = classify([row(0, exit_code=0), row(1, retired=True),
+                    row(2, retired=True, exit_code=-9)])
+    assert got == {"dead": [], "hung": [], "alive": []}
+
+
+def test_stale_heartbeat_past_grace_is_hung():
+    got = classify([row(0, last_ok_wall=NOW - 11.0)])
+    assert got["hung"] == [0]
+
+
+def test_wedged_tick_loop_cannot_hide_behind_live_rpc_threads():
+    """``loop_age_s`` is the worker's own report of time since its tick
+    loop last beat: a wedged loop whose RPC threads still answer keeps
+    ``last_ok_wall`` fresh but not the beat — age takes the MAX."""
+    got = classify([row(0, last_ok_wall=NOW, loop_age_s=11.0)])
+    assert got["hung"] == [0]
+
+
+def test_startup_grace_shields_cold_compile_silence():
+    got = classify([row(0, spawn_wall=NOW - 5.0,
+                        last_ok_wall=NOW - 20.0)])
+    assert got["alive"] == [0]
+
+
+def test_draining_replica_is_never_hung():
+    got = classify([row(0, last_ok_wall=NOW - 50.0, draining=True)])
+    assert got == {"dead": [], "hung": [], "alive": [0]}
+
+
+# ========================================================= autoscaler
+HOT = {"queue_depth": 20, "pool_pressure": 0.9, "in_flight": 5,
+       "alive": True}
+IDLE = {"queue_depth": 0, "pool_pressure": 0.0, "in_flight": 0,
+        "alive": True}
+
+
+def fleet(n, load):
+    return [{"replica": i, **load} for i in range(n)]
+
+
+def test_spawn_needs_sustained_pressure_and_resets_on_a_dip():
+    p = AutoscalePolicy(max_replicas=4, sustain_s=2.0)
+    assert p.decide(0.0, fleet(1, HOT)) is None
+    assert p.decide(1.9, fleet(1, HOT)) is None  # hysteresis window open
+    assert p.decide(2.5, fleet(1, IDLE)) is None  # dip resets the window
+    assert p.decide(3.0, fleet(1, HOT)) is None
+    assert p.decide(4.9, fleet(1, HOT)) is None
+    assert p.decide(5.0, fleet(1, HOT)) == ("spawn", None)
+    assert p.spawns == 1
+
+
+def test_one_hot_replica_is_imbalance_not_capacity():
+    p = AutoscalePolicy(sustain_s=0.0)
+    mixed = [{"replica": 0, **HOT}, {"replica": 1, **IDLE}]
+    assert p.decide(0.0, mixed) is None
+    assert p.decide(10.0, mixed) is None
+
+
+def test_spawn_never_exceeds_max_replicas():
+    p = AutoscalePolicy(max_replicas=2, sustain_s=0.0)
+    assert p.decide(0.0, fleet(2, HOT)) is None
+    assert p.decide(10.0, fleet(2, HOT)) is None
+
+
+def test_drain_targets_highest_id_and_respects_min_replicas():
+    p = AutoscalePolicy(min_replicas=1, idle_sustain_s=1.0)
+    assert p.decide(0.0, fleet(2, IDLE)) is None
+    assert p.decide(1.0, fleet(2, IDLE)) == ("drain", 1)
+    assert p.drains == 1
+    # one live replica left: the floor holds no matter how idle
+    p2 = AutoscalePolicy(min_replicas=1, idle_sustain_s=0.0)
+    assert p2.decide(0.0, fleet(1, IDLE)) is None
+    assert p2.decide(99.0, fleet(1, IDLE)) is None
+
+
+def test_drain_refuses_while_any_request_is_in_flight():
+    p = AutoscalePolicy(min_replicas=1, idle_sustain_s=0.0)
+    busy = [{"replica": 0, **IDLE},
+            {"replica": 1, **IDLE, "in_flight": 1}]
+    assert p.decide(0.0, busy) is None
+    assert p.decide(50.0, busy) is None
+    assert p.drains == 0
+
+
+def test_budgets_and_cooldown_stop_flapping():
+    p = AutoscalePolicy(max_replicas=8, sustain_s=0.0, spawn_budget=1,
+                        cooldown_s=5.0)
+    assert p.decide(0.0, fleet(1, HOT)) == ("spawn", None)
+    # cooldown blocks the next action even with pressure still high
+    assert p.decide(2.0, fleet(2, HOT)) is None
+    # budget spent: no further spawns even past the cooldown
+    assert p.decide(60.0, fleet(2, HOT)) is None
+    assert p.decide(120.0, fleet(2, HOT)) is None
+    assert p.spawns == 1
+
+
+def test_dead_replicas_are_invisible_to_the_policy():
+    """A dead replica's last stats row must not poison the overload
+    vote (idle-looking corpse would veto every spawn)."""
+    p = AutoscalePolicy(max_replicas=4, sustain_s=0.0)
+    rows = [{"replica": 0, **HOT},
+            {"replica": 1, **IDLE, "alive": False}]
+    assert p.decide(0.0, rows) == ("spawn", None)
+
+
+def test_policy_rejects_impossible_bounds():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=3, max_replicas=2)
+
+
+# ==================================================== journal failover
+def _submit(rid, prompt):
+    return {"kind": "serve-submit", "req": rid, "prompt": prompt,
+            "max_new_tokens": 4, "eos_token_id": None,
+            "temperature": 1.0, "top_k": 0, "top_p": 1.0,
+            "deadline_ms": None, "ttft_deadline_ms": None}
+
+
+def test_failover_split_partitions_a_dead_replicas_journal(tmp_path):
+    j = tmp_path / "journal_r1.jsonl"
+    recs = [
+        _submit(1, [5, 6]),
+        _submit(2, [7]),
+        _submit(3, [8, 9]),
+        _submit(4, [3]),
+        {"kind": "serve-tokens", "req": 1, "toks": [10, 11]},
+        {"kind": "serve-tokens", "req": 3, "toks": [12]},
+        {"kind": "serve-finish", "req": 1, "status": "completed"},
+        {"kind": "serve-finish", "req": 2, "status": "timeout"},
+    ]
+    lines = [json.dumps(r) for r in recs]
+    lines.append('{"kind": "serve-tokens", "req": 4, "to')  # torn tail
+    j.write_text("\n".join(lines) + "\n")
+
+    completed, incomplete, timeouts = failover_split(j)
+    assert completed == {1: [10, 11]}  # delivered: folded into results
+    # in-flight at crash, in request order — tokens already generated
+    # are NOT carried (replay regenerates them token-exactly)
+    assert [r["req"] for r in incomplete] == [3, 4]
+    assert incomplete[0]["prompt"] == [8, 9]
+    assert timeouts == 1  # terminal: counted, never replayed
+
+
+def test_failover_split_of_missing_journal_is_empty(tmp_path):
+    completed, incomplete, timeouts = failover_split(tmp_path / "nope")
+    assert (completed, incomplete, timeouts) == ({}, [], 0)
+
+
+# ====================================================== backoff curve
+def test_restart_backoff_is_the_shared_capped_curve():
+    assert [restart_backoff(a, 0.5) for a in (1, 2, 3, 4)] \
+        == [0.5, 1.0, 2.0, 4.0]
+    assert restart_backoff(20, 0.5) == 60.0  # capped for serving
+    assert restart_backoff(10, 1.0, cap_s=float("inf")) == 512.0
+
+
+# ======================================================= rpc transport
+@pytest.fixture()
+def echo_server():
+    calls = []
+
+    def handler(req):
+        calls.append(req)
+        if req.get("boom"):
+            raise RuntimeError("handler crashed")  # reply dropped
+        if req.get("reject"):
+            return {"ok": False, "error": "rejected"}
+        return {"ok": True, "echo": req.get("x")}
+
+    server = ReplicaRpcServer(handler)
+    try:
+        yield server, calls
+    finally:
+        server.close()
+
+
+def test_rpc_roundtrip(echo_server):
+    server, _ = echo_server
+    client = ReplicaProcClient(server.address)
+    assert client.request({"op": "ping", "x": 7})["echo"] == 7
+
+
+def test_protocol_error_is_never_retried(echo_server):
+    """ok=false is the worker SAYING no — retrying it would turn one
+    rejection into three identical submissions."""
+    server, calls = echo_server
+    client = ReplicaProcClient(server.address)
+    with pytest.raises(RuntimeError):
+        client.request({"op": "submit", "reject": True})
+    assert len(calls) == 1
+
+
+def test_dropped_reply_is_retried_as_transport_error():
+    """The worker's catch-all drops the reply on a handler crash; the
+    host sees an empty line (OSError) and retries — at-least-once, which
+    is safe because submit dedupes worker-side by req_id."""
+    attempts = {"n": 0}
+
+    def flaky(req):
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            raise RuntimeError("first call crashed")
+        return {"ok": True}
+
+    server = ReplicaRpcServer(flaky)
+    try:
+        client = ReplicaProcClient(server.address)
+        assert client.request({"op": "stats"})["ok"]
+        assert attempts["n"] == 2
+    finally:
+        server.close()
+
+
+def test_dead_address_raises_replica_unreachable():
+    server = ReplicaRpcServer(lambda req: {"ok": True})
+    addr = server.address
+    server.close()
+    client = ReplicaProcClient(addr, timeout_s=0.5)
+    with pytest.raises(ReplicaUnreachable):
+        client.request({"op": "stats"}, attempts=1)
