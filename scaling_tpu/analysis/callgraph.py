@@ -139,6 +139,9 @@ class ClassInfo:
     attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
     # self.<attr> = <module>  ->  attr -> module dotted name ("jax", "numpy")
     attr_modules: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # self.<attr> = self.<method>  ->  attr -> method simple name (a
+    # self-stored callback: ``self._cb = self._on_done; self._cb()``)
+    attr_callbacks: Dict[str, str] = dataclasses.field(default_factory=dict)
     bases: List[str] = dataclasses.field(default_factory=list)
 
 
@@ -199,6 +202,14 @@ class CallGraph:
         self.edges: Dict[str, Set[str]] = {}  # caller qualname -> callees
         self.unresolved: Dict[str, List[ast.Call]] = {}  # caller -> dynamic calls
         self.thread_spawns: List[ThreadSpawn] = []
+        # abstract method qualname -> override qualnames in subclasses.
+        # Kept SEPARATE from ``edges``: the concurrency rules
+        # (STA009-STA011) are pinned on exact static edges; the protocol
+        # rules opt in via ``descendants(..., virtual=True)`` so a call
+        # on the abstract ControlPlane surface flows into both backends.
+        self.override_edges: Dict[str, Set[str]] = {}
+        self._local_types_cache: Dict[str, Dict[str, str]] = {}
+        self._alias_cache: Dict[str, Dict[str, str]] = {}
 
     # -------------------------------------------------------------- build
     @classmethod
@@ -226,6 +237,7 @@ class CallGraph:
             graph._index_module(mod)
         graph._infer_attr_types()
         graph._resolve_calls()
+        graph._infer_overrides()
         return graph
 
     # ---------------------------------------------------------- indexing
@@ -367,6 +379,36 @@ class CallGraph:
                     return self.classes[dotted]
         return None
 
+    def _annotation_class(self, mod: ModuleInfo, ann: ast.AST
+                          ) -> Optional[ClassInfo]:
+        """The ClassInfo an annotation names — ``Foo``, ``"Foo"``,
+        ``mod.Foo``, ``Optional[Foo]`` (one peel). Feeds the
+        ``self.x = <annotated param>`` attr-typing below: constructor
+        injection (``def __init__(self, client: ReplicaProcClient)``)
+        is how this codebase wires the protocol objects together, and
+        without it every RPC/barrier call through an injected handle
+        is a resolution dead end."""
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(ann, ast.Subscript):
+            base = ann.value
+            base_name = base.attr if isinstance(base, ast.Attribute) \
+                else getattr(base, "id", None)
+            if base_name == "Optional":
+                return self._annotation_class(mod, ann.slice)
+            return None
+        if isinstance(ann, ast.Attribute):
+            dotted = self._follow_export(mod.imports.resolve(ann))
+            if dotted and dotted in self.classes:
+                return self.classes[dotted]
+            return None
+        if isinstance(ann, ast.Name):
+            return self._lookup_class(mod, ann.id)
+        return None
+
     def _infer_attr_types(self) -> None:
         """``self.x = ClassName(...)`` types attr ``x``; ``self.x = jax``
         (a module alias) records a module attr — both feed call and name
@@ -374,6 +416,13 @@ class CallGraph:
         for cinfo in self.classes.values():
             mod = cinfo.module
             for meth in cinfo.methods.values():
+                margs = meth.node.args
+                param_ann = {
+                    a.arg: a.annotation
+                    for a in (margs.posonlyargs + margs.args
+                              + margs.kwonlyargs)
+                    if a.annotation is not None
+                }
                 for node in ast.walk(meth.node):
                     if not isinstance(node, ast.Assign):
                         continue
@@ -389,6 +438,32 @@ class CallGraph:
                         if klass is not None:
                             cinfo.attr_types.setdefault(attr, klass.dotted)
                             continue
+                        # self.<attr> = self.<method>: a stored callback
+                        # (``self._cb = self._on_done``) — later
+                        # ``self._cb()`` calls resolve to the method
+                        if (
+                            isinstance(node.value, ast.Attribute)
+                            and isinstance(node.value.value, ast.Name)
+                            and node.value.value.id == "self"
+                        ):
+                            cinfo.attr_callbacks.setdefault(
+                                attr, node.value.attr
+                            )
+                            continue
+                        # self.<attr> = <param> where the parameter is
+                        # class-annotated (constructor injection)
+                        if (
+                            isinstance(node.value, ast.Name)
+                            and node.value.id in param_ann
+                        ):
+                            klass = self._annotation_class(
+                                mod, param_ann[node.value.id]
+                            )
+                            if klass is not None:
+                                cinfo.attr_types.setdefault(
+                                    attr, klass.dotted
+                                )
+                                continue
                         if isinstance(node.value, ast.Name):
                             dotted = mod.imports.map.get(node.value.id)
                             if dotted and dotted not in self.classes and (
@@ -428,23 +503,32 @@ class CallGraph:
 
     def _local_module_alias(self, fn: FunctionInfo, name: str
                             ) -> Optional[str]:
-        cinfo = (fn.module.classes.get(fn.class_name)
-                 if fn.class_name else None)
-        if cinfo is None:
-            return None
-        for node in own_nodes(fn.node):
-            if (
-                isinstance(node, ast.Assign)
-                and len(node.targets) == 1
-                and isinstance(node.targets[0], ast.Name)
-                and node.targets[0].id == name
-                and isinstance(node.value, ast.Attribute)
-                and isinstance(node.value.value, ast.Name)
-                and node.value.value.id == "self"
-                and node.value.attr in cinfo.attr_modules
-            ):
-                return cinfo.attr_modules[node.value.attr]
-        return None
+        # One AST walk per function, memoized: resolve_name runs per
+        # call site, and re-walking the body for every lookup turns the
+        # whole-package pass quadratic (the analyzer's own wall budget
+        # is pinned in tier-1).
+        cached = self._alias_cache.get(fn.qualname)
+        if cached is None:
+            cached = {}
+            cinfo = (fn.module.classes.get(fn.class_name)
+                     if fn.class_name else None)
+            if cinfo is not None and cinfo.attr_modules:
+                for node in own_nodes(fn.node):
+                    if (
+                        isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and isinstance(node.value, ast.Attribute)
+                        and isinstance(node.value.value, ast.Name)
+                        and node.value.value.id == "self"
+                        and node.value.attr in cinfo.attr_modules
+                    ):
+                        cached.setdefault(
+                            node.targets[0].id,
+                            cinfo.attr_modules[node.value.attr],
+                        )
+            self._alias_cache[fn.qualname] = cached
+        return cached.get(name)
 
     def _method_of(self, class_dotted: str, name: str
                    ) -> Optional[FunctionInfo]:
@@ -476,7 +560,20 @@ class CallGraph:
     def _local_types(self, fn: FunctionInfo) -> Dict[str, str]:
         """Local var -> class dotted, from ``x = ClassName(...)``,
         ``x = self.attr`` of a typed attribute, and parameter
-        annotations naming a package class (``commit: CheckpointCommit``)."""
+        annotations naming a package class (``commit: CheckpointCommit``).
+
+        Memoized per function: every rule that scans call sites asks for
+        this map, and the answer is fixed once the graph is built — the
+        cache turns the analyzer's dominant repeated AST walk into a
+        dict hit (the STA009-STA014 passes share one graph per run)."""
+        cached = self._local_types_cache.get(fn.qualname)
+        if cached is not None:
+            return cached
+        out = self._local_types_uncached(fn)
+        self._local_types_cache[fn.qualname] = out
+        return out
+
+    def _local_types_uncached(self, fn: FunctionInfo) -> Dict[str, str]:
         mod = fn.module
         cinfo = (mod.classes.get(fn.class_name)
                  if fn.class_name else None)
@@ -556,6 +653,10 @@ class CallGraph:
                 m = self._method_of(cinfo.dotted, func.attr)
                 if m is not None:
                     return m
+                # self-stored callback: self._cb = self._on_done
+                cb = cinfo.attr_callbacks.get(func.attr)
+                if cb is not None:
+                    return self._method_of(cinfo.dotted, cb)
                 return None
             # self.attr.method(...) via attribute type
             if (
@@ -581,6 +682,19 @@ class CallGraph:
             return None
         return None
 
+    def _resolve_spawn_target(self, fn: FunctionInfo, value: ast.AST,
+                              local_types: Dict[str, str]
+                              ) -> Optional[FunctionInfo]:
+        """A ``Thread(target=...)`` entry point: a plain callable, or a
+        ``functools.partial(<callable>, ...)`` wrapping one (the standard
+        way to hand a thread entry bound arguments)."""
+        if isinstance(value, ast.Call):
+            name = self.resolve_name(fn, value.func)
+            if name in ("functools.partial", "partial") and value.args:
+                return self.resolve_callable(fn, value.args[0], local_types)
+            return None
+        return self.resolve_callable(fn, value, local_types)
+
     def _resolve_calls(self) -> None:
         for fn in list(self.functions.values()):
             callees: Set[str] = set()
@@ -602,7 +716,7 @@ class CallGraph:
                     tgt = None
                     for kw in node.keywords:
                         if kw.arg == "target":
-                            tgt = self.resolve_callable(
+                            tgt = self._resolve_spawn_target(
                                 fn, kw.value, local_types
                             )
                     self.thread_spawns.append(
@@ -623,6 +737,68 @@ class CallGraph:
             self.edges[fn.qualname] = callees
             if unresolved:
                 self.unresolved[fn.qualname] = unresolved
+
+    # ------------------------------------------------------ overrides
+    @staticmethod
+    def _is_abstract(fn: FunctionInfo) -> bool:
+        """A method whose body is only ``raise`` / ``pass`` / ``...`` /
+        a docstring — the package's abstract-surface idiom (the
+        ``ControlPlane`` backend hooks). Calls resolving to one of these
+        tell the static edges nothing; the override edges carry the
+        dispatch into the concrete backends."""
+        body = list(getattr(fn.node, "body", []))
+        if body and isinstance(body[0], ast.Expr) and isinstance(
+            body[0].value, ast.Constant
+        ) and isinstance(body[0].value.value, str):
+            body = body[1:]
+        if not body:
+            return True
+        for stmt in body:
+            if isinstance(stmt, (ast.Raise, ast.Pass)):
+                continue
+            if isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Constant
+            ) and stmt.value.value is Ellipsis:
+                continue
+            return False
+        return True
+
+    def _infer_overrides(self) -> None:
+        """``override_edges``: abstract method -> same-named methods of
+        every subclass in the package (single-level base resolution, the
+        same best effort :meth:`_method_of` applies upward)."""
+        # class dotted -> direct subclasses (dotted)
+        subclasses: Dict[str, List[str]] = {}
+        for cinfo in self.classes.values():
+            for b in cinfo.bases:
+                base = self.classes.get(b)
+                if base is None and b:
+                    base = self._lookup_class(cinfo.module, b.split(".")[-1])
+                if base is not None:
+                    subclasses.setdefault(base.dotted, []).append(
+                        cinfo.dotted
+                    )
+        for class_dotted, subs in subclasses.items():
+            cinfo = self.classes[class_dotted]
+            for name, meth in cinfo.methods.items():
+                if not self._is_abstract(meth):
+                    continue
+                stack = list(subs)
+                seen: Set[str] = set()
+                while stack:
+                    sub = stack.pop()
+                    if sub in seen:
+                        continue
+                    seen.add(sub)
+                    sub_info = self.classes.get(sub)
+                    if sub_info is None:
+                        continue
+                    override = sub_info.methods.get(name)
+                    if override is not None and override is not meth:
+                        self.override_edges.setdefault(
+                            meth.qualname, set()
+                        ).add(override.qualname)
+                    stack.extend(subclasses.get(sub, ()))
 
     # ------------------------------------------------------- reachability
     def find(self, spec: str) -> List[FunctionInfo]:
@@ -668,14 +844,22 @@ class CallGraph:
                 queue.append(target)
         return order
 
-    def descendants(self, seeds: Iterable[str]) -> Set[str]:
-        """Qualnames reachable from ``seeds`` (qualnames), seeds included."""
+    def descendants(self, seeds: Iterable[str],
+                    virtual: bool = False) -> Set[str]:
+        """Qualnames reachable from ``seeds`` (qualnames), seeds
+        included. ``virtual=True`` additionally follows
+        :attr:`override_edges` — a call on an abstract surface reaches
+        every backend override (the protocol rules' dispatch model;
+        the concurrency rules keep the exact static edges)."""
         seen: Set[str] = set()
         queue = [s for s in seeds if s in self.functions]
         seen.update(queue)
         while queue:
             q = queue.pop(0)
-            for callee in self.edges.get(q, ()):
+            callees: Set[str] = set(self.edges.get(q, ()))
+            if virtual:
+                callees |= self.override_edges.get(q, set())
+            for callee in sorted(callees):
                 if callee not in seen and callee in self.functions:
                     seen.add(callee)
                     queue.append(callee)

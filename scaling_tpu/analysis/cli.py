@@ -1,9 +1,14 @@
-"""CLI: ``python -m scaling_tpu.analysis [lint|audit|all]``.
+"""CLI: ``python -m scaling_tpu.analysis [lint|audit|protocol|all]``.
 
 Emits a human table on stdout and, with ``--json``, a machine-readable
 report. Exit code 0 == clean tree (no unsuppressed lint findings, no
-golden drift); non-zero == the gate fired. ``audit --repin`` rewrites the
-goldens from the current tree (commit the diff deliberately).
+golden drift); non-zero == the gate fired. ``audit --repin`` /
+``protocol --repin`` rewrite the respective goldens from the current
+tree (commit the diff deliberately).
+
+One :class:`~.callgraph.CallGraph` is built per run and shared by every
+whole-program consumer — the lint's STA009-STA015 and the ``protocol``
+inventory — so ``all`` pays the AST walk once.
 """
 
 from __future__ import annotations
@@ -16,11 +21,11 @@ from pathlib import Path
 REPO_ROOT = Path(__file__).resolve().parents[2]
 
 
-def _lint(args) -> tuple[int, dict]:
+def _lint(args, graph=None) -> tuple[int, dict]:
     from .lint import RULES, lint_paths
 
     paths = [Path(p) for p in (args.paths or [REPO_ROOT / "scaling_tpu"])]
-    findings = lint_paths(paths, root=args.root or REPO_ROOT)
+    findings = lint_paths(paths, root=args.root or REPO_ROOT, graph=graph)
     active = [f for f in findings if not f.suppressed]
     for f in findings:
         print(str(f))
@@ -118,12 +123,53 @@ def _audit(args) -> tuple[int, dict]:
     return (1 if drift else 0), payload
 
 
+def _protocol(args, graph) -> tuple[int, dict]:
+    """The goldens-pinned protocol inventory: barrier name templates +
+    participating functions, per-module RPC op tables. jax-free —
+    rides the shared call graph. Golden compare is skipped on a
+    ``--paths``-scoped run (the pinned surface is the whole tree)."""
+    from .protocol import (
+        ProtocolModel,
+        build_inventory,
+        compare_inventory,
+        write_inventory,
+    )
+
+    model = ProtocolModel(graph)
+    inv = build_inventory(graph, model)
+    for name, rec in inv["barriers"].items():
+        print(f"barrier {name:<24} waits={len(rec['waits'])} "
+              f"arrives={len(rec['arrives'])}")
+    for modname, rec in inv["rpc"].items():
+        for op, info in rec["ops"].items():
+            handler = ",".join(info["handler"]) or "-"
+            print(f"rpc {modname}:{op:<12} clients={len(info['clients'])} "
+                  f"handler={handler} "
+                  f"replies={{{','.join(info['reply_keys'])}}}")
+    golden_dir = Path(args.goldens) if args.goldens else None
+    drift: list[str] = []
+    if args.repin:
+        path = write_inventory(inv, golden_dir)
+        print(f"protocol: repinned -> {path}")
+    elif args.paths:
+        print("protocol: golden compare skipped (--paths-scoped run)")
+    else:
+        drift = compare_inventory(inv, golden_dir)
+        for line in drift:
+            print(f"DRIFT: {line}")
+        print(f"protocol: golden {'OK' if not drift else 'DRIFT'}")
+    payload = {"inventory": inv, "drift": drift,
+               "repinned": bool(args.repin)}
+    return (1 if drift else 0), payload
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m scaling_tpu.analysis",
         description="JAX-aware static lint + lowered-HLO audit",
     )
-    parser.add_argument("command", choices=["lint", "audit", "all"])
+    parser.add_argument("command",
+                        choices=["lint", "audit", "protocol", "all"])
     parser.add_argument("--json", metavar="FILE",
                         help="also write a machine-readable report")
     parser.add_argument("--paths", nargs="*",
@@ -140,12 +186,27 @@ def main(argv=None) -> int:
     rc = 0
     # bumped whenever the JSON report's structure changes (ISSUE 15:
     # version 2 added schema_version itself + the ordered lint["rules"]
-    # per-rule summary); consumers diff structurally against this
-    payload: dict = {"schema_version": 2}
+    # per-rule summary; ISSUE 17: version 3 added the protocol rules
+    # STA012-STA015 to lint["rules"] and the "protocol" section —
+    # inventory + drift); consumers diff structurally against this
+    payload: dict = {"schema_version": 3}
+    graph = None
+    if args.command in ("lint", "protocol", "all"):
+        # ONE call graph per run, shared by lint's whole-program rules
+        # and the protocol inventory
+        from .callgraph import CallGraph
+
+        graph_paths = [Path(p) for p in
+                       (args.paths or [REPO_ROOT / "scaling_tpu"])]
+        graph = CallGraph.build(graph_paths, root=args.root or REPO_ROOT)
     if args.command in ("lint", "all"):
-        lint_rc, lint_payload = _lint(args)
+        lint_rc, lint_payload = _lint(args, graph=graph)
         rc = max(rc, lint_rc)
         payload["lint"] = lint_payload
+    if args.command in ("protocol", "all"):
+        proto_rc, proto_payload = _protocol(args, graph)
+        rc = max(rc, proto_rc)
+        payload["protocol"] = proto_payload
     if args.command in ("audit", "all"):
         audit_rc, audit_payload = _audit(args)
         rc = max(rc, audit_rc)

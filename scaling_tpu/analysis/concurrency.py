@@ -134,6 +134,16 @@ PROCESS_FAULT_POINTS = {
 # body declares those instance attributes' lock-free sharing deliberate
 _LOCKFREE_RE = re.compile(r"#\s*sta:\s*lock\(([^)]*)\)")
 
+
+def _annotation_comments(mod, node) -> List[Tuple[int, str]]:
+    """Real COMMENT tokens within a node's lexical range (docstrings
+    quoting an annotation never count — see lint.iter_comments)."""
+    from .lint import iter_comments
+
+    end = getattr(node, "end_lineno", node.lineno)
+    return [(i, t) for i, t in iter_comments(mod.source)
+            if node.lineno <= i <= end]
+
 # attribute types that are themselves synchronization/thread-safe
 _SAFE_ATTR_CONSTRUCTORS = (
     "threading.Lock", "threading.RLock", "threading.Condition",
@@ -231,11 +241,9 @@ class _ClassConcurrency:
                         self.safe_attrs.add(attr)
 
     def _scan_annotations(self) -> None:
-        node = self.cinfo.node
-        end = getattr(node, "end_lineno", node.lineno)
-        lines = self.cinfo.module.source.splitlines()
-        for i in range(node.lineno - 1, min(end, len(lines))):
-            m = _LOCKFREE_RE.search(lines[i])
+        for _, text in _annotation_comments(self.cinfo.module,
+                                            self.cinfo.node):
+            m = _LOCKFREE_RE.search(text)
             if m:
                 self.lockfree.update(
                     a.strip() for a in m.group(1).split(",") if a.strip()
@@ -403,8 +411,15 @@ class _ClassConcurrency:
         return sites
 
 
-def check_lock_discipline(graph: CallGraph) -> List:
-    """STA009 over every class that spawns threads onto its own code."""
+def check_lock_discipline(
+    graph: CallGraph,
+    lock_usage: Optional[Set[Tuple[str, str]]] = None,
+) -> List:
+    """STA009 over every class that spawns threads onto its own code.
+
+    ``lock_usage`` (when given) collects ``(class_dotted, attr)`` pairs
+    whose ``# sta: lock(attr)`` annotation suppressed a real hazard —
+    the stale-suppression audit's ground truth."""
     em = _Emitter()
     # class dotted -> [(side label, entry FunctionInfo)]
     per_class: Dict[str, List[Tuple[str, FunctionInfo]]] = {}
@@ -459,7 +474,7 @@ def check_lock_discipline(graph: CallGraph) -> List:
         if main_acc_merged:
             sides.append(("the main-thread public API", main_acc_merged))
 
-        _report_races(em, cinfo, model, sides)
+        _report_races(em, cinfo, model, sides, lock_usage)
     return em.findings
 
 
@@ -475,12 +490,13 @@ def _with_entry_locks(acc: Dict[str, List], locks: Dict[str, frozenset]
 
 
 def _report_races(em: _Emitter, cinfo: ClassInfo, model: _ClassConcurrency,
-                  sides: List[Tuple[str, Dict[str, List]]]) -> None:
+                  sides: List[Tuple[str, Dict[str, List]]],
+                  lock_usage: Optional[Set[Tuple[str, str]]] = None) -> None:
     attrs: Set[str] = set()
     for _, acc in sides:
         attrs |= set(acc)
     for attr in sorted(attrs):
-        if attr in model.safe_attrs or attr in model.lockfree:
+        if attr in model.safe_attrs:
             continue
         # collect (side, access) pairs; hazard = a WRITE on one side and
         # any access on another with no common lock between them
@@ -503,6 +519,13 @@ def _report_races(em: _Emitter, cinfo: ClassInfo, model: _ClassConcurrency,
                             hazard = (label_w, fn_w, node_w,
                                       label_o, fn_o, node_o, okind)
         if hazard is None:
+            continue
+        # the lockfree check sits AFTER hazard detection so the stale-
+        # suppression audit (STA015) can tell a load-bearing
+        # `# sta: lock(attr)` from one whose hazard no longer exists
+        if attr in model.lockfree:
+            if lock_usage is not None:
+                lock_usage.add((cinfo.dotted, attr))
             continue
         label_w, fn_w, node_w, label_o, fn_o, node_o, okind = hazard
         em.emit(
@@ -809,12 +832,61 @@ def check_unguarded_io(
 
 
 # ---------------------------------------------------------------- driver
+class _Loc:
+    """Pseudo-node carrying a location for comment-anchored findings."""
+
+    def __init__(self, lineno: int, col_offset: int = 0) -> None:
+        self.lineno = lineno
+        self.col_offset = col_offset
+
+
+def check_stale_lock_annotations(
+    graph: CallGraph,
+    lock_usage: Set[Tuple[str, str]],
+    em: Optional[_Emitter] = None,
+) -> List:
+    """STA015 (lock half): a ``# sta: lock(attr, ...)`` annotation is
+    stale when NONE of its attrs suppressed a hazard this run — either
+    the class no longer spawns threads onto its own code, or the
+    racing access pattern is gone. Stale annotations are worse than
+    noise: they pre-suppress the next real race on that field."""
+    em = em or _Emitter()
+    for class_dotted in sorted(graph.classes):
+        cinfo = graph.classes[class_dotted]
+        for lineno, text in _annotation_comments(cinfo.module, cinfo.node):
+            m = _LOCKFREE_RE.search(text)
+            if not m:
+                continue
+            attrs = [a.strip() for a in m.group(1).split(",") if a.strip()]
+            if any((class_dotted, a) in lock_usage for a in attrs):
+                continue
+            em.emit(
+                "STA015", cinfo.module, _Loc(lineno),
+                f"stale '# sta: lock({m.group(1).strip()})' on "
+                f"{cinfo.name}: no cross-thread hazard on "
+                f"{'these fields' if len(attrs) > 1 else 'this field'} "
+                "is being suppressed — the class no longer races here. "
+                "Remove the annotation (keep the prose if it documents "
+                "intent) so it cannot pre-suppress the next real race",
+            )
+    return em.findings
+
+
 def check_program(paths: Iterable[Path | str],
-                  root: Optional[Path | str] = None) -> List:
-    """All three whole-program rules over one shared call graph."""
-    graph = CallGraph.build(paths, root=root)
+                  root: Optional[Path | str] = None,
+                  graph: Optional[CallGraph] = None) -> List:
+    """Every whole-program rule (STA009-STA015) over ONE shared call
+    graph — pass ``graph`` to reuse a prebuilt one (the CLI builds a
+    single graph per run and shares it across commands)."""
+    if graph is None:
+        graph = CallGraph.build(paths, root=root)
     findings: List = []
-    findings.extend(check_lock_discipline(graph))
+    lock_usage: Set[Tuple[str, str]] = set()
+    findings.extend(check_lock_discipline(graph, lock_usage=lock_usage))
     findings.extend(check_hot_path_syncs(graph))
     findings.extend(check_unguarded_io(graph))
+    from .protocol import check_protocol  # lazy: protocol imports us
+
+    findings.extend(check_protocol(graph))
+    findings.extend(check_stale_lock_annotations(graph, lock_usage))
     return findings

@@ -60,13 +60,45 @@ STA011    error     raw I/O (``open``/``os.replace``/``os.write``/
                     ``FaultPlan`` point — the ROADMAP's "new I/O paths
                     take a fault point + retry" contract, enforced
                     mechanically. Whole-program rule (concurrency.py).
+STA012    error     barrier-divergence: an exit path (return /
+                    fall-through) skips a named control-plane barrier
+                    that another path rendezvouses on, AFTER a shared
+                    side-effect in their common prefix — the PR 4
+                    split-exit deadlock shape (one host enters
+                    ``commit:step-N``, a peer exits early; the barrier
+                    never fills). ``raise``/``sys.exit`` exits, abort-
+                    flag-checked drains, ``cp.arrive`` paths, and
+                    ``# sta: barrier-exempt(<name>)`` are sanctioned.
+                    Whole-program rule (protocol.py).
+STA013    error     RPC-contract mismatch between a module's client
+                    send sites (dict literals with an ``"op"`` key)
+                    and its server dispatch table: an op with no
+                    handler, a dead handler no client sends, a reply
+                    key a client reads that no handler path returns.
+                    Whole-program rule (protocol.py).
+STA014    error     protocol-edge coverage: an RPC send, named-barrier
+                    wait, or replica spawn/kill site in the gated
+                    subsystems (+ trainer/) not under a ``FaultPlan``
+                    point / ``retry_io`` guard or not inside/beneath an
+                    ``obs.span`` — STA011's contract extended to the
+                    protocol layer. Whole-program rule (protocol.py).
+STA015    warning   stale suppression: a ``# sta: disable=...`` comment
+                    on a line where no (suppressed) finding fires, or a
+                    ``# sta: lock(attr)`` annotation suppressing no
+                    cross-thread hazard. Stale suppressions pre-silence
+                    the next real finding on that line/field. Emitted
+                    by the whole-program pass only (a per-file-only run
+                    cannot tell which program-rule suppressions are
+                    live).
 ========  ========  ==========================================================
 
 Suppress a finding on its line with ``# sta: disable=STA003`` (a comma
 rule list, ``# sta: disable=STA009,STA011``, suppresses exactly those
 rules) or a bare ``# sta: disable`` (every rule on the line). Suppressed
 findings are still reported (with ``suppressed: true``) but do not fail
-the gate.
+the gate. STA015 itself is deliberately NOT silenced by the bare form
+(a stale bare disable would self-suppress); an explicit
+``# sta: disable=STA015`` in the comment's rule list is honored.
 
 *Traced context* (where STA001-STA003 apply) is detected structurally:
 functions decorated with ``jax.jit`` / ``jax.checkpoint`` / ``jax.vmap`` /
@@ -103,6 +135,14 @@ RULES = {
                         "serve tick hot path"),
     "STA011": ("error", "raw I/O in a gated subsystem outside every "
                         "retry_io / FaultPlan guard"),
+    "STA012": ("error", "exit path skips a barrier another path "
+                        "rendezvouses on after shared side-effects"),
+    "STA013": ("error", "RPC op/reply contract mismatch between client "
+                        "sends and the server dispatch table"),
+    "STA014": ("error", "protocol edge (rpc send / barrier wait / replica "
+                        "spawn-kill) missing fault/retry guard or span"),
+    "STA015": ("warning", "stale suppression: a '# sta:' annotation that "
+                          "no longer suppresses any finding"),
 }
 
 # Module allowlist for traced-context rules (ISSUE 2: nn/, parallel/, ops/;
@@ -179,13 +219,35 @@ _KEY_CONSUMERS = {
 _SUPPRESS_RE = re.compile(r"#\s*sta:\s*disable(?:=([A-Za-z0-9_, ]+))?")
 
 
+def iter_comments(source: str) -> List[Tuple[int, str]]:
+    """(lineno, text) for every actual COMMENT token. Annotation scans
+    (``# sta: disable`` / ``lock(...)`` / ``barrier-exempt(...)``) go
+    through here so a docstring QUOTING an annotation — this package's
+    own docs are full of them — neither suppresses anything nor trips
+    the stale-suppression audit. Falls back to a whole-line scan only
+    when the source does not tokenize (the syntax-error path, where
+    nothing downstream runs anyway)."""
+    import io
+    import tokenize
+
+    out: List[Tuple[int, str]] = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out.append((tok.start[0], tok.string))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return [(i, text) for i, text in
+                enumerate(source.splitlines(), start=1) if "#" in text]
+    return out
+
+
 def parse_suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
     """line -> suppressed rule set (None = bare disable, every rule).
     Shared by the per-file pass and the whole-program rules
     (concurrency.py) so ``# sta: disable=STA009,STA011`` means the same
-    thing everywhere."""
+    thing everywhere. Only real comments count (see iter_comments)."""
     out: Dict[int, Optional[Set[str]]] = {}
-    for i, text in enumerate(source.splitlines(), start=1):
+    for i, text in iter_comments(source):
         m = _SUPPRESS_RE.search(text)
         if not m:
             continue
@@ -855,29 +917,80 @@ def lint_file(path: Path, root: Optional[Path] = None) -> List[Finding]:
         ]
 
 
+def _stale_disables(
+    files: List[Path], root: Path, findings: List[Finding]
+) -> List[Finding]:
+    """STA015 (disable half): every ``# sta: disable[=rules]`` comment
+    must suppress at least one finding that actually fires on its line
+    (restricted to the listed rules when a list is given). Emitted
+    unsuppressed by design — a stale bare disable must not silence its
+    own staleness finding; an explicit ``disable=STA015`` is honored
+    (and marks the comment intentional)."""
+    by_loc: Dict[Tuple[str, int], Set[str]] = {}
+    for f in findings:
+        if f.suppressed:
+            by_loc.setdefault((f.path, f.line), set()).add(f.rule)
+    out: List[Finding] = []
+    for path in files:
+        try:
+            rel = str(path.resolve().relative_to(root.resolve()))
+        except ValueError:
+            rel = str(path)
+        try:
+            source = path.read_text()
+        except OSError:
+            continue
+        for line, rules in sorted(parse_suppressions(source).items()):
+            if rules is not None and "STA015" in rules:
+                continue  # explicitly opted out / self-referential
+            fired = by_loc.get((rel, line), set())
+            live = fired if rules is None else (fired & rules)
+            if live:
+                continue
+            listed = "" if rules is None else "=" + ",".join(sorted(rules))
+            out.append(Finding(
+                "STA015", RULES["STA015"][0], rel, line, 0,
+                f"stale '# sta: disable{listed}': no finding fires on "
+                "this line any more — remove the comment so it cannot "
+                "pre-suppress the next real finding here",
+                False,
+            ))
+    return out
+
+
 def lint_paths(
     paths: Iterable[Path | str],
     root: Optional[Path] = None,
     program: bool = True,
+    graph=None,
 ) -> List[Finding]:
     """Lint every ``.py`` under ``paths`` (files or directories).
 
     Runs the per-file AST rules (STA001-STA008) plus — unless
     ``program=False`` — the whole-program call-graph rules
-    (STA009-STA011, concurrency.py) over the same path set as one
-    analysis unit. Ordering is stable: (path, line, col, rule)."""
+    (STA009-STA014, concurrency.py + protocol.py) and the
+    stale-suppression audit (STA015) over the same path set as one
+    analysis unit. Pass ``graph`` (a prebuilt ``CallGraph`` over the
+    same paths) to skip the rebuild — the CLI constructs one graph per
+    run and shares it across commands. Ordering is stable:
+    (path, line, col, rule)."""
     root = Path(root) if root else Path.cwd()
     # materialize once: a generator argument would be exhausted by the
     # per-file loop and silently hand check_program an EMPTY path set
     paths = [Path(p) for p in paths]
     findings: List[Finding] = []
+    seen_files: List[Path] = []
     for p in paths:
         files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
         for f in files:
             findings.extend(lint_file(f, root))
+            seen_files.append(f)
     if program:
         from .concurrency import check_program
 
-        findings.extend(check_program(paths, root=root))
+        findings.extend(check_program(paths, root=root, graph=graph))
+        # stale-disable audit LAST: it needs the complete finding set
+        # (per-file + whole-program) to judge what a comment suppresses
+        findings.extend(_stale_disables(seen_files, root, findings))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

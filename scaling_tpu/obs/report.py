@@ -72,10 +72,10 @@ def load_run_dir(run_dir: Path | str, recursive: bool = True) -> RunData:
     for path in sorted(glob("*.jsonl")):
         files += 1
         try:
-            # stays raw: the analyzer is already fault-tolerant by
+            # stays raw: the report reader is already fault-tolerant by
             # design — an unreadable file counts as bad and the report
             # proceeds (torn tails are data, not errors, post-crash)
-            text = path.read_text()  # sta: disable=STA011
+            text = path.read_text()
         except OSError:
             bad += 1
             continue

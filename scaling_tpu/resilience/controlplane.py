@@ -518,7 +518,11 @@ class TcpControlPlane(ControlPlane):
         self._request({"op": "prune", "name": name})
 
     def set_flag(self, name: str, value: str = "1") -> None:
-        self._request({"op": "set_flag", "name": name, "value": value})
+        # flag writes are rare, high-signal control events (abort /
+        # preempt broadcast) — worth a span each
+        with span("cp.set_flag", flag=name, host=self.host_id,
+                  level="debug"):
+            self._request({"op": "set_flag", "name": name, "value": value})
 
     def get_flag(self, name: str) -> Optional[str]:
         return self._request({"op": "get_flag", "name": name})["value"]

@@ -165,6 +165,9 @@ def spawn_worker(
     encoded_payload: str,
 ) -> subprocess.Popen:
     """Start one worker process (local exec or ssh-wrapped)."""
+    from ..resilience.faults import get_fault_plan
+
+    get_fault_plan().fire("runner.worker.spawn")
     cmd = build_worker_command(config, env_exports, encoded_payload)
     docker = config.runner_type == RunnerType.PDSH_DOCKER
     quoted = " ".join(shlex.quote(a) for a in cmd)
@@ -208,6 +211,9 @@ def runner_main(config: RunnerConfig, payload: Any) -> int:
 
     # babysit: if any worker dies non-zero, kill the rest
     # (reference: launch.py:125-161)
+    from ..obs import span
+    from ..resilience.faults import get_fault_plan
+
     exit_code = 0
     try:
         while procs:
@@ -218,14 +224,18 @@ def runner_main(config: RunnerConfig, payload: Any) -> int:
                 procs.remove(p)
                 if ret != 0:
                     exit_code = ret
-                    for other in procs:
-                        other.terminate()
+                    get_fault_plan().fire("runner.worker.kill")
+                    with span("runner.teardown", rc=ret):
+                        for other in procs:
+                            other.terminate()
             import time
 
             time.sleep(0.2)
     except KeyboardInterrupt:
-        for p in procs:
-            p.terminate()
+        get_fault_plan().fire("runner.worker.kill")
+        with span("runner.teardown", rc=130):
+            for p in procs:
+                p.terminate()
         exit_code = 130
     return exit_code
 

@@ -168,10 +168,14 @@ def run_bench(engine, workload, time_scale: float = 1.0,
         from ..resilience import StepStallWatchdog
 
         def _on_stall(tick, elapsed):
+            from ..resilience.faults import get_fault_plan
+
             logger.log_event(
                 "serve-stall", tick=tick, stalled_s=round(elapsed, 3)
             )
-            os.kill(os.getpid(), _signal.SIGKILL)
+            get_fault_plan().fire("serve.stall.kill")
+            with span("serve.stall.kill", tick=tick):
+                os.kill(os.getpid(), _signal.SIGKILL)
 
         watchdog = StepStallWatchdog(tick_timeout_s, on_stall=_on_stall)
         watchdog.start()
@@ -543,6 +547,8 @@ def run_supervised(argv: List[str], args) -> int:
     import subprocess
 
     from ..logging import logger
+    from ..obs import span
+    from ..resilience.faults import get_fault_plan
 
     child_argv: List[str] = []
     skip = False
@@ -588,7 +594,9 @@ def run_supervised(argv: List[str], args) -> int:
                    *child_argv]
             if attempts > 0 and "--resume" not in child_argv:
                 cmd.append("--resume")
-            state["child"] = subprocess.Popen(cmd, env=env)
+            get_fault_plan().fire("serve.supervisor.spawn")
+            with span("serve.supervisor.spawn", attempt=attempts):
+                state["child"] = subprocess.Popen(cmd, env=env)
             if state["draining"]:
                 # the signal raced the launch: the handler saw no child
                 state["child"].send_signal(signal.SIGTERM)
@@ -725,8 +733,9 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
     from concurrent.futures import ThreadPoolExecutor
 
     from ..logging import logger
-    from ..obs import get_registry
+    from ..obs import get_registry, span
     from ..obs.report import percentile
+    from ..resilience.faults import get_fault_plan
     from .journal import RequestJournal
     from .replica_proc import FleetSupervisor, spawn_replica_proc
     from .router import AutoscalePolicy, FleetRouter, ReplicaUnreachable
@@ -774,6 +783,18 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
             env=clean_env if env is None else env,
         )
 
+    drain_req = {"flag": False}
+
+    def _drain_sig(signum, frame):
+        # flag only: RPC fan-out happens on the loop, not in the handler
+        drain_req["flag"] = True
+
+    # Install before spawning: workers log serve-replica-ready the
+    # moment they publish their addr, which is before spawn() returns
+    # on the host — a drain signal sent at first-ready must not hit the
+    # default SIGTERM disposition and kill the bench under its workers.
+    prev = signal.signal(signal.SIGTERM, _drain_sig)
+
     # parallel launch: every worker pays its cold jit warmup at once
     with ThreadPoolExecutor(max_workers=args.replicas_proc) as ex:
         handles = list(ex.map(
@@ -803,13 +824,6 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
         restart_budget=args.restart_budget,
         policy=policy, on_drain=harvest,
     )
-    drain_req = {"flag": False}
-
-    def _drain_sig(signum, frame):
-        # flag only: RPC fan-out happens on the loop, not in the handler
-        drain_req["flag"] = True
-
-    prev = signal.signal(signal.SIGTERM, _drain_sig)
     pending = sorted(workload, key=lambda w: w[0])
     idx = 0
     shed = 0
@@ -884,20 +898,25 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
                     pass
                 harvest(h)
                 h.request_shutdown()
-        for h in router.replicas:
-            if h.proc.poll() is None:
-                try:
-                    h.proc.wait(timeout=30)
-                except subprocess.TimeoutExpired:
-                    logger.warning(
-                        f"replica {h.replica_id} ignored shutdown; killing"
-                    )
-                    h.proc.kill()
+        get_fault_plan().fire("serve.fleet.teardown")
+        with span("serve.fleet.teardown"):
+            for h in router.replicas:
+                if h.proc.poll() is None:
+                    try:
+                        h.proc.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        logger.warning(
+                            f"replica {h.replica_id} ignored shutdown; "
+                            "killing"
+                        )
+                        h.proc.kill()
     finally:
         signal.signal(signal.SIGTERM, prev)
-        for h in router.replicas:
-            if h.proc.poll() is None:
-                h.proc.kill()  # no orphan keeps writing to the run dir
+        with span("serve.fleet.teardown", phase="finally"):
+            for h in router.replicas:
+                if h.proc.poll() is None:
+                    # no orphan keeps writing to the run dir
+                    h.proc.kill()
 
     completed = {
         r: rec for r, rec in recs.items() if rec["status"] == "completed"

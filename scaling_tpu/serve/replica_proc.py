@@ -71,6 +71,7 @@ from pathlib import Path
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..logging import logger
+from ..obs import span
 from ..resilience.faults import get_fault_plan
 from ..resilience.guards import retry_io
 from ..runner.supervise import restart_backoff
@@ -184,8 +185,10 @@ class _ReplicaWorker:
     # threads with no lock ON PURPOSE: it is a monotonic float beat
     # (GIL-atomic store), and taking the tick lock to read it would
     # make the heartbeat blind to exactly the wedged-tick state it
-    # exists to expose.
-    # sta: lock(_loop_wall)
+    # exists to expose. (No `# sta: lock` annotation: the RPC threads
+    # are spawned by ReplicaRpcServer, not this class, so the analyzer
+    # models no hazard here — a stale annotation would only pre-silence
+    # a future real one.)
 
     def __init__(self, engine, linger_s: float = DEFAULT_LINGER_S):
         self.engine = engine
@@ -440,7 +443,9 @@ class ProcReplicaHandle:
 
     # ---------------------------------------------------------- rpc
     def _rpc(self, req: dict, attempts: int = 3) -> dict:
-        reply = self.client.request(req, attempts=attempts)
+        with span("serve.replica.rpc_client", op=req.get("op"),
+                  replica=self.replica_id, level="debug"):
+            reply = self.client.request(req, attempts=attempts)
         self.last_ok_wall = time.monotonic()
         return reply
 
@@ -558,31 +563,32 @@ def spawn_replica_proc(replica_id: int, worker_cfg: dict, run_dir,
              what="replica config write")
     child_env = dict(os.environ if env is None else env)
     child_env["SCALING_TPU_HOST_ID"] = str(replica_id)
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "scaling_tpu.serve.replica_proc",
-         "--config", str(cfg_path)],
-        env=child_env,
-    )
-    deadline = time.monotonic() + ready_timeout_s
-    while True:
-        if addr_path.exists():
-            addr = retry_io(
-                addr_path.read_text, what="replica address read"
-            ).strip()
-            if addr:
-                break
-        rc = proc.poll()
-        if rc is not None:
-            raise OSError(
-                f"replica {replica_id} died during startup (rc={rc})"
-            )
-        if time.monotonic() > deadline:
-            proc.kill()
-            raise OSError(
-                f"replica {replica_id} not ready within "
-                f"{ready_timeout_s:.0f}s"
-            )
-        time.sleep(0.05)
+    with span("serve.replica.spawn", replica=replica_id):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "scaling_tpu.serve.replica_proc",
+             "--config", str(cfg_path)],
+            env=child_env,
+        )
+        deadline = time.monotonic() + ready_timeout_s
+        while True:
+            if addr_path.exists():
+                addr = retry_io(
+                    addr_path.read_text, what="replica address read"
+                ).strip()
+                if addr:
+                    break
+            rc = proc.poll()
+            if rc is not None:
+                raise OSError(
+                    f"replica {replica_id} died during startup (rc={rc})"
+                )
+            if time.monotonic() > deadline:
+                proc.kill()
+                raise OSError(
+                    f"replica {replica_id} not ready within "
+                    f"{ready_timeout_s:.0f}s"
+                )
+            time.sleep(0.05)
     return ProcReplicaHandle(
         replica_id, proc, ReplicaProcClient(addr),
         int(cfg["engine"]["block_size"]),
@@ -721,9 +727,11 @@ class FleetSupervisor:
             )
             # a hung process holds its journal namespace hostage:
             # SIGKILL promotes it to dead and the failover below owns it
+            get_fault_plan().fire("serve.replica.hung_kill")
             try:
-                h.proc.kill()
-                h.proc.wait(timeout=10)
+                with span("serve.replica.hung_kill", replica=rid):
+                    h.proc.kill()
+                    h.proc.wait(timeout=10)
             except OSError as e:
                 logger.warning(f"SIGKILL replica {rid} failed: {e!r}")
             cls["dead"].append(rid)

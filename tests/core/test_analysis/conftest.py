@@ -1,0 +1,22 @@
+"""Shared fixtures for the analysis suite."""
+
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[3]
+
+
+@pytest.fixture(scope="session")
+def whole_package_lint():
+    """ONE timed full-package lint shared by the clean-tree pin and the
+    wall-budget pin (ISSUE 17) — the two tests assert different
+    properties of the SAME run, and a second copy would double the
+    analysis suite's tier-1 cost."""
+    from scaling_tpu.analysis.lint import lint_paths
+
+    t0 = time.perf_counter()
+    findings = lint_paths([REPO / "scaling_tpu"], root=REPO)
+    wall = time.perf_counter() - t0
+    return findings, wall

@@ -1,0 +1,43 @@
+"""Seeded STA015 violations (ISSUE 17): suppressions that no longer
+suppress anything. A ``# sta: disable=RULE`` on a line where the rule
+does not fire is a stale blanket that would pre-silence the NEXT real
+finding; a class-level ``# sta: lock(attr)`` whose attribute has no
+detected hazard is the same hazard in lock-annotation form. ``Heartbeat``
+seeds the NON-finding: a lock annotation that genuinely eats a
+cross-thread race stays clean (and keeps STA009 quiet). Line numbers
+are asserted by tests/core/test_analysis/test_lint.py; keep edits
+additive at the bottom.
+"""
+
+import threading
+
+PORT = 7401  # sta: disable=STA003 — STA015: STA003 cannot fire here
+
+# explicitly disabling STA015 itself opts a (deliberate) stale line out
+KEEP = 7402  # sta: disable=STA003,STA015
+
+
+class Heartbeat:
+    # ``beat`` is a single float store bumped by the loop thread and the
+    # caller's thread for coarse liveness — deliberately lock-free:
+    # sta: lock(beat)
+
+    def __init__(self):
+        self.beat = 0.0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            self.beat += 1.0
+
+    def bump(self):
+        self.beat += 2.0  # the racing main-thread side the lock(...) eats
+
+
+class StaleAnnotated:
+    # ``ghost`` is only ever written in the constructor — nothing to
+    # suppress, so the annotation below is stale:
+    # sta: lock(ghost)
+
+    def __init__(self):
+        self.ghost = 0

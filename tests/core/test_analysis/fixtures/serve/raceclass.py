@@ -5,11 +5,11 @@ thread with no common lock. Line numbers are asserted by
 tests/core/test_analysis/test_lint.py; keep edits additive at the
 bottom (the class's attribute sides are part of the contract).
 
-The class also seeds the two NON-findings the rule must honor: an
-attribute guarded by the same ``with self._lock:`` on both sides stays
-clean, a field declared deliberately lock-free via ``# sta: lock(...)``
-stays clean, and a second race whose flagged write carries a per-line
-``# sta: disable=STA009`` is reported suppressed.
+The class also seeds the NON-findings the rule must honor: an attribute
+guarded by the same ``with self._lock:`` on both sides stays clean, and
+a race whose flagged write carries ``# sta: disable=STA009`` is
+reported suppressed. The two ``# sta: lock(...)`` annotations eat NO
+hazard (their only peer writes are constructor-side) — seeded STA015s.
 """
 
 import threading

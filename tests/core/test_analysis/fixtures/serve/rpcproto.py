@@ -1,0 +1,60 @@
+"""Seeded STA013 + STA014 violations (ISSUE 17): a client/server RPC
+pair in ONE module whose op sets disagree three ways (unknown op, reply
+key never returned, dead dispatch arm), plus protocol edges (rpc send,
+replica spawn, replica kill) missing their fault/retry guard and span.
+``covered_ping`` seeds the NON-finding: the same send under a FaultPlan
+point and an obs.span stays clean. Line numbers are asserted by
+tests/core/test_analysis/test_lint.py; keep edits additive at the
+bottom.
+"""
+
+import subprocess
+
+
+def span(name, **kw):
+    """Stub span context — the analyzer matches the call shape."""
+    return None
+
+
+class ProtoClient:
+    def __init__(self, transport, faults):
+        self.transport = transport
+        self.faults = faults
+
+    def _post(self, req):
+        return self.transport.request(req)
+
+    def ping(self):
+        reply = self._post({"op": "ping"})  # STA014: unguarded, unspanned
+        return reply["latency"]  # STA013: no handler returns 'latency'
+
+    def status(self):
+        return self._post({"op": "status"})  # STA013 unknown op + STA014
+
+    def covered_ping(self):
+        self.faults.fire("serve.fixture.rpc")
+        with span("serve.fixture.rpc"):
+            reply = self._post({"op": "ping"})  # guarded + spanned: clean
+        return reply.get("pong")
+
+
+class ProtoServer:
+    def handle(self, req):
+        op = req.get("op")
+        if op == "ping":
+            return {"ok": True, "pong": 1}
+        if op == "reset":  # STA013: dead dispatch arm, no client sends it
+            return {"ok": True}
+        return {"ok": False, "error": "unknown-op"}
+
+
+def spawn_fixture_proc(cmd):
+    return subprocess.Popen(cmd)  # STA014: spawn without guard or span
+
+
+def kill_fixture_proc(proc):
+    proc.kill()  # STA014: kill without guard or span
+
+
+def suppressed_kill(proc):
+    proc.kill()  # sta: disable=STA014 (best-effort teardown breadcrumb)

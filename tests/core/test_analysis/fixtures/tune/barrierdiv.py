@@ -1,0 +1,66 @@
+"""Seeded STA012 violation (ISSUE 17): barrier divergence — one exit
+path returns early after a shared side-effect while a sibling path
+parks at the same named barrier, stranding the peer for the full
+timeout. Placed under tune/ (outside STA014's protocol scope) so ONLY
+the divergence rule fires here. Line numbers are asserted by
+tests/core/test_analysis/test_lint.py; keep edits additive at the
+bottom.
+
+``sanctioned`` and ``exempt_helper`` seed every NON-finding shape the
+rule must honor: a raise (loud exits belong to the supervisor), an
+abort-flag drain, a uniform ``num_hosts`` topology branch (every host
+takes the same side, so no peer exists to strand), an early exit that
+registers arrival (releasing peers instead of parking them), and the
+``# sta: barrier-exempt(name)`` annotation.
+"""
+
+
+class _Cp:
+    """Stub control plane — the analyzer keys on the call shapes."""
+
+    num_hosts = 2
+
+    def barrier(self, name):
+        return True
+
+    def arrive(self, name):
+        return None
+
+    def set_flag(self, name, value="1"):
+        return None
+
+
+class Committer:
+    def __init__(self, cp: _Cp):
+        self.cp = cp
+        self.abort_requested = False
+
+    def commit(self, fast_path):
+        self.cp.set_flag("commit-intent")  # shared side-effect
+        if fast_path:
+            return None  # STA012: skips the barrier peers wait on
+        self.cp.barrier("commit")
+        return True
+
+    def sanctioned(self, bad, preempted):
+        self.cp.set_flag("commit-intent")
+        if bad:
+            raise RuntimeError("loud exit: the supervisor owns crashes")
+        if self.abort_requested:
+            return None  # abort-flag drain: sanctioned
+        if self.cp.num_hosts <= 1:
+            return None  # uniform topology branch: no peers to strand
+        if preempted:
+            self.cp.arrive("commit")
+            return None  # arrival releases peers: sanctioned
+        self.cp.barrier("commit")
+        return True
+
+    def exempt_helper(self, skip):
+        # sta: barrier-exempt(commit) — single-host test helper; peers
+        # never co-enter this path
+        self.cp.set_flag("commit-intent")
+        if skip:
+            return None
+        self.cp.barrier("commit")
+        return True

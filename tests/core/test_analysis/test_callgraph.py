@@ -192,3 +192,162 @@ def test_module_alias_attribute_resolves(tmp_path):
     call = [n for n in __import__("ast").walk(fn.node)
             if n.__class__.__name__ == "Call"][0]
     assert g.resolve_name(fn, call.func) == "jax.block_until_ready"
+
+
+# ------------------------------------------------- ISSUE 17 edge cases
+def test_decorated_functions_keep_their_edges(tmp_path):
+    """A decorator changes the runtime object, not the static node: the
+    decorated function stays a graph node, calls to it resolve, and its
+    own calls are its edges."""
+    g = build(tmp_path, {"pkg/mod.py": (
+        "import functools\n"
+        "\n"
+        "def logged(fn):\n"
+        "    @functools.wraps(fn)\n"
+        "    def inner(*a, **k):\n"
+        "        return fn(*a, **k)\n"
+        "    return inner\n"
+        "\n"
+        "@logged\n"
+        "def helper():\n"
+        "    return leaf()\n"
+        "\n"
+        "def leaf():\n"
+        "    return 1\n"
+        "\n"
+        "def top():\n"
+        "    return helper()\n"
+    )})
+    assert "pkg.mod:helper" in edges_of(g, "pkg.mod:top")
+    assert edges_of(g, "pkg.mod:helper") == ["pkg.mod:leaf"]
+
+
+def test_functools_partial_thread_target_resolves(tmp_path):
+    """``Thread(target=functools.partial(self._run, 3))`` — the standard
+    way to hand a thread entry bound arguments — must resolve to the
+    wrapped method, for methods AND module functions."""
+    g = build(tmp_path, {"pkg/mod.py": (
+        "import functools\n"
+        "import threading\n"
+        "\n"
+        "def pump(n):\n"
+        "    pass\n"
+        "\n"
+        "class Loop:\n"
+        "    def start(self):\n"
+        "        t1 = threading.Thread(target=functools.partial(self._run, 3))\n"
+        "        t2 = threading.Thread(target=functools.partial(pump, 7))\n"
+        "        return t1, t2\n"
+        "    def _run(self, n):\n"
+        "        pass\n"
+    )})
+    spawns = {s.target.dotted for s in g.thread_spawns if s.target}
+    assert spawns == {"Loop._run", "pump"}
+
+
+def test_lambda_in_comprehension_contributes_edges(tmp_path):
+    """``own_nodes`` descends lambdas (they run in the enclosing frame),
+    including lambdas built inside comprehensions — the callback-table
+    idiom must not hide the calls the lambdas make."""
+    g = build(tmp_path, {"pkg/mod.py": (
+        "class Loop:\n"
+        "    def beat(self):\n"
+        "        pass\n"
+        "    def arm(self):\n"
+        "        cbs = [lambda: self.beat() for _ in range(3)]\n"
+        "        return cbs\n"
+    )})
+    assert "pkg.mod:Loop.beat" in edges_of(g, "pkg.mod:Loop.arm")
+
+
+def test_self_stored_callback_resolves(tmp_path):
+    """``self._cb = self._on_done`` then ``self._cb()`` routes to the
+    stored method (the supervisor's restart-hook idiom)."""
+    g = build(tmp_path, {"pkg/mod.py": (
+        "class Sup:\n"
+        "    def __init__(self):\n"
+        "        self._cb = self._on_done\n"
+        "    def fire(self):\n"
+        "        return self._cb()\n"
+        "    def _on_done(self):\n"
+        "        pass\n"
+    )})
+    assert edges_of(g, "pkg.mod:Sup.fire") == ["pkg.mod:Sup._on_done"]
+
+
+def test_annotated_param_attr_typing(tmp_path):
+    """Constructor injection: ``def __init__(self, cp: ControlPlane):
+    self.cp = cp`` types the attribute from the parameter annotation —
+    plain, string ('ControlPlane'), and Optional[...] spellings."""
+    g = build(tmp_path, {
+        "pkg/cp.py": (
+            "class ControlPlane:\n"
+            "    def barrier(self, name):\n"
+            "        pass\n"
+        ),
+        "pkg/use.py": (
+            "from typing import Optional\n"
+            "from .cp import ControlPlane\n"
+            "\n"
+            "class A:\n"
+            "    def __init__(self, cp: ControlPlane):\n"
+            "        self.cp = cp\n"
+            "    def go(self):\n"
+            "        self.cp.barrier('x')\n"
+            "\n"
+            "class B:\n"
+            "    def __init__(self, cp: 'ControlPlane'):\n"
+            "        self.cp = cp\n"
+            "    def go(self):\n"
+            "        self.cp.barrier('x')\n"
+            "\n"
+            "class C:\n"
+            "    def __init__(self, cp: Optional[ControlPlane]):\n"
+            "        self.cp = cp\n"
+            "    def go(self):\n"
+            "        self.cp.barrier('x')\n"
+        ),
+    })
+    for klass in ("A", "B", "C"):
+        assert edges_of(g, f"pkg.use:{klass}.go") == \
+            ["pkg.cp:ControlPlane.barrier"], klass
+
+
+def test_override_edges_stay_out_of_static_edges(tmp_path):
+    """Virtual dispatch is OPT-IN: a call on an abstract surface reaches
+    the overrides only through ``descendants(..., virtual=True)`` — the
+    concurrency rules' exact static edges never grow them."""
+    g = build(tmp_path, {"pkg/mod.py": (
+        "class Base:\n"
+        "    def put(self, k):\n"
+        "        ...\n"
+        "\n"
+        "class Mem(Base):\n"
+        "    def put(self, k):\n"
+        "        return self._store(k)\n"
+        "    def _store(self, k):\n"
+        "        pass\n"
+        "\n"
+        "class Disk(Base):\n"
+        "    def put(self, k):\n"
+        "        pass\n"
+        "\n"
+        "def client(b: Base):\n"
+        "    pass\n"
+        "\n"
+        "class Holder:\n"
+        "    def __init__(self, b: Base):\n"
+        "        self.b = b\n"
+        "    def go(self):\n"
+        "        self.b.put('k')\n"
+    )})
+    assert g.override_edges["pkg.mod:Base.put"] == {
+        "pkg.mod:Mem.put", "pkg.mod:Disk.put",
+    }
+    # the static call edge lands on the abstract surface only
+    assert edges_of(g, "pkg.mod:Holder.go") == ["pkg.mod:Base.put"]
+    static = g.descendants({"pkg.mod:Holder.go"})
+    assert "pkg.mod:Mem.put" not in static
+    virtual = g.descendants({"pkg.mod:Holder.go"}, virtual=True)
+    assert {"pkg.mod:Mem.put", "pkg.mod:Disk.put",
+            "pkg.mod:Mem._store"} <= virtual

@@ -32,20 +32,22 @@ def run_cli(*args, timeout=600):
 
 def test_lint_gate_clean_tree_exits_zero(tmp_path):
     """The clean tree is the enforced baseline — INCLUDING the
-    whole-program rules (ISSUE 15): the JSON report carries its schema
-    version and a stable per-rule summary the gate diffs structurally,
-    with STA009-STA011 present and pinned at zero unsuppressed."""
+    whole-program rules (ISSUE 15) and the protocol rules (ISSUE 17):
+    the JSON report carries its schema version and a stable per-rule
+    summary the gate diffs structurally, with STA009-STA015 present and
+    pinned at zero unsuppressed."""
     out = tmp_path / "lint.json"
     p = run_cli("lint", "--json", str(out), timeout=300)
     assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
     assert "lint: 0 finding(s)" in p.stdout
     payload = json.loads(out.read_text())
-    assert payload["schema_version"] == 2
+    assert payload["schema_version"] == 3
     summary = payload["lint"]["rules"]
     ids = [r["rule"] for r in summary]
     # stable ordering: sorted rule ids, every known rule exactly once
     assert ids == sorted(ids) and len(ids) == len(set(ids))
-    assert {"STA009", "STA010", "STA011"} <= set(ids)
+    assert {"STA009", "STA010", "STA011", "STA012", "STA013", "STA014",
+            "STA015"} <= set(ids)
     for rec in summary:
         assert rec["unsuppressed"] == 0, rec
         assert rec["severity"] in ("error", "warning")
@@ -57,19 +59,69 @@ def test_lint_gate_seeded_violations_exit_nonzero(tmp_path):
                 timeout=120)
     assert p.returncode != 0
     payload = json.loads(out.read_text())
-    assert payload["schema_version"] == 2
+    assert payload["schema_version"] == 3
     rules = {f["rule"] for f in payload["lint"]["findings"]}
     assert {"STA001", "STA002", "STA003", "STA004", "STA005", "STA006",
-            "STA007", "STA008", "STA009", "STA010", "STA011"} <= rules
+            "STA007", "STA008", "STA009", "STA010", "STA011", "STA012",
+            "STA013", "STA014", "STA015"} <= rules
     assert payload["lint"]["unsuppressed"] > 0
     assert payload["exit_code"] != 0
     # the per-rule summary counts agree with the findings list
     by_rule = {r["rule"]: r for r in payload["lint"]["rules"]}
-    for rule in ("STA009", "STA010", "STA011"):
+    for rule in ("STA009", "STA010", "STA011", "STA012", "STA013",
+                 "STA014", "STA015"):
         assert by_rule[rule]["findings"] == sum(
             1 for f in payload["lint"]["findings"] if f["rule"] == rule
         )
         assert by_rule[rule]["unsuppressed"] >= 1
+
+
+def test_protocol_gate_matches_golden(tmp_path):
+    """ISSUE 17: the clean tree reproduces the committed protocol
+    inventory — barrier name templates with their participants, and the
+    per-module RPC op tables. The serving fleet's submit/poll/drain/
+    stats/shutdown ops and the control plane's barrier/heartbeat ops
+    must all be present with their reply keys."""
+    out = tmp_path / "protocol.json"
+    p = run_cli("protocol", "--json", str(out), timeout=300)
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-2000:]
+    payload = json.loads(out.read_text())
+    assert payload["schema_version"] == 3
+    assert payload["protocol"]["drift"] == []
+    inv = payload["protocol"]["inventory"]
+    assert "step-{}" in inv["barriers"]
+    assert inv["barriers"]["step-{}"]["waits"]  # trainer check-in waits
+    assert inv["barriers"]["step-{}"]["arrives"]  # preempt broadcast arrives
+    replica_ops = inv["rpc"]["scaling_tpu.serve.replica_proc"]["ops"]
+    assert {"submit", "poll", "drain", "stats", "shutdown"} <= set(replica_ops)
+    assert "stats" in replica_ops["stats"]["reply_keys"]
+    cp_ops = inv["rpc"]["scaling_tpu.resilience.controlplane"]["ops"]
+    assert {"arrive", "hb", "set_flag", "get_flag", "count",
+            "peers", "prune"} <= set(cp_ops)
+    # every op in the table has a handler on the server side — STA013
+    # pins this too, but the golden makes the drift diff structural
+    for op, rec in replica_ops.items():
+        assert rec["handler"], op
+        assert rec["clients"], op
+
+
+def test_protocol_gate_detects_seeded_drift(tmp_path):
+    """A doctored protocol golden (a handler deleted from the table, a
+    barrier renamed) must make the same invocation exit non-zero — a
+    removed dispatch arm or a skipped barrier fails CI structurally,
+    not just at runtime under fault drills."""
+    from scaling_tpu.analysis.protocol import golden_path
+
+    gdir = tmp_path / "goldens"
+    gdir.mkdir()
+    golden = json.loads(golden_path().read_text())
+    del golden["rpc"]["scaling_tpu.serve.replica_proc"]["ops"]["drain"]
+    golden["barriers"]["renamed-{}"] = golden["barriers"].pop("step-{}")
+    (gdir / "protocol.json").write_text(json.dumps(golden))
+    p = run_cli("protocol", "--goldens", str(gdir))
+    assert p.returncode != 0
+    assert "DRIFT" in p.stdout
+    assert "drain" in p.stdout and "renamed-{}" in p.stdout
 
 
 def test_audit_gate_matches_golden(tmp_path):

@@ -14,11 +14,13 @@ FIXTURES = Path(__file__).resolve().parent / "fixtures"
 
 # (rule, line) pairs seeded in fixtures/nn/violations.py,
 # fixtures/{trainer,runner,obs,serve,tune}/swallowed.py,
-# fixtures/serve/raceclass.py (STA009), fixtures/serve/hotsync.py
-# (STA010) and fixtures/runner/rawio.py (STA011) — line numbers are
-# part of the fixtures' contract (edits there stay additive at the
-# bottom; each fixture's lines deliberately avoid the others' so every
-# (rule, line) pair stays unique)
+# fixtures/serve/raceclass.py (STA009 + stale lock annotations),
+# fixtures/serve/hotsync.py (STA010), fixtures/runner/rawio.py
+# (STA011), fixtures/tune/barrierdiv.py (STA012), fixtures/serve/
+# rpcproto.py (STA013/STA014) and fixtures/obs/stale.py (STA015) —
+# line numbers are part of the fixtures' contract (edits there stay
+# additive at the bottom; each fixture's lines deliberately avoid the
+# others' so every (rule, line) pair stays unique)
 EXPECTED = [
     ("STA001", 17),   # if jnp.any(...)
     ("STA002", 24),   # np.tanh on traced
@@ -47,6 +49,19 @@ EXPECTED = [
     ("STA010", 42),   # hotsync: device_get under FleetRouter.submit (PR 16)
     ("STA011", 19),   # rawio: raw write_text outside every guard
     ("STA011", 46),   # rawio: raw replica-RPC dial outside retry_io (PR 16)
+    ("STA012", 41),   # barrierdiv: early return skips the commit barrier
+    ("STA013", 29),   # rpcproto: reply key 'latency' never returned
+    ("STA013", 32),   # rpcproto: op 'status' has no handler
+    ("STA013", 46),   # rpcproto: dead dispatch arm for op 'reset'
+    ("STA014", 28),   # rpcproto: unguarded/unspanned ping send
+    ("STA014", 30),   # runner swallowed: proc.terminate() kill edge bare
+    ("STA014", 32),   # rpcproto: unguarded/unspanned status send
+    ("STA014", 52),   # rpcproto: bare subprocess.Popen spawn
+    ("STA014", 56),   # rpcproto: bare proc.kill()
+    ("STA015", 14),   # stale: disable=STA003 where STA003 cannot fire
+    ("STA015", 24),   # raceclass: lock(tick_count) eats nothing (ctor-only peer)
+    ("STA015", 40),   # stale: lock(ghost) with no hazard on ghost
+    ("STA015", 61),   # raceclass: lock(loop_wall) eats nothing (ctor-only peer)
 ]
 SUPPRESSED = [
     ("STA003", 60),  # sta: disable=STA003
@@ -58,6 +73,7 @@ SUPPRESSED = [
     ("STA009", 51),  # raceclass: latching drain flag, sta: disable=STA009
     ("STA010", 30),  # hotsync: deliberate token landing, sta: disable=STA010
     ("STA011", 24),  # rawio: best-effort pid breadcrumb, sta: disable=STA011
+    ("STA014", 60),  # rpcproto: teardown breadcrumb kill, sta: disable=STA014
 ]
 
 
@@ -91,10 +107,10 @@ def test_suppression_comment_downgrades(fixture_findings, rule, line):
     assert len(hits) == 1 and hits[0].suppressed
 
 
-def test_clean_tree_has_zero_unsuppressed_findings():
+def test_clean_tree_has_zero_unsuppressed_findings(whole_package_lint):
     """Today's clean state is the enforced baseline: the whole package
     lints clean (suppressions are visible and deliberate)."""
-    findings = lint_paths([REPO / "scaling_tpu"], root=REPO)
+    findings, _wall = whole_package_lint
     active = [f for f in findings if not f.suppressed]
     assert not active, "\n".join(str(f) for f in active)
 
@@ -148,7 +164,8 @@ def test_rule_table_is_stable():
     golden reports reference them)."""
     assert set(RULES) == {
         "STA001", "STA002", "STA003", "STA004", "STA005", "STA006", "STA007",
-        "STA008", "STA009", "STA010", "STA011",
+        "STA008", "STA009", "STA010", "STA011", "STA012", "STA013", "STA014",
+        "STA015",
     }
     for rule, (severity, _) in RULES.items():
         assert severity in ("error", "warning"), rule

@@ -1,0 +1,478 @@
+"""Protocol rule units (ISSUE 17): STA012 barrier-divergence, STA013
+RPC-contract, STA014 protocol-edge coverage, STA015 stale suppressions,
+and the goldens-pinned protocol inventory — each modeling decision
+(sanctioned exits, uniform topology branches, transitive guard/span
+coverage, the reply-key envelope) pinned over small synthetic trees."""
+
+import json
+from pathlib import Path
+
+from scaling_tpu.analysis.callgraph import CallGraph
+from scaling_tpu.analysis.lint import lint_paths
+from scaling_tpu.analysis.protocol import (
+    ProtocolModel,
+    build_inventory,
+    compare_inventory,
+    write_inventory,
+)
+
+REPO = Path(__file__).resolve().parents[3]
+
+
+def run(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return lint_paths([tmp_path], root=tmp_path)
+
+
+def active(findings, rule=None):
+    return [f for f in findings
+            if not f.suppressed and (rule is None or f.rule == rule)]
+
+
+# ================================================================ STA012
+BARRIER = (
+    "class Cp:\n"
+    "    num_hosts = 2\n"
+    "    def barrier(self, name): ...\n"
+    "    def arrive(self, name): ...\n"
+    "    def set_flag(self, name): ...\n"
+    "\n"
+    "class Worker:\n"
+    "    def __init__(self, cp: Cp):\n"
+    "        self.cp = cp\n"
+    "        self.abort_flag = False\n"
+    "    def step(self, cond):\n"
+    "        self.cp.set_flag('intent')\n"
+    "{body}"
+    "        self.cp.barrier('commit')\n"
+    "        return True\n"
+)
+
+
+def test_sta012_early_return_after_effect_fires(tmp_path):
+    f = active(run(tmp_path, {"m.py": BARRIER.format(
+        body="        if cond:\n            return None\n"
+    )}), "STA012")
+    assert len(f) == 1 and "'commit'" in f[0].message
+    assert f[0].line == 14  # the skipping return
+
+
+def test_sta012_raise_exit_is_sanctioned(tmp_path):
+    # loud exits (raise, sys.exit) belong to the supervisor, not STA012
+    assert active(run(tmp_path, {"m.py": BARRIER.format(
+        body="        if cond:\n            raise RuntimeError('die')\n"
+    )}), "STA012") == []
+    assert active(run(tmp_path, {"m.py": "import sys\n" + BARRIER.format(
+        body="        if cond:\n            sys.exit(3)\n"
+    )}), "STA012") == []
+
+
+def test_sta012_abort_flag_drain_is_sanctioned(tmp_path):
+    f = active(run(tmp_path, {"m.py": BARRIER.format(
+        body="        if self.abort_flag:\n            return None\n"
+    )}), "STA012")
+    assert f == []
+
+
+def test_sta012_arrival_on_exit_is_sanctioned(tmp_path):
+    # registering arrival RELEASES peers instead of parking them
+    f = active(run(tmp_path, {"m.py": BARRIER.format(
+        body="        if cond:\n"
+             "            self.cp.arrive('commit')\n"
+             "            return None\n"
+    )}), "STA012")
+    assert f == []
+
+
+def test_sta012_uniform_topology_branch_is_sanctioned(tmp_path):
+    # num_hosts is the same number on every host: each host takes the
+    # SAME side of the branch, so the skipping side has no peers
+    f = active(run(tmp_path, {"m.py": BARRIER.format(
+        body="        if self.cp.num_hosts <= 1:\n            return None\n"
+    )}), "STA012")
+    assert f == []
+
+
+def test_sta012_no_shared_effect_before_divergence_is_clean(tmp_path):
+    # diverging BEFORE any shared side-effect strands nothing: the peer
+    # has observed no state implying this host is en route
+    src = (
+        "class Cp:\n"
+        "    def barrier(self, name): ...\n"
+        "    def set_flag(self, name): ...\n"
+        "\n"
+        "class Worker:\n"
+        "    def __init__(self, cp: Cp):\n"
+        "        self.cp = cp\n"
+        "    def step(self, cond):\n"
+        "        if cond:\n"
+        "            return None\n"
+        "        self.cp.set_flag('intent')\n"
+        "        self.cp.barrier('commit')\n"
+        "        return True\n"
+    )
+    assert active(run(tmp_path, {"m.py": src}), "STA012") == []
+
+
+def test_sta012_barrier_exempt_annotation(tmp_path):
+    f = active(run(tmp_path, {"m.py": BARRIER.format(
+        body="        # sta: barrier-exempt(commit) — test-only helper\n"
+             "        if cond:\n            return None\n"
+    )}), "STA012")
+    assert f == []
+
+
+def test_sta012_effect_via_callee_counts(tmp_path):
+    # the shared side-effect closure propagates: a helper doing raw I/O
+    # in the common prefix makes the early return hazardous
+    src = (
+        "class Cp:\n"
+        "    def barrier(self, name): ...\n"
+        "\n"
+        "class Worker:\n"
+        "    def __init__(self, cp: Cp):\n"
+        "        self.cp = cp\n"
+        "    def journal(self, path):\n"
+        "        path.write_text('mark')\n"
+        "    def step(self, cond, path):\n"
+        "        self.journal(path)\n"
+        "        if cond:\n"
+        "            return None\n"
+        "        self.cp.barrier('commit')\n"
+        "        return True\n"
+    )
+    f = active(run(tmp_path, {"m.py": src}), "STA012")
+    assert len(f) == 1 and f[0].line == 12
+
+
+# ================================================================ STA013
+RPC = (
+    "class Client:\n"
+    "    def __init__(self, t):\n"
+    "        self.t = t\n"
+    "    def call(self):\n"
+    "{client_body}"
+    "\n"
+    "class Server:\n"
+    "    def handle(self, req):\n"
+    "        op = req.get('op')\n"
+    "        if op == 'ping':\n"
+    "            return {{'ok': True, 'pong': 1}}\n"
+    "{extra_arm}"
+    "        return {{'ok': False, 'error': 'unknown-op'}}\n"
+)
+
+
+def _rpc(client_body, extra_arm=""):
+    return RPC.format(client_body=client_body, extra_arm=extra_arm)
+
+
+def test_sta013_unknown_op_fires(tmp_path):
+    f = active(run(tmp_path, {"m.py": _rpc(
+        "        return self.t.request({'op': 'nope'})\n"
+    )}), "STA013")
+    assert len(f) == 2  # unknown op at the send + the now-dead ping arm
+    assert any("'nope'" in x.message and "no handler" in x.message for x in f)
+
+
+def test_sta013_reply_key_never_returned_fires(tmp_path):
+    f = active(run(tmp_path, {"m.py": _rpc(
+        "        r = self.t.request({'op': 'ping'})\n"
+        "        return r['zap']\n"
+    )}), "STA013")
+    assert len(f) == 1 and "'zap'" in f[0].message
+    assert f[0].line == 6  # the read, not the send
+
+
+def test_sta013_returned_and_envelope_keys_are_clean(tmp_path):
+    f = active(run(tmp_path, {"m.py": _rpc(
+        "        r = self.t.request({'op': 'ping'})\n"
+        "        if not r.get('ok'):\n"  # envelope key: always legal
+        "            return r.get('error')\n"
+        "        return r['pong']\n"     # declared reply key
+    )}), "STA013")
+    assert f == []
+
+
+def test_sta013_dead_dispatch_arm_fires(tmp_path):
+    f = active(run(tmp_path, {"m.py": _rpc(
+        "        return self.t.request({'op': 'ping'})\n",
+        extra_arm="        if op == 'reset':\n"
+                  "            return {'ok': True}\n",
+    )}), "STA013")
+    assert len(f) == 1 and "'reset'" in f[0].message and "never" in f[0].message
+
+
+def test_sta013_dynamic_op_and_client_only_module_are_clean(tmp_path):
+    # a computed op name is not checkable; a module with no co-located
+    # dispatch table (client half of a cross-module pair) is skipped
+    f = active(run(tmp_path, {"m.py": _rpc(
+        "        return self.t.request({'op': self.opname()})\n"
+    )}), "STA013")
+    assert [x for x in f if "no handler" in x.message] == []
+    client_only = (
+        "class Client:\n"
+        "    def __init__(self, t):\n"
+        "        self.t = t\n"
+        "    def call(self):\n"
+        "        return self.t.request({'op': 'anything'})\n"
+    )
+    assert active(run(tmp_path / "co", {"m.py": client_only}), "STA013") == []
+
+
+# ================================================================ STA014
+COVERAGE = (
+    "def span(name, **kw): ...\n"
+    "def retry_io(fn, **kw): ...\n"
+    "\n"
+    "class C:\n"
+    "    def __init__(self, t, faults):\n"
+    "        self.t = t\n"
+    "        self.faults = faults\n"
+    "{methods}"
+)
+
+
+def test_sta014_bare_send_fires_with_both_gaps(tmp_path):
+    f = active(run(tmp_path, {"serve/m.py": COVERAGE.format(
+        methods="    def bare(self):\n"
+                "        return self.t.request({'op': 'x'})\n"
+    )}), "STA014")
+    assert len(f) == 1
+    assert "FaultPlan" in f[0].message and "obs.span" in f[0].message
+
+
+def test_sta014_same_code_outside_scope_is_clean(tmp_path):
+    f = active(run(tmp_path, {"lib/m.py": COVERAGE.format(
+        methods="    def bare(self):\n"
+                "        return self.t.request({'op': 'x'})\n"
+    )}), "STA014")
+    assert f == []
+
+
+def test_sta014_guarded_but_unspanned_reports_span_only(tmp_path):
+    f = active(run(tmp_path, {"serve/m.py": COVERAGE.format(
+        methods="    def guarded(self):\n"
+                "        self.faults.fire('serve.drill')\n"
+                "        return self.t.request({'op': 'x'})\n"
+    )}), "STA014")
+    assert len(f) == 1
+    assert "obs.span" in f[0].message and "FaultPlan" not in f[0].message
+
+
+def test_sta014_fault_point_plus_span_is_clean(tmp_path):
+    f = active(run(tmp_path, {"serve/m.py": COVERAGE.format(
+        methods="    def covered(self):\n"
+                "        self.faults.fire('serve.drill')\n"
+                "        with span('serve.rpc'):\n"
+                "            return self.t.request({'op': 'x'})\n"
+    )}), "STA014")
+    assert f == []
+
+
+def test_sta014_retry_io_establishes_the_guard(tmp_path):
+    f = active(run(tmp_path, {"serve/m.py": COVERAGE.format(
+        methods="    def covered(self):\n"
+                "        with span('serve.rpc'):\n"
+                "            return retry_io(\n"
+                "                lambda: self.t.request({'op': 'x'}))\n"
+    )}), "STA014")
+    assert f == []
+
+
+def test_sta014_coverage_flows_through_call_sites(tmp_path):
+    # the send lives in a helper; the CALLER fires the fault point and
+    # opens the span around the helper call — transitively covered
+    f = active(run(tmp_path, {"serve/m.py": COVERAGE.format(
+        methods="    def outer(self):\n"
+                "        self.faults.fire('serve.drill')\n"
+                "        with span('serve.rpc'):\n"
+                "            return self.inner()\n"
+                "    def inner(self):\n"
+                "        return self.t.request({'op': 'x'})\n"
+    )}), "STA014")
+    assert f == []
+
+
+def test_sta014_spawn_and_kill_sites_fire(tmp_path):
+    src = (
+        "import subprocess\n"
+        "def boot(cmd):\n"
+        "    return subprocess.Popen(cmd)\n"
+        "def reap(proc):\n"
+        "    proc.kill()\n"
+    )
+    f = active(run(tmp_path, {"runner/m.py": src}), "STA014")
+    assert len(f) == 2
+    assert {x.line for x in f} == {3, 5}
+    assert any("spawn" in x.message for x in f)
+    assert any("kill" in x.message for x in f)
+
+
+# ================================================================ STA015
+def test_sta015_stale_disable_fires(tmp_path):
+    f = active(run(tmp_path, {"m.py": "x = 1  # sta: disable=STA003\n"}),
+               "STA015")
+    assert len(f) == 1 and f[0].line == 1 and "STA003" in f[0].message
+
+
+def test_sta015_live_disable_is_clean(tmp_path):
+    findings = run(tmp_path, {"m.py": (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)  # sta: disable=STA003\n"
+    )})
+    assert active(findings, "STA015") == []
+    assert [f.rule for f in findings if f.suppressed] == ["STA003"]
+
+
+def test_sta015_explicit_optout_and_docstring_mention(tmp_path):
+    # listing STA015 itself marks the staleness deliberate; a disable
+    # QUOTED in a docstring is prose, not a suppression
+    assert active(run(tmp_path, {
+        "m.py": "x = 1  # sta: disable=STA003,STA015\n"
+    }), "STA015") == []
+    assert active(run(tmp_path, {
+        "m.py": '"""docs quoting # sta: disable=STA003 in prose"""\n'
+    }), "STA015") == []
+
+
+def test_sta015_stale_lock_annotation_fires(tmp_path):
+    src = (
+        "class C:\n"
+        "    # sta: lock(ghost)\n"
+        "    def __init__(self):\n"
+        "        self.ghost = 0\n"
+    )
+    f = active(run(tmp_path, {"m.py": src}), "STA015")
+    assert len(f) == 1 and f[0].line == 2 and "ghost" in f[0].message
+
+
+def test_sta015_lock_annotation_eating_a_race_is_live(tmp_path):
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    # sta: lock(beat)\n"
+        "    def __init__(self):\n"
+        "        self.beat = 0\n"
+        "        threading.Thread(target=self._loop).start()\n"
+        "    def _loop(self):\n"
+        "        self.beat += 1\n"
+        "    def bump(self):\n"
+        "        self.beat += 2\n"
+    )
+    findings = run(tmp_path, {"m.py": src})
+    assert active(findings, "STA015") == []
+    assert active(findings, "STA009") == []  # the annotation ate it
+
+
+# ============================================================= inventory
+PROTO_TREE = {
+    "serve/rpc.py": (
+        "class Client:\n"
+        "    def __init__(self, t):\n"
+        "        self.t = t\n"
+        "    def call(self):\n"
+        "        r = self.t.request({'op': 'ping'})\n"
+        "        return r['pong']\n"
+        "\n"
+        "class Server:\n"
+        "    def handle(self, req):\n"
+        "        op = req.get('op')\n"
+        "        if op == 'ping':\n"
+        "            return {'ok': True, 'pong': 1}\n"
+        "        return {'ok': False, 'error': 'unknown-op'}\n"
+    ),
+    "trainer/loop.py": (
+        "class Loop:\n"
+        "    def __init__(self, cp):\n"
+        "        self.cp = cp\n"
+        "    def checkin(self, step):\n"
+        "        self.cp.barrier(f'step-{step}')\n"
+        "    def broadcast(self, step):\n"
+        "        self.cp.arrive(f'step-{step}')\n"
+    ),
+}
+
+
+def _graph(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return CallGraph.build([tmp_path], root=tmp_path)
+
+
+def test_inventory_structure(tmp_path):
+    inv = build_inventory(_graph(tmp_path, PROTO_TREE))
+    assert inv["schema_version"] == 1
+    # the f-string name collapses to a template
+    assert set(inv["barriers"]) == {"step-{}"}
+    rec = inv["barriers"]["step-{}"]
+    assert rec["waits"] == ["trainer.loop.Loop.checkin"]
+    assert rec["arrives"] == ["trainer.loop.Loop.broadcast"]
+    ops = inv["rpc"]["serve.rpc"]["ops"]
+    assert set(ops) == {"ping"}
+    assert ops["ping"]["clients"] == ["serve.rpc.Client.call"]
+    assert ops["ping"]["handler"] == ["serve.rpc.Server.handle"]
+    assert "pong" in ops["ping"]["reply_keys"]
+    assert "pong" in ops["ping"]["reads"]
+
+
+def test_inventory_roundtrip_and_drift(tmp_path):
+    inv = build_inventory(_graph(tmp_path / "tree", PROTO_TREE))
+    gdir = tmp_path / "goldens"
+    gdir.mkdir()
+    path = write_inventory(inv, gdir)
+    assert json.loads(Path(path).read_text()) == inv
+    assert compare_inventory(inv, gdir) == []
+    # structural drift: a dropped op, a renamed barrier
+    mutated = json.loads(json.dumps(inv))
+    del mutated["rpc"]["serve.rpc"]["ops"]["ping"]
+    mutated["barriers"]["epoch-{}"] = mutated["barriers"].pop("step-{}")
+    drift = compare_inventory(mutated, gdir)
+    assert any("ping" in d for d in drift)
+    assert any("epoch-{}" in d for d in drift)
+    assert any("step-{}" in d for d in drift)
+
+
+def test_inventory_missing_golden_advises_repin(tmp_path):
+    inv = build_inventory(_graph(tmp_path / "tree", PROTO_TREE))
+    drift = compare_inventory(inv, tmp_path / "nowhere")
+    assert len(drift) == 1 and "--repin" in drift[0]
+
+
+# ===================================================== perf / pipeline
+def test_lint_reuses_a_prebuilt_graph(tmp_path, monkeypatch):
+    # the CLI builds ONE CallGraph per run and threads it through every
+    # whole-program consumer; a provided graph must never be rebuilt
+    files = {"serve/m.py": "def f():\n    return 1\n"}
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    graph = CallGraph.build([tmp_path], root=tmp_path)
+    monkeypatch.setattr(
+        CallGraph, "build",
+        classmethod(lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("graph rebuilt"))),
+    )
+    findings = lint_paths([tmp_path], root=tmp_path, graph=graph)
+    assert findings == []
+    model = ProtocolModel(graph)
+    assert build_inventory(graph, model)["schema_version"] == 1
+
+
+def test_whole_package_analysis_wall_budget(whole_package_lint):
+    """Satellite guard: one full lint (per-file rules + call graph +
+    STA009-STA015) over the package stays inside a CI-friendly budget.
+    The clean run measures ~7 s on a warm 2-core host (alias resolution
+    is memoized per function); 90 s is the alarm threshold for an
+    accidentally quadratic closure."""
+    findings, wall = whole_package_lint
+    assert [f for f in findings if not f.suppressed] == []
+    assert wall < 90.0, f"analysis took {wall:.1f}s"

@@ -332,3 +332,24 @@ def test_straggler_table_classification():
     rendered = report.render()
     assert "never-heartbeat" in rendered and "dead" in rendered
     assert rendered.splitlines()[0].split() == ["host", "step", "hb_age_s", "state"]
+
+
+def test_set_flag_emits_span():
+    """ISSUE 17 (STA014 sweep): the broadcast-flag write — a rare,
+    high-signal control event (abort, preempt) — runs inside the
+    ``cp.set_flag`` span so fleet incident timelines show who raised
+    which flag when."""
+    from scaling_tpu.obs.registry import get_registry
+
+    key = "span_seconds{span=cp.set_flag}"
+    srv = TcpControlPlaneServer()
+    try:
+        cp = TcpControlPlane(srv.address, 0, 1)
+        before = get_registry().snapshot()["histograms"].get(key, {}).get(
+            "count", 0)
+        cp.set_flag("drain")
+        after = get_registry().snapshot()["histograms"][key]["count"]
+        assert after == before + 1
+        assert cp.get_flag("drain") == "1"
+    finally:
+        srv.close()

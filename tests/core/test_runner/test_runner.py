@@ -330,3 +330,23 @@ def test_distributed_train_step_across_processes(tmp_path: Path, devices, pp):
     # shards — pipe-sharded ones included at pp=2) reproduced the trained
     # params bit-exactly on both processes
     assert all(rec["orbax_roundtrip"] for rec in records)
+
+
+def test_spawn_worker_fault_point_fires():
+    """ISSUE 17 (STA014 sweep): worker spawn is a drillable protocol
+    edge — ``runner.worker.spawn=fail@1`` injects before any process
+    starts, so launch-failure handling is testable without a dead
+    host."""
+    from scaling_tpu.resilience.faults import (
+        FaultPlan,
+        InjectedFault,
+        set_fault_plan,
+    )
+    from scaling_tpu.runner.runner import spawn_worker
+
+    set_fault_plan(FaultPlan("runner.worker.spawn=fail@1"))
+    try:
+        with pytest.raises(InjectedFault):
+            spawn_worker(RunnerConfig(), "localhost", {}, "cGF5bG9hZA==")
+    finally:
+        set_fault_plan(FaultPlan(""))

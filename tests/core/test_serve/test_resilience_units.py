@@ -289,3 +289,27 @@ def test_journal_lines_are_single_json_objects(tmp_path):
     lines = (tmp_path / "j.jsonl").read_text().splitlines()
     kinds = [json.loads(l)["kind"] for l in lines]
     assert kinds == ["serve-submit", "serve-tokens", "serve-finish"]
+
+
+def test_replica_rpc_client_span_and_liveness_stamp():
+    """ISSUE 17 (STA014 sweep): every handle->replica RPC runs inside
+    the ``serve.replica.rpc_client`` span, and a successful round-trip
+    refreshes ``last_ok_wall`` — the supervisor's hung-replica
+    signal."""
+    from scaling_tpu.obs.registry import get_registry
+    from scaling_tpu.serve.replica_proc import ProcReplicaHandle
+
+    class _Client:
+        def request(self, req, attempts=3):
+            return {"ok": True, "echo": req["op"]}
+
+    key = "span_seconds{span=serve.replica.rpc_client}"
+    h = ProcReplicaHandle(0, proc=None, client=_Client(), block_size=16)
+    h.last_ok_wall = 0.0
+    before = get_registry().snapshot()["histograms"].get(key, {}).get(
+        "count", 0)
+    reply = h._rpc({"op": "stats"})
+    after = get_registry().snapshot()["histograms"][key]["count"]
+    assert after == before + 1
+    assert reply["echo"] == "stats"
+    assert h.last_ok_wall > 0.0
