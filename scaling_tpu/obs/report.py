@@ -583,9 +583,29 @@ def serving_section(data: RunData) -> Tuple[List[str], Dict[str, float]]:
                     f"timeouts={int(r.get('timeouts', 0))} "
                     f"pressure={float(r.get('pool_pressure', 0.0)):.2f}"
                 )
+                if r.get("host") is not None:
+                    row += f" host={r['host']}"
                 if not r.get("alive", True):
                     row += " [FAILED]"
                 lines.append(row)
+        # host-mode attribution (docs/SERVING.md "Host mode"): which
+        # hosts the placement plan expected vs which actually published
+        # a rendezvous record. A planned host that never reported is a
+        # machine the fleet silently ran without — the
+        # --assert-max-replica-restarts gate fails on it loudly.
+        hosts_planned = s.get("fleet_hosts")
+        if isinstance(hosts_planned, list) and hosts_planned:
+            reported = {int(h) for h in (s.get("hosts_reported") or [])}
+            missing = [h for h in hosts_planned if int(h) not in reported]
+            stats["serve_fleet_hosts"] = float(len(hosts_planned))
+            stats["serve_hosts_missing"] = float(len(missing))
+            line = (f"  hosts: planned={hosts_planned} "
+                    f"reported={sorted(reported)} "
+                    f"submit_dups={int(s.get('submit_dups', 0))} "
+                    f"rpc_retries={int(s.get('rpc_retries', 0))}")
+            if missing:
+                line += f" MISSING={missing}"
+            lines.append(line)
         if s.get("spec_k_sweep"):
             # the --spec-k-sweep arm: every draft length's measured
             # tokens/s + accept rate, best-k first-class
@@ -644,6 +664,22 @@ def serving_section(data: RunData) -> Tuple[List[str], Dict[str, float]]:
             f"hung={count('serve-replica-hung')} "
             f"gave_up={count('serve-replica-give-up')}"
         )
+        # per-host attribution (host mode): where the deaths and
+        # relaunches actually happened — a whole-host failure reads as
+        # one host absorbing every dead/restart while the others stay
+        # clean
+        by_host: dict = {}
+        for e in fleet_events:
+            if e.get("host") is not None:
+                by_host.setdefault(int(e["host"]), []).append(e["event"])
+        if by_host:
+            lines.append("  fleet timeline by host: " + "; ".join(
+                f"host {h}: "
+                f"ready={by_host[h].count('serve-replica-ready')} "
+                f"dead={by_host[h].count('serve-replica-dead')} "
+                f"restarts={by_host[h].count('serve-replica-restart')}"
+                for h in sorted(by_host)
+            ))
         t0 = float(fleet_events[0]["ts"])
         shown = fleet_events[:30]
         for e in shown:
@@ -651,9 +687,9 @@ def serving_section(data: RunData) -> Tuple[List[str], Dict[str, float]]:
             who = e.get("replica")
             detail = " ".join(
                 f"{k}={e[k]}" for k in (
-                    "rc", "attempt", "budget", "backoff_s", "recovered",
-                    "redispatch", "redispatched", "stranded", "attempts",
-                    "hb_age_s", "loop_age_s", "restarts",
+                    "host", "rc", "attempt", "budget", "backoff_s",
+                    "recovered", "redispatch", "redispatched", "stranded",
+                    "attempts", "hb_age_s", "loop_age_s", "restarts",
                 )
                 if e.get(k) is not None
             )
@@ -899,6 +935,17 @@ def check_gates(data: RunData, assert_mfu: Optional[float] = None,
                     f"supervised relaunch(es) > ceiling "
                     f"{assert_max_replica_restarts} (replicas are "
                     "crash-looping — check the fleet timeline)"
+                )
+            missing = sstats.get("serve_hosts_missing")
+            if missing:
+                # a planned host with no rendezvous record is a silent
+                # capacity loss no restart count would surface
+                failures.append(
+                    f"assert-max-replica-restarts: {int(missing)} "
+                    f"planned host(s) never rendezvoused (of "
+                    f"{int(sstats.get('serve_fleet_hosts', 0))} in the "
+                    "placement plan) — the fleet ran without them; "
+                    "check the hosts line and ssh reachability"
                 )
         if assert_spec_accept_rate is not None:
             rate = sstats.get("serve_spec_accept_rate")

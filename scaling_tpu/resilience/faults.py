@@ -79,12 +79,33 @@ point               fired
                     request (submit/poll/stats/drain); ``fail`` drops
                     that reply — the host's ``retry_io`` layer retries,
                     which is exactly the at-least-once window the
-                    idempotent ops are designed for
+                    idempotent ops are designed for. Network sub-actions
+                    (advisory, applied by the handler): ``delay`` sleeps
+                    ~0.25s before serving (slow link), ``partition``
+                    drops the REQUEST before it is processed (the op
+                    never happened), ``drop`` serves the request and
+                    then drops the REPLY — the precise admitted-but-
+                    unacknowledged window idempotent submit exists for
+``serve.replica.net_partition``  WORKER-side, before every handled RPC
+                    is even looked at; arm ``partition@N xM @host=K`` to
+                    cut one fake host off the network for a window of M
+                    RPCs — the host-mode partition drill (retries, zero
+                    duplicate admissions)
+``serve.replica.rendezvous``  on every rendezvous-file op: the worker's
+                    address publish, the host's reads while waiting for
+                    a spawned replica, and the atomic worker-config
+                    write (``serve.replica_proc``); ``fail`` is an
+                    OSError inside the ``retry_io`` layer all sides ride
+``serve.replica.teardown``  HOST-side, before force-killing one replica
+                    worker (bench teardown reaching through ssh for
+                    remote replicas); ``fail`` aborts that kill — the
+                    drill for a teardown that cannot reach its host
 ``serve.replica.kill``  WORKER-side, before each engine tick while the
                     replica has work; ``kill@N@host=K`` (workers export
-                    ``SCALING_TPU_HOST_ID=<replica_id>``) SIGKILLs
-                    exactly one replica mid-stream — the chaos e2e's
-                    journal-exact failover drill
+                    ``SCALING_TPU_HOST_ID=<replica_id>``, or the fake
+                    host id in host mode) SIGKILLs exactly one replica —
+                    or every replica of one host — mid-stream: the chaos
+                    e2e's journal-exact failover drill
 ==================  =====================================================
 
 Spec grammar (comma list): ``point=action[@N][xM][@host=K][@epoch=E]``
@@ -113,6 +134,12 @@ still counted. Actions:
               detects it)
 - ``nan``     advisory: returned to the call site, which poisons the
               observed loss
+- ``drop``    advisory: the RPC handler serves the request, then drops
+              the reply on the floor (reply lost in the partition)
+- ``delay``   advisory: the RPC handler sleeps before serving (a slow
+              or congested link)
+- ``partition``  advisory: the RPC handler discards the request before
+              processing (the packet never arrived)
 
 Example: ``SCALING_TPU_FAULTS="ckpt.write=kill@13,data.read=fail@1x2"``;
 host-scoped: ``SCALING_TPU_FAULTS="host.kill=kill@5@host=1"``.
@@ -129,7 +156,8 @@ from ..logging import logger
 
 ENV_VAR = "SCALING_TPU_FAULTS"
 
-ACTIONS = ("kill", "fail", "sigterm", "hang", "corrupt", "nan")
+ACTIONS = ("kill", "fail", "sigterm", "hang", "corrupt", "nan",
+           "drop", "delay", "partition")
 
 # actions fire() executes itself; "corrupt"/"nan" are advisory returns
 _EXECUTED = ("kill", "fail", "sigterm", "hang")

@@ -158,6 +158,28 @@ def worker_env(
     }
 
 
+def ssh_wrap(
+    host: str,
+    cmd: List[str],
+    env_exports: Dict[str, str],
+    cwd: Optional[str] = None,
+) -> List[str]:
+    """The ssh argv that runs ``cmd`` on ``host``: cd into ``cwd``
+    (default: this process's, assumed shared-FS-visible like the rest of
+    the launch contract), export the env inline, exec the quoted argv.
+    One definition shared by the training launcher and the serving
+    fleet's remote replica spawn — the wrapping is where quoting bugs
+    live, so it exists exactly once."""
+    quoted = " ".join(shlex.quote(a) for a in cmd)
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in env_exports.items()
+    )
+    wd = shlex.quote(str(cwd or os.getcwd()))
+    remote = f"cd {wd} && {exports} {quoted}" if exports \
+        else f"cd {wd} && {quoted}"
+    return ["ssh", host, remote]
+
+
 def spawn_worker(
     config: RunnerConfig,
     host: str,
@@ -170,19 +192,14 @@ def spawn_worker(
     get_fault_plan().fire("runner.worker.spawn")
     cmd = build_worker_command(config, env_exports, encoded_payload)
     docker = config.runner_type == RunnerType.PDSH_DOCKER
-    quoted = " ".join(shlex.quote(a) for a in cmd)
     if host in LOCAL_HOSTS:
         return subprocess.Popen(cmd, env={**os.environ, **env_exports})
     if docker:
         # env already rides inside the docker argv; no cd — the
         # container's workdir/mounts define the code location
+        quoted = " ".join(shlex.quote(a) for a in cmd)
         return subprocess.Popen(["ssh", host, quoted])
-    exports = " ".join(
-        f"{k}={shlex.quote(v)}" for k, v in env_exports.items()
-    )
-    return subprocess.Popen(
-        ["ssh", host, f"cd {shlex.quote(os.getcwd())} && {exports} {quoted}"]
-    )
+    return subprocess.Popen(ssh_wrap(host, cmd, env_exports))
 
 
 def runner_main(config: RunnerConfig, payload: Any) -> int:

@@ -153,17 +153,17 @@ def _signal_local(p: subprocess.Popen, sig: str) -> None:
         logger.warning(f"SIG{sig} to worker pid {p.pid} failed: {e!r}")
 
 
-def _remote_pkill(host: str, encoded: str, sig: str) -> None:
-    """Signal a remote host's workers of THIS launch via ssh pkill.
+def remote_pkill(host: str, marker: str, sig: str) -> None:
+    """Signal a remote host's processes matching ``marker`` via ssh pkill.
 
     The local Popen for an ssh-launched worker is only the ssh client —
-    signalling it does not reach the remote process. The pkill pattern
-    is this launch's unique payload marker: the base64 payload is
-    shell- and regex-safe by construction, and 48 chars keeps clear of
-    base64 padding while staying unique per job."""
+    signalling it does not reach the remote process. ``marker`` must be
+    a pattern unique to the processes being signalled (the training
+    supervisor uses its launch's payload prefix; the serving fleet uses
+    a replica's per-spawn config path)."""
     try:
         r = subprocess.run(
-            ["ssh", host, f"pkill -{sig} -f -- --payload={encoded[:48]}"],
+            ["ssh", host, f"pkill -{sig} -f -- {marker}"],
             timeout=30, capture_output=True,
         )
         # pkill 1 = pattern matched nothing (workers already gone) —
@@ -176,6 +176,13 @@ def _remote_pkill(host: str, encoded: str, sig: str) -> None:
             )
     except (OSError, subprocess.TimeoutExpired) as e:
         logger.warning(f"remote SIG{sig} on {host} failed: {e!r}")
+
+
+def _remote_pkill(host: str, encoded: str, sig: str) -> None:
+    """The training launch's marker: its unique base64 payload prefix —
+    shell- and regex-safe by construction, and 48 chars keeps clear of
+    base64 padding while staying unique per job."""
+    remote_pkill(host, f"--payload={encoded[:48]}", sig)
 
 
 def _relay_sigterm(

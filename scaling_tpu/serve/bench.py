@@ -737,7 +737,12 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
     from ..obs.report import percentile
     from ..resilience.faults import get_fault_plan
     from .journal import RequestJournal
-    from .replica_proc import FleetSupervisor, spawn_replica_proc
+    from .replica_proc import (
+        FleetSupervisor,
+        read_rendezvous,
+        rendezvous_file,
+        spawn_replica_proc,
+    )
     from .router import AutoscalePolicy, FleetRouter, ReplicaUnreachable
 
     # fresh run: stale journals from a previous drill in this dir (ANY
@@ -746,6 +751,36 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
     for stale in run_dir.glob(f"{journal_base.stem}*{journal_base.suffix}"):
         stale.unlink()
     fleet_journal = RequestJournal(journal_base)
+
+    # ---- host mode (--hostsfile, docs/SERVING.md "Host mode") ----
+    # replicas spawn across the hostsfile's machines (ssh for remote
+    # hosts, local exec for localhost entries), publish their host:port
+    # into the run dir's rendezvous file, and the control plane's flag
+    # files carry drain/abort to workers a partition has cut off from
+    # RPC. Placement rides the tuner's PlacementPlan: relaunches pin
+    # their recorded host, autoscale spawns go to the least-loaded
+    # feasible host.
+    plan = None
+    control = None
+    host_of: dict = {}  # replica_id -> host_id (sticky across relaunch)
+    if args.hostsfile:
+        from ..resilience.controlplane import FileControlPlane
+        from ..runner.config import RunnerConfig
+        from ..runner.runner import get_resource_pool
+        from ..tune.serving import PlacementPlan
+
+        pool = get_resource_pool(RunnerConfig(
+            hostsfile=args.hostsfile, default_gpu_count=1,
+        ))
+        plan = PlacementPlan.from_pool(pool)
+        control = FileControlPlane(
+            run_dir / "control", host_id=0, num_hosts=len(plan.hosts),
+        )
+        rdv = rendezvous_file(run_dir)
+        if rdv.exists():
+            # a previous drill's entries would satisfy ready-waits with
+            # dead addresses
+            rdv.unlink()
     worker_cfg = {
         "journal_base": str(journal_base),
         "metrics_path": str(run_dir / "metrics.jsonl"),
@@ -769,6 +804,9 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
             "max_waiting": args.max_waiting,
         },
     }
+    if plan is not None:
+        worker_cfg["control_dir"] = str(run_dir / "control")
+        worker_cfg["num_hosts"] = len(plan.hosts)
     chaos_env = dict(os.environ)
     clean_env = dict(os.environ)
     # a chaos plan arms the INITIAL spawns only: hit counters are
@@ -778,9 +816,30 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
     clean_env.pop("SCALING_TPU_FAULTS", None)
 
     def spawn(replica_id, env=None):
+        kw = {}
+        if plan is not None:
+            hid = host_of.get(replica_id)
+            if hid is None:
+                # a NEW replica (autoscale): least-loaded feasible host;
+                # a relaunch found its pin above and never re-places
+                counts: dict = {}
+                for hh in host_of.values():
+                    counts[hh] = counts.get(hh, 0) + 1
+                hid = plan.next_host(counts)
+                if hid is None:
+                    # every host is slot-full: land on the least loaded
+                    # rather than refuse the spawn (oversubscription
+                    # beats a stranded relaunch)
+                    hid = min(
+                        plan.hosts,
+                        key=lambda h: (counts.get(h.host_id, 0),
+                                       h.host_id),
+                    ).host_id
+                host_of[replica_id] = hid
+            kw = {"hostname": plan.hostname(hid), "host_id": hid}
         return spawn_replica_proc(
             replica_id, worker_cfg, run_dir,
-            env=clean_env if env is None else env,
+            env=clean_env if env is None else env, **kw,
         )
 
     drain_req = {"flag": False}
@@ -795,6 +854,11 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
     # default SIGTERM disposition and kill the bench under its workers.
     prev = signal.signal(signal.SIGTERM, _drain_sig)
 
+    if plan is not None:
+        # place the initial fleet up front (same least-loaded rule the
+        # autoscale spawn uses) — infeasible fleets fail loudly here
+        for r, hid in enumerate(plan.initial_assignment(args.replicas_proc)):
+            host_of[r] = hid
     # parallel launch: every worker pays its cold jit warmup at once
     with ThreadPoolExecutor(max_workers=args.replicas_proc) as ex:
         handles = list(ex.map(
@@ -844,6 +908,10 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
                 logger.log_event(
                     "serve-drain", fleet=True, replicas=len(router.live),
                 )
+                if control is not None:
+                    # the control-plane flag reaches workers a partition
+                    # has cut off from the RPC fan-out below
+                    control.set_flag("serve-drain")
                 router.begin_drain()
             if now - last_sup >= 0.05:
                 last_sup = now
@@ -910,13 +978,24 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
                             "killing"
                         )
                         h.proc.kill()
+    except BaseException:
+        if control is not None:
+            # abort rides the control-plane rails: workers a partition
+            # (or a dead ssh channel) cut off from RPC still see the
+            # flag file and exit instead of orphaning on their host
+            try:
+                control.set_flag("serve-abort")
+            except OSError:
+                pass
+        raise
     finally:
         signal.signal(signal.SIGTERM, prev)
         with span("serve.fleet.teardown", phase="finally"):
             for h in router.replicas:
                 if h.proc.poll() is None:
-                    # no orphan keeps writing to the run dir
-                    h.proc.kill()
+                    # no orphan keeps writing to the run dir — kill()
+                    # reaches through ssh for remote-host replicas
+                    h.kill()
 
     completed = {
         r: rec for r, rec in recs.items() if rec["status"] == "completed"
@@ -948,6 +1027,8 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
     agg = dict.fromkeys(agg_keys, 0)
     ticks = 0
     max_prefills = 0
+    submit_dups = 0
+    rpc_retries = 0
     replica_rows = []
     for h in router.replicas:
         s = h.last_stats
@@ -958,11 +1039,16 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
         max_prefills = max(
             max_prefills, int(s.get("max_concurrent_prefills", 0))
         )
+        submit_dups += h.last_dups
+        rpc_retries += h.rpc_retries
         replica_rows.append({
             "replica": h.replica_id,
+            "host": h.host_id,
             "alive": h.alive,
             "retired": h.retired,
             "restarts": h.restarts,
+            "dups": h.last_dups,
+            "rpc_retries": h.rpc_retries,
             "requests": int(s.get("completed", 0)),
             "output_tokens": int(s.get("output_tokens", 0)),
             "timeouts": int(s.get("timeout_count", 0)),
@@ -1028,7 +1114,24 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
         "recovered_requests": len(sup.recovered),
         "redispatched_requests": sup.redispatched,
         "replicas_gave_up": len(sup.gave_up),
+        # partition-drill counters: worker-side dedup hits (an RPC retry
+        # or in-doubt re-offer the engine had already admitted) and
+        # client-side transport retries
+        "submit_dups": submit_dups,
+        "rpc_retries": rpc_retries,
     }
+    if plan is not None:
+        # the host-mode story: which hosts the plan expected vs which
+        # actually rendezvoused (obs report's never-reported gate)
+        stats["fleet_hosts"] = [h.host_id for h in plan.hosts]
+        try:
+            reported = read_rendezvous(rendezvous_file(run_dir))
+        except OSError:
+            reported = {}
+        stats["hosts_reported"] = sorted({
+            int(rec["host"]) for rec in reported.values()
+            if rec.get("host") is not None
+        })
     # the event rides WITHOUT the raw outputs map (events.jsonl is for
     # telemetry, not payloads); the returned stats / --json carry it for
     # the chaos drill's token-exact diff
@@ -1196,6 +1299,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "in-run (SIGKILL a replica -> journal-exact "
                         "failover to survivors + budgeted relaunch); "
                         "replaces --replicas, toy model only, mp=1")
+    parser.add_argument("--hostsfile", metavar="FILE",
+                        help="with --replicas-proc: span the fleet over "
+                        "the hosts listed here (runner hostsfile syntax, "
+                        "slots= caps replicas per host). Remote hosts "
+                        "spawn over ssh, workers publish host:port into "
+                        "<run-dir>/rendezvous.jsonl, drain/abort ride "
+                        "the control-plane flag files, and relaunches "
+                        "pin their recorded host (docs/SERVING.md "
+                        "\"Host mode\")")
     parser.add_argument("--autoscale", action="store_true",
                         help="with --replicas-proc: spawn a replica "
                         "under sustained fleet-wide pressure, drain one "
@@ -1368,6 +1480,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.autoscale and args.max_replicas < args.min_replicas:
             parser.error("--max-replicas < --min-replicas")
     else:
+        if args.hostsfile:
+            parser.error("--hostsfile spans the PROCESS fleet over "
+                         "machines; it needs --replicas-proc")
         _ensure_devices(args.replicas * args.mp)
     # the proc-fleet HOST never builds an engine: the jax-importing
     # modules load only in the worker subprocesses
@@ -1583,6 +1698,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 mark = ""
             if row.get("restarts"):
                 mark = f" restarts={row['restarts']}" + mark
+            if row.get("host") is not None:
+                mark = f" host={row['host']}" + mark
             print(f"    replica {row['replica']}: "
                   f"requests={row['requests']} "
                   f"tokens={row['output_tokens']} "
@@ -1595,6 +1712,11 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"drains={stats['replica_drains']} "
               f"recovered={stats['recovered_requests']} "
               f"redispatched={stats['redispatched_requests']}")
+        if stats.get("fleet_hosts") is not None:
+            print(f"  hosts: planned={stats['fleet_hosts']} "
+                  f"reported={stats['hosts_reported']} "
+                  f"submit_dups={stats['submit_dups']} "
+                  f"rpc_retries={stats['rpc_retries']}")
     if stats.get("spec_k_sweep"):
         print(f"  spec-k sweep (best k={stats['spec_k_best']}):")
         for row in stats["spec_k_sweep"]:

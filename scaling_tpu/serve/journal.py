@@ -236,6 +236,16 @@ def failover_split(path):
     return rep.completed, rep.incomplete, rep.timeout_count
 
 
+def submitted_ids(path) -> set:
+    """Req ids with a submit record in ``path`` — the admission arbiter
+    for in-doubt RPCs: a submit whose reply was lost in a partition was
+    admitted iff the (dead) replica's journal carries its record. The
+    supervisor consults this at failover so an in-doubt request is
+    re-dispatched EXACTLY once — via journal replay when it was admitted,
+    via the router's parked copy when it never was."""
+    return set(replay_journal(path).submits)
+
+
 def replay_journal(path) -> JournalReplay:
     """Parse a journal (tolerant of one torn tail line — the SIGKILL
     signature) into :class:`JournalReplay`."""

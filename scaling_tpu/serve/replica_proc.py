@@ -18,8 +18,8 @@ can do to you:
   as the heartbeat: the reply carries the tick loop's age so a wedged
   loop is visible even while the RPC threads still answer), ``drain``,
   ``shutdown``. The worker journals to the same ``journal_r<id>``
-  namespace the threaded fleet uses and warms up BEFORE its address
-  file appears — readiness is the address file, atomically replaced.
+  namespace the threaded fleet uses and warms up BEFORE its rendezvous
+  entry appears — readiness is the rendezvous record, appended last.
 
 - **Host**: :class:`ProcReplicaHandle` answers the exact
   :class:`~.router.ReplicaHandle` surface over RPC, so the router's
@@ -48,10 +48,30 @@ can do to you:
   events (``serve-replica-{spawn,drain,restart,give-up}``) that
   ``obs report`` renders in the fleet timeline.
 
+- **Host mode** (docs/SERVING.md "Host mode"): the same worker spawns
+  on REMOTE machines through the ``runner/`` host-fleet machinery
+  (``runner.runner.ssh_wrap``, hostsfile pools); instead of a loopback
+  address file each worker appends its ``host:port`` to a rendezvous
+  file under the run dir (``rendezvous.jsonl`` — one O_APPEND line per
+  incarnation, the journal's multi-writer-safe idiom) and the spawner
+  waits for the matching (replica, incarnation) entry. The line-JSON
+  contract is transport-agnostic, so submit/poll/stats/drain work
+  unchanged over real network sockets. An RPC submit whose reply is
+  lost in a partition is parked IN DOUBT by the router (it may have
+  been admitted); it is re-offered to the same replica until a
+  definitive answer arrives, and arbitrated against the journal at
+  failover — never double-admitted, never lost. Drain and abort also
+  ride the ``resilience.controlplane`` flag rails (shared-FS control
+  dir) so a fleet-wide SIGTERM reaches workers even when RPC cannot.
+
 Fault points (docs in :mod:`..resilience.faults`):
 ``serve.replica.spawn`` (host, per launch), ``serve.replica.rpc``
-(worker, per handled request), ``serve.replica.kill`` (worker, before
-each tick while it has work — the mid-stream SIGKILL drill).
+(worker, per handled request; advisory ``drop``/``delay``/``partition``
+sub-actions emulate the network), ``serve.replica.net_partition``
+(worker, before a request is even looked at — the host-scoped partition
+drill), ``serve.replica.rendezvous`` (both sides of the rendezvous
+file), ``serve.replica.kill`` (worker, before each tick while it has
+work — the mid-stream SIGKILL drill).
 
 Host side is jax-free; only the worker imports the engine (each
 process owns its devices, so the GIL lessons from PR 14 disappear by
@@ -74,8 +94,9 @@ from ..logging import logger
 from ..obs import span
 from ..resilience.faults import get_fault_plan
 from ..resilience.guards import retry_io
-from ..runner.supervise import restart_backoff
-from .journal import failover_split, journal_path
+from ..runner.runner import LOCAL_HOSTS, ssh_wrap
+from ..runner.supervise import remote_pkill, restart_backoff
+from .journal import failover_split, journal_path, submitted_ids
 from .router import (
     AutoscalePolicy,
     FleetRouter,
@@ -96,11 +117,71 @@ DEFAULT_LINGER_S = 60.0
 
 def _atomic_write(path, text: str) -> None:
     """tmp + rename so a reader never observes a torn file (the
-    control plane's address-file idiom)."""
+    control plane's address-file idiom). Worker-config writes share
+    the rendezvous file's failure drill: ``retry_io`` with the
+    ``serve.replica.rendezvous`` fault point inside the retried op —
+    a transient shared-FS error must not abort a spawn."""
     p = Path(path)
     tmp = p.with_name(p.name + ".tmp")
-    tmp.write_text(text)
-    os.replace(tmp, p)
+
+    def op():
+        get_fault_plan().fire("serve.replica.rendezvous", path=p)
+        tmp.write_text(text)
+        os.replace(tmp, p)
+
+    retry_io(op, what="replica worker config write")
+
+
+# ========================================================= rendezvous
+RENDEZVOUS_NAME = "rendezvous.jsonl"
+
+
+def rendezvous_file(run_dir) -> Path:
+    return Path(run_dir) / RENDEZVOUS_NAME
+
+
+def publish_rendezvous(path, record: dict) -> None:
+    """Append one replica's address record to the rendezvous file.
+
+    One whole line per O_APPEND write — the request journal's
+    multi-writer idiom: N workers on N machines share one shared-FS
+    file and never tear each other's records. Rides ``retry_io`` with
+    the ``serve.replica.rendezvous`` fault point inside the retried op
+    (a transient shared-FS error at publish time must not kill a
+    freshly warmed worker)."""
+    line = json.dumps(record) + "\n"
+
+    def op():
+        get_fault_plan().fire("serve.replica.rendezvous", path=path)
+        with open(path, "a") as f:
+            f.write(line)
+
+    retry_io(op, what="replica rendezvous publish")
+
+
+def read_rendezvous(path) -> Dict[int, dict]:
+    """Newest rendezvous record per replica id (later incarnations of
+    a relaunched replica append later lines and win). Tolerant of a
+    torn tail line — a reader racing a writer's O_APPEND sees at most
+    one partial record, never a corrupted earlier one."""
+
+    def op():
+        get_fault_plan().fire("serve.replica.rendezvous", path=path)
+        p = Path(path)
+        if not p.is_file():
+            return {}
+        out: Dict[int, dict] = {}
+        for line in p.read_text().splitlines():
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+                out[int(rec["replica"])] = rec
+            except (ValueError, KeyError, TypeError):
+                continue  # torn tail / foreign line: skip, never raise
+        return out
+
+    return retry_io(op, what="replica rendezvous read")
 
 
 # ======================================================== worker side
@@ -190,12 +271,19 @@ class _ReplicaWorker:
     # models no hazard here — a stale annotation would only pre-silence
     # a future real one.)
 
-    def __init__(self, engine, linger_s: float = DEFAULT_LINGER_S):
+    def __init__(self, engine, linger_s: float = DEFAULT_LINGER_S,
+                 host_id: Optional[int] = None, control=None):
         self.engine = engine
         self.linger_s = linger_s
+        self.host_id = host_id
+        # optional FileControlPlane over the run dir: the drain/abort
+        # flag rail that reaches this worker even when RPC cannot
+        self.control = control
+        self.dup_submits = 0  # idempotency hits: retried submits deduped
         self.tick_lock = threading.Lock()
         self.shutdown = threading.Event()
         self._loop_wall = time.monotonic()
+        self._last_flag_poll = 0.0
 
     # ------------------------------------------------------------ ops
     def _knows(self, req_id: int) -> bool:
@@ -220,7 +308,26 @@ class _ReplicaWorker:
         }
 
     def handle(self, req: dict) -> dict:
-        get_fault_plan().fire("serve.replica.rpc")
+        # the partition drill fires BEFORE the request is even looked
+        # at: on an armed hit the packet "never arrived" — no state
+        # change, no reply, the host's retry/in-doubt machinery owns it
+        if get_fault_plan().fire("serve.replica.net_partition") \
+                in ("partition", "drop"):
+            raise OSError("injected network partition: request dropped")
+        act = get_fault_plan().fire("serve.replica.rpc")
+        if act == "delay":
+            time.sleep(0.25)  # a slow or congested link
+        elif act == "partition":
+            raise OSError("injected rpc partition: request dropped")
+        reply = self._dispatch(req)
+        if act == "drop":
+            # the request WAS served (a submit is admitted, journaled);
+            # only the reply dies — the precise ambiguity window the
+            # idempotent-submit dedup and in-doubt parking exist for
+            raise OSError("injected rpc drop: reply lost after dispatch")
+        return reply
+
+    def _dispatch(self, req: dict) -> dict:
         op = req.get("op")
         if op == "submit":
             kw = dict(req.get("kw") or {})
@@ -230,6 +337,7 @@ class _ReplicaWorker:
                 # reply was lost; re-enqueueing would serve the request
                 # twice (identical tokens — same sampler keys — but
                 # double the compute and inflated counts)
+                self.dup_submits += 1
                 return {"ok": True, "admitted": True, "req": int(rid),
                         "dup": True}
             # NOT under tick_lock: ServeEngine.submit only appends to
@@ -249,7 +357,9 @@ class _ReplicaWorker:
                     "req": res.request.req_id}
         if op == "stats":
             return {"ok": True, "stats": self.engine.stats_snapshot(),
-                    "loop_age_s": time.monotonic() - self._loop_wall}
+                    "loop_age_s": time.monotonic() - self._loop_wall,
+                    "host": self.host_id,
+                    "dups": self.dup_submits}
         if op == "poll":
             # cursor-based and read-only: a reply lost to a retry
             # re-ships the same suffix instead of dropping it
@@ -268,10 +378,35 @@ class _ReplicaWorker:
         return {"ok": False, "error": f"unknown op {op!r}"}
 
     # ------------------------------------------------------ tick loop
+    def _poll_control_flags(self) -> Optional[int]:
+        """Check the control plane's drain/abort flags (throttled to
+        ~4 Hz — whole-file reads on a shared FS). Returns an exit code
+        to return from the loop, or None to keep running. This is the
+        RPC-independent rail: a partitioned or dying host can still
+        drain/abort the whole fleet through the shared control dir."""
+        if self.control is None:
+            return None
+        now = time.monotonic()
+        if now - self._last_flag_poll < 0.25:
+            return None
+        self._last_flag_poll = now
+        if self.control.get_flag("serve-abort"):
+            logger.warning("control-plane abort flag set; replica exiting")
+            return 1
+        if not self.engine.draining \
+                and self.control.get_flag("serve-drain"):
+            logger.warning("control-plane drain flag set; draining")
+            with self.tick_lock:
+                self.engine.begin_drain()
+        return None
+
     def run(self) -> int:
         idle_since: Optional[float] = None
         while True:
             self._loop_wall = time.monotonic()
+            rc = self._poll_control_flags()
+            if rc is not None:
+                return rc
             if self.engine.scheduler.has_work:
                 idle_since = None
                 # the chaos drill's SIGKILL lands here: requests are in
@@ -300,9 +435,9 @@ class _ReplicaWorker:
 
 def worker_main(argv: Optional[List[str]] = None) -> int:
     """Entry point of one replica subprocess: build the engine, warm it
-    up, start the RPC server, publish the address file (the readiness
-    signal — LAST, so the host never routes to a replica still inside
-    its cold jit compile), then run the tick loop."""
+    up, start the RPC server, append the rendezvous record (the
+    readiness signal — LAST, so the host never routes to a replica
+    still inside its cold jit compile), then run the tick loop."""
     import argparse
 
     parser = argparse.ArgumentParser(
@@ -344,17 +479,40 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     # request (warmup_mode guards too — this is belt and braces)
     engine.attach_journal(RequestJournal(cfg["journal"]))
 
+    host_id = cfg.get("host_id")
+    control = None
+    if cfg.get("control_dir"):
+        from ..resilience.controlplane import FileControlPlane
+
+        control = FileControlPlane(
+            cfg["control_dir"],
+            host_id=int(host_id) if host_id is not None else replica_id,
+            num_hosts=int(cfg.get("num_hosts", 1)),
+        )
     worker = _ReplicaWorker(
-        engine, linger_s=float(cfg.get("linger_s", DEFAULT_LINGER_S))
+        engine, linger_s=float(cfg.get("linger_s", DEFAULT_LINGER_S)),
+        host_id=int(host_id) if host_id is not None else None,
+        control=control,
     )
-    server = ReplicaRpcServer(worker.handle)
+    # host mode binds all interfaces and advertises the hostsfile name;
+    # single-box mode keeps the loopback default
+    server = ReplicaRpcServer(
+        worker.handle, host=cfg.get("bind_host", "127.0.0.1")
+    )
+    port = server.address.rsplit(":", 1)[1]
+    advertise = f"{cfg['advertise_host']}:{port}" \
+        if cfg.get("advertise_host") else server.address
     # readiness signal LAST: warmup is done, the server is accepting
-    retry_io(
-        lambda: _atomic_write(cfg["addr_path"], server.address + "\n"),
-        what="replica address publish",
-    )
+    publish_rendezvous(cfg["rendezvous_path"], {
+        "replica": replica_id,
+        "host": host_id,
+        "addr": advertise,
+        "pid": os.getpid(),
+        "incarnation": int(cfg.get("incarnation", 0)),
+    })
     logger.log_event(
-        "serve-replica-ready", replica=replica_id, address=server.address,
+        "serve-replica-ready", replica=replica_id, address=advertise,
+        host=host_id,
     )
     try:
         return worker.run()
@@ -372,10 +530,17 @@ class ReplicaProcClient:
         host, port = address.rsplit(":", 1)
         self._addr = (host, int(port))
         self._timeout = timeout_s
+        self.retries = 0  # transport retries taken (partition forensics)
 
-    def _request_once(self, req: dict) -> dict:
+    def _request_once(self, req: dict, state: Optional[dict] = None) -> dict:
         with socket.create_connection(self._addr, self._timeout) as conn:
             conn.sendall((json.dumps(req) + "\n").encode())
+            if state is not None:
+                # the request LEFT this host: from here on a failure is
+                # ambiguous — the worker may have processed it and only
+                # the reply died (the partition's in-doubt window).
+                # A refused connection above never sets this.
+                state["sent"] = True
             line = conn.makefile("r").readline()
             if not line:
                 # the worker's catch-all dropped our reply (injected
@@ -384,18 +549,31 @@ class ReplicaProcClient:
             return json.loads(line)
 
     def request(self, req: dict, attempts: int = 3) -> dict:
+        state = {"sent": False, "calls": 0}
+
+        def once():
+            state["calls"] += 1
+            return self._request_once(req, state)
+
         try:
             reply = retry_io(
-                lambda: self._request_once(req),
+                once,
                 attempts=attempts,
                 retry_on=(OSError, ValueError),
                 what=f"replica rpc {req.get('op')!r}",
             )
         except (OSError, ValueError) as e:
-            raise ReplicaUnreachable(
+            self.retries += max(0, state["calls"] - 1)
+            err = ReplicaUnreachable(
                 f"replica at {self._addr[0]}:{self._addr[1]} "
                 f"unreachable for {req.get('op')!r}: {e!r}"
-            ) from e
+            )
+            # True when any attempt got past sendall: the op may have
+            # executed worker-side. The router parks such a submit in
+            # doubt instead of re-dispatching it to another replica.
+            err.maybe_admitted = state["sent"]
+            raise err from e
+        self.retries += max(0, state["calls"] - 1)
         if not reply.get("ok"):
             raise RuntimeError(f"replica rpc {req} failed: {reply}")
         return reply
@@ -423,7 +601,9 @@ class ProcReplicaHandle:
     submit attempt."""
 
     def __init__(self, replica_id: int, proc, client: ReplicaProcClient,
-                 block_size: int):
+                 block_size: int, host_id: Optional[int] = None,
+                 hostname: Optional[str] = None,
+                 cfg_path: Optional[str] = None):
         self.engine = None  # no in-process engine behind this handle
         self.replica_id = replica_id
         self.alive = True
@@ -432,10 +612,15 @@ class ProcReplicaHandle:
         self.proc = proc
         self.client = client
         self.block_size = block_size
+        self.host_id = host_id  # placement: which fleet host runs it
+        self.hostname = hostname  # None/localhost -> local subprocess
+        self.cfg_path = cfg_path  # remote pkill marker (unique/replica)
         self.spawn_wall = time.monotonic()
         self.last_ok_wall = self.spawn_wall
         self.last_loop_age_s = 0.0
         self.last_stats: dict = {}
+        self.last_dups = 0  # worker-side deduped submit retries
+        self.rpc_retries_banked = 0  # retries from replaced clients
         self.restarts = 0
         self.retired = False  # drained away by the autoscaler
         self.poll_cursor = 0
@@ -456,7 +641,32 @@ class ProcReplicaHandle:
         reply = self._rpc({"op": "stats"})
         self.last_stats = reply["stats"]
         self.last_loop_age_s = float(reply.get("loop_age_s", 0.0))
+        self.last_dups = int(reply.get("dups", 0))
         return self.last_stats
+
+    @property
+    def rpc_retries(self) -> int:
+        return self.rpc_retries_banked + self.client.retries
+
+    def kill(self) -> None:
+        """SIGKILL this replica's worker. For a remote replica the
+        local Popen is only the ssh client, so killing it strands the
+        worker — an ssh pkill on the per-replica config path (unique
+        marker) reaps the remote process too."""
+        get_fault_plan().fire(
+            "serve.replica.teardown", replica=self.replica_id
+        )
+        with span("serve.replica.teardown", replica=self.replica_id):
+            try:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+            except OSError as e:
+                logger.warning(
+                    f"SIGKILL replica {self.replica_id} failed: {e!r}"
+                )
+            if self.hostname and self.hostname not in LOCAL_HOSTS \
+                    and self.cfg_path:
+                remote_pkill(self.hostname, str(self.cfg_path), "KILL")
 
     def poll_finished(self) -> List[dict]:
         """Ship finished-request records the host has not seen yet
@@ -480,8 +690,13 @@ class ProcReplicaHandle:
         # bank the dead incarnation's tick count (best effort: as of its
         # last heartbeat) so the summary's fleet tick total survives
         self.ticks_banked += int(self.last_stats.get("tick", 0))
+        self.rpc_retries_banked += self.client.retries
         self.proc = fresh.proc
         self.client = fresh.client
+        self.hostname = fresh.hostname
+        self.host_id = fresh.host_id if fresh.host_id is not None \
+            else self.host_id
+        self.cfg_path = fresh.cfg_path or self.cfg_path
         self.spawn_wall = fresh.spawn_wall
         self.last_ok_wall = fresh.last_ok_wall
         self.last_stats = {}
@@ -536,47 +751,77 @@ class ProcReplicaHandle:
         return int(s.get("running", 0)), int(s.get("waiting", 0))
 
 
+# env keys a remote replica worker needs exported over ssh (the config
+# file itself rides the shared-FS run dir)
+_REMOTE_ENV_KEYS = (
+    "SCALING_TPU_HOST_ID", "SCALING_TPU_FAULTS",
+    "SCALING_TPU_EVENTS_PATH", "SCALING_TPU_TEST_CACHE",
+    "JAX_PLATFORMS", "XLA_FLAGS", "PYTHONPATH",
+)
+
+
 def spawn_replica_proc(replica_id: int, worker_cfg: dict, run_dir,
                        *, env: Optional[dict] = None,
                        ready_timeout_s: float = DEFAULT_STARTUP_GRACE_S,
+                       hostname: Optional[str] = None,
+                       host_id: Optional[int] = None,
                        ) -> ProcReplicaHandle:
     """Launch ONE replica worker and wait for its readiness signal.
 
-    Writes the worker config, unlinks any stale address file, spawns
-    the subprocess (``SCALING_TPU_HOST_ID=<replica_id>`` so ``@host=K``
-    fault selectors target one replica), and blocks until the address
-    file appears. Raises OSError when the worker dies during startup or
-    the grace expires — the supervisor's budgeted backoff absorbs it.
-    """
+    Writes the worker config, spawns the subprocess — locally, or on
+    ``hostname`` through the runner's ssh wrapping when the host is not
+    this machine (the run dir is assumed shared-FS, the launch
+    contract) — and blocks until the worker's rendezvous record for
+    THIS incarnation appears. ``SCALING_TPU_HOST_ID`` is the fake/real
+    host id in host mode (``@host=K`` fault selectors target a whole
+    host) and the replica id single-box. Raises OSError when the worker
+    dies during startup or the grace expires — the supervisor's
+    budgeted backoff absorbs it."""
     get_fault_plan().fire("serve.replica.spawn")
     run_dir = Path(run_dir)
-    addr_path = run_dir / f"replica_{replica_id}.addr"
     cfg_path = run_dir / f"replica_{replica_id}.json"
-    addr_path.unlink(missing_ok=True)
+    rdv_path = rendezvous_file(run_dir)
+    # a relaunch must not mistake the dead incarnation's entry for
+    # readiness: each spawn claims the next incarnation number and the
+    # wait below matches on it
+    prev = read_rendezvous(rdv_path).get(replica_id)
+    incarnation = int(prev["incarnation"]) + 1 if prev else 0
     cfg = dict(
-        worker_cfg, replica_id=replica_id, addr_path=str(addr_path),
+        worker_cfg, replica_id=replica_id,
+        rendezvous_path=str(rdv_path),
+        incarnation=incarnation,
+        host_id=host_id,
         journal=str(journal_path(worker_cfg["journal_base"], replica_id)),
     )
     cfg.pop("journal_base", None)
+    remote = hostname is not None and hostname not in LOCAL_HOSTS
+    if remote:
+        cfg.setdefault("bind_host", "0.0.0.0")
+        cfg.setdefault("advertise_host", hostname)
     text = json.dumps(cfg, indent=1)
     retry_io(lambda: cfg_path.write_text(text),
              what="replica config write")
     child_env = dict(os.environ if env is None else env)
-    child_env["SCALING_TPU_HOST_ID"] = str(replica_id)
-    with span("serve.replica.spawn", replica=replica_id):
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "scaling_tpu.serve.replica_proc",
-             "--config", str(cfg_path)],
-            env=child_env,
-        )
+    child_env["SCALING_TPU_HOST_ID"] = str(
+        host_id if host_id is not None else replica_id
+    )
+    cmd = [sys.executable, "-m", "scaling_tpu.serve.replica_proc",
+           "--config", str(cfg_path)]
+    with span("serve.replica.spawn", replica=replica_id, host=host_id):
+        if remote:
+            exports = {k: child_env[k] for k in _REMOTE_ENV_KEYS
+                       if k in child_env}
+            proc = subprocess.Popen(ssh_wrap(hostname, cmd, exports))
+        else:
+            proc = subprocess.Popen(cmd, env=child_env)
         deadline = time.monotonic() + ready_timeout_s
+        addr = None
         while True:
-            if addr_path.exists():
-                addr = retry_io(
-                    addr_path.read_text, what="replica address read"
-                ).strip()
-                if addr:
-                    break
+            rec = read_rendezvous(rdv_path).get(replica_id)
+            if rec is not None \
+                    and int(rec.get("incarnation", -1)) == incarnation:
+                addr = str(rec["addr"])
+                break
             rc = proc.poll()
             if rc is not None:
                 raise OSError(
@@ -592,6 +837,7 @@ def spawn_replica_proc(replica_id: int, worker_cfg: dict, run_dir,
     return ProcReplicaHandle(
         replica_id, proc, ReplicaProcClient(addr),
         int(cfg["engine"]["block_size"]),
+        host_id=host_id, hostname=hostname, cfg_path=str(cfg_path),
     )
 
 
@@ -609,14 +855,22 @@ def classify_replicas(
     retired, draining}``.
 
     *dead*: the process exited non-zero (SIGKILL is negative).
-    *hung*: still running but the heartbeat is stale — age is the MAX
-    of (time since the last successful RPC) and (the worker's own
-    reported tick-loop age), so a wedged tick loop whose RPC threads
-    still answer cannot hide — and the startup grace has passed (cold
-    jit compiles legitimately go silent for minutes). An exit-0 or
-    retired (autoscale-drained) replica is neither alive nor dead.
-    Pure function: the detection policy is unit-testable with literal
-    timestamps."""
+    *hung*: still running but the heartbeat is stale — and the startup
+    grace has passed (cold jit compiles legitimately go silent for
+    minutes). An exit-0 or retired (autoscale-drained) replica is
+    neither alive nor dead. Pure function: the detection policy is
+    unit-testable with literal timestamps.
+
+    Clock discipline (the PR 4 controlplane rule): every timestamp here
+    lives on the HOST's monotonic clock. ``last_ok_wall`` is stamped by
+    the host at RPC-reply receipt; ``loop_age_s`` is the worker's
+    self-reported tick-loop age AT that receipt — a remote-measured
+    DURATION, which is skew-free, shifted onto the host timeline by
+    adding it to the receipt gap. The last known loop beat is therefore
+    ``last_ok_wall - loop_age_s`` (host clock), and staleness is
+    ``now - that``. Never compare a remote machine's monotonic or wall
+    reading against the host clock directly: two uptimes have unrelated
+    origins, and NTP-sized wall skew dwarfs a 10s heartbeat window."""
     now = time.monotonic() if now is None else now
     dead: List[int] = []
     hung: List[int] = []
@@ -629,7 +883,12 @@ def classify_replicas(
             if rc != 0:
                 dead.append(r["replica"])
             continue  # exited 0: finished/drained, not alive, not dead
-        age = max(now - r["last_ok_wall"], float(r.get("loop_age_s", 0.0)))
+        # time since the worker's tick loop last provably beat, on the
+        # host timeline: receipt gap + the loop's age at receipt. A
+        # wedged loop whose RPC threads still answer keeps the gap near
+        # zero but its reported age grows, so it cannot hide.
+        age = (now - r["last_ok_wall"]) \
+            + max(0.0, float(r.get("loop_age_s", 0.0)))
         in_grace = now - r["spawn_wall"] <= startup_grace_s
         if age > heartbeat_timeout_s and not in_grace \
                 and not r.get("draining"):
@@ -692,6 +951,7 @@ class FleetSupervisor:
         for h in self.router.replicas:
             rows.append({
                 "replica": h.replica_id,
+                "host": h.host_id,
                 "exit_code": h.proc.poll(),
                 "spawn_wall": h.spawn_wall,
                 "last_ok_wall": h.last_ok_wall,
@@ -712,6 +972,9 @@ class FleetSupervisor:
                 pass  # classified below from exit code / heartbeat age
             except RuntimeError as e:
                 logger.warning(f"replica {h.replica_id} stats: {e!r}")
+        # re-offer in-doubt submits (lost replies) to their replicas:
+        # a healed partition answers dup/admitted and the park clears
+        self.router.resolve_in_doubt()
         cls = classify_replicas(
             self._snapshot_rows(),
             heartbeat_timeout_s=self.heartbeat_timeout_s,
@@ -721,19 +984,16 @@ class FleetSupervisor:
         for rid in cls["hung"]:
             h = self.router.replica(rid)
             logger.log_event(
-                "serve-replica-hung", replica=rid,
+                "serve-replica-hung", replica=rid, host=h.host_id,
                 hb_age_s=round(now - h.last_ok_wall, 3),
                 loop_age_s=round(h.last_loop_age_s, 3),
             )
             # a hung process holds its journal namespace hostage:
             # SIGKILL promotes it to dead and the failover below owns it
             get_fault_plan().fire("serve.replica.hung_kill")
-            try:
-                with span("serve.replica.hung_kill", replica=rid):
-                    h.proc.kill()
-                    h.proc.wait(timeout=10)
-            except OSError as e:
-                logger.warning(f"SIGKILL replica {rid} failed: {e!r}")
+            with span("serve.replica.hung_kill", replica=rid,
+                      host=h.host_id):
+                h.kill()  # remote-aware: ssh pkill reaps an ssh worker
             cls["dead"].append(rid)
         for rid in cls["dead"]:
             self._failover(rid, now)
@@ -750,23 +1010,37 @@ class FleetSupervisor:
         if not handle.alive:
             return  # already failed over; relaunch is pending/given up
         self.router.fail_replica(replica_id)
-        completed, incomplete, timeouts = failover_split(
-            journal_path(self.journal_base, replica_id)
-        )
+        dead_journal = journal_path(self.journal_base, replica_id)
+        completed, incomplete, timeouts = failover_split(dead_journal)
         self.recovered.update(
             {int(k): list(v) for k, v in completed.items()}
         )
         self.recovered_timeouts += timeouts
         self.orphans.extend(incomplete)
+        # arbitrate the router's in-doubt parks against the journal:
+        # an in-doubt submit WITH a journal record was admitted — the
+        # split above already owns it (completed/incomplete/timeout);
+        # one WITHOUT was never admitted, so the parked copy is the
+        # only copy and joins the orphans. Exactly one path re-serves
+        # each request — never both.
+        parked = self.router.take_in_doubt(replica_id)
+        unadmitted = 0
+        if parked:
+            admitted = submitted_ids(dead_journal)
+            for rec in parked:
+                if int(rec["req"]) not in admitted:
+                    self.orphans.append(rec)
+                    unadmitted += 1
         logger.log_event(
-            "serve-replica-dead", replica=replica_id,
+            "serve-replica-dead", replica=replica_id, host=handle.host_id,
             rc=handle.proc.poll(), recovered=len(completed),
-            redispatch=len(incomplete), timeouts=timeouts,
+            redispatch=len(incomplete) + unadmitted, timeouts=timeouts,
         )
         attempt = self._attempts.get(replica_id, 0) + 1
         if attempt > self.restart_budget:
             logger.log_event(
                 "serve-replica-give-up", replica=replica_id,
+                host=handle.host_id,
                 attempts=attempt - 1, budget=self.restart_budget,
             )
             self.gave_up.append(replica_id)
@@ -778,6 +1052,7 @@ class FleetSupervisor:
         }
         logger.log_event(
             "serve-replica-restart", replica=replica_id,
+            host=handle.host_id,
             attempt=attempt, budget=self.restart_budget,
             backoff_s=round(delay, 3),
         )
@@ -881,7 +1156,7 @@ class FleetSupervisor:
             handle = self.router.replica(target)
             logger.log_event(
                 "serve-replica-drain", replica=target,
-                restarts=handle.restarts,
+                host=handle.host_id, restarts=handle.restarts,
             )
             if self.on_drain is not None:
                 self.on_drain(handle)  # last poll while it still answers

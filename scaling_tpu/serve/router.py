@@ -50,6 +50,21 @@ construction. A handle whose replica process died mid-call raises
 Backpressure — try the next live replica — and leaves the
 dead/hung/relaunch decision to the fleet supervisor
 (``replica_proc.FleetSupervisor``).
+
+Host mode adds the partition-tolerance rule: a submit whose transport
+failure happened AFTER the request left this host (the client tags
+``maybe_admitted`` on :class:`ReplicaUnreachable`) may have been
+admitted with only its reply lost. Re-dispatching it to another replica
+could serve it TWICE (double compute, inflated counters), so the router
+parks it IN DOUBT (:class:`InDoubtAdmit`) pinned to that replica and
+re-offers it there every supervisor tick: a healed partition answers
+``dup``/admitted and the park clears; a definitive Backpressure sends
+it back through normal dispatch; and if the replica is declared dead,
+the supervisor arbitrates the park against the dead journal
+(``take_in_doubt`` + ``journal.submitted_ids``) — admitted requests ride
+the normal journal failover, never-admitted ones re-enter as orphans.
+Exactly-once admission either way. A connection REFUSED before the
+request was sent is unambiguous and keeps the old retry-elsewhere path.
 """
 
 from __future__ import annotations
@@ -70,7 +85,26 @@ class ReplicaUnreachable(OSError):
     """A replica's RPC channel is gone (process dead, socket refused,
     retries exhausted). Raised by process-backed handles; the router's
     dispatch loop skips the replica like a Backpressure answer and the
-    supervisor's liveness pass owns the failover."""
+    supervisor's liveness pass owns the failover. The client sets
+    ``maybe_admitted=True`` when any attempt got past send — the op may
+    have executed remotely with only the reply lost (a submit in this
+    state is parked in doubt, never re-dispatched elsewhere)."""
+
+    maybe_admitted = False
+
+
+class InDoubtAdmit:
+    """A submit whose RPC died after the request left this host: the
+    pinned replica may or may not have admitted it. The router owns it
+    from here (``resolve_in_doubt`` / failover arbitration); callers
+    treat it like an admit — the request is neither shed nor free to
+    re-submit."""
+
+    __slots__ = ("req_id", "replica_id")
+
+    def __init__(self, req_id: int, replica_id: int):
+        self.req_id = req_id
+        self.replica_id = replica_id
 
 
 @dataclasses.dataclass
@@ -191,6 +225,11 @@ class FleetRouter:
         self._next_req_id = 0
         self.retries_elsewhere = 0
         self.rejected = 0  # submissions every live replica shed
+        # req_id -> journal-submit-shaped record (+ "replica" pin) for
+        # submits whose RPC died after send — exactly-once admission
+        # bookkeeping (resolve_in_doubt / take_in_doubt)
+        self._in_doubt: Dict[int, dict] = {}
+        self.in_doubt_parks = 0  # total park events (telemetry)
 
     # ---------------------------------------------------------- plumbing
     @property
@@ -272,10 +311,30 @@ class FleetRouter:
                     prompt, max_new_tokens, req_id=req_id,
                     count_shed=False, **kwargs
                 )
-            except ReplicaUnreachable:
-                # the process died under us mid-dispatch: skip it like a
-                # shed (the supervisor's liveness pass will classify it
-                # and run the journal failover) and try the next replica
+            except ReplicaUnreachable as err:
+                if getattr(err, "maybe_admitted", False):
+                    # the request LEFT this host before the channel
+                    # died: the replica may have admitted it with only
+                    # the reply lost. Re-dispatching elsewhere risks
+                    # serving it TWICE — park it pinned to this replica;
+                    # resolve_in_doubt / failover arbitration finish the
+                    # story exactly once.
+                    with self._lock:
+                        self._in_doubt[req_id] = self._park_record(
+                            req_id, handle.replica_id, prompt,
+                            max_new_tokens, kwargs,
+                        )
+                        self.in_doubt_parks += 1
+                    logger.log_event(
+                        "serve-submit-in-doubt", req=req_id,
+                        replica=handle.replica_id,
+                    )
+                    return InDoubtAdmit(req_id, handle.replica_id)
+                # connection refused before anything was sent: the
+                # process died under us mid-dispatch and the request
+                # unambiguously never reached it — skip it like a shed
+                # (the supervisor's liveness pass will classify it and
+                # run the journal failover) and try the next replica
                 bp = Backpressure(
                     reason="replica-unreachable", pool_pressure=1.0,
                     waiting=0, draining=False,
@@ -302,6 +361,96 @@ class FleetRouter:
         with self._lock:
             self.rejected += 1
         return bp
+
+    @staticmethod
+    def _park_record(req_id: int, replica_id: int, prompt: List[int],
+                     max_new_tokens: int, kwargs: dict) -> dict:
+        """The in-doubt park entry: shaped exactly like a journal submit
+        record (plus the ``replica`` pin) so an unadmitted park can join
+        the supervisor's orphan re-dispatch verbatim."""
+        return {
+            "kind": "serve-submit",
+            "req": int(req_id),
+            "replica": int(replica_id),
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(max_new_tokens),
+            "eos_token_id": kwargs.get("eos_token_id"),
+            "temperature": kwargs.get("temperature", 0.0),
+            "top_k": kwargs.get("top_k"),
+            "top_p": kwargs.get("top_p"),
+            "deadline_ms": kwargs.get("deadline_ms"),
+            "ttft_deadline_ms": kwargs.get("ttft_deadline_ms"),
+        }
+
+    def resolve_in_doubt(self) -> None:
+        """Re-offer every parked in-doubt submit to its pinned replica
+        (the supervisor calls this each tick). Idempotent submit makes
+        the re-offer safe in every world: a replica that DID admit the
+        original answers dup (park clears, nothing double-served); one
+        that never saw it admits fresh (park clears); a definitive
+        Backpressure proves not-admitted, so the request re-enters
+        normal dispatch; a still-unreachable replica keeps the park for
+        the next tick. Parks pinned to a dead replica are left for the
+        failover's journal arbitration (``take_in_doubt``)."""
+        if not self._in_doubt:
+            return
+        with self._lock:
+            pending = list(self._in_doubt.values())
+        for rec in pending:
+            try:
+                handle = self.replica(rec["replica"])
+            except KeyError:
+                continue
+            if not handle.alive:
+                continue
+            kw = {
+                k: rec.get(k)
+                for k in ("eos_token_id", "temperature", "top_k",
+                          "top_p", "deadline_ms", "ttft_deadline_ms")
+            }
+            try:
+                res = handle.submit(
+                    rec["prompt"], rec["max_new_tokens"],
+                    req_id=rec["req"], count_shed=False, **kw,
+                )
+            except ReplicaUnreachable:
+                continue  # still partitioned: parked until next tick
+            with self._lock:
+                self._in_doubt.pop(rec["req"], None)
+            if isinstance(res, Backpressure):
+                # definitive NOT-admitted: the original send never
+                # landed in the engine. The caller was already told
+                # "admitted", so ownership stands — force it through
+                # normal dispatch like an orphan (recovery work is
+                # never shed).
+                out = self.submit(
+                    rec["prompt"], rec["max_new_tokens"],
+                    req_id=rec["req"], force=True, **kw,
+                )
+                if isinstance(out, Backpressure):
+                    with self._lock:  # nothing reachable: re-park
+                        self._in_doubt[rec["req"]] = rec
+            else:
+                logger.log_event(
+                    "serve-in-doubt-resolved", req=rec["req"],
+                    replica=rec["replica"],
+                )
+
+    def take_in_doubt(self, replica_id: int) -> List[dict]:
+        """Pop every in-doubt submit parked on ``replica_id`` — the
+        supervisor calls this at failover and arbitrates each record
+        against the dead replica's journal (``journal.submitted_ids``):
+        admitted -> the journal replay already owns it; never admitted
+        -> the parked record (journal-submit-shaped by construction)
+        joins the orphan re-dispatch. Either way, exactly once."""
+        with self._lock:
+            taken = [
+                rec for rec in self._in_doubt.values()
+                if rec["replica"] == replica_id
+            ]
+            for rec in taken:
+                self._in_doubt.pop(rec["req"], None)
+        return taken
 
     def begin_drain(self) -> None:
         """Drain the whole fleet (the SIGTERM handler's target): every
@@ -363,7 +512,10 @@ class FleetRouter:
     # --------------------------------------------------------- telemetry
     @property
     def has_work(self) -> bool:
-        return any(r.has_work for r in self.live)
+        # an in-doubt park is pending work even when every engine's
+        # queues are empty — the bench must not declare the run done
+        # while an admission is unresolved
+        return any(r.has_work for r in self.live) or bool(self._in_doubt)
 
     def sync_next_req_id(self) -> None:
         """After journal replay seeded engines with historical ids, the
@@ -396,6 +548,8 @@ class FleetRouter:
                 ),
                 "retries_elsewhere": self.retries_elsewhere,
                 "rejected": self.rejected,
+                "in_doubt_parks": self.in_doubt_parks,
+                "in_doubt_pending": len(self._in_doubt),
                 "per_replica": per,
             }
 

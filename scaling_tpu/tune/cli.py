@@ -296,6 +296,46 @@ def serve_main(args) -> int:
         "serving_config": best.point.to_config(model),
         "dropped_over_hbm": len(points) - len(ranked),
     }
+    if args.serve_hostsfile:
+        # the placement axis (docs/SERVING.md "Host mode"): WHERE the
+        # best point's replicas may spawn — per-host slot and HBM
+        # feasibility over the deployment's hostsfile, plus the
+        # least-loaded initial assignment `serve bench --hostsfile`
+        # would make. Golden-safe: the pin compares only "ranked".
+        from ..runner.config import RunnerConfig
+        from ..runner.runner import get_resource_pool
+        from .serving import (
+            HBM_GB,
+            HostCapacity,
+            PlacementPlan,
+            serving_memory_gb,
+        )
+
+        pool = get_resource_pool(RunnerConfig(
+            hostsfile=args.serve_hostsfile, default_gpu_count=1,
+        ))
+        per_gb = serving_memory_gb(model, best.point) * best.point.mp
+        chip_gb = HBM_GB.get(topo.generation, float("inf"))
+        plan = PlacementPlan(
+            [
+                HostCapacity(i, hn, max(int(s), 1),
+                             chip_gb * max(int(s), 1))
+                for i, (hn, s) in enumerate(pool.items())
+            ],
+            per_replica_gb=per_gb,
+        )
+        try:
+            assignment = plan.initial_assignment(best.point.replicas)
+        except ValueError as e:
+            assignment = None
+            print(f"# tune: placement infeasible for best point: {e}",
+                  file=sys.stderr)
+        payload["placement"] = {
+            "hostsfile": str(args.serve_hostsfile),
+            "per_replica_gb": round(per_gb, 3),
+            "hosts": plan.to_payload(),
+            "assignment": assignment,
+        }
     print(f"tune --serve: {len(ranked)} feasible serving point(s) of "
           f"{model_name} on {args.devices} chip(s) [{topo.generation}, "
           f"ici_domain={topo.domain}; {payload['dropped_over_hbm']} "
@@ -316,6 +356,15 @@ def serve_main(args) -> int:
     print(f"best: {best.point.label} predicted {best.tokens_per_s:.0f} "
           f"fleet tokens/s (run: python -m scaling_tpu.serve bench "
           f"--config <emitted>)")
+    if payload.get("placement"):
+        pl = payload["placement"]
+        print(f"placement: {len(pl['hosts'])} host(s), "
+              f"{pl['per_replica_gb']:.2f} GB/replica, "
+              f"assignment={pl['assignment']}")
+        for row in pl["hosts"]:
+            print(f"    host {row['host_id']} ({row['hostname']}): "
+                  f"slots={row['slots']} "
+                  f"max_replicas={row['max_replicas']}")
     if args.emit_config:
         Path(args.emit_config).write_text(
             json.dumps(payload["serving_config"], indent=1) + "\n"
@@ -418,6 +467,13 @@ def main(argv=None) -> int:
     parser.add_argument("--serve-num-slots", type=int, default=8,
                         help="decode slots per replica (fixed across the "
                         "sweep; the jitted batch size)")
+    parser.add_argument("--serve-hostsfile", metavar="FILE",
+                        help="with --serve: plan WHERE the best point's "
+                        "replicas spawn — per-host slot/HBM feasibility "
+                        "over this runner hostsfile, published as the "
+                        "payload's 'placement' table (the same "
+                        "least-loaded rule serve bench --hostsfile "
+                        "applies at spawn time)")
     parser.add_argument("--serve-calibrate-from", metavar="RUN_DIR",
                         help="scale predicted tick time by the measured "
                         "serve.mixed/serve.decode spans of this serve "

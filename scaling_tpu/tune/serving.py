@@ -385,3 +385,128 @@ def check_serve_golden(payload: dict, path: Path) -> List[str]:
                 f"(> {GOLDEN_RTOL:.0%} band)"
             )
     return drift
+
+
+# ----------------------------------------------------------- placement
+@dataclasses.dataclass(frozen=True)
+class HostCapacity:
+    """One machine of the serving fleet as the placement axis sees it:
+    ``slots`` replica processes at most, ``hbm_gb`` usable accelerator
+    memory for ALL of them together."""
+
+    host_id: int
+    hostname: str
+    slots: int
+    hbm_gb: float = float("inf")
+
+
+class PlacementPlan:
+    """WHERE the next replica may spawn: per-host slot + HBM feasibility
+    over a hostsfile-shaped fleet. Pure policy, no I/O and no clocks —
+    the serve bench consults it at spawn time (initial placement,
+    relaunch pinning falls outside: a relaunch reuses its recorded
+    host), and ``tune --serve --serve-hostsfile`` publishes the same
+    math as the payload's ``placement`` table so the ranking and the
+    bench agree on what fits."""
+
+    def __init__(self, hosts: Sequence[HostCapacity],
+                 per_replica_gb: float = 0.0):
+        if not hosts:
+            raise ValueError("a placement plan needs at least one host")
+        ids = [h.host_id for h in hosts]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate host ids {ids}")
+        self.hosts = list(hosts)
+        self.per_replica_gb = float(per_replica_gb)
+
+    @classmethod
+    def from_pool(cls, pool: Dict[str, int],
+                  per_replica_gb: float = 0.0,
+                  hbm_gb: float = float("inf")) -> "PlacementPlan":
+        """From a runner resource pool (``runner.get_resource_pool`` —
+        ordered {hostname: slots}); host ids follow hostsfile order."""
+        return cls(
+            [
+                HostCapacity(i, hostname, max(int(slots), 1), hbm_gb)
+                for i, (hostname, slots) in enumerate(pool.items())
+            ],
+            per_replica_gb=per_replica_gb,
+        )
+
+    def host(self, host_id: int) -> HostCapacity:
+        for h in self.hosts:
+            if h.host_id == host_id:
+                return h
+        raise KeyError(f"no host {host_id} in the placement plan")
+
+    def hostname(self, host_id: int) -> str:
+        return self.host(host_id).hostname
+
+    def feasible(self, host_id: int, count: int) -> bool:
+        """Can host ``host_id``, already running ``count`` replicas,
+        take one more? Slot-bound AND memory-bound: ``count + 1``
+        replicas' HBM must fit the host's budget."""
+        h = self.host(host_id)
+        if count >= h.slots:
+            return False
+        return (count + 1) * self.per_replica_gb <= h.hbm_gb
+
+    def next_host(self, counts: Dict[int, int]) -> Optional[int]:
+        """The least-loaded feasible host (lowest id breaks ties), or
+        None when no host can take another replica. ``counts`` maps
+        host_id -> replicas currently placed there (missing = 0)."""
+        best = None
+        for h in self.hosts:
+            count = int(counts.get(h.host_id, 0))
+            if not self.feasible(h.host_id, count):
+                continue
+            if best is None or count < best[0]:
+                best = (count, h.host_id)
+        return None if best is None else best[1]
+
+    def initial_assignment(self, n: int) -> List[int]:
+        """Host ids for replicas ``0..n-1`` — least-loaded round-robin
+        through ``next_host`` so the initial spread and the autoscale
+        spread follow the SAME rule. Raises when the fleet cannot hold
+        ``n`` replicas (better a loud launch error than a worker that
+        OOMs or oversubscribes its host mid-run)."""
+        counts: Dict[int, int] = {}
+        out: List[int] = []
+        for r in range(n):
+            hid = self.next_host(counts)
+            if hid is None:
+                cap = sum(h.slots for h in self.hosts)
+                raise ValueError(
+                    f"placement infeasible: replica {r} of {n} has no "
+                    f"host with a free slot that fits "
+                    f"{self.per_replica_gb:.2f} GB/replica "
+                    f"(fleet capacity {cap} slot(s) over "
+                    f"{len(self.hosts)} host(s))"
+                )
+            counts[hid] = counts.get(hid, 0) + 1
+            out.append(hid)
+        return out
+
+    def to_payload(self) -> List[dict]:
+        """The tune payload's ``placement`` table: per-host capacity in
+        replicas, both slot- and HBM-bound."""
+        rows = []
+        for h in self.hosts:
+            if self.per_replica_gb > 0 and h.hbm_gb != float("inf"):
+                mem_cap = int(h.hbm_gb // self.per_replica_gb)
+            else:
+                mem_cap = None
+            rows.append({
+                "host_id": h.host_id,
+                "hostname": h.hostname,
+                "slots": h.slots,
+                "hbm_gb": (
+                    None if h.hbm_gb == float("inf")
+                    else round(h.hbm_gb, 2)
+                ),
+                "max_replicas_by_memory": mem_cap,
+                "max_replicas": (
+                    h.slots if mem_cap is None else min(h.slots, mem_cap)
+                ),
+            })
+        return rows

@@ -339,6 +339,32 @@ def test_sta011_retry_io_guards_lambda_and_named_callable(tmp_path):
     assert active(run(tmp_path, {"runner/m.py": src}), "STA011") == []
 
 
+def test_sta011_rendezvous_append_rides_the_retry_guard(tmp_path):
+    """The multi-host rendezvous file is shared-FS I/O like any other:
+    a raw O_APPEND publish in serve/ fires, while the real idiom —
+    ``serve.replica.rendezvous`` fault point INSIDE the ``retry_io``'d
+    op — is covered (the STA011/STA014 contract for host mode)."""
+    bare = (
+        "def publish(path, line):\n"
+        "    with open(path, 'a') as f:\n"
+        "        f.write(line)\n"
+    )
+    f = active(run(tmp_path / "t1", {"serve/m.py": bare}), "STA011")
+    assert len(f) == 1 and "open" in f[0].message
+    guarded = (
+        "from scaling_tpu.resilience.guards import retry_io\n"
+        "\n"
+        "def publish(plan, path, line):\n"
+        "    def op():\n"
+        "        plan.fire('serve.replica.rendezvous')\n"
+        "        with open(path, 'a') as f:\n"
+        "            f.write(line)\n"
+        "    retry_io(op, what='replica rendezvous publish')\n"
+    )
+    assert active(run(tmp_path / "t2", {"serve/m.py": guarded}),
+                  "STA011") == []
+
+
 def test_sta011_fault_point_guards_but_process_points_do_not(tmp_path):
     guarded = (
         "def save(plan, p, data):\n"

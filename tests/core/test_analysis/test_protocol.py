@@ -223,6 +223,34 @@ def test_sta013_dynamic_op_and_client_only_module_are_clean(tmp_path):
     assert active(run(tmp_path / "co", {"m.py": client_only}), "STA013") == []
 
 
+def test_sta013_in_doubt_dedup_reply_keys_are_declared(tmp_path):
+    """The idempotent-submit protocol's dup answer is a declared reply
+    shape like any other arm's: a client may read ``dup`` because the
+    submit handler returns it — the partition-tolerance path is inside
+    the contract, not special-cased around it."""
+    src = (
+        "class Client:\n"
+        "    def __init__(self, t):\n"
+        "        self.t = t\n"
+        "    def reoffer(self, req_id):\n"
+        "        r = self.t.request({'op': 'submit', 'req_id': req_id})\n"
+        "        return bool(r.get('dup'))\n"
+        "\n"
+        "class Server:\n"
+        "    def __init__(self):\n"
+        "        self.seen = set()\n"
+        "    def handle(self, req):\n"
+        "        op = req.get('op')\n"
+        "        if op == 'submit':\n"
+        "            if req['req_id'] in self.seen:\n"
+        "                return {'ok': True, 'dup': True}\n"
+        "            self.seen.add(req['req_id'])\n"
+        "            return {'ok': True, 'dup': False}\n"
+        "        return {'ok': False, 'error': 'unknown-op'}\n"
+    )
+    assert active(run(tmp_path, {"m.py": src}), "STA013") == []
+
+
 # ================================================================ STA014
 COVERAGE = (
     "def span(name, **kw): ...\n"
@@ -310,6 +338,30 @@ def test_sta014_spawn_and_kill_sites_fire(tmp_path):
     assert {x.line for x in f} == {3, 5}
     assert any("spawn" in x.message for x in f)
     assert any("kill" in x.message for x in f)
+
+
+def test_sta014_ssh_wrapped_remote_spawn_is_inside_the_gate(tmp_path):
+    """A remote worker launch is still a ``subprocess.Popen`` — of the
+    ssh client — and stays a protocol edge exactly like a local spawn:
+    bare fires, fault-point + span covers it (the multi-host serving
+    fleet's spawn path, docs/SERVING.md "Host mode")."""
+    bare = (
+        "import subprocess\n"
+        "def spawn_remote(host, cmd):\n"
+        "    return subprocess.Popen(['ssh', host, ' '.join(cmd)])\n"
+    )
+    f = active(run(tmp_path / "t1", {"runner/m.py": bare}), "STA014")
+    assert len(f) == 1 and "spawn" in f[0].message
+    covered = (
+        "import subprocess\n"
+        "def span(name, **kw): ...\n"
+        "def spawn_remote(plan, host, cmd):\n"
+        "    plan.fire('serve.replica.spawn')\n"
+        "    with span('serve.replica.spawn'):\n"
+        "        return subprocess.Popen(['ssh', host, ' '.join(cmd)])\n"
+    )
+    assert active(run(tmp_path / "t2", {"runner/m.py": covered}),
+                  "STA014") == []
 
 
 # ================================================================ STA015

@@ -1,9 +1,11 @@
 """Process-fleet policy units (ISSUE 16): the supervisor's pure
 liveness classifier, the autoscaler's watermark hysteresis + budgets,
-journal failover harvesting, the shared restart-backoff curve, and the
-replica RPC transport's retry discipline — each driven with literal
-timestamps / literal journal lines / loopback sockets. No engines, no
-subprocesses: the chaos e2e (test_proc_fleet_e2e.py) owns those."""
+journal failover harvesting, the shared restart-backoff curve, the
+replica RPC transport's retry discipline, the multi-host rendezvous
+file, and the router's in-doubt admission protocol (ISSUE 18) — each
+driven with literal timestamps / literal journal lines / loopback
+sockets / scripted handles. No engines, no subprocesses: the chaos
+e2es (test_proc_fleet_e2e.py, test_host_fleet_e2e.py) own those."""
 
 import json
 
@@ -12,11 +14,22 @@ import pytest
 from scaling_tpu.runner.supervise import restart_backoff
 from scaling_tpu.serve.journal import failover_split
 from scaling_tpu.serve.replica_proc import (
+    RemoteAdmit,
     ReplicaProcClient,
     ReplicaRpcServer,
     classify_replicas,
+    publish_rendezvous,
+    read_rendezvous,
+    rendezvous_file,
 )
-from scaling_tpu.serve.router import AutoscalePolicy, ReplicaUnreachable
+from scaling_tpu.serve.router import (
+    AutoscalePolicy,
+    FleetRouter,
+    InDoubtAdmit,
+    ReplicaStats,
+    ReplicaUnreachable,
+)
+from scaling_tpu.serve.scheduler import Backpressure
 
 NOW = 100.0
 
@@ -58,9 +71,20 @@ def test_stale_heartbeat_past_grace_is_hung():
 def test_wedged_tick_loop_cannot_hide_behind_live_rpc_threads():
     """``loop_age_s`` is the worker's own report of time since its tick
     loop last beat: a wedged loop whose RPC threads still answer keeps
-    ``last_ok_wall`` fresh but not the beat — age takes the MAX."""
+    ``last_ok_wall`` fresh but not the beat — the ages ADD (host-side
+    receipt age + worker-side loop age on the host's timeline)."""
     got = classify([row(0, last_ok_wall=NOW, loop_age_s=11.0)])
     assert got["hung"] == [0]
+
+
+def test_remote_clock_skew_cannot_fake_liveness():
+    """Liveness never compares a remote worker's clock against the
+    router host's: the worker reports a DURATION (``loop_age_s``) and
+    the host shifts it onto its own timeline by adding the receipt
+    age. A fresh-looking heartbeat carrying a stale loop age is hung —
+    under a cross-clock MAX a skewed remote could look alive forever."""
+    got = classify([row(0, last_ok_wall=NOW - 1.0, loop_age_s=9.5)])
+    assert got["hung"] == [0]  # 1.0 host-side + 9.5 worker-side > 10
 
 
 def test_startup_grace_shields_cold_compile_silence():
@@ -265,5 +289,171 @@ def test_dead_address_raises_replica_unreachable():
     addr = server.address
     server.close()
     client = ReplicaProcClient(addr, timeout_s=0.5)
-    with pytest.raises(ReplicaUnreachable):
+    with pytest.raises(ReplicaUnreachable) as ei:
         client.request({"op": "stats"}, attempts=1)
+    # connection refused: nothing ever left this host — unambiguous
+    assert ei.value.maybe_admitted is False
+
+
+def test_unreachable_after_send_is_flagged_maybe_admitted():
+    """Every attempt reached the worker but no reply came back (the
+    server's catch-all drops replies on handler crashes): the op MAY
+    have executed remotely — the exception must say so, because the
+    router's park-vs-retry-elsewhere decision hangs on this bit."""
+
+    def always_crash(req):
+        raise RuntimeError("handler crashed; reply dropped")
+
+    server = ReplicaRpcServer(always_crash)
+    try:
+        client = ReplicaProcClient(server.address, timeout_s=0.5)
+        with pytest.raises(ReplicaUnreachable) as ei:
+            client.request({"op": "submit"}, attempts=2)
+        assert ei.value.maybe_admitted is True
+    finally:
+        server.close()
+
+
+# ========================================================== rendezvous
+def test_rendezvous_newest_record_per_replica_wins(tmp_path):
+    p = rendezvous_file(tmp_path)
+    publish_rendezvous(p, {"replica": 0, "host": 0, "addr": "a:1",
+                           "pid": 10, "incarnation": 1})
+    publish_rendezvous(p, {"replica": 1, "host": 1, "addr": "b:2",
+                           "pid": 11, "incarnation": 1})
+    # replica 0 relaunched on another host: later line, higher
+    # incarnation — readers must follow the move
+    publish_rendezvous(p, {"replica": 0, "host": 1, "addr": "c:3",
+                           "pid": 12, "incarnation": 2})
+    got = read_rendezvous(p)
+    assert got[0] == {"replica": 0, "host": 1, "addr": "c:3",
+                      "pid": 12, "incarnation": 2}
+    assert got[1]["addr"] == "b:2"
+
+
+def test_rendezvous_read_tolerates_torn_tail_and_missing_file(tmp_path):
+    assert read_rendezvous(rendezvous_file(tmp_path)) == {}
+    p = rendezvous_file(tmp_path)
+    publish_rendezvous(p, {"replica": 0, "host": 0, "addr": "a:1",
+                           "pid": 10, "incarnation": 1})
+    with open(p, "a") as f:
+        f.write('{"replica": 1, "host": 1, "ad')  # racing writer's tail
+    got = read_rendezvous(p)
+    assert list(got) == [0]  # torn line skipped, earlier record intact
+
+
+# ================================================== in-doubt admission
+class ScriptedHandle:
+    """The :class:`~scaling_tpu.serve.router.ReplicaHandle` surface
+    with a scripted ``submit`` — drives the router's park/resolve
+    machinery without sockets or engines."""
+
+    def __init__(self, rid, script=(), block_size=4):
+        self.replica_id = rid
+        self.alive = True
+        self.block_size = block_size
+        self.stats = ReplicaStats()
+        self.script = list(script)
+        self.submits = []  # (req_id, kwargs) in arrival order
+
+    def load(self):
+        return (0, 0.0)
+
+    def submit(self, prompt, max_new_tokens, **kw):
+        self.submits.append((kw.get("req_id"), kw))
+        action = self.script.pop(0) if self.script else "admit"
+        if action == "admit":
+            return RemoteAdmit(kw.get("req_id"), self.replica_id)
+        if action == "bp":
+            return Backpressure(reason="pool", pool_pressure=1.0,
+                                waiting=0, draining=False)
+        err = ReplicaUnreachable(action)
+        err.maybe_admitted = (action == "lost")  # vs "refused"
+        raise err
+
+    def begin_drain(self):
+        pass
+
+    @property
+    def has_work(self):
+        return False
+
+    def next_req_id(self):
+        return 0
+
+    def queue_sizes(self):
+        return (0, 0)
+
+
+def test_reply_lost_submit_parks_pinned_never_retries_elsewhere():
+    h0, h1 = ScriptedHandle(0, ["lost"]), ScriptedHandle(1)
+    r = FleetRouter(handles=[h0, h1])
+    res = r.submit([1, 2, 3], 4)
+    assert isinstance(res, InDoubtAdmit)
+    assert (res.req_id, res.replica_id) == (0, 0)
+    # the whole point: replica 1 must NOT see the ambiguous submit —
+    # replica 0 may have admitted it with only the reply lost
+    assert h1.submits == []
+    assert r.has_work  # park pends even with every queue empty
+    s = r.stats()
+    assert s["in_doubt_parks"] == 1 and s["in_doubt_pending"] == 1
+
+
+def test_refused_submit_is_unambiguous_and_retries_elsewhere():
+    h0, h1 = ScriptedHandle(0, ["refused"]), ScriptedHandle(1)
+    r = FleetRouter(handles=[h0, h1])
+    res = r.submit([1, 2, 3], 4)
+    assert isinstance(res, RemoteAdmit) and res.replica_id == 1
+    assert r.stats()["in_doubt_pending"] == 0
+    assert r.retries_elsewhere == 1
+
+
+def test_resolve_in_doubt_reoffers_same_req_to_pinned_replica():
+    h0 = ScriptedHandle(0, ["lost", "admit"])
+    r = FleetRouter(handles=[h0, ScriptedHandle(1)])
+    r.submit([1, 2, 3], 4, temperature=0.7)
+    r.resolve_in_doubt()
+    assert r.stats()["in_doubt_pending"] == 0 and not r.has_work
+    # same req_id, same sampling params: worker-side dedup (or fresh
+    # admit) makes the re-offer exactly-once either way
+    assert [req for req, _ in h0.submits] == [0, 0]
+    assert h0.submits[1][1]["temperature"] == 0.7
+
+
+def test_resolve_in_doubt_stays_parked_while_unreachable():
+    h0 = ScriptedHandle(0, ["lost", "refused"])
+    r = FleetRouter(handles=[h0, ScriptedHandle(1)])
+    r.submit([1, 2, 3], 4)
+    r.resolve_in_doubt()
+    assert r.stats()["in_doubt_pending"] == 1  # next tick tries again
+
+
+def test_resolve_in_doubt_backpressure_forces_normal_dispatch():
+    """A definitive Backpressure answer proves the original submit was
+    never admitted — the caller was already told 'admitted', so the
+    request re-enters dispatch with force=True (recovery is never
+    shed) and may land on ANY replica."""
+    h0 = ScriptedHandle(0, ["lost", "bp", "bp"])
+    h1 = ScriptedHandle(1)
+    r = FleetRouter(handles=[h0, h1])
+    r.submit([1, 2, 3], 4)
+    r.resolve_in_doubt()
+    assert r.stats()["in_doubt_pending"] == 0
+    req, kw = h1.submits[-1]
+    assert req == 0 and kw.get("force") is True
+
+
+def test_take_in_doubt_pops_only_the_dead_replicas_parks():
+    h0 = ScriptedHandle(0, ["lost"])
+    h1 = ScriptedHandle(1, ["lost", "refused"])  # stays unreachable
+    r = FleetRouter(handles=[h0, h1])
+    r.submit([1, 2, 3], 4)
+    h0.alive = False  # host died; its park awaits journal arbitration
+    r.submit([4, 5, 6], 4)  # dispatch skips dead h0 -> parks on h1
+    r.resolve_in_doubt()  # must not touch the dead replica's park
+    assert h0.submits == [(0, h0.submits[0][1])]  # only the original
+    taken = r.take_in_doubt(0)
+    assert [rec["req"] for rec in taken] == [0]
+    assert taken[0]["kind"] == "serve-submit"  # journal-shaped record
+    assert taken[0]["prompt"] == [1, 2, 3]
+    assert r.stats()["in_doubt_pending"] == 1  # h1's park remains

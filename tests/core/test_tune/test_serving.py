@@ -163,3 +163,44 @@ def test_serving_golden_pinned_and_detects_drift():
     doctored = dict(payload)
     doctored["ranked"] = list(reversed(payload["ranked"]))
     assert check_serve_golden(doctored, path)
+
+
+# ===================================================== placement axis
+def plan2x2(per_gb=0.0, hbm=float("inf")):
+    from scaling_tpu.tune.serving import HostCapacity, PlacementPlan
+    return PlacementPlan(
+        [HostCapacity(0, "tpu-a", 2, hbm), HostCapacity(1, "tpu-b", 2, hbm)],
+        per_replica_gb=per_gb,
+    )
+
+
+def test_placement_round_robins_least_loaded_lowest_id_ties():
+    plan = plan2x2()
+    assert plan.initial_assignment(3) == [0, 1, 0]
+    assert plan.next_host({0: 2, 1: 1}) == 1
+    assert plan.next_host({0: 2, 1: 2}) is None  # slot-bound full
+
+
+def test_placement_hbm_gate_binds_before_slots():
+    # 2 slots/host but only one 10GB replica fits in 15GB of HBM
+    plan = plan2x2(per_gb=10.0, hbm=15.0)
+    assert plan.feasible(0, 0) and not plan.feasible(0, 1)
+    assert plan.initial_assignment(2) == [0, 1]
+    with pytest.raises(ValueError, match="placement infeasible"):
+        plan.initial_assignment(3)
+
+
+def test_placement_from_pool_follows_hostsfile_order():
+    from scaling_tpu.tune.serving import PlacementPlan
+    plan = PlacementPlan.from_pool({"h0": 1, "h1": 3})
+    assert [(h.host_id, h.hostname, h.slots) for h in plan.hosts] \
+        == [(0, "h0", 1), (1, "h1", 3)]
+
+
+def test_placement_payload_reports_both_capacity_bounds():
+    rows = plan2x2(per_gb=10.0, hbm=15.0).to_payload()
+    assert rows[0]["max_replicas_by_memory"] == 1
+    assert rows[0]["max_replicas"] == 1  # min(slots=2, memory=1)
+    unbounded = plan2x2().to_payload()
+    assert unbounded[1]["hbm_gb"] is None
+    assert unbounded[1]["max_replicas"] == 2
