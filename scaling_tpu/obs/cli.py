@@ -53,11 +53,16 @@ def main(argv=None) -> int:
                         help="fail (exit 1) when the speculative-decoding "
                         "accept rate is below FLOOR, or the run recorded "
                         "no speculation telemetry (docs/SERVING.md)")
+    parser.add_argument("--assert-max-resizes", type=int, metavar="CEIL",
+                        help="fail (exit 1) when a supervised run resized "
+                        "(downsize OR elastic upsize) more than CEIL "
+                        "times, or the run dir holds no supervisor "
+                        "telemetry at all (docs/RESILIENCE.md elastic "
+                        "capacity); the flap drill's zero-churn gate")
     parser.add_argument("--assert-max-downsizes", type=int, metavar="CEIL",
-                        help="fail (exit 1) when a supervised run "
-                        "downsized more than CEIL times, or the run dir "
-                        "holds no supervisor telemetry at all "
-                        "(docs/RESILIENCE.md elastic resharding)")
+                        help="alias of --assert-max-resizes (predates "
+                        "elastic upsizing; counts BOTH directions so a "
+                        "flapping host cannot pass on a technicality)")
     parser.add_argument("--assert-max-shed-rate", type=float,
                         metavar="CEIL",
                         help="fail (exit 1) when the serving shed rate "
@@ -108,6 +113,7 @@ def main(argv=None) -> int:
         assert_ttft=args.assert_ttft,
         assert_spec_accept_rate=args.assert_spec_accept_rate,
         assert_max_downsizes=args.assert_max_downsizes,
+        assert_max_resizes=args.assert_max_resizes,
         assert_max_shed_rate=args.assert_max_shed_rate,
         assert_max_serve_timeouts=args.assert_max_serve_timeouts,
         assert_max_replica_skew=args.assert_max_replica_skew,
@@ -119,6 +125,7 @@ def main(argv=None) -> int:
             or args.assert_ttft is not None
             or args.assert_spec_accept_rate is not None
             or args.assert_max_downsizes is not None
+            or args.assert_max_resizes is not None
             or args.assert_max_shed_rate is not None
             or args.assert_max_serve_timeouts is not None
             or args.assert_max_replica_skew is not None

@@ -760,15 +760,15 @@ def serving_section(data: RunData) -> Tuple[List[str], Dict[str, float]]:
 
 def world_size_transitions(data: RunData) -> List[str]:
     """World-size transitions of an elastic run, as ``old->new`` labels:
-    supervisor ``downsize`` events (the replan decision) and trainer
-    ``ckpt-reshard`` events (a restore that actually crossed mesh
-    shapes). Deduplicated consecutively — N hosts restoring the same
-    transition is one transition."""
+    supervisor ``downsize`` / ``upsize`` events (the replan decisions,
+    both directions) and trainer ``ckpt-reshard`` events (a restore that
+    actually crossed mesh shapes). Deduplicated consecutively — N hosts
+    restoring the same transition is one transition."""
     out: List[str] = []
     for e in data.lifecycle:
-        if e.get("event") == "downsize":
+        if e.get("event") in ("downsize", "upsize"):
             label = (f"{e.get('old_world', '?')}->{e.get('new_world', '?')}"
-                     f" (downsize/{e.get('source', '?')})")
+                     f" ({e['event']}/{e.get('source', '?')})")
         elif e.get("event") == "ckpt-reshard":
             label = (f"{e.get('saved_world', '?')}->"
                      f"{e.get('restoring_world', '?')} (reshard "
@@ -802,6 +802,7 @@ def timeline_section(data: RunData) -> List[str]:
     )
     stalls = sum(1 for e in lifecycle if e["event"] == "step-stall")
     downsizes = sum(1 for e in lifecycle if e["event"] == "downsize")
+    upsizes = sum(1 for e in lifecycle if e["event"] == "upsize")
     totals = (
         f"  totals: restarts={restarts} preemptions={preempts} "
         f"stalls={stalls}"
@@ -810,6 +811,8 @@ def timeline_section(data: RunData) -> List[str]:
         # appended only for elastic runs so committed golden reports
         # from non-elastic runs stay byte-identical
         totals += f" downsizes={downsizes}"
+    if upsizes:
+        totals += f" upsizes={upsizes}"
     lines.append(totals)
     transitions = world_size_transitions(data)
     if transitions:
@@ -857,6 +860,7 @@ def check_gates(data: RunData, assert_mfu: Optional[float] = None,
                 assert_ttft: Optional[float] = None,
                 assert_spec_accept_rate: Optional[float] = None,
                 assert_max_downsizes: Optional[int] = None,
+                assert_max_resizes: Optional[int] = None,
                 assert_max_shed_rate: Optional[float] = None,
                 assert_max_serve_timeouts: Optional[int] = None,
                 assert_max_replica_skew: Optional[float] = None,
@@ -1010,26 +1014,38 @@ def check_gates(data: RunData, assert_mfu: Optional[float] = None,
                 f"(predicted {tstats['tuner_predicted_step_s']:.3f}s vs "
                 f"measured {tstats['tuner_measured_step_s']:.3f}s)"
             )
-    if assert_max_downsizes is not None:
+    if assert_max_resizes is not None or assert_max_downsizes is not None:
+        # one resize gate, both directions: ``--assert-max-downsizes``
+        # predates elastic upsizing and is kept as an alias with the
+        # same (resize-counting) semantics — a flapping host that
+        # churns the pod up AND down must not pass a downsize-only
+        # ceiling on a technicality. Tightest requested ceiling wins.
+        flag = ("assert-max-resizes" if assert_max_resizes is not None
+                else "assert-max-downsizes")
+        ceiling = min(
+            c for c in (assert_max_resizes, assert_max_downsizes)
+            if c is not None
+        )
         # the gate only means something for a SUPERVISED run: without
-        # supervisor lifecycle events the absence of downsize events is
+        # supervisor lifecycle events the absence of resize events is
         # silence, not health — missing data fails, like every gate
         supervised = any(
             e.get("event") == "epoch-start" for e in data.lifecycle
         )
-        downsizes = sum(
-            1 for e in data.lifecycle if e.get("event") == "downsize"
+        resizes = sum(
+            1 for e in data.lifecycle
+            if e.get("event") in ("downsize", "upsize")
         )
         if not supervised:
             failures.append(
-                "assert-max-downsizes: no supervisor telemetry in the run "
+                f"{flag}: no supervisor telemetry in the run "
                 "dir (no epoch-start events — was the run launched with "
                 "runner.supervise?)"
             )
-        elif downsizes > assert_max_downsizes:
+        elif resizes > ceiling:
             failures.append(
-                f"assert-max-downsizes: {downsizes} downsize(s) > ceiling "
-                f"{assert_max_downsizes} (world-size transitions: "
+                f"{flag}: {resizes} resize(s) > ceiling "
+                f"{ceiling} (world-size transitions: "
                 f"{', '.join(world_size_transitions(data)) or 'none'})"
             )
     if assert_mfu is not None:

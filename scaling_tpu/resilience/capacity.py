@@ -1,0 +1,737 @@
+"""Elastic capacity: auto size-back-up and train<->serve chip arbitration.
+
+The supervisor downsizes onto survivors (``runner.downsize_after``) but a
+pod that lost a host stays small forever unless an operator relaunches.
+This module closes the loop in both directions, riding the SAME file
+rails as the control plane (never ad-hoc sockets):
+
+- **Announcements** (`capacity/announce/<name>.json`): a restored or
+  standby host publishes ``{name, host, slots, incarnation}``. The
+  supervisor watches the channel with :class:`UpsizeTracker` hysteresis
+  — ``upsize_after`` CONSECUTIVE fresh observations of the SAME
+  incarnation are required before an upsize fires, mirroring
+  ``downsize_after`` on the way down. Every restore bumps the
+  incarnation, so a flapping host resets its own streak by construction
+  and can never churn the pod; a host that downsized the job must
+  re-prove itself from zero (the tracker resets on every downsize).
+- **Demand** (`capacity/demand.json`): the serving fleet heartbeats its
+  pool pressure / queue depth / replica count.
+- **Leases** (`capacity/lease-<host>.json`): the arbitration journal.
+  One :class:`CapacityManager` (supervisor-side) moves a host between
+  training and serving through an explicit state machine::
+
+      granted -> active -> reclaiming -> released
+
+  Sustained fleet pressure borrows a host from training (training
+  drains + downsizes, the lease is written ``granted``, the fleet's
+  placement planner spawns replicas there and marks it ``active``);
+  sustained fleet idle triggers a reclaim (``reclaiming``, the fleet
+  drains its replicas and writes ``released``, training upsizes). A
+  lease stuck in ``granted`` past ``lease_timeout_s`` — the client died
+  mid-handoff — is expired back to training, so a `capacity.lease`
+  chaos kill leaves no orphaned host. Cooldowns plus the
+  ``min_train_hosts`` / ``min_replicas`` floors bound the churn.
+
+Every transition lands as a journaled ``capacity-*`` event on the obs
+rails, and the three fault points ``capacity.upsize`` /
+``capacity.lease`` / ``capacity.reclaim`` (docs in :mod:`.faults`) let
+chaos drills kill or fail each leg mid-handoff.
+
+The channel lives at ``<control_root>/capacity`` — deliberately OUTSIDE
+the per-epoch control dirs the supervisor wipes at each relaunch, so
+announcements and leases survive coordinator epochs. Writers only ever
+replace whole files (same atomicity contract as
+:class:`~.controlplane.FileControlPlane`), and every backend op rides
+:func:`~.guards.retry_io`. Nothing here imports jax (resilience package
+rule).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..logging import logger
+from ..obs.spans import span
+from .faults import get_fault_plan
+from .guards import retry_io
+
+# default freshness horizon: an announcement or demand record older than
+# this is treated as withdrawn (the publisher stopped heartbeating)
+DEFAULT_STALE_S = 15.0
+
+LEASE_STATES = ("granted", "active", "reclaiming", "released")
+
+
+@dataclasses.dataclass(frozen=True)
+class HostOffer:
+    """One fresh capacity announcement, as observed by the supervisor."""
+
+    name: str  # announcement identity (unique per standby unit)
+    host: str  # hostname workers/replicas are spawned on
+    slots: int
+    incarnation: int
+    age_s: float
+
+
+@dataclasses.dataclass
+class FleetDemand:
+    """The serving fleet's newest demand heartbeat."""
+
+    pressure: float  # 0..1 pool pressure (max across alive replicas)
+    queue: int  # total queued requests across the fleet
+    replicas: int  # alive replica count
+    wall: float  # channel receipt stamp (reader's FS clock)
+
+    def age(self, now: Optional[float] = None) -> float:
+        return (now if now is not None else time.time()) - self.wall
+
+
+@dataclasses.dataclass
+class Lease:
+    """One host's position in the train<->serve handoff state machine."""
+
+    host: str
+    slots: int
+    state: str  # granted -> active -> reclaiming -> released
+    since: float  # wall time of the last state transition
+    epoch: int = 0  # training coordinator epoch at grant (diagnostics)
+    reason: str = ""  # why the newest transition happened
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def outstanding(self) -> bool:
+        """True while the host is NOT training's to use."""
+        return self.state in ("granted", "active", "reclaiming")
+
+
+class CapacityChannel:
+    """File rails for announcements, fleet demand, and the lease journal.
+
+    Layout under ``root`` (conventionally ``<control_root>/capacity``)::
+
+        announce/<name>.json   standby/restored capacity heartbeats
+        demand.json            fleet pressure heartbeat (atomic replace)
+        lease-<host>.json      arbitration journal, one file per host
+
+    Freshness is judged by file mtime — one clock (the FS server's) for
+    every record, same reasoning as the control plane's heartbeats.
+    Leases carry no freshness: the journal is durable state, and a
+    crashed participant is exactly what ``lease_timeout_s`` handles.
+    """
+
+    def __init__(self, root: Path | str):
+        self.root = Path(root)
+        (self.root / "announce").mkdir(parents=True, exist_ok=True)
+
+    # -- shared atomic write (same contract as FileControlPlane) -------
+    def _atomic_write(self, path: Path, text: str) -> None:
+        tmp = path.with_name(
+            f".{path.name}.tmp{os.getpid()}.{threading.get_ident()}"
+        )
+        tmp.write_text(text)
+        os.replace(tmp, path)
+
+    # -- announcements --------------------------------------------------
+    def announce(self, name: str, host: str, slots: int,
+                 incarnation: int) -> None:
+        """Publish (or refresh) one standby unit's availability. Callers
+        MUST bump ``incarnation`` on every restore — that is what makes
+        a flap reset its own hysteresis streak."""
+        rec = {"name": name, "host": host, "slots": int(slots),
+               "incarnation": int(incarnation)}
+        retry_io(
+            lambda: self._atomic_write(
+                self.root / "announce" / f"{name}.json", json.dumps(rec)
+            ),
+            what=f"capacity announce {name!r}",
+        )
+
+    def withdraw(self, name: str) -> None:
+        """Remove an announcement (the unit went away again)."""
+        def op():
+            try:
+                (self.root / "announce" / f"{name}.json").unlink()
+            except FileNotFoundError:
+                pass  # already consumed/withdrawn — the benign race
+
+        retry_io(op, what=f"capacity withdraw {name!r}")
+
+    # consume == withdraw; the separate name marks intent (the
+    # supervisor absorbed the capacity, the unit did not vanish)
+    consume = withdraw
+
+    def offers(self, stale_s: float = DEFAULT_STALE_S,
+               now: Optional[float] = None) -> Dict[str, HostOffer]:
+        """Every FRESH announcement, keyed by name. Stale files are left
+        in place (the publisher may resume heartbeating) but invisible."""
+        return retry_io(
+            lambda: self._offers_once(stale_s, now),
+            what="capacity offers read",
+        )
+
+    def _offers_once(self, stale_s: float,
+                     now: Optional[float]) -> Dict[str, HostOffer]:
+        now = now if now is not None else time.time()
+        out: Dict[str, HostOffer] = {}
+        for f in (self.root / "announce").glob("*.json"):
+            try:
+                rec = json.loads(f.read_text())
+                age = now - f.stat().st_mtime
+                if age > stale_s:
+                    continue
+                offer = HostOffer(
+                    name=str(rec["name"]), host=str(rec["host"]),
+                    slots=int(rec["slots"]),
+                    incarnation=int(rec["incarnation"]), age_s=age,
+                )
+                out[offer.name] = offer
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                # reader racing the writer's first publish — transient
+                logger.debug(f"unreadable announcement {f}: {e!r}")
+        return out
+
+    # -- fleet demand ---------------------------------------------------
+    def publish_demand(self, pressure: float, queue: int,
+                       replicas: int) -> None:
+        rec = {"pressure": float(pressure), "queue": int(queue),
+               "replicas": int(replicas)}
+        retry_io(
+            lambda: self._atomic_write(
+                self.root / "demand.json", json.dumps(rec)
+            ),
+            what="capacity demand publish",
+        )
+
+    def read_demand(self, stale_s: float = DEFAULT_STALE_S,
+                    now: Optional[float] = None) -> Optional[FleetDemand]:
+        def op():
+            f = self.root / "demand.json"
+            try:
+                rec = json.loads(f.read_text())
+                wall = f.stat().st_mtime
+            except FileNotFoundError:
+                return None
+            return FleetDemand(
+                pressure=float(rec["pressure"]), queue=int(rec["queue"]),
+                replicas=int(rec["replicas"]), wall=wall,
+            )
+
+        try:
+            demand = retry_io(op, what="capacity demand read")
+        except (ValueError, KeyError, TypeError) as e:
+            logger.debug(f"unreadable demand record: {e!r}")
+            return None
+        if demand is None:
+            return None
+        if demand.age(now) > stale_s:
+            return None  # the fleet stopped heartbeating — no demand
+        return demand
+
+    # -- lease journal --------------------------------------------------
+    def _lease_path(self, host: str) -> Path:
+        return self.root / f"lease-{host.replace('/', '_')}.json"
+
+    def write_lease(self, lease: Lease) -> None:
+        assert lease.state in LEASE_STATES, lease.state
+        retry_io(
+            lambda: self._atomic_write(
+                self._lease_path(lease.host), json.dumps(lease.to_dict())
+            ),
+            what=f"lease write {lease.host!r}",
+        )
+
+    def read_leases(self) -> Dict[str, Lease]:
+        return retry_io(self._read_leases_once, what="lease read")
+
+    def _read_leases_once(self) -> Dict[str, Lease]:
+        out: Dict[str, Lease] = {}
+        for f in self.root.glob("lease-*.json"):
+            try:
+                out_lease = Lease(**json.loads(f.read_text()))
+                out[out_lease.host] = out_lease
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                logger.debug(f"unreadable lease {f}: {e!r}")
+        return out
+
+    def clear_lease(self, host: str) -> None:
+        """Drop a lease the supervisor fully absorbed (post-upsize)."""
+        def op():
+            try:
+                self._lease_path(host).unlink()
+            except FileNotFoundError:
+                pass
+
+        retry_io(op, what=f"lease clear {host!r}")
+
+
+class TcpCapacityChannel(CapacityChannel):
+    """Capacity rails over the TCP control plane (no shared FS).
+
+    Same surface as the file channel; records live in the coordinator's
+    :class:`~.controlplane.TcpControlPlaneServer` under the ``cap_*``
+    ops. Freshness uses server receipt stamps translated into this
+    clock, exactly like heartbeat reads."""
+
+    def __init__(self, address: str):
+        # deliberately NOT calling super().__init__ — no directory
+        from .controlplane import TcpControlPlane
+
+        self._cp = TcpControlPlane(address, host_id=0, num_hosts=1)
+
+    def _put(self, kind: str, name: str, record: dict) -> None:
+        self._cp.capacity_set(kind, name, record)
+
+    def _list(self, kind: str) -> Tuple[List[dict], float]:
+        reply = self._cp.capacity_list(kind)
+        offset = time.time() - float(reply.get("now") or time.time())
+        return list(reply["records"]), offset
+
+    def _del(self, kind: str, name: str) -> None:
+        self._cp.capacity_del(kind, name)
+
+    def announce(self, name: str, host: str, slots: int,
+                 incarnation: int) -> None:
+        self._put("announce", name, {
+            "name": name, "host": host, "slots": int(slots),
+            "incarnation": int(incarnation),
+        })
+
+    def withdraw(self, name: str) -> None:
+        self._del("announce", name)
+
+    consume = withdraw
+
+    def offers(self, stale_s: float = DEFAULT_STALE_S,
+               now: Optional[float] = None) -> Dict[str, HostOffer]:
+        now = now if now is not None else time.time()
+        records, offset = self._list("announce")
+        out: Dict[str, HostOffer] = {}
+        for rec in records:
+            age = now - (float(rec["wall"]) + offset)
+            if age > stale_s:
+                continue
+            offer = HostOffer(
+                name=str(rec["name"]), host=str(rec["host"]),
+                slots=int(rec["slots"]),
+                incarnation=int(rec["incarnation"]), age_s=age,
+            )
+            out[offer.name] = offer
+        return out
+
+    def publish_demand(self, pressure: float, queue: int,
+                       replicas: int) -> None:
+        self._put("demand", "demand", {
+            "pressure": float(pressure), "queue": int(queue),
+            "replicas": int(replicas),
+        })
+
+    def read_demand(self, stale_s: float = DEFAULT_STALE_S,
+                    now: Optional[float] = None) -> Optional[FleetDemand]:
+        now = now if now is not None else time.time()
+        records, offset = self._list("demand")
+        if not records:
+            return None
+        rec = records[-1]
+        demand = FleetDemand(
+            pressure=float(rec["pressure"]), queue=int(rec["queue"]),
+            replicas=int(rec["replicas"]), wall=float(rec["wall"]) + offset,
+        )
+        return None if demand.age(now) > stale_s else demand
+
+    def write_lease(self, lease: Lease) -> None:
+        assert lease.state in LEASE_STATES, lease.state
+        self._put("lease", lease.host, lease.to_dict())
+
+    def read_leases(self) -> Dict[str, Lease]:
+        records, _ = self._list("lease")
+        out: Dict[str, Lease] = {}
+        for rec in records:
+            rec = {k: v for k, v in rec.items() if k != "wall"}
+            lease = Lease(**rec)
+            out[lease.host] = lease
+        return out
+
+    def clear_lease(self, host: str) -> None:
+        self._del("lease", host)
+
+
+# ---------------------------------------------------------- pure policy
+def classify_offers(
+    offers: Dict[str, HostOffer],
+    member_hosts: Set[str],
+    leases: Dict[str, Lease],
+) -> Dict[str, List[str]]:
+    """Split fresh announcements into candidate / member / leased names.
+
+    *member*: the announced hostname is already in the training pool
+    (operator noise or a confused host — never upsize on it). For
+    local slot-expansion pools pass ``member_hosts=set()``: there the
+    hostname is always "localhost" and every announced slot is real
+    additional capacity. *leased*: the hostname has an outstanding
+    lease — it is the FLEET's until released, invisible to the upsize
+    tracker. Pure function, mirrors :func:`..runner.supervise.classify_workers`.
+    """
+    out: Dict[str, List[str]] = {"candidate": [], "member": [], "leased": []}
+    for name, offer in offers.items():
+        lease = leases.get(offer.host)
+        if lease is not None and lease.outstanding():
+            out["leased"].append(name)
+        elif offer.host in member_hosts:
+            out["member"].append(name)
+        else:
+            out["candidate"].append(name)
+    for bucket in out.values():
+        bucket.sort()
+    return out
+
+
+class UpsizeTracker:
+    """Hysteresis for size-back-up: a candidate must be observed fresh
+    ``upsize_after`` CONSECUTIVE polls — same incarnation throughout —
+    before it may trigger an upsize.
+
+    Mirror image of ``downsize_after``'s consecutive-loss counter. The
+    incarnation rule is what makes flap immunity *structural* rather
+    than timing-dependent: a host that dies and re-announces bumps its
+    incarnation, so even a flap faster than the poll cadence (invisible
+    as an absence) resets the streak. Pure observation logic — no I/O,
+    no clocks — so the flap drill is a deterministic unit test."""
+
+    def __init__(self, upsize_after: int):
+        assert upsize_after >= 1
+        self.upsize_after = upsize_after
+        # name -> (incarnation, consecutive fresh observations)
+        self._streaks: Dict[str, Tuple[int, int]] = {}
+
+    def observe(self, candidates: Dict[str, HostOffer]) -> List[str]:
+        """Feed one poll's candidate offers; returns the names whose
+        streak just reached maturity (stable order)."""
+        matured: List[str] = []
+        for name in list(self._streaks):
+            if name not in candidates:
+                del self._streaks[name]  # absence resets the streak
+        for name, offer in candidates.items():
+            inc, count = self._streaks.get(name, (offer.incarnation, 0))
+            if inc != offer.incarnation:
+                count = 0  # a restore happened between polls: re-prove
+            count += 1
+            self._streaks[name] = (offer.incarnation, count)
+            if count >= self.upsize_after:
+                matured.append(name)
+        return sorted(matured)
+
+    def forget(self, name: str) -> None:
+        self._streaks.pop(name, None)
+
+    def reset(self) -> None:
+        """Every streak back to zero — called on each downsize so
+        capacity that just failed the job must re-prove itself."""
+        self._streaks.clear()
+
+
+@dataclasses.dataclass
+class ArbitrationPolicy:
+    """Knobs for the train<->serve arbiter (all times in seconds)."""
+
+    pressure_high: float = 0.5  # sustained pool pressure that borrows a host
+    idle_low: float = 0.05  # pressure below this with an empty queue = idle
+    sustain_s: float = 2.0  # how long pressure must hold before a lease
+    idle_sustain_s: float = 2.0  # how long idle must hold before a reclaim
+    cooldown_s: float = 5.0  # minimum gap between lease/reclaim decisions
+    lease_timeout_s: float = 30.0  # granted-but-never-activated expiry
+    min_train_hosts: int = 1  # training never lends below this
+    min_replicas: int = 1  # never reclaim the fleet below this
+
+
+class CapacityManager:
+    """Arbitrates one shared host pool between training and serving.
+
+    Same shape as the serving fleet's ``AutoscalePolicy``: ``decide``
+    is fed observations (the clock, the fleet's demand heartbeat, the
+    lease journal, training's world size) and returns at most one
+    action — all I/O, journaling, and fault injection stay with the
+    caller. Sustain windows and the cooldown are the only state."""
+
+    def __init__(self, policy: Optional[ArbitrationPolicy] = None):
+        self.policy = policy or ArbitrationPolicy()
+        self._pressure_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._last_action_at: Optional[float] = None
+
+    def note_action(self, now: float) -> None:
+        """Start the cooldown (the caller EXECUTED a decision)."""
+        self._last_action_at = now
+        self._pressure_since = None
+        self._idle_since = None
+
+    def _cooled(self, now: float) -> bool:
+        return (self._last_action_at is None
+                or now - self._last_action_at >= self.policy.cooldown_s)
+
+    def decide(
+        self,
+        now: float,
+        *,
+        demand: Optional[FleetDemand],
+        leases: Dict[str, Lease],
+        train_world: int,
+    ) -> Optional[tuple]:
+        """At most one of:
+
+        ``("expire", lease)`` — a ``granted`` lease the fleet never
+        activated within ``lease_timeout_s``: the client died
+        mid-handoff, the host goes straight back to training. Checked
+        first and exempt from the cooldown — an orphaned host is a
+        safety condition, not churn.
+
+        ``("reclaim", lease)`` — sustained fleet idle on an ``active``
+        lease, and the fleet would keep ``min_replicas`` without it.
+
+        ``("lease", demand)`` — sustained fleet pressure, training above
+        ``min_train_hosts``, and no lease already outstanding (one host
+        in flight at a time keeps the journal trivially arbitrable).
+        """
+        p = self.policy
+        for lease in leases.values():
+            if (lease.state == "granted"
+                    and now - lease.since > p.lease_timeout_s):
+                return ("expire", lease)
+        outstanding = [l for l in leases.values() if l.outstanding()]
+        if demand is None:
+            # no fleet heartbeat: demand is unknowable — never lease on
+            # silence, and let active leases ride (the timeout above
+            # only guards the granted-but-unclaimed window)
+            self._pressure_since = None
+            self._idle_since = None
+            return None
+        # sustain windows (explicit None checks: a window that opened at
+        # t=0.0 is falsy but very much open)
+        if demand.pressure >= p.pressure_high:
+            if self._pressure_since is None:
+                self._pressure_since = now
+        else:
+            self._pressure_since = None
+        if demand.pressure <= p.idle_low and demand.queue == 0:
+            if self._idle_since is None:
+                self._idle_since = now
+        else:
+            self._idle_since = None
+        if not self._cooled(now):
+            return None
+        active = [l for l in outstanding if l.state == "active"]
+        if (self._idle_since is not None
+                and now - self._idle_since >= p.idle_sustain_s
+                and active
+                and demand.replicas - 1 >= p.min_replicas):
+            return ("reclaim", active[0])
+        if (self._pressure_since is not None
+                and now - self._pressure_since >= p.sustain_s
+                and not outstanding
+                and train_world - 1 >= p.min_train_hosts):
+            return ("lease", demand)
+        return None
+
+
+# -------------------------------------------------- supervisor binding
+class SupervisorCapacity:
+    """The training supervisor's view of the capacity channel.
+
+    ``poll`` is called from the epoch monitor loop; it throttles itself,
+    feeds the hysteresis tracker, runs the arbiter, executes the
+    journal-only transitions (reclaim initiation, expiry) in place, and
+    returns the drain-requiring actions for the supervisor to execute
+    at a step boundary:
+
+    - ``("upsize", [HostOffer, ...])`` — announcements matured
+    - ``("upsize-release", Lease)`` — the fleet released a leased host
+    - ``("lease", FleetDemand)`` — the arbiter wants to lend a host
+    """
+
+    def __init__(
+        self,
+        channel: CapacityChannel,
+        *,
+        upsize_after: Optional[int] = None,
+        manager: Optional[CapacityManager] = None,
+        stale_s: float = DEFAULT_STALE_S,
+        poll_interval_s: float = 0.5,
+    ):
+        self.channel = channel
+        self.tracker = (
+            UpsizeTracker(upsize_after) if upsize_after is not None else None
+        )
+        self.manager = manager
+        self.stale_s = stale_s
+        self.poll_interval_s = poll_interval_s
+        self._next_poll = 0.0
+
+    def poll(self, now: float, *, member_hosts: Set[str],
+             train_world: int) -> Optional[tuple]:
+        if now < self._next_poll:
+            return None
+        self._next_poll = now + self.poll_interval_s
+        leases = self.channel.read_leases()
+        # fleet gave a host back: training takes it at the next boundary
+        for lease in leases.values():
+            if lease.state == "released":
+                return ("upsize-release", lease)
+        if self.manager is not None:
+            demand = self.channel.read_demand(self.stale_s, now=now)
+            act = self.manager.decide(
+                now, demand=demand, leases=leases, train_world=train_world,
+            )
+            if act is not None:
+                kind, obj = act
+                if kind == "expire":
+                    self._reclaim(obj, now, reason="expired",
+                                  to_state="released")
+                elif kind == "reclaim":
+                    self._reclaim(obj, now, reason="idle",
+                                  to_state="reclaiming")
+                else:  # lease — needs a training drain first
+                    return act
+        if self.tracker is not None:
+            offers = self.channel.offers(self.stale_s, now=now)
+            buckets = classify_offers(offers, member_hosts, leases)
+            matured = self.tracker.observe(
+                {n: offers[n] for n in buckets["candidate"]}
+            )
+            if matured:
+                get_fault_plan().fire(
+                    "capacity.upsize", path=",".join(matured)
+                )
+                return ("upsize", [offers[n] for n in matured])
+        return None
+
+    def _reclaim(self, lease: Lease, now: float, *, reason: str,
+                 to_state: str) -> None:
+        """Journal a reclaim initiation (idle) or an expiry (dead
+        client). ``capacity.reclaim`` fires BEFORE the journal write —
+        a chaos kill here leaves the lease in its prior state, which
+        either side can resume from (granted re-expires, active
+        re-reclaims)."""
+        get_fault_plan().fire("capacity.reclaim", path=f"{reason}:{lease.host}")
+        with span("capacity.reclaim", host=lease.host, reason=reason):
+            self.channel.write_lease(dataclasses.replace(
+                lease, state=to_state, since=now, reason=reason,
+            ))
+        logger.log_event(
+            "capacity-reclaim", host=lease.host, state=to_state,
+            reason=reason,
+        )
+        if self.manager is not None:
+            self.manager.note_action(now)
+
+    def grant(self, host: str, slots: int, *, epoch: int,
+              now: Optional[float] = None) -> Lease:
+        """Journal a lease grant (the drain already completed; training
+        no longer occupies ``host``). ``capacity.lease`` fires BEFORE
+        the write: a kill here means no lease exists — the caller keeps
+        the host and relaunches at full size, nothing orphaned."""
+        now = now if now is not None else time.time()
+        get_fault_plan().fire("capacity.lease", path=f"grant:{host}")
+        lease = Lease(host=host, slots=slots, state="granted", since=now,
+                      epoch=epoch, reason="pressure")
+        with span("capacity.grant", host=host, slots=slots):
+            self.channel.write_lease(lease)
+        logger.log_event(
+            "capacity-lease", host=host, slots=slots, state="granted",
+            epoch=epoch,
+        )
+        if self.manager is not None:
+            self.manager.note_action(now)
+        return lease
+
+    def absorb(self, action: tuple) -> None:
+        """Consume the channel state behind an EXECUTED upsize so it can
+        never retrigger: matured announcements are withdrawn, a
+        released lease is cleared from the journal."""
+        kind = action[0]
+        if kind == "upsize":
+            for offer in action[1]:
+                self.channel.consume(offer.name)
+                if self.tracker is not None:
+                    self.tracker.forget(offer.name)
+        elif kind == "upsize-release":
+            self.channel.clear_lease(action[1].host)
+        if self.manager is not None:
+            self.manager.note_action(time.time())
+
+    def on_downsize(self) -> None:
+        """A downsize happened: every upsize streak starts over (the
+        capacity that shrank the job must re-prove itself)."""
+        if self.tracker is not None:
+            self.tracker.reset()
+
+
+# -------------------------------------------------------- fleet binding
+class FleetCapacityClient:
+    """The serving fleet's side of the handoff.
+
+    The fleet loop calls :meth:`publish` every tick (self-throttled
+    demand heartbeat), spawns replicas on :meth:`granted` leases and
+    :meth:`activate`\\ s them, and on :meth:`reclaiming` leases drains
+    the host's replicas then :meth:`release`\\ s. All journal writes are
+    idempotent whole-file replaces — a crashed fleet repeats them
+    safely after relaunch."""
+
+    def __init__(self, channel: CapacityChannel, *,
+                 publish_interval_s: float = 0.5):
+        self.channel = channel
+        self.publish_interval_s = publish_interval_s
+        self._next_publish = 0.0
+
+    def publish(self, *, pressure: float, queue: int, replicas: int,
+                now: Optional[float] = None) -> None:
+        now = now if now is not None else time.time()
+        if now < self._next_publish:
+            return
+        self._next_publish = now + self.publish_interval_s
+        self.channel.publish_demand(pressure, queue, replicas)
+
+    def granted(self) -> List[Lease]:
+        return [l for l in self.channel.read_leases().values()
+                if l.state == "granted"]
+
+    def activate(self, lease: Lease,
+                 now: Optional[float] = None) -> Lease:
+        """granted -> active, AFTER the replica on the leased host came
+        up. ``capacity.lease`` fires before the write: a kill here
+        leaves the lease ``granted``, which the manager expires back to
+        training after ``lease_timeout_s`` — the crashed fleet cannot
+        strand the host."""
+        now = now if now is not None else time.time()
+        get_fault_plan().fire("capacity.lease", path=f"activate:{lease.host}")
+        out = dataclasses.replace(lease, state="active", since=now,
+                                  reason="activated")
+        with span("capacity.activate", host=lease.host):
+            self.channel.write_lease(out)
+        logger.log_event(
+            "capacity-lease", host=lease.host, slots=lease.slots,
+            state="active",
+        )
+        return out
+
+    def reclaiming(self) -> List[Lease]:
+        return [l for l in self.channel.read_leases().values()
+                if l.state == "reclaiming"]
+
+    def release(self, lease: Lease, now: Optional[float] = None) -> Lease:
+        """reclaiming -> released, AFTER the host's replicas drained."""
+        now = now if now is not None else time.time()
+        out = dataclasses.replace(lease, state="released", since=now,
+                                  reason="drained")
+        with span("capacity.release", host=lease.host):
+            self.channel.write_lease(out)
+        logger.log_event(
+            "capacity-lease", host=lease.host, slots=lease.slots,
+            state="released",
+        )
+        return out

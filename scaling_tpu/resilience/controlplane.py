@@ -354,6 +354,7 @@ class TcpControlPlaneServer:
         self._hb: Dict[int, dict] = {}
         self._barriers: Dict[str, set] = {}
         self._flags: Dict[str, str] = {}
+        self._capacity: Dict[str, Dict[str, dict]] = {}
         self._lock = threading.Lock()
         # stays raw: one-time server bind at startup — a port conflict
         # or bad address is a config error that must abort loudly, not
@@ -448,6 +449,27 @@ class TcpControlPlaneServer:
                 return {"ok": True}
             if op == "get_flag":
                 return {"ok": True, "value": self._flags.get(req["name"])}
+            # capacity rails (resilience.capacity.TcpCapacityChannel):
+            # kind-scoped key/value records — announcements, the fleet
+            # demand heartbeat, and the lease journal — receipt-stamped
+            # with the server clock like heartbeats, so staleness math
+            # never mixes publisher clocks
+            if op == "cap_set":
+                rec = dict(req["record"])
+                rec["wall"] = time.time()
+                self._capacity.setdefault(req["kind"], {})[req["name"]] = rec
+                return {"ok": True}
+            if op == "cap_list":
+                return {
+                    "ok": True,
+                    "records": list(
+                        self._capacity.get(req["kind"], {}).values()
+                    ),
+                    "now": time.time(),
+                }
+            if op == "cap_del":
+                self._capacity.get(req["kind"], {}).pop(req["name"], None)
+                return {"ok": True}
             return {"ok": False, "error": f"unknown op {op!r}"}
 
     def close(self) -> None:
@@ -526,6 +548,27 @@ class TcpControlPlane(ControlPlane):
 
     def get_flag(self, name: str) -> Optional[str]:
         return self._request({"op": "get_flag", "name": name})["value"]
+
+    # -- elastic-capacity records (resilience.capacity rails) -----------
+    # Sends live HERE, next to the server's dispatch table, so the
+    # STA013 contract check sees client and handler together; the
+    # capacity channel composes these instead of hand-rolling op dicts.
+    def capacity_set(self, kind: str, name: str, record: dict) -> None:
+        with span("cp.cap_set", kind=kind, key=name, level="debug"):
+            self._request({"op": "cap_set", "kind": kind, "name": name,
+                           "record": record})
+
+    def capacity_list(self, kind: str) -> dict:
+        """Reply dict: ``records`` (each stamped with server-receipt
+        ``wall``) plus ``now``, the server clock at read time — the pair
+        callers need to translate freshness into their own clock."""
+        with span("cp.cap_list", kind=kind, level="debug"):
+            reply = self._request({"op": "cap_list", "kind": kind})
+        return {"records": reply["records"], "now": reply["now"]}
+
+    def capacity_del(self, kind: str, name: str) -> None:
+        with span("cp.cap_del", kind=kind, key=name, level="debug"):
+            self._request({"op": "cap_del", "kind": kind, "name": name})
 
 
 # ------------------------------------------------------------- helpers

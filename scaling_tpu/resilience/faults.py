@@ -106,6 +106,29 @@ point               fired
                     host id in host mode) SIGKILLs exactly one replica —
                     or every replica of one host — mid-stream: the chaos
                     e2e's journal-exact failover drill
+``capacity.upsize``  supervisor-side, when announced capacity MATURES
+                    through the upsize hysteresis, before the drain is
+                    relayed (``resilience.capacity.SupervisorCapacity``);
+                    ``kill`` here dies between the decision and the
+                    coordinated save — the relaunched supervisor simply
+                    re-observes the still-announcing host
+``capacity.lease``  both sides of the train->serve handoff: before the
+                    supervisor's lease-grant journal write
+                    (``SupervisorCapacity.grant``, path ``grant:<host>``)
+                    and before the fleet's activation write
+                    (``FleetCapacityClient.activate``, path
+                    ``activate:<host>``). A kill at either write leaves
+                    the journal in the PRIOR state, which arbitrates the
+                    handoff: no grant -> training keeps the host;
+                    granted-but-never-active -> the manager expires the
+                    lease back to training after ``lease_timeout_s`` —
+                    no orphaned host either way
+``capacity.reclaim``  before the reclaim/expiry journal write
+                    (``reclaiming`` on sustained fleet idle, path
+                    ``idle:<host>``; ``released`` on a dead-client
+                    expiry, path ``expire:<host>``); a kill leaves the
+                    lease in its prior state, which either side resumes
+                    from (granted re-expires, active re-reclaims)
 ==================  =====================================================
 
 Spec grammar (comma list): ``point=action[@N][xM][@host=K][@epoch=E]``

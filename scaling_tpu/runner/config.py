@@ -143,7 +143,79 @@ class RunnerConfig(BaseConfig):
         "picked by comm cost (tune.best_layout over the surviving "
         "slots) rather than by naively shrinking dp. None skips the "
         "tuner and only shrinks the world (the payload topology, when "
-        "present, is still rewritten to the new world size)",
+        "present, is still rewritten to the new world size). The same "
+        "replan runs on elastic UPSIZES over the larger slot count",
+    )
+    upsize_after: Optional[int] = Field(
+        None,
+        description="elastic size-back-up (docs/RESILIENCE.md 'Elastic "
+        "capacity'): restored/standby capacity announcing itself on the "
+        "control plane's capacity channel must be observed healthy this "
+        "many CONSECUTIVE supervisor polls — same incarnation "
+        "throughout — before the supervisor drains at a step boundary "
+        "and relaunches over the larger host list (hysteresis "
+        "mirroring downsize_after; a flapping host can never churn the "
+        "pod, and capacity that downsized the job re-proves itself "
+        "from zero). The restart budget re-baselines per world size. "
+        "None disables auto upsizing",
+        ge=1,
+    )
+    capacity_stale_seconds: float = Field(
+        15.0,
+        description="a capacity announcement or fleet demand heartbeat "
+        "older than this is treated as withdrawn",
+        gt=0,
+    )
+    capacity_poll_seconds: float = Field(
+        0.5,
+        description="how often the supervisor reads the capacity "
+        "channel (upsize hysteresis counts in units of this poll)",
+        gt=0,
+    )
+    arbitrate: bool = Field(
+        False,
+        description="run the train<->serve CapacityManager: sustained "
+        "serving-fleet pressure on the capacity channel borrows a host "
+        "from training (lease), sustained fleet idle returns it "
+        "(reclaim). Lease state rides the capacity journal; see "
+        "docs/RESILIENCE.md 'Elastic capacity'",
+    )
+    min_train_hosts: int = Field(
+        1,
+        description="arbitration floor: training never lends a host "
+        "below this world size",
+        ge=1,
+    )
+    capacity_pressure_high: float = Field(
+        0.5,
+        description="fleet pool pressure at or above this, sustained "
+        "for capacity_sustain_seconds, triggers a lease",
+        ge=0,
+    )
+    capacity_sustain_seconds: float = Field(
+        2.0, description="how long fleet pressure must hold before a "
+        "host is leased", ge=0,
+    )
+    capacity_idle_seconds: float = Field(
+        2.0, description="how long fleet idle must hold before a leased "
+        "host is reclaimed", ge=0,
+    )
+    capacity_cooldown_seconds: float = Field(
+        5.0, description="minimum gap between arbitration decisions "
+        "(lease or reclaim)", ge=0,
+    )
+    lease_timeout_seconds: float = Field(
+        30.0,
+        description="a lease still 'granted' (never activated by the "
+        "fleet) after this long is expired back to training — the "
+        "no-orphaned-host guarantee when a client dies mid-handoff",
+        gt=0,
+    )
+    min_replicas: int = Field(
+        1,
+        description="arbitration floor: never reclaim the serving "
+        "fleet below this many replicas",
+        ge=0,
     )
 
 

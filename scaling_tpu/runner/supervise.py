@@ -40,14 +40,26 @@ Megatron-LM arxiv 2104.04473 — else a plain world shrink), rewrites the
 payload topology when one rides along, emits a ``downsize`` event on
 the obs rails, and relaunches: the workers resume through
 reshard-on-restore (``resilience.reshard``). The restart budget resets
-per world size. Restored capacity sizes back up through the same
-mechanism: relaunching the supervisor over the full host list restores
-the downsized checkpoint onto the bigger mesh.
+per world size.
+
+**Elastic upsizing** (``runner.upsize_after``, docs/RESILIENCE.md
+"Elastic capacity"): restored or standby capacity announces itself on
+the control root's capacity channel (:mod:`..resilience.capacity`);
+after ``upsize_after`` consecutive healthy observations the supervisor
+drains the pod at a step boundary through the coordinated-preemption
+save, replans the layout over the LARGER host list, and relaunches —
+reshard-on-restore grows the mesh, consumed-samples carry over
+skip/repeat-free, and the restart budget re-baselines just as downsize
+does. With ``runner.arbitrate`` the same channel carries train<->serve
+leases: sustained serving-fleet pressure borrows a host from training
+(drain, downsize, journaled lease grant), sustained idle returns it
+(the fleet drains its replicas, training upsizes).
 
 Every transition lands as a structured event (``logger.log_event``):
 ``epoch-start``, ``host-dead``, ``teardown-complete``, ``relaunch``,
 ``preempt-relay``, ``epoch-clean-exit``, ``epoch-stalled``,
-``downsize``, ``give-up``.
+``downsize``, ``upsize``, ``capacity-drain``, ``capacity-lease``,
+``capacity-reclaim``, ``give-up``.
 """
 
 from __future__ import annotations
@@ -62,6 +74,12 @@ from typing import Any, Dict, List, Optional
 
 from ..logging import logger
 from ..obs import span
+from ..resilience.capacity import (
+    ArbitrationPolicy,
+    CapacityChannel,
+    CapacityManager,
+    SupervisorCapacity,
+)
 from ..resilience.controlplane import (
     ABORT_FLAG,
     ENV_CONTROL_DIR,
@@ -291,13 +309,18 @@ def _run_epoch(
     control_root: Path,
     epoch: int,
     state: Dict[str, Any],
+    capacity: Optional[SupervisorCapacity] = None,
 ) -> int:
     """One coordinator epoch: spawn, monitor, and (on failure) tear down.
 
     Returns 0 on a clean epoch (training finished or coordinated
     preemption), non-zero when a host died/hung and the epoch was torn
     down. ``state["gone"]`` is left holding the worker indices this
-    epoch lost (empty on a clean epoch) — the downsize planner's input."""
+    epoch lost (empty on a clean epoch) — the downsize planner's input.
+    When ``capacity`` decides a resize/lease is due, the epoch is
+    drained exactly like a coordinated preemption (every host saves at
+    the same step boundary, exits 0) and the decision is left in
+    ``state["capacity"]`` for :func:`supervise_main` to execute."""
     epoch_dir = control_root / f"epoch-{epoch}"
     if epoch_dir.exists():
         # ephemeral coordination state from a PREVIOUS supervisor run
@@ -332,6 +355,7 @@ def _run_epoch(
     started = time.monotonic()
     preempt_broadcast = False
     state["gone"] = []
+    state["capacity"] = None
     while True:
         time.sleep(config.supervisor_poll_seconds)
         if state["preempted"] and not preempt_broadcast:
@@ -339,6 +363,38 @@ def _run_epoch(
             preempt_broadcast = True
             logger.log_event("preempt-relay", host="supervisor",
                              epoch=epoch)
+        if (capacity is not None and not preempt_broadcast
+                and state["capacity"] is None
+                # a worker that has not heartbeated THIS epoch may not
+                # even have its SIGTERM handler installed yet (still
+                # importing / restoring): draining now would kill it
+                # outright, fail the epoch, and lose the decision — the
+                # channel re-surfaces matured actions on every poll, so
+                # waiting for full coverage costs nothing
+                and len(cp.peer_heartbeats()) >= num_hosts):
+            try:
+                act = capacity.poll(
+                    time.time(),
+                    member_hosts=(
+                        set() if is_local_pool(pool) else set(pool)
+                    ),
+                    train_world=len(workers),
+                )
+            except Exception as e:
+                # the capacity channel must never take down a healthy
+                # epoch — a sick announcement dir or an injected fault
+                # skips this poll, training continues
+                logger.warning(f"capacity poll failed: {e!r}")
+                act = None
+            if act is not None:
+                # drain like a coordinated preemption: every host saves
+                # at the same step boundary and exits 0; the resize is
+                # executed between epochs
+                state["capacity"] = act
+                _relay_sigterm(procs, workers, encoded)
+                logger.log_event(
+                    "capacity-drain", epoch=epoch, action=act[0],
+                )
         rcs = [p.poll() for p in procs]
         if all(rc is not None for rc in rcs):
             if all(rc == 0 for rc in rcs):
@@ -458,13 +514,14 @@ def replan_layout(
 
 def _shrink_topology(topo: Dict[str, Any], new_slots: int
                      ) -> Optional[Dict[str, Any]]:
-    """Plain-shrink rewrite of a payload-carried topology: keep the
-    model axes (pp/cp/mp — shrinking those needs the tuner's validity
-    rules) and fold the lost capacity out of the data axis. Preserves
-    the saving run's global_batch_size when the new grid divides it
-    (gas grows — the data stream then continues skip/repeat-free at the
-    same per-step sample blocks); otherwise keeps gas and re-derives
-    gbs. None when the surviving slots cannot host the fixed axes."""
+    """Plain refit of a payload-carried topology to ``new_slots``: keep
+    the model axes (pp/cp/mp — changing those needs the tuner's
+    validity rules) and fold the capacity delta into the data axis,
+    shrink and GROW alike. Preserves the saving run's global_batch_size
+    when the new grid divides it (gas adjusts — the data stream then
+    continues skip/repeat-free at the same per-step sample blocks);
+    otherwise keeps gas and re-derives gbs. None when the new slots
+    cannot host the fixed axes."""
     try:
         pp = int(topo.get("pipe_parallel_size") or 1)
         cp = int(topo.get("context_parallel_size") or 1)
@@ -518,13 +575,25 @@ def plan_downsize(
     if not is_local_pool(new_pool):
         new_pool = {h: pool[h] for h, _ in survivors}
     new_slots = sum(new_pool.values())
+    replan, new_payload = _replan_payload(
+        config, new_slots, payload, direction="downsize"
+    )
+    return new_pool, plan_workers(new_pool), replan, new_payload
+
+
+def _replan_payload(
+    config: RunnerConfig, new_slots: int, payload: Any, *, direction: str
+) -> tuple:
+    """The resize tail shared by downsize and upsize: tuner replan over
+    the new slot count, then the payload-carried topology rewrite.
+
+    A payload-carried topology MUST be rewritten to the new world size
+    — relaunching 4 survivors into an 8-way mesh (or 8 hosts into a
+    4-way one) fails every epoch at startup and burns the fresh budget.
+    Tuner pick when available, else the plain dp refit."""
     replan = replan_layout(config, new_slots, payload)
     new_payload = payload
     if isinstance(payload, dict) and isinstance(payload.get("topology"), dict):
-        # a payload-carried topology MUST be rewritten to the new world
-        # size — relaunching 4 survivors into an 8-way mesh fails every
-        # downsized epoch at startup and burns the fresh budget. Tuner
-        # pick when available, else the plain dp shrink.
         new_topo = (
             replan["topology"] if replan is not None
             else _shrink_topology(payload["topology"], new_slots)
@@ -533,12 +602,212 @@ def plan_downsize(
             new_payload = {**payload, "topology": new_topo}
         else:
             logger.warning(
-                "downsize: the payload topology's pp*cp*mp does not fit "
-                f"{new_slots} surviving slot(s) and no tuner replan is "
+                f"{direction}: the payload topology's pp*cp*mp does not "
+                f"fit {new_slots} slot(s) and no tuner replan is "
                 "available; relaunching with the topology UNCHANGED — "
                 "set runner.downsize_model so the layout is replanned"
             )
+    return replan, new_payload
+
+
+def plan_upsize(
+    config: RunnerConfig,
+    pool: Dict[str, int],
+    additions: List[tuple],
+    payload: Any,
+) -> Optional[tuple]:
+    """The grown plan after capacity returned: merge ``additions``
+    (``(host, slots)`` pairs — matured announcements or a released
+    lease) into the pool, replan the layout over the larger slot count.
+
+    Local slot-expansion pools grow by adding slots to the local entry
+    (the fake-pod / single-machine mode); a remote hostname already in
+    the pool is skipped — it is running workers right now, there is
+    nothing to add. Returns ``(pool, workers, replan, payload)`` like
+    :func:`plan_downsize`, or None when nothing new would be added."""
+    new_pool = dict(pool)
+    added: List[str] = []
+    for host, slots in additions:
+        if host in new_pool:
+            if is_local_pool({host}):
+                new_pool[host] = new_pool[host] + max(int(slots), 1)
+                added.append(host)
+            continue  # remote member already planned: nothing to add
+        new_pool[host] = max(int(slots), 1)
+        added.append(host)
+    if not added:
+        return None
+    new_slots = sum(new_pool.values())
+    replan, new_payload = _replan_payload(
+        config, new_slots, payload, direction="upsize"
+    )
     return new_pool, plan_workers(new_pool), replan, new_payload
+
+
+def choose_lease_victim(
+    pool: Dict[str, int], workers: List[tuple], master_addr: str
+) -> tuple:
+    """``(worker_index, host, slots)`` training hands to the fleet on a
+    lease: the LAST worker, skipping the coordinator's host when any
+    other host exists (demoting the coordinator would force a
+    re-election for a voluntary lend). Local slot-expansion pools lend
+    one slot; remote pools lend the whole host with all its slots."""
+    local = is_local_pool(pool)
+    for idx in range(len(workers) - 1, -1, -1):
+        host = workers[idx][0]
+        if local or host != master_addr:
+            return idx, host, (1 if local else pool[host])
+    idx = len(workers) - 1
+    host = workers[idx][0]
+    return idx, host, (1 if local else pool[host])
+
+
+def resolve_master_addr(
+    pinned: Optional[str], pool: Dict[str, int], previous: str
+) -> str:
+    """Coordinator election across elastic resizes (downsize AND
+    upsize). The pinned ``runner.master_addr`` wins whenever it names a
+    CURRENT pool member — including a host that left and came back,
+    which is safe exactly because every epoch rendezvouses on a fresh
+    ``master_port`` (base + epoch): the returned host's stale
+    coordinator socket from its pre-downsize incarnation can never
+    capture the new epoch's rendezvous. When the pinned host is absent,
+    keep the PREVIOUS coordinator if it survived (election stability —
+    no pointless re-rendezvous churn), else elect the first pool
+    host."""
+    if pinned and pinned in pool:
+        return pinned
+    if previous in pool:
+        return previous
+    return next(iter(pool))
+
+
+def _build_capacity(
+    config: RunnerConfig, control_root: Path
+) -> Optional[SupervisorCapacity]:
+    """The supervisor's capacity rails, when elasticity is on. The
+    channel lives BESIDE the per-epoch control dirs (which are wiped on
+    every relaunch): announcements and leases must survive coordinator
+    epochs. The arbitration manager only exists under ``arbitrate`` —
+    upsize-only runs poll announcements but never lend a host."""
+    if config.upsize_after is None and not config.arbitrate:
+        return None
+    manager = None
+    if config.arbitrate:
+        manager = CapacityManager(ArbitrationPolicy(
+            pressure_high=config.capacity_pressure_high,
+            sustain_s=config.capacity_sustain_seconds,
+            idle_sustain_s=config.capacity_idle_seconds,
+            cooldown_s=config.capacity_cooldown_seconds,
+            lease_timeout_s=config.lease_timeout_seconds,
+            min_train_hosts=config.min_train_hosts,
+            min_replicas=config.min_replicas,
+        ))
+    return SupervisorCapacity(
+        CapacityChannel(control_root / "capacity"),
+        upsize_after=config.upsize_after,
+        manager=manager,
+        stale_s=config.capacity_stale_seconds,
+        poll_interval_s=config.capacity_poll_seconds,
+    )
+
+
+def _execute_capacity_action(
+    config: RunnerConfig,
+    capacity: SupervisorCapacity,
+    act: tuple,
+    epoch: int,
+    ctx: Dict[str, Any],
+) -> bool:
+    """Apply a drained capacity decision between epochs. ``ctx`` holds
+    the mutable plan (``pool``/``workers``/``payload``/``master_addr``)
+    and is updated in place; returns True when the world actually
+    resized (the caller re-baselines the restart budget)."""
+    pool, workers = ctx["pool"], ctx["workers"]
+    payload, master_addr = ctx["payload"], ctx["master_addr"]
+    if act[0] == "lease":
+        idx, lease_host, lease_slots = choose_lease_victim(
+            pool, workers, master_addr
+        )
+        plan = plan_downsize(config, pool, workers, [idx], payload)
+        if plan is None:
+            logger.warning(
+                "lease requested by the capacity arbiter but no viable "
+                f"smaller plan exists (min_hosts={config.min_hosts}); "
+                "relaunching at the current size"
+            )
+            capacity.absorb(act)  # start the cooldown — do not thrash
+            return False
+        try:
+            capacity.grant(lease_host, lease_slots, epoch=epoch)
+        except Exception as e:
+            # grant-before-shrink ordering is the no-orphan guarantee:
+            # a failed/killed grant write means NO lease exists, so
+            # training keeps the host and relaunches at full size —
+            # nothing is stranded between the two owners
+            logger.warning(
+                f"lease grant for {lease_host} failed ({e!r}); keeping "
+                "the host and relaunching at the current size"
+            )
+            capacity.absorb(act)
+            return False
+        old_world = len(workers)
+        pool, workers, replan, payload = plan
+        master_addr = resolve_master_addr(
+            config.master_addr, pool, master_addr
+        )
+        logger.log_event(
+            "downsize", epoch=epoch, old_world=old_world,
+            new_world=len(workers), removed_hosts=[lease_host],
+            layout=replan["label"] if replan else None,
+            predicted_step_s=(
+                replan["predicted_step_s"] if replan else None
+            ),
+            source="lease",
+        )
+        logger.warning(
+            f"leased {lease_host} ({lease_slots} slot(s)) to the serving "
+            f"fleet; pod {old_world} -> {len(workers)} host(s)"
+        )
+        capacity.on_downsize()
+    else:  # "upsize" (matured announcements) / "upsize-release" (lease)
+        additions = (
+            [(o.host, o.slots) for o in act[1]] if act[0] == "upsize"
+            else [(act[1].host, act[1].slots)]
+        )
+        plan = plan_upsize(config, pool, additions, payload)
+        if plan is None:
+            logger.warning(
+                f"upsize matured for {additions} but added no capacity; "
+                "relaunching unchanged"
+            )
+            capacity.absorb(act)
+            return False
+        old_world = len(workers)
+        pool, workers, replan, payload = plan
+        master_addr = resolve_master_addr(
+            config.master_addr, pool, master_addr
+        )
+        source = "announce" if act[0] == "upsize" else "lease-return"
+        logger.log_event(
+            "upsize", epoch=epoch, old_world=old_world,
+            new_world=len(workers),
+            added_hosts=sorted({h for h, _ in additions}),
+            layout=replan["label"] if replan else None,
+            predicted_step_s=(
+                replan["predicted_step_s"] if replan else None
+            ),
+            source=source,
+        )
+        logger.warning(
+            f"upsizing pod {old_world} -> {len(workers)} host(s) "
+            f"({source}); workers relaunch via reshard-on-restore"
+            + (f" into tuner layout {replan['label']}" if replan else "")
+        )
+        capacity.absorb(act)
+    ctx.update(pool=pool, workers=workers, payload=payload,
+               master_addr=master_addr)
+    return True
 
 
 def supervise_main(config: RunnerConfig, payload: Any) -> int:
@@ -555,6 +824,11 @@ def supervise_main(config: RunnerConfig, payload: Any) -> int:
     encoded = encode_payload(payload)
     control_root = Path(config.control_dir)
     control_root.mkdir(parents=True, exist_ok=True)
+
+    # the capacity channel lives BESIDE the per-epoch control dirs (which
+    # are wiped on every relaunch): announcements and leases must survive
+    # coordinator epochs
+    capacity = _build_capacity(config, control_root)
 
     # SIGTERM to the supervisor = coordinated preemption of the pod
     # (chained to any previously installed handler, like the trainer's)
@@ -579,11 +853,30 @@ def supervise_main(config: RunnerConfig, payload: Any) -> int:
         with span("supervisor.epoch", level="info", epoch=epoch) as ep:
             rc = _run_epoch(
                 config, pool, workers, encoded, master_addr, control_root,
-                epoch, state,
+                epoch, state, capacity,
             )
             ep.annotate(rc=rc)
         if rc == 0:
-            return 0
+            act = state.get("capacity")
+            if act is None or state["preempted"] or capacity is None:
+                return 0
+            ctx = {"pool": pool, "workers": workers, "payload": payload,
+                   "master_addr": master_addr}
+            resized = _execute_capacity_action(
+                config, capacity, act, epoch, ctx
+            )
+            if resized:
+                pool, workers, payload = (
+                    ctx["pool"], ctx["workers"], ctx["payload"]
+                )
+                master_addr = ctx["master_addr"]
+                encoded = encode_payload(payload)
+                consecutive_losses = 0
+                # a fresh budget for the new world size, exactly like
+                # downsize: the budget is PER world size
+                restarts = 0
+            epoch += 1
+            continue
         if state["preempted"]:
             # an operator-initiated shutdown that still lost a host is
             # not a reason to spin the pod back up
@@ -605,22 +898,27 @@ def supervise_main(config: RunnerConfig, payload: Any) -> int:
                 )
             else:
                 old_world = len(workers)
-                removed_hostnames = set(pool) - set(plan[0])
                 pool, workers, replan, payload = plan
                 encoded = encode_payload(payload)
-                master_addr = config.master_addr or list(pool)[0]
-                if master_addr in removed_hostnames:
-                    # a pinned master_addr naming a host the downsize
-                    # just removed would make every downsized epoch
-                    # rendezvous against the dead coordinator and burn
-                    # the fresh budget on guaranteed failures —
-                    # re-elect a survivor
-                    master_addr = list(pool)[0]
+                # a pinned master_addr naming a host the downsize just
+                # removed would make every downsized epoch rendezvous
+                # against the dead coordinator and burn the fresh
+                # budget on guaranteed failures — re-elect a survivor
+                # (resolve_master_addr re-adopts the pin if the host
+                # later returns through an upsize)
+                elected = resolve_master_addr(
+                    config.master_addr, pool, master_addr
+                )
+                if elected != master_addr:
                     logger.warning(
-                        f"downsize removed the pinned master_addr "
-                        f"({config.master_addr}); re-electing "
-                        f"{master_addr} as coordinator"
+                        f"downsize removed coordinator {master_addr}; "
+                        f"re-electing {elected}"
                     )
+                master_addr = elected
+                if capacity is not None:
+                    # the capacity that shrank the job must re-prove
+                    # itself: every upsize streak starts over
+                    capacity.on_downsize()
                 logger.log_event(
                     "downsize", epoch=epoch, old_world=old_world,
                     new_world=len(workers), removed_hosts=sorted(gone),

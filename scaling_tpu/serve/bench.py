@@ -710,6 +710,86 @@ def _run_fleet(args, infs, workload, journal_base, make_engine,
     )
 
 
+def _fleet_capacity_tick(client, sup, router, plan, host_of,
+                         leases, counters) -> None:
+    """One arbitration pass on the elastic capacity channel
+    (``--capacity-dir``, ``resilience.capacity``): heartbeat the
+    fleet's demand, take delivery of granted leases, give reclaimed
+    hosts back.
+
+    - **demand**: max pool pressure across alive replicas + total queue
+      depth — the signal the training-side ``CapacityManager`` sustains
+      over before borrowing or reclaiming a host.
+    - **granted**: admit the leased host into the placement plan, spawn
+      one replica pinned there, then mark the lease ``active``. A
+      failed spawn leaves the lease ``granted`` — retried next tick,
+      and expired back to training by the manager if the fleet dies.
+    - **reclaiming**: drain the host's replicas through the supervisor
+      (clean retire, journal harvested); once every one has actually
+      exited, write ``released`` and drop the host from the plan —
+      training upsizes back over it.
+    """
+    from ..logging import logger
+
+    alive = [h for h in router.replicas if h.alive and not h.retired]
+    pressure = max(
+        (float(h.last_stats.get("pool_pressure", 0.0)) for h in alive),
+        default=0.0,
+    )
+    queue = sum(int(h.last_stats.get("waiting", 0)) for h in alive)
+    client.publish(pressure=pressure, queue=queue, replicas=len(alive))
+    for lease in client.granted():
+        if lease.host in leases:
+            continue  # already spawning/active for this grant
+        hid = None
+        if plan is not None:
+            hid = plan.add_host(lease.host, lease.slots).host_id
+            # pin BEFORE the spawn so the placement closure lands the
+            # new replica on the leased host, not the least-loaded one
+            host_of[max(h.replica_id for h in router.replicas) + 1] = hid
+        rid = sup.spawn_replica()
+        if rid is None:
+            if plan is not None:
+                plan.remove_host(lease.host, lease.slots)
+            continue  # lease stays granted; retried next tick
+        try:
+            active = client.activate(lease)
+        except Exception as e:
+            # activation write failed (injected capacity.lease fault or
+            # sick channel): the replica must not squat on a host the
+            # manager will expire back to training — retire it now
+            logger.warning(
+                f"lease activation for {lease.host} failed ({e!r}); "
+                "draining the replica"
+            )
+            sup.drain_replica(rid, reason="capacity-activate-failed")
+            if plan is not None:
+                plan.remove_host(lease.host, lease.slots)
+            continue
+        leases[lease.host] = {"lease": active, "replicas": [rid]}
+        counters["activated"] += 1
+    for lease in client.reclaiming():
+        rec = leases.get(lease.host)
+        rids = list(rec["replicas"]) if rec else []
+        still_running = []
+        for rid in rids:
+            try:
+                h = router.replica(rid)
+            except (KeyError, ValueError):
+                continue
+            if h.alive and not h.retired:
+                sup.drain_replica(rid, reason="capacity-reclaim")
+            if h.proc.poll() is None:
+                still_running.append(rid)
+        if still_running:
+            continue  # release only after the host is actually clear
+        client.release(lease)
+        if plan is not None:
+            plan.remove_host(lease.host, lease.slots)
+        leases.pop(lease.host, None)
+        counters["released"] += 1
+
+
 def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
     """Process-isolated fleet mode (``--replicas-proc N``,
     docs/SERVING.md "Process mode"): every replica is a SUBPROCESS
@@ -888,6 +968,21 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
         restart_budget=args.restart_budget,
         policy=policy, on_drain=harvest,
     )
+    # ---- elastic capacity (--capacity-dir, docs/RESILIENCE.md
+    # "Elastic capacity") ---- the fleet joins the training
+    # supervisor's capacity channel: demand heartbeats feed the
+    # arbitration manager, granted leases spawn replicas on the
+    # borrowed host, reclaims drain them and hand the host back.
+    cap_client = None
+    cap_leases: dict = {}  # host -> {"lease": Lease, "replicas": [id]}
+    cap_counters = {"activated": 0, "released": 0}
+    if args.capacity_dir:
+        from ..resilience.capacity import CapacityChannel, FleetCapacityClient
+
+        cap_client = FleetCapacityClient(
+            CapacityChannel(Path(args.capacity_dir)),
+            publish_interval_s=args.capacity_publish_s,
+        )
     pending = sorted(workload, key=lambda w: w[0])
     idx = 0
     shed = 0
@@ -916,6 +1011,11 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
             if now - last_sup >= 0.05:
                 last_sup = now
                 sup.tick()
+                if cap_client is not None and not draining:
+                    _fleet_capacity_tick(
+                        cap_client, sup, router, plan, host_of,
+                        cap_leases, cap_counters,
+                    )
                 for h in router.replicas:
                     if h.alive and not h.retired:
                         harvest(h)
@@ -1120,6 +1220,12 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
         "submit_dups": submit_dups,
         "rpc_retries": rpc_retries,
     }
+    if cap_client is not None:
+        # the arbitration story: borrowed-host leases this fleet
+        # activated and handed back (docs/RESILIENCE.md)
+        stats["capacity_leases_activated"] = cap_counters["activated"]
+        stats["capacity_leases_released"] = cap_counters["released"]
+        stats["capacity_leases_open"] = len(cap_leases)
     if plan is not None:
         # the host-mode story: which hosts the plan expected vs which
         # actually rendezvoused (obs report's never-reported gate)
@@ -1328,6 +1434,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="with --replicas-proc: supervised "
                         "relaunches allowed per replica before the "
                         "supervisor gives it up")
+    parser.add_argument("--capacity-dir", metavar="DIR",
+                        help="with --replicas-proc: join the elastic "
+                        "capacity channel at DIR (the training "
+                        "supervisor's <control_dir>/capacity — "
+                        "docs/RESILIENCE.md \"Elastic capacity\"). The "
+                        "fleet heartbeats its pool pressure there; the "
+                        "training-side arbiter answers sustained "
+                        "pressure by LEASING a training host (the fleet "
+                        "spawns a replica on it and activates the "
+                        "lease) and reclaims it at sustained idle (the "
+                        "fleet drains that host's replicas, then "
+                        "releases)")
+    parser.add_argument("--capacity-publish-s", type=float, default=0.5,
+                        help="demand-heartbeat period on the capacity "
+                        "channel")
     parser.add_argument("--config", metavar="FILE",
                         help="tuner-emitted serving config (python -m "
                         "scaling_tpu.tune --serve --emit-config): its "

@@ -1141,31 +1141,50 @@ class FleetSupervisor:
             return
         action, target = decision
         if action == "spawn":
-            new_id = max(h.replica_id for h in self.router.replicas) + 1
-            try:
-                fresh = self.spawn_fn(new_id)
-            except OSError as e:
-                logger.warning(f"autoscale spawn failed: {e!r}")
-                return
-            self.router.add_replica(fresh)  # logs serve-replica-spawn
-            try:
-                fresh.refresh()
-            except ReplicaUnreachable:
-                pass
+            self.spawn_replica()
         elif action == "drain":
-            handle = self.router.replica(target)
-            logger.log_event(
-                "serve-replica-drain", replica=target,
-                host=handle.host_id, restarts=handle.restarts,
-            )
-            if self.on_drain is not None:
-                self.on_drain(handle)  # last poll while it still answers
-            # the policy only drains a replica with zero in-flight
-            # work, so drain + shutdown is an immediate clean exit
-            handle.begin_drain()
-            handle.request_shutdown()
-            handle.retired = True
-            handle.alive = False
+            self.drain_replica(target)
+
+    def spawn_replica(self) -> Optional[int]:
+        """Launch one more replica at the next free id (autoscale-up,
+        and the capacity arbiter's spawn-on-leased-host — the caller
+        pins the placement through its ``spawn_fn``). Returns the new
+        replica id, or None when the spawn failed."""
+        new_id = max(h.replica_id for h in self.router.replicas) + 1
+        try:
+            fresh = self.spawn_fn(new_id)
+        except OSError as e:
+            logger.warning(f"autoscale spawn failed: {e!r}")
+            return None
+        self.router.add_replica(fresh)  # logs serve-replica-spawn
+        try:
+            fresh.refresh()
+        except ReplicaUnreachable:
+            pass
+        return new_id
+
+    def drain_replica(self, target: int, reason: str = "autoscale") -> None:
+        """Retire one replica cleanly: drain event, last journal poll
+        through ``on_drain`` while it still answers RPCs, then
+        drain + shutdown. Shared by the autoscale policy's scale-down
+        and the capacity arbiter's reclaim (a leased host going back to
+        training must shed its replicas the same clean way)."""
+        handle = self.router.replica(target)
+        logger.log_event(
+            "serve-replica-drain", replica=target,
+            host=handle.host_id, restarts=handle.restarts,
+            reason=reason,
+        )
+        if self.on_drain is not None:
+            self.on_drain(handle)  # last poll while it still answers
+        # the autoscale policy only drains a replica with zero
+        # in-flight work, so drain + shutdown is an immediate clean
+        # exit; a capacity reclaim may drain with work queued — the
+        # worker finishes in-flight requests before exiting
+        handle.begin_drain()
+        handle.request_shutdown()
+        handle.retired = True
+        handle.alive = False
 
 
 def main(argv: Optional[List[str]] = None) -> int:

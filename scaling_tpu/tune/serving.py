@@ -439,6 +439,43 @@ class PlacementPlan:
                 return h
         raise KeyError(f"no host {host_id} in the placement plan")
 
+    def add_host(self, hostname: str, slots: int = 1,
+                 hbm_gb: float = float("inf")) -> HostCapacity:
+        """Admit a LEASED host into the plan mid-run (the elastic
+        capacity arbiter borrowed it from training —
+        ``resilience.capacity``): next free id, immediately eligible
+        for ``next_host`` placement. A hostname already planned gains
+        slots instead of a duplicate row (a second lease of the same
+        machine's remaining chips)."""
+        for i, h in enumerate(self.hosts):
+            if h.hostname == hostname:
+                grown = HostCapacity(
+                    h.host_id, h.hostname, h.slots + max(int(slots), 1),
+                    h.hbm_gb,
+                )
+                self.hosts[i] = grown
+                return grown
+        hid = max((h.host_id for h in self.hosts), default=-1) + 1
+        cap = HostCapacity(hid, hostname, max(int(slots), 1), hbm_gb)
+        self.hosts.append(cap)
+        return cap
+
+    def remove_host(self, hostname: str, slots: Optional[int] = None
+                    ) -> None:
+        """Give a leased host back (reclaim completed): drop its row, or
+        shrink it by ``slots`` when only part of the machine was leased.
+        Unknown hostnames are a no-op — release is idempotent."""
+        for i, h in enumerate(self.hosts):
+            if h.hostname != hostname:
+                continue
+            if slots is not None and h.slots > slots:
+                self.hosts[i] = HostCapacity(
+                    h.host_id, h.hostname, h.slots - slots, h.hbm_gb
+                )
+            else:
+                del self.hosts[i]
+            return
+
     def hostname(self, host_id: int) -> str:
         return self.host(host_id).hostname
 

@@ -148,6 +148,42 @@ def test_sta012_effect_via_callee_counts(tmp_path):
     assert len(f) == 1 and f[0].line == 12
 
 
+def test_sta012_lease_handoff_grant_then_bail_fires(tmp_path):
+    """The capacity lease-handoff shape (docs/RESILIENCE.md "Elastic
+    capacity"): the supervisor journals the grant (a shared effect the
+    fleet acts on) and then must reach the handoff rendezvous — bailing
+    between grant and barrier strands the fleet waiting on a host the
+    trainer still owns. Granting only after the divergence is clean:
+    nothing observable happened before the bail."""
+    hazard = (
+        "class Cp:\n"
+        "    num_hosts = 2\n"
+        "    def barrier(self, name): ...\n"
+        "\n"
+        "class Arbiter:\n"
+        "    def __init__(self, cp: Cp):\n"
+        "        self.cp = cp\n"
+        "    def grant(self, path):\n"
+        "        path.write_text('granted')\n"
+        "    def handoff(self, path, planned):\n"
+        "        self.grant(path)\n"
+        "        if not planned:\n"
+        "            return None\n"
+        "        self.cp.barrier('capacity-handoff')\n"
+        "        return True\n"
+    )
+    f = active(run(tmp_path / "bad", {"m.py": hazard}), "STA012")
+    assert len(f) == 1 and "'capacity-handoff'" in f[0].message
+    clean = hazard.replace(
+        "        self.grant(path)\n        if not planned:\n",
+        "        if not planned:\n",
+    ).replace(
+        "            return None\n",
+        "            return None\n        self.grant(path)\n",
+    )
+    assert active(run(tmp_path / "ok", {"m.py": clean}), "STA012") == []
+
+
 # ================================================================ STA013
 RPC = (
     "class Client:\n"
@@ -361,6 +397,31 @@ def test_sta014_ssh_wrapped_remote_spawn_is_inside_the_gate(tmp_path):
         "        return subprocess.Popen(['ssh', host, ' '.join(cmd)])\n"
     )
     assert active(run(tmp_path / "t2", {"runner/m.py": covered}),
+                  "STA014") == []
+
+
+def test_sta014_lease_activation_edge_is_inside_the_gate(tmp_path):
+    """The fleet half of the lease handoff: activating a borrowed host
+    is an RPC edge in resilience/ like any other — bare fires on both
+    gaps; the real shape (``capacity.lease`` fault point before the
+    state write, span around the send — resilience.capacity's
+    activation idiom) is covered."""
+    bare = COVERAGE.format(
+        methods="    def activate(self, host):\n"
+                "        return self.t.request(\n"
+                "            {'op': 'cap_set', 'name': host})\n"
+    )
+    f = active(run(tmp_path / "bare", {"resilience/m.py": bare}), "STA014")
+    assert len(f) == 1
+    assert "FaultPlan" in f[0].message and "obs.span" in f[0].message
+    covered = COVERAGE.format(
+        methods="    def activate(self, host):\n"
+                "        self.faults.fire('capacity.lease')\n"
+                "        with span('capacity.activate', host=host):\n"
+                "            return self.t.request(\n"
+                "                {'op': 'cap_set', 'name': host})\n"
+    )
+    assert active(run(tmp_path / "cov", {"resilience/m.py": covered}),
                   "STA014") == []
 
 

@@ -12,7 +12,10 @@ Spec keys: ``master_port``, ``num_hosts``, ``control_dir``, ``payload``
 (forwarded to multihost_script), plus optional supervisor knobs
 ``heartbeat_timeout`` / ``startup_grace`` / ``restart_budget`` /
 ``restart_backoff`` / ``worker_grace`` / ``downsize_after`` /
-``min_hosts``.
+``min_hosts`` and elastic-capacity knobs ``upsize_after`` /
+``capacity_poll`` / ``capacity_stale`` / ``arbitrate`` /
+``min_train_hosts`` / ``pressure_high`` / ``sustain`` / ``idle`` /
+``cooldown`` / ``lease_timeout`` / ``min_replicas``.
 """
 
 import json
@@ -44,6 +47,17 @@ def main() -> int:
         "worker_grace_seconds": spec.get("worker_grace", 5.0),
         "downsize_after": spec.get("downsize_after"),
         "min_hosts": spec.get("min_hosts", 1),
+        "upsize_after": spec.get("upsize_after"),
+        "capacity_poll_seconds": spec.get("capacity_poll", 0.2),
+        "capacity_stale_seconds": spec.get("capacity_stale", 15.0),
+        "arbitrate": spec.get("arbitrate", False),
+        "min_train_hosts": spec.get("min_train_hosts", 1),
+        "capacity_pressure_high": spec.get("pressure_high", 0.5),
+        "capacity_sustain_seconds": spec.get("sustain", 0.5),
+        "capacity_idle_seconds": spec.get("idle", 0.5),
+        "capacity_cooldown_seconds": spec.get("cooldown", 1.0),
+        "lease_timeout_seconds": spec.get("lease_timeout", 30.0),
+        "min_replicas": spec.get("min_replicas", 0),
     })
     return runner_main(config, payload=spec["payload"])
 

@@ -79,6 +79,19 @@ def main() -> int:
     losses_path = base / f"host{host_id}_losses.jsonl"
     result_path = base / f"host{host_id}_result.json"
 
+    # heartbeat implies drain-safe: the supervisor gates capacity drains
+    # on heartbeat coverage, so a SIGTERM may arrive any time after the
+    # first heartbeat — arm a handler BEFORE announcing liveness (the
+    # trainer's own handler, installed after the slow build, chains to
+    # this one and adopts anything it caught)
+    import signal as _signal
+
+    early_term = {"hit": False}
+    _signal.signal(
+        _signal.SIGTERM,
+        lambda signum, frame: early_term.__setitem__("hit", True),
+    )
+
     cp = controlplane_from_env()
     if cp is not None:
         # visible to the supervisor before the slow part (trainer build +
@@ -130,6 +143,10 @@ def main() -> int:
         batch_to_model_input=batch_to_model_input,
     )
     trainer.install_preemption_handler()
+    if early_term["hit"]:
+        # a drain landed during the build window: exit at the first
+        # boundary exactly as if it arrived one instant later
+        trainer._preempted = True
     if cp is not None:
         trainer.attach_control_plane(
             cp, barrier_timeout_s=float(spec.get("barrier_timeout", 30.0))

@@ -1,0 +1,462 @@
+"""Elastic-capacity units (ISSUE 19): the capacity channel's file and
+TCP rails, the pure upsize-decision pipeline (classify_offers +
+UpsizeTracker hysteresis, including the flap drill), the train<->serve
+CapacityManager (lease lifecycle, cooldown, floors, expiry), the
+supervisor/fleet bindings, and the ``capacity.*`` fault points'
+kill-mid-handoff semantics. Nothing here imports jax — every policy
+branch is driven with literal clocks."""
+
+import time
+
+import pytest
+
+from scaling_tpu.resilience.capacity import (
+    ArbitrationPolicy,
+    CapacityChannel,
+    CapacityManager,
+    FleetCapacityClient,
+    FleetDemand,
+    HostOffer,
+    Lease,
+    SupervisorCapacity,
+    TcpCapacityChannel,
+    UpsizeTracker,
+    classify_offers,
+)
+from scaling_tpu.resilience.faults import (
+    FaultPlan,
+    InjectedFault,
+    set_fault_plan,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    set_fault_plan(FaultPlan(""))
+    yield
+    set_fault_plan(None)
+
+
+@pytest.fixture(params=["file", "tcp"])
+def channel(request, tmp_path):
+    if request.param == "file":
+        yield CapacityChannel(tmp_path / "capacity")
+    else:
+        from scaling_tpu.resilience.controlplane import TcpControlPlaneServer
+
+        srv = TcpControlPlaneServer()
+        yield TcpCapacityChannel(srv.address)
+        srv.close()
+
+
+def _offer(name="standby-1", host="tpu-c", slots=2, incarnation=1, age=0.0):
+    return HostOffer(name=name, host=host, slots=slots,
+                     incarnation=incarnation, age_s=age)
+
+
+def _demand(pressure=0.9, queue=4, replicas=1, wall=None):
+    return FleetDemand(pressure=pressure, queue=queue, replicas=replicas,
+                       wall=wall if wall is not None else time.time())
+
+
+# ============================================================== channel
+def test_channel_announce_offers_withdraw(channel):
+    channel.announce("standby-1", "tpu-c", 2, incarnation=3)
+    channel.announce("standby-2", "tpu-d", 1, incarnation=1)
+    offers = channel.offers(stale_s=30.0)
+    assert set(offers) == {"standby-1", "standby-2"}
+    o = offers["standby-1"]
+    assert (o.host, o.slots, o.incarnation) == ("tpu-c", 2, 3)
+    assert o.age_s < 30.0
+    channel.withdraw("standby-1")
+    channel.withdraw("standby-1")  # idempotent
+    assert set(channel.offers(stale_s=30.0)) == {"standby-2"}
+
+
+def test_channel_stale_announcements_are_invisible(channel):
+    channel.announce("standby-1", "tpu-c", 2, incarnation=1)
+    # a reader far in the future sees the record as withdrawn
+    assert channel.offers(stale_s=5.0, now=time.time() + 60.0) == {}
+    # ...but the record is not deleted: a fresh read still finds it
+    assert set(channel.offers(stale_s=120.0)) == {"standby-1"}
+
+
+def test_channel_demand_roundtrip_and_staleness(channel):
+    assert channel.read_demand() is None
+    channel.publish_demand(0.75, 12, 3)
+    d = channel.read_demand(stale_s=30.0)
+    assert (d.pressure, d.queue, d.replicas) == (0.75, 12, 3)
+    assert channel.read_demand(stale_s=5.0, now=time.time() + 60.0) is None
+
+
+def test_channel_lease_journal_roundtrip(channel):
+    assert channel.read_leases() == {}
+    lease = Lease(host="tpu-b", slots=4, state="granted", since=123.0,
+                  epoch=7, reason="pressure")
+    channel.write_lease(lease)
+    got = channel.read_leases()["tpu-b"]
+    assert got == lease
+    # whole-file replace: a state transition overwrites, never appends
+    channel.write_lease(Lease(host="tpu-b", slots=4, state="active",
+                              since=124.0, epoch=7, reason="activated"))
+    assert channel.read_leases()["tpu-b"].state == "active"
+    channel.clear_lease("tpu-b")
+    assert channel.read_leases() == {}
+    with pytest.raises(AssertionError):
+        channel.write_lease(Lease(host="x", slots=1, state="bogus",
+                                  since=0.0))
+
+
+def test_file_channel_tolerates_torn_records(tmp_path):
+    ch = CapacityChannel(tmp_path)
+    ch.announce("ok", "tpu-c", 1, incarnation=1)
+    (tmp_path / "announce" / "torn.json").write_text('{"name": "to')
+    (tmp_path / "lease-ghost.json").write_text("not json")
+    assert set(ch.offers(stale_s=30.0)) == {"ok"}
+    assert ch.read_leases() == {}
+
+
+# ========================================================== pure policy
+def test_classify_offers_buckets():
+    offers = {
+        "a": _offer("a", host="tpu-new"),
+        "b": _offer("b", host="tpu-member"),
+        "c": _offer("c", host="tpu-lent"),
+        "d": _offer("d", host="tpu-returned"),
+    }
+    leases = {
+        "tpu-lent": Lease("tpu-lent", 1, "active", 0.0),
+        "tpu-returned": Lease("tpu-returned", 1, "released", 0.0),
+    }
+    out = classify_offers(offers, {"tpu-member"}, leases)
+    # a released lease is training's again: the offer is a candidate
+    assert out == {"candidate": ["a", "d"], "member": ["b"], "leased": ["c"]}
+    # local slot-expansion pools pass member_hosts=set(): every slot real
+    out = classify_offers(
+        {"a": _offer("a", host="localhost")}, set(), {},
+    )
+    assert out["candidate"] == ["a"]
+
+
+def test_upsize_tracker_matures_after_consecutive_observations():
+    t = UpsizeTracker(3)
+    c = {"a": _offer("a")}
+    assert t.observe(c) == []
+    assert t.observe(c) == []
+    assert t.observe(c) == ["a"]
+    assert t.observe(c) == ["a"]  # stays matured while present
+
+
+def test_upsize_tracker_absence_resets_streak():
+    t = UpsizeTracker(2)
+    assert t.observe({"a": _offer("a")}) == []
+    assert t.observe({}) == []  # one missed poll: start over
+    assert t.observe({"a": _offer("a")}) == []
+    assert t.observe({"a": _offer("a")}) == ["a"]
+
+
+def test_upsize_tracker_flap_drill_zero_matures():
+    """The flap drill's core invariant: a host dying and re-announcing
+    bumps its incarnation, so even flaps FASTER than the poll cadence
+    (never observed as an absence) reset the streak — the pod never
+    resizes, no matter how long the oscillation runs."""
+    t = UpsizeTracker(2)
+    matured = []
+    for inc in range(1, 20):  # every poll sees a fresh incarnation
+        matured += t.observe({"flappy": _offer("flappy", incarnation=inc)})
+    assert matured == []
+    # the moment the host holds still, maturity follows
+    assert t.observe({"flappy": _offer("flappy", incarnation=20)}) == []
+    assert t.observe({"flappy": _offer("flappy", incarnation=20)}) == [
+        "flappy"
+    ]
+
+
+def test_upsize_tracker_reset_forces_reproof():
+    t = UpsizeTracker(2)
+    t.observe({"a": _offer("a")})
+    t.reset()  # a downsize happened: re-prove from zero
+    assert t.observe({"a": _offer("a")}) == []
+    assert t.observe({"a": _offer("a")}) == ["a"]
+    t.forget("a")
+    assert t.observe({"a": _offer("a")}) == []
+
+
+# ====================================================== CapacityManager
+def _mgr(**kw):
+    kw.setdefault("sustain_s", 2.0)
+    kw.setdefault("idle_sustain_s", 2.0)
+    kw.setdefault("cooldown_s", 5.0)
+    kw.setdefault("lease_timeout_s", 30.0)
+    return CapacityManager(ArbitrationPolicy(**kw))
+
+
+def test_manager_leases_after_sustained_pressure():
+    m = _mgr()
+    hot = _demand(pressure=0.9, wall=0.0)
+    assert m.decide(0.0, demand=hot, leases={}, train_world=2) is None
+    assert m.decide(1.0, demand=hot, leases={}, train_world=2) is None
+    act = m.decide(2.5, demand=hot, leases={}, train_world=2)
+    assert act == ("lease", hot)
+    # a pressure dip resets the sustain window
+    m2 = _mgr()
+    m2.decide(0.0, demand=hot, leases={}, train_world=2)
+    m2.decide(1.0, demand=_demand(pressure=0.1), leases={}, train_world=2)
+    assert m2.decide(2.5, demand=hot, leases={}, train_world=2) is None
+
+
+def test_manager_floors_and_outstanding_lease_block_lending():
+    hot = _demand(pressure=0.9)
+    # min_train_hosts: training at the floor never lends
+    m = _mgr(min_train_hosts=2)
+    m.decide(0.0, demand=hot, leases={}, train_world=2)
+    assert m.decide(3.0, demand=hot, leases={}, train_world=2) is None
+    # one host in flight at a time: an outstanding lease blocks the next
+    m2 = _mgr()
+    leases = {"tpu-b": Lease("tpu-b", 1, "active", 0.0)}
+    m2.decide(0.0, demand=hot, leases=leases, train_world=3)
+    assert m2.decide(3.0, demand=hot, leases=leases, train_world=3) is None
+
+
+def test_manager_reclaims_after_sustained_idle_respecting_min_replicas():
+    idle = _demand(pressure=0.0, queue=0, replicas=3)
+    lease = Lease("tpu-b", 1, "active", 0.0)
+    m = _mgr(min_replicas=1)
+    assert m.decide(0.0, demand=idle, leases={"tpu-b": lease},
+                    train_world=1) is None
+    act = m.decide(2.5, demand=idle, leases={"tpu-b": lease}, train_world=1)
+    assert act == ("reclaim", lease)
+    # the fleet at its floor keeps the host even when idle
+    floor = _demand(pressure=0.0, queue=0, replicas=1)
+    m2 = _mgr(min_replicas=1)
+    m2.decide(0.0, demand=floor, leases={"tpu-b": lease}, train_world=1)
+    assert m2.decide(3.0, demand=floor, leases={"tpu-b": lease},
+                     train_world=1) is None
+    # no reclaim without an ACTIVE lease (granted = handoff in flight)
+    granted = {"tpu-b": Lease("tpu-b", 1, "granted", 0.0)}
+    m3 = _mgr()
+    m3.decide(0.0, demand=idle, leases=granted, train_world=1)
+    assert m3.decide(3.0, demand=idle, leases=granted, train_world=1) is None
+
+
+def test_manager_cooldown_gates_consecutive_actions():
+    m = _mgr(cooldown_s=10.0)
+    hot = _demand(pressure=0.9)
+    m.decide(0.0, demand=hot, leases={}, train_world=3)
+    assert m.decide(2.5, demand=hot, leases={}, train_world=3) is not None
+    m.note_action(2.5)  # the caller executed the lease
+    # windows cleared + cooldown: nothing fires even with pressure held
+    m.decide(3.0, demand=hot, leases={}, train_world=2)
+    assert m.decide(6.0, demand=hot, leases={}, train_world=2) is None
+    # pressure held through the cooldown re-filled the (restarted)
+    # window: the next lease fires the moment the cooldown expires
+    assert m.decide(13.0, demand=hot, leases={}, train_world=2) is not None
+    # but a window opened INSIDE the cooldown still needs its sustain
+    m2 = _mgr(cooldown_s=10.0)
+    m2.note_action(0.0)
+    m2.decide(9.5, demand=hot, leases={}, train_world=2)
+    assert m2.decide(10.5, demand=hot, leases={}, train_world=2) is None
+    assert m2.decide(11.5, demand=hot, leases={}, train_world=2) is not None
+
+
+def test_manager_expires_granted_lease_exempt_from_cooldown():
+    """A lease stuck in ``granted`` is a dead client mid-handoff: the
+    host must come back to training even inside the cooldown, and even
+    with no demand heartbeat at all (the fleet crashed)."""
+    m = _mgr(lease_timeout_s=30.0, cooldown_s=1000.0)
+    m.note_action(0.0)
+    stuck = Lease("tpu-b", 1, "granted", since=0.0)
+    assert m.decide(10.0, demand=None, leases={"tpu-b": stuck},
+                    train_world=1) is None
+    act = m.decide(31.0, demand=None, leases={"tpu-b": stuck}, train_world=1)
+    assert act == ("expire", stuck)
+    # an ACTIVE lease rides out fleet silence — the fleet owns the host
+    active = Lease("tpu-b", 1, "active", since=0.0)
+    assert m.decide(100.0, demand=None, leases={"tpu-b": active},
+                    train_world=1) is None
+
+
+# =================================================== SupervisorCapacity
+def _sup(tmp_path, *, upsize_after=2, manager=None, poll=0.0):
+    return SupervisorCapacity(
+        CapacityChannel(tmp_path / "capacity"),
+        upsize_after=upsize_after, manager=manager,
+        stale_s=30.0, poll_interval_s=poll,
+    )
+
+
+def test_supervisor_poll_matures_upsize_and_absorb_consumes(tmp_path):
+    cap = _sup(tmp_path, upsize_after=2)
+    cap.channel.announce("standby-1", "tpu-c", 2, incarnation=1)
+    assert cap.poll(0.0, member_hosts={"tpu-a"}, train_world=1) is None
+    act = cap.poll(1.0, member_hosts={"tpu-a"}, train_world=1)
+    assert act is not None and act[0] == "upsize"
+    assert [o.host for o in act[1]] == ["tpu-c"]
+    cap.absorb(act)  # consume: the announcement can never retrigger
+    assert cap.poll(2.0, member_hosts={"tpu-a", "tpu-c"}, train_world=2) \
+        is None
+    assert cap.channel.offers(30.0) == {}
+
+
+def test_supervisor_poll_throttles_and_skips_members(tmp_path):
+    cap = _sup(tmp_path, upsize_after=1, poll=10.0)
+    cap.channel.announce("standby-1", "tpu-a", 1, incarnation=1)
+    # member host: classified out, never an upsize
+    assert cap.poll(0.0, member_hosts={"tpu-a"}, train_world=1) is None
+    cap.channel.announce("standby-2", "tpu-c", 1, incarnation=1)
+    # inside the poll interval: no I/O, no decision
+    assert cap.poll(5.0, member_hosts={"tpu-a"}, train_world=1) is None
+    act = cap.poll(10.0, member_hosts={"tpu-a"}, train_world=1)
+    assert act is not None and [o.name for o in act[1]] == ["standby-2"]
+
+
+def test_supervisor_on_downsize_resets_streaks(tmp_path):
+    """The re-prove rule: capacity observed N-1 times before a downsize
+    must start over — the host that shrank the job does not get credit
+    for looking healthy while killing it."""
+    cap = _sup(tmp_path, upsize_after=2)
+    cap.channel.announce("standby-1", "tpu-c", 1, incarnation=1)
+    assert cap.poll(0.0, member_hosts=set(), train_world=2) is None
+    cap.on_downsize()
+    assert cap.poll(1.0, member_hosts=set(), train_world=1) is None
+    act = cap.poll(2.0, member_hosts=set(), train_world=1)
+    assert act is not None and act[0] == "upsize"
+
+
+def test_supervisor_grant_journals_lease_and_cooldown(tmp_path):
+    mgr = _mgr(cooldown_s=100.0)
+    cap = _sup(tmp_path, upsize_after=None, manager=mgr)
+    lease = cap.grant("tpu-b", 2, epoch=3, now=50.0)
+    assert lease.state == "granted" and lease.epoch == 3
+    got = cap.channel.read_leases()["tpu-b"]
+    assert got.state == "granted" and got.slots == 2
+    assert mgr._last_action_at == 50.0  # cooldown armed
+
+
+def test_supervisor_poll_returns_lease_action_on_pressure(tmp_path):
+    cap = _sup(tmp_path, upsize_after=None, manager=_mgr(sustain_s=0.0))
+    cap.channel.publish_demand(0.9, 8, 1)
+    act = cap.poll(time.time(), member_hosts={"a", "b"}, train_world=2)
+    assert act is not None and act[0] == "lease"
+    assert isinstance(act[1], FleetDemand)
+
+
+def test_supervisor_poll_executes_reclaim_in_place(tmp_path):
+    """Reclaim initiation is journal-only (no training drain): poll
+    writes ``reclaiming`` itself and returns nothing; the fleet drains
+    and releases; the NEXT poll surfaces the upsize-release action."""
+    now = time.time()
+    cap = _sup(tmp_path, upsize_after=None,
+               manager=_mgr(idle_sustain_s=0.0, cooldown_s=0.0))
+    cap.channel.write_lease(Lease("tpu-b", 1, "active", since=now - 60))
+    cap.channel.publish_demand(0.0, 0, 3)
+    assert cap.poll(now, member_hosts={"a"}, train_world=1) is None
+    assert cap.channel.read_leases()["tpu-b"].state == "reclaiming"
+    # fleet drained and released: training takes the host back
+    client = FleetCapacityClient(cap.channel)
+    client.release(cap.channel.read_leases()["tpu-b"])
+    act = cap.poll(now + 1.0, member_hosts={"a"}, train_world=1)
+    assert act is not None and act[0] == "upsize-release"
+    assert act[1].host == "tpu-b"
+    cap.absorb(act)
+    assert cap.channel.read_leases() == {}  # journal clean post-upsize
+
+
+def test_supervisor_poll_expires_stuck_grant(tmp_path):
+    now = time.time()
+    cap = _sup(tmp_path, upsize_after=None, manager=_mgr(lease_timeout_s=5.0))
+    cap.channel.write_lease(Lease("tpu-b", 1, "granted", since=now - 60))
+    assert cap.poll(now, member_hosts={"a"}, train_world=1) is None
+    assert cap.channel.read_leases()["tpu-b"].state == "released"
+    act = cap.poll(now + 1.0, member_hosts={"a"}, train_world=1)
+    assert act is not None and act[0] == "upsize-release"
+
+
+# ================================================== FleetCapacityClient
+def test_fleet_client_lease_lifecycle(tmp_path):
+    ch = CapacityChannel(tmp_path)
+    client = FleetCapacityClient(ch, publish_interval_s=10.0)
+    client.publish(pressure=0.9, queue=5, replicas=1, now=0.0)
+    # throttled: the second publish inside the interval is dropped
+    client.publish(pressure=0.1, queue=0, replicas=1, now=1.0)
+    assert ch.read_demand(stale_s=60.0).pressure == 0.9
+    ch.write_lease(Lease("tpu-b", 2, "granted", since=0.0))
+    [lease] = client.granted()
+    active = client.activate(lease, now=1.0)
+    assert active.state == "active"
+    assert ch.read_leases()["tpu-b"].state == "active"
+    assert client.granted() == []
+    ch.write_lease(Lease("tpu-b", 2, "reclaiming", since=2.0))
+    [rec] = client.reclaiming()
+    released = client.release(rec, now=3.0)
+    assert released.state == "released"
+    assert ch.read_leases()["tpu-b"].state == "released"
+
+
+# ========================================================= fault points
+def test_fault_point_capacity_upsize_fires_before_action(tmp_path):
+    set_fault_plan(FaultPlan("capacity.upsize=fail"))
+    cap = _sup(tmp_path, upsize_after=1)
+    cap.channel.announce("standby-1", "tpu-c", 1, incarnation=1)
+    with pytest.raises(InjectedFault):
+        cap.poll(0.0, member_hosts=set(), train_world=1)
+
+
+def test_fault_point_grant_kill_leaves_no_lease(tmp_path):
+    """The no-orphan ordering, supervisor side: ``capacity.lease``
+    fires BEFORE the grant journal write, so a kill/fail there means no
+    lease exists — training keeps the host; the fleet sees nothing."""
+    set_fault_plan(FaultPlan("capacity.lease=fail"))
+    cap = _sup(tmp_path, upsize_after=None, manager=_mgr())
+    with pytest.raises(InjectedFault):
+        cap.grant("tpu-b", 1, epoch=0)
+    assert cap.channel.read_leases() == {}
+
+
+def test_fault_point_activate_kill_leaves_lease_granted_then_expires(
+    tmp_path,
+):
+    """The no-orphan ordering, fleet side: a kill at activation leaves
+    the lease ``granted``; the manager's timeout expires it back to
+    training — the host is never stranded with a dead fleet."""
+    set_fault_plan(FaultPlan("capacity.lease=fail"))
+    ch = CapacityChannel(tmp_path)
+    client = FleetCapacityClient(ch)
+    ch.write_lease(Lease("tpu-b", 1, "granted", since=0.0))
+    with pytest.raises(InjectedFault):
+        client.activate(ch.read_leases()["tpu-b"], now=1.0)
+    assert ch.read_leases()["tpu-b"].state == "granted"
+    m = _mgr(lease_timeout_s=30.0)
+    act = m.decide(40.0, demand=None, leases=ch.read_leases(), train_world=1)
+    assert act == ("expire", ch.read_leases()["tpu-b"])
+
+
+def test_fault_point_reclaim_kill_leaves_prior_state(tmp_path):
+    """A kill at ``capacity.reclaim`` leaves the journal in the PRIOR
+    state: an active lease stays active (re-reclaimed next idle window),
+    a stuck grant stays granted (re-expired next poll) — both sides can
+    resume, nothing is lost."""
+    set_fault_plan(FaultPlan("capacity.reclaim=fail"))
+    now = time.time()
+    cap = _sup(tmp_path, upsize_after=None,
+               manager=_mgr(idle_sustain_s=0.0, cooldown_s=0.0))
+    cap.channel.write_lease(Lease("tpu-b", 1, "active", since=now - 60))
+    cap.channel.publish_demand(0.0, 0, 3)
+    with pytest.raises(InjectedFault):
+        cap.poll(now, member_hosts={"a"}, train_world=1)
+    assert cap.channel.read_leases()["tpu-b"].state == "active"
+    # retry succeeds once the injected fault is exhausted (xM=1 default)
+    assert cap.poll(now + 1.0, member_hosts={"a"}, train_world=1) is None
+    assert cap.channel.read_leases()["tpu-b"].state == "reclaiming"
+
+
+def test_fault_points_count_hits_when_unarmed(tmp_path):
+    plan = FaultPlan("")
+    set_fault_plan(plan)
+    cap = _sup(tmp_path, upsize_after=1)
+    cap.channel.announce("standby-1", "tpu-c", 1, incarnation=1)
+    act = cap.poll(0.0, member_hosts=set(), train_world=1)
+    assert act is not None
+    assert plan.hits("capacity.upsize") == 1
+    cap.grant("tpu-x", 1, epoch=0)
+    assert plan.hits("capacity.lease") == 1

@@ -21,6 +21,8 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
+import time
 from pathlib import Path
 
 import numpy as np
@@ -46,7 +48,13 @@ def free_port() -> int:
 
 def run_supervised(tmp_dir: Path, name: str, faults: str = "",
                    timeout: float = SCENARIO_TIMEOUT, *, num_hosts: int = 2,
-                   steps: int = 8, save_interval: int = 3, **spec_extra):
+                   steps: int = 8, save_interval: int = 3, actor=None,
+                   **spec_extra):
+    """``actor``, when given, runs in a daemon thread alongside the
+    supervised run — ``actor(workdir, proc)`` — playing the out-of-pod
+    participant an elastic scenario needs (a restored host announcing on
+    the capacity channel, a serving fleet heartbeating demand). It must
+    poll ``proc.poll() is None`` and return when the run exits."""
     workdir = tmp_dir / name
     spec = {
         "master_port": free_port(),
@@ -91,12 +99,19 @@ def run_supervised(tmp_dir: Path, name: str, faults: str = "",
         cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True, start_new_session=True,
     )
+    actor_thread = None
+    if actor is not None:
+        actor_thread = threading.Thread(
+            target=actor, args=(workdir, p), daemon=True)
+        actor_thread.start()
     try:
         stdout, stderr = p.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
         os.killpg(p.pid, signal.SIGKILL)
         p.wait(timeout=30)
         raise
+    if actor_thread is not None:
+        actor_thread.join(timeout=10)
     return subprocess.CompletedProcess(p.args, p.returncode, stdout, stderr), workdir
 
 
@@ -550,3 +565,309 @@ def test_hung_host_detected_by_stale_heartbeat_and_relaunched(baseline):
     assert dead and all(e["reason"] == "heartbeat-stale" for e in dead)
     assert all(0 in e["hosts"] for e in dead)
     assert any(e["event"] == "epoch-clean-exit" for e in events)
+
+
+@pytest.fixture(scope="module")
+def baseline12(baseline):
+    """Uninterrupted 12-step golden run for the elastic-capacity e2es
+    (their world-2 epochs need enough steps to commit checkpoints of
+    their own before the resize dance starts)."""
+    tmp, _ = baseline
+    p, workdir = run_supervised(tmp, "gold12", num_hosts=1, steps=12)
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-3000:]
+    gold = read_losses(workdir, 0)
+    assert sorted(gold) == list(range(1, 13))
+    return tmp, gold
+
+
+def _event_seen(tmp: Path, name: str, event: str) -> bool:
+    f = tmp / f"{name}_telemetry" / "events.jsonl"
+    try:
+        lines = f.read_text().splitlines()
+    except OSError:
+        return False
+    for line in lines:
+        try:
+            if json.loads(line).get("event") == event:
+                return True
+        except ValueError:
+            continue  # torn tail line mid-write
+    return False
+
+
+@pytest.mark.slow
+def test_upsize_restored_host_sizes_pod_back_up_loss_exact(baseline12):
+    """Elastic size-back-up e2e (ISSUE 19 tentpole): host 1 dies at its
+    5th loop entry in epochs 0 and 1 (``@epoch=`` scoped — the restored
+    capacity must NOT be re-killed later), the supervisor downsizes to 1
+    after ``downsize_after=2`` losses — and THEN the capacity comes
+    back: an out-of-pod actor announces the restored host on the
+    capacity channel with a stable incarnation. After ``upsize_after=3``
+    consecutive healthy observations the supervisor drains the
+    downsized epoch at a step boundary (coordinated-preemption save),
+    replans over the larger pool, and relaunches at world 2:
+    reshard-on-restore GROWS the mesh (1 -> 2), consumed samples carry
+    over skip/repeat-free, and the final losses are EXACT vs the
+    uninterrupted golden run. The run dir renders both world-size
+    transitions through ``obs report`` and passes/fails the generalized
+    ``--assert-max-resizes`` gate at 2/1.
+
+    Slow tier: five supervised epochs incl. the 12-step golden run."""
+    tmp, gold = baseline12
+
+    def restored_host(workdir, proc):
+        # the restored host: silent until after the downsize (a host
+        # that shrank the job must re-prove itself from OUTSIDE the
+        # pod), then a steady heartbeat with a FIXED incarnation until
+        # the supervisor acts on it
+        from scaling_tpu.resilience.capacity import CapacityChannel
+
+        while proc.poll() is None and not _event_seen(
+                tmp, "upsize", "downsize"):
+            time.sleep(0.1)
+        ch = CapacityChannel(workdir / "control" / "capacity")
+        # heartbeat until the upsize EXECUTES (not merely drains): a
+        # drained decision that could not be applied must find the
+        # announcement still there on the retry
+        while proc.poll() is None and not _event_seen(
+                tmp, "upsize", "upsize"):
+            ch.announce("standby-1", "localhost", 1, incarnation=1)
+            time.sleep(0.1)
+        ch.withdraw("standby-1")
+
+    p, workdir = run_supervised(
+        tmp, "upsize", steps=12,
+        faults=(
+            "host.kill=kill@5x*@host=1@epoch=0,"
+            "host.kill=kill@5x*@host=1@epoch=1"
+        ),
+        restart_budget=2, downsize_after=2, upsize_after=3,
+        actor=restored_host, timeout=420,
+    )
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-3000:]
+    # BOTH hosts finished the final full-size epoch — the restored
+    # capacity rejoined and ran to completion
+    for host in (0, 1):
+        result = read_result(workdir, host)
+        assert result["iterations"] == 12
+        assert result["epoch"] == 3  # 0,1 @ 2; 2 @ 1 (drained); 3 @ 2
+    # epoch 2 resumed from a checkpoint the 2-host world wrote
+    assert read_result(workdir, 0)["resumed_from"] >= 6
+    losses = read_losses(workdir, 0)
+    assert sorted(losses) == list(range(1, 13))
+    np.testing.assert_array_equal(
+        np.asarray([losses[s] for s in range(1, 13)]),
+        np.asarray([gold[s] for s in range(1, 13)]),
+    )
+    # the restored host's replayed steps are exact too (it missed the
+    # middle of the run, so only compare the steps it logged)
+    losses1 = read_losses(workdir, 1)
+    assert losses1
+    for s, v in losses1.items():
+        assert v == gold[s], f"host1 step {s}: {v} != {gold[s]}"
+
+    events = read_events(tmp, "upsize")
+    downs = [e for e in events if e["event"] == "downsize"]
+    assert len(downs) == 1
+    assert downs[0]["old_world"] == 2 and downs[0]["new_world"] == 1
+    ups = [e for e in events if e["event"] == "upsize"]
+    assert len(ups) == 1
+    assert ups[0]["old_world"] == 1 and ups[0]["new_world"] == 2
+    assert ups[0]["source"] == "announce"
+    assert ups[0]["added_hosts"] == ["localhost"]
+    drains = [e for e in events if e["event"] == "capacity-drain"]
+    assert [e["action"] for e in drains] == ["upsize"]
+    # reshard-on-restore engaged in BOTH directions
+    reshards = [
+        (e["saved_hosts"], e["restoring_hosts"])
+        for e in events if e["event"] == "ckpt-reshard"
+    ]
+    assert (2, 1) in reshards and (1, 2) in reshards
+    assert any(e["event"] == "epoch-clean-exit" for e in events)
+
+    from scaling_tpu.obs.cli import main as obs_main
+    from scaling_tpu.obs.report import load_run_dir, render_report
+
+    telemetry = tmp / "upsize_telemetry"
+    data = load_run_dir(telemetry)
+    assert data.bad_lines == 0, f"unparseable telemetry: {data.bad_lines}"
+    report = render_report(data, telemetry)
+    assert "world-size transitions:" in report
+    assert "2->1" in report and "1->2" in report
+    assert "downsizes=1" in report and "upsizes=1" in report
+    assert obs_main(
+        ["report", str(telemetry), "--assert-max-resizes", "2"]
+    ) == 0
+    assert obs_main(
+        ["report", str(telemetry), "--assert-max-resizes", "1"]
+    ) == 1
+    # the legacy flag is an alias counting BOTH directions
+    assert obs_main(
+        ["report", str(telemetry), "--assert-max-downsizes", "2"]
+    ) == 0
+    assert obs_main(
+        ["report", str(telemetry), "--assert-max-downsizes", "1"]
+    ) == 1
+
+
+@pytest.mark.slow
+def test_arbitration_serving_burst_borrows_and_returns_a_host(baseline12):
+    """Train<->serve arbitration e2e (ISSUE 19 tentpole): a fake serving
+    fleet rides the same capacity channel. Sustained fleet pressure
+    makes the arbiter lend a training host — drain at a step boundary,
+    journaled lease GRANT (grant-before-shrink: the no-orphan
+    guarantee), downsize with ``source="lease"`` — and sustained fleet
+    idle returns it: journal-only reclaim, fleet releases, training
+    upsizes with ``source="lease-return"``. A ``capacity.lease`` fault
+    kills the FIRST handoff mid-grant: no lease may exist afterwards
+    (training keeps the host, relaunches at full size) and the arbiter
+    retries after its cooldown — kill-mid-handoff leaves no orphaned
+    host on either side. Final losses EXACT vs the uninterrupted
+    golden; the lease journal is empty at exit.
+
+    Slow tier: five supervised epochs (the injected grant failure adds
+    a full-size relaunch before the real handoff)."""
+    tmp, gold = baseline12
+    handoff = {"activated": 0, "released": 0}
+
+    def fleet(workdir, proc):
+        from scaling_tpu.resilience.capacity import (
+            CapacityChannel,
+            FleetCapacityClient,
+        )
+
+        ch = CapacityChannel(workdir / "control" / "capacity")
+        client = FleetCapacityClient(ch, publish_interval_s=0.0)
+        # let training make real progress before the burst
+        losses = workdir / "host0_losses.jsonl"
+        while proc.poll() is None and not losses.is_file():
+            time.sleep(0.1)
+        lease = None
+        while proc.poll() is None and lease is None:
+            client.publish(pressure=0.9, queue=8, replicas=1)
+            granted = client.granted()
+            lease = granted[0] if granted else None
+            time.sleep(0.1)
+        if lease is None:
+            return
+        lease = client.activate(lease)
+        handoff["activated"] += 1
+        # burst over: sustained idle until the arbiter reclaims
+        back = None
+        while proc.poll() is None and back is None:
+            client.publish(pressure=0.0, queue=0, replicas=1)
+            reclaiming = client.reclaiming()
+            back = reclaiming[0] if reclaiming else None
+            time.sleep(0.1)
+        if back is not None:
+            client.release(back)
+            handoff["released"] += 1
+
+    p, workdir = run_supervised(
+        tmp, "arb", steps=16, arbitrate=True, min_train_hosts=1,
+        sustain=0.3, idle=0.3, cooldown=0.5,
+        faults="capacity.lease=fail@1",
+        restart_budget=2, actor=fleet, timeout=420,
+    )
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-3000:]
+    assert handoff == {"activated": 1, "released": 1}
+    for host in (0, 1):
+        result = read_result(workdir, host)
+        assert result["iterations"] == 16
+    losses = read_losses(workdir, 0)
+    assert sorted(losses) == list(range(1, 17))
+    gold16 = {}
+    p0, golddir = run_supervised(tmp, "arb_gold", num_hosts=1, steps=16)
+    assert p0.returncode == 0, p0.stdout[-3000:] + p0.stderr[-3000:]
+    gold16 = read_losses(golddir, 0)
+    np.testing.assert_array_equal(
+        np.asarray([losses[s] for s in range(1, 17)]),
+        np.asarray([gold16[s] for s in range(1, 17)]),
+    )
+
+    events = read_events(tmp, "arb")
+    downs = [e for e in events if e["event"] == "downsize"]
+    assert len(downs) == 1
+    assert downs[0]["source"] == "lease"
+    assert downs[0]["old_world"] == 2 and downs[0]["new_world"] == 1
+    assert downs[0]["removed_hosts"] == ["localhost"]
+    ups = [e for e in events if e["event"] == "upsize"]
+    assert len(ups) == 1
+    assert ups[0]["source"] == "lease-return"
+    assert ups[0]["old_world"] == 1 and ups[0]["new_world"] == 2
+    # the killed first handoff: TWO lease drains, ONE downsize — the
+    # failed grant left no lease, training kept the host
+    drains = [e["action"] for e in events
+              if e["event"] == "capacity-drain"]
+    assert drains.count("lease") == 2
+    assert drains.count("upsize-release") == 1
+    grants = [e for e in events if e["event"] == "capacity-lease"]
+    assert [e["state"] for e in grants] == ["granted"]
+    reclaims = [e for e in events if e["event"] == "capacity-reclaim"]
+    assert len(reclaims) == 1 and reclaims[0]["reason"] == "idle"
+
+    # no orphaned lease survives the round trip
+    from scaling_tpu.resilience.capacity import CapacityChannel
+
+    assert CapacityChannel(workdir / "control" / "capacity") \
+        .read_leases() == {}
+
+    from scaling_tpu.obs.cli import main as obs_main
+    from scaling_tpu.obs.report import load_run_dir, render_report
+
+    telemetry = tmp / "arb_telemetry"
+    data = load_run_dir(telemetry)
+    assert data.bad_lines == 0, f"unparseable telemetry: {data.bad_lines}"
+    report = render_report(data, telemetry)
+    assert "2->1" in report and "1->2" in report
+    assert obs_main(
+        ["report", str(telemetry), "--assert-max-resizes", "2"]
+    ) == 0
+    assert obs_main(
+        ["report", str(telemetry), "--assert-max-resizes", "1"]
+    ) == 1
+
+
+def test_flapping_host_never_churns_the_pod(baseline):
+    """Flap drill (ISSUE 19 tentpole): a host that oscillates faster
+    than the hysteresis window — every announcement carries a BUMPED
+    incarnation, i.e. the unit restarted between observations — must
+    produce ZERO resizes. The streak resets on every incarnation
+    change, so the announcement can never mature no matter how long it
+    flaps. The run completes undisturbed at full size, loss-exact, and
+    the zero-churn gate ``--assert-max-resizes 0`` passes."""
+    tmp, gold = baseline
+
+    def flapper(workdir, proc):
+        from scaling_tpu.resilience.capacity import CapacityChannel
+
+        ch = CapacityChannel(workdir / "control" / "capacity")
+        incarnation = 0
+        while proc.poll() is None:
+            incarnation += 1
+            ch.announce("flappy", "localhost", 1, incarnation=incarnation)
+            time.sleep(0.05)
+
+    p, workdir = run_supervised(
+        tmp, "flap", upsize_after=3, actor=flapper,
+    )
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-3000:]
+    for host in (0, 1):
+        result = read_result(workdir, host)
+        assert result["iterations"] == 8
+        losses = read_losses(workdir, host)
+        np.testing.assert_array_equal(
+            np.asarray([losses[s] for s in range(1, 9)]),
+            np.asarray([gold[s] for s in range(1, 9)]),
+        )
+    events = read_events(tmp, "flap")
+    assert not [e for e in events if e["event"] in
+                ("downsize", "upsize", "capacity-drain")]
+    assert any(e["event"] == "epoch-clean-exit" for e in events)
+
+    from scaling_tpu.obs.cli import main as obs_main
+
+    assert obs_main([
+        "report", str(tmp / "flap_telemetry"),
+        "--assert-max-resizes", "0",
+    ]) == 0

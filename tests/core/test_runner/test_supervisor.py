@@ -233,6 +233,77 @@ def test_epoch_stall_drain_is_not_success(tmp_path, monkeypatch):
     assert supervise._run_epoch(*args, 1, {"preempted": False}) == 0
 
 
+def test_capacity_drain_waits_for_full_heartbeat_coverage(
+    tmp_path, monkeypatch
+):
+    """A matured capacity action must NOT drain an epoch before every
+    worker has heartbeated: a worker still importing/restoring has no
+    SIGTERM handler installed, so the relay would kill it outright —
+    failing the epoch and losing the decision. The poll is gated on
+    full heartbeat coverage; the channel re-surfaces matured actions on
+    every poll, so the drain just lands a tick later."""
+    import json
+
+    from scaling_tpu.runner import supervise
+
+    events = tmp_path / "events.jsonl"
+    monkeypatch.setenv("SCALING_TPU_EVENTS_PATH", str(events))
+    control_root = tmp_path / "cp"
+
+    class Worker:
+        pid = 777
+
+        def __init__(self):
+            self.rc = None
+            self.polls = 0
+            self.terminated = False
+
+        def poll(self):
+            self.polls += 1
+            if self.polls == 6:
+                # the worker comes up mid-epoch: first heartbeat
+                FileControlPlane(
+                    control_root / "epoch-0", 0, 1
+                ).heartbeat(0, status="starting")
+            return self.rc
+
+        def terminate(self):
+            self.terminated = True
+            self.rc = 0  # drains at the boundary like a real worker
+
+    worker = Worker()
+    monkeypatch.setattr(supervise, "spawn_worker", lambda *a, **k: worker)
+
+    class AlwaysMatured:
+        def __init__(self):
+            self.first_poll_at = None
+
+        def poll(self, now, *, member_hosts, train_world):
+            if self.first_poll_at is None:
+                self.first_poll_at = worker.polls
+            return ("upsize", ["standby-1"])
+
+    capacity = AlwaysMatured()
+    config = RunnerConfig.from_dict({
+        "hosts": ["localhost"], "supervise": True,
+        "control_dir": str(control_root), "supervisor_poll_seconds": 0.01,
+    })
+    state = {"preempted": False}
+    rc = supervise._run_epoch(
+        config, {"localhost": 1}, [("localhost", 0)], "payload",
+        "localhost", control_root, 0, state, capacity,
+    )
+    assert rc == 0
+    assert worker.terminated
+    # the decision survived the epoch for supervise_main to execute
+    assert state["capacity"] == ("upsize", ["standby-1"])
+    # the capacity channel was never even polled before coverage
+    assert capacity.first_poll_at >= 6
+    recs = [json.loads(l) for l in events.read_text().splitlines()]
+    drains = [r for r in recs if r["event"] == "capacity-drain"]
+    assert len(drains) == 1 and drains[0]["action"] == "upsize"
+
+
 def test_teardown_escalates_sigterm_to_sigkill(tmp_path):
     """A worker that ignores SIGTERM (wedged collective) must be
     SIGKILLed after the grace period; a cooperative worker dies on
@@ -359,7 +430,7 @@ def test_supervise_main_downsizes_after_consecutive_losses(
     seen = []
 
     def fake_run_epoch(config, pool, workers, encoded, master_addr,
-                       control_root, epoch, state):
+                       control_root, epoch, state, capacity=None):
         seen.append(list(workers))
         if len(workers) > 1:
             state["gone"] = [1]  # worker 1 dies every epoch at full size
@@ -401,7 +472,7 @@ def test_supervise_main_stall_drains_do_not_count_toward_downsize(
     calls = {"n": 0}
 
     def fake_run_epoch(config, pool, workers, encoded, master_addr,
-                       control_root, epoch, state):
+                       control_root, epoch, state, capacity=None):
         calls["n"] += 1
         state["gone"] = []
         return 1 if calls["n"] <= 2 else 0  # two stalls, then clean
@@ -468,7 +539,7 @@ def test_downsize_reelects_master_when_pinned_addr_is_removed(
     masters = []
 
     def fake_run_epoch(config, pool, workers, encoded, master_addr,
-                       control_root, epoch, state):
+                       control_root, epoch, state, capacity=None):
         masters.append(master_addr)
         if "tpu-a" in pool:
             state["gone"] = [0]  # tpu-a (worker 0, the pinned master) dies
@@ -486,3 +557,160 @@ def test_downsize_reelects_master_when_pinned_addr_is_removed(
     assert supervise.supervise_main(config, payload={}) == 0
     assert masters[0] == "tpu-a"       # full-size epoch: pinned master
     assert masters[-1] == "tpu-b"      # downsized epoch: re-elected
+
+
+# ----------------------------------------------------- elastic upsizing
+def test_plan_upsize_local_pool_grows_slots():
+    from scaling_tpu.resilience.capacity import HostOffer
+    from scaling_tpu.runner.supervise import plan_upsize
+
+    config = RunnerConfig.from_dict({
+        "hosts": ["localhost"], "supervise": True, "control_dir": "/tmp/x",
+        "upsize_after": 1,
+    })
+    pool = {"localhost": 1}
+    payload = {"topology": {"world_size": 1, "data_parallel_size": 1,
+                            "micro_batch_size": 2,
+                            "gradient_accumulation_steps": 2,
+                            "global_batch_size": 4}}
+    offer = HostOffer(name="standby-1", host="localhost", slots=1,
+                      incarnation=3, age_s=0.1)
+    plan = plan_upsize(config, pool, [(offer.host, offer.slots)], payload)
+    assert plan is not None
+    new_pool, new_workers, replan, new_payload = plan
+    assert new_pool == {"localhost": 2} and len(new_workers) == 2
+    assert replan is None  # no downsize_model: plain grow
+    topo = new_payload["topology"]
+    assert topo["world_size"] == 2 and topo["data_parallel_size"] == 2
+    # gbs preserved across the GROW too: gas folds down, stream intact
+    assert topo["global_batch_size"] == 4
+    assert topo["gradient_accumulation_steps"] == 1
+
+
+def test_plan_upsize_remote_adds_host_and_skips_members():
+    from scaling_tpu.runner.supervise import plan_upsize
+
+    config = RunnerConfig.from_dict({
+        "hosts": ["tpu-a"], "supervise": True, "control_dir": "/tmp/x",
+        "upsize_after": 1, "default_gpu_count": 1,
+    })
+    pool = {"tpu-a": 1}
+    plan = plan_upsize(config, pool, [("tpu-b", 1)], payload={})
+    assert plan is not None
+    new_pool, new_workers, _, _ = plan
+    assert new_pool == {"tpu-a": 1, "tpu-b": 1} and len(new_workers) == 2
+    # an offer for a host already in the pod adds nothing — no plan
+    assert plan_upsize(config, pool, [("tpu-a", 1)], payload={}) is None
+    assert plan_upsize(config, pool, [], payload={}) is None
+
+
+def test_plan_upsize_replans_layout_with_tuner_model():
+    from scaling_tpu.runner.supervise import plan_upsize
+
+    config = RunnerConfig.from_dict({
+        "hosts": ["localhost"], "supervise": True, "control_dir": "/tmp/x",
+        "upsize_after": 1, "downsize_model": "0.5b",
+        "default_gpu_count": 2,
+    })
+    plan = plan_upsize(config, {"localhost": 2}, [("localhost", 2)],
+                       payload={"topology": {"world_size": 2}})
+    assert plan is not None
+    _, new_workers, replan, new_payload = plan
+    assert len(new_workers) == 4
+    assert replan is not None
+    assert replan["topology"]["world_size"] == 4
+    assert new_payload["topology"]["world_size"] == 4
+
+
+def test_resolve_master_addr_round_trip_reelection():
+    """Satellite: a pinned master_addr naming a host that LEFT and came
+    back must coordinate again after the upsize — and must NOT hold the
+    job while it is out. Each epoch rendezvouses on a fresh port
+    (master_port + epoch), so flipping back to the pin is safe."""
+    from scaling_tpu.runner.supervise import resolve_master_addr
+
+    # full pod: the pin wins
+    assert resolve_master_addr("tpu-a", {"tpu-a": 1, "tpu-b": 1},
+                               "tpu-a") == "tpu-a"
+    # tpu-a leaves: fall to the surviving previous coordinator
+    assert resolve_master_addr("tpu-a", {"tpu-b": 1}, "tpu-b") == "tpu-b"
+    # ...or to the first pool host when the previous also left
+    assert resolve_master_addr("tpu-a", {"tpu-c": 1, "tpu-b": 1},
+                               "tpu-a") == "tpu-c"
+    # tpu-a restored + upsized back in: the pin re-elects
+    assert resolve_master_addr("tpu-a", {"tpu-a": 1, "tpu-b": 1},
+                               "tpu-b") == "tpu-a"
+    # no pin: stability — keep the incumbent while it survives
+    assert resolve_master_addr(None, {"tpu-a": 1, "tpu-b": 1},
+                               "tpu-b") == "tpu-b"
+    assert resolve_master_addr(None, {"tpu-b": 1}, "tpu-a") == "tpu-b"
+
+
+def test_choose_lease_victim_spares_coordinator_and_local_lends_slot():
+    from scaling_tpu.runner.supervise import choose_lease_victim
+
+    # remote pool: last worker's host goes, but never the coordinator
+    # while another host can serve
+    pool = {"tpu-a": 2, "tpu-b": 2}
+    workers = [("tpu-a", 0), ("tpu-a", 1), ("tpu-b", 0), ("tpu-b", 1)]
+    idx, host, slots = choose_lease_victim(pool, workers, "tpu-b")
+    assert host == "tpu-a" and slots == 2
+    idx, host, slots = choose_lease_victim(pool, workers, "tpu-a")
+    assert host == "tpu-b" and slots == 2
+    # local pool: lend ONE slot, not the whole machine
+    idx, host, slots = choose_lease_victim(
+        {"localhost": 2}, [("localhost", 0), ("localhost", 1)], "127.0.0.1",
+    )
+    assert host == "localhost" and slots == 1 and idx == 1
+
+
+def test_supervise_main_executes_upsize_between_epochs(
+    tmp_path, monkeypatch
+):
+    """The elastic loop end to end at the unit tier: a clean epoch with
+    a pending capacity action grows the pod, logs the `upsize` event,
+    re-baselines the budget, and runs the next epoch at the new size."""
+    import json
+
+    from scaling_tpu.resilience.capacity import HostOffer
+    from scaling_tpu.runner import supervise
+
+    events = tmp_path / "events.jsonl"
+    monkeypatch.setenv("SCALING_TPU_EVENTS_PATH", str(events))
+    sizes = []
+    offer = HostOffer(name="standby-1", host="localhost", slots=1,
+                      incarnation=1, age_s=0.0)
+
+    def fake_run_epoch(config, pool, workers, encoded, master_addr,
+                       control_root, epoch, state, capacity=None):
+        sizes.append(len(workers))
+        state["capacity"] = ("upsize", [offer]) if epoch == 0 else None
+        state["gone"] = []
+        return 0
+
+    absorbed = []
+
+    class FakeCapacity:
+        def absorb(self, act):
+            absorbed.append(act)
+
+        def on_downsize(self):
+            pass
+
+    monkeypatch.setattr(supervise, "_run_epoch", fake_run_epoch)
+    monkeypatch.setattr(
+        supervise, "_build_capacity", lambda config, root: FakeCapacity()
+    )
+    config = RunnerConfig.from_dict({
+        "hosts": ["localhost"], "supervise": True,
+        "control_dir": str(tmp_path / "cp"), "default_gpu_count": 1,
+        "upsize_after": 1, "restart_backoff_seconds": 0.0,
+    })
+    assert supervise.supervise_main(config, payload={}) == 0
+    assert sizes == [1, 2]  # drained at 1, relaunched at 2
+    recs = [json.loads(l) for l in events.read_text().splitlines()]
+    ups = [r for r in recs if r["event"] == "upsize"]
+    assert len(ups) == 1
+    assert ups[0]["old_world"] == 1 and ups[0]["new_world"] == 2
+    assert ups[0]["source"] == "announce"
+    assert absorbed == [("upsize", [offer])]  # announcements consumed
