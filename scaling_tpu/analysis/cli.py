@@ -188,7 +188,9 @@ def main(argv=None) -> int:
     # version 2 added schema_version itself + the ordered lint["rules"]
     # per-rule summary; ISSUE 17: version 3 added the protocol rules
     # STA012-STA015 to lint["rules"] and the "protocol" section —
-    # inventory + drift); consumers diff structurally against this
+    # inventory + drift; ISSUE 20's STA016 rides version 3, a new
+    # per-rule row is additive); consumers diff structurally against
+    # this
     payload: dict = {"schema_version": 3}
     graph = None
     if args.command in ("lint", "protocol", "all"):

@@ -90,6 +90,17 @@ STA015    warning   stale suppression: a ``# sta: disable=...`` comment
                     by the whole-program pass only (a per-file-only run
                     cannot tell which program-rule suppressions are
                     live).
+STA016    error     trace-propagation: an RPC request dict literal (an
+                    ``"op"`` key) in serve/ without a literal
+                    ``"trace"`` key. The serving fleet's distributed-
+                    tracing contract (docs/OBSERVABILITY.md, Tracing):
+                    every envelope crossing a process boundary carries
+                    the ambient trace context — even as None — or a
+                    failover re-dispatch silently severs the request's
+                    timeline. Control-plane envelopes (resilience/)
+                    are exempt: their cross-host identity is DERIVED
+                    (``derive_trace_id``) at both ends, not carried.
+                    Whole-program rule (protocol.py).
 ========  ========  ==========================================================
 
 Suppress a finding on its line with ``# sta: disable=STA003`` (a comma
@@ -143,6 +154,9 @@ RULES = {
                         "spawn-kill) missing fault/retry guard or span"),
     "STA015": ("warning", "stale suppression: a '# sta:' annotation that "
                           "no longer suppresses any finding"),
+    "STA016": ("error", "serve/ RPC request dict without a literal "
+                        "'trace' key — the envelope must carry the "
+                        "ambient trace context"),
 }
 
 # Module allowlist for traced-context rules (ISSUE 2: nn/, parallel/, ops/;

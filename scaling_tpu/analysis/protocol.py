@@ -146,6 +146,12 @@ class RpcSend:
     node: ast.Call
     op: Optional[str]  # None = dynamic op value
     reads: List[Tuple[str, ast.AST]] = dataclasses.field(default_factory=list)
+    # the request dict carries a literal "trace" key (STA016: the
+    # serving fleet's trace-propagation contract); dict_node is the
+    # envelope literal itself, so the finding anchors on the dict's
+    # line (where the missing key belongs), not the call's
+    has_trace: bool = False
+    dict_node: Optional[ast.AST] = None
 
 
 @dataclasses.dataclass
@@ -225,6 +231,19 @@ class ProtocolModel:
                 return True, None
         return False, None
 
+    @staticmethod
+    def _has_trace_key(d: ast.AST) -> bool:
+        """The request dict carries a literal ``"trace"`` key (a
+        ``None`` key means ``**spread`` — opaque, give the benefit of
+        the doubt: a spread may well inject the trace)."""
+        if not isinstance(d, ast.Dict):
+            return False
+        return any(
+            k is None or (isinstance(k, ast.Constant)
+                          and k.value == "trace")
+            for k in d.keys
+        )
+
     def _collect_sends(self, fn: FunctionInfo) -> List[RpcSend]:
         """Dict literals carrying an ``"op"`` key passed into a call —
         the line-JSON RPC send idiom — plus the reply keys each send's
@@ -241,7 +260,9 @@ class ProtocolModel:
             for arg in list(node.args) + [kw.value for kw in node.keywords]:
                 is_rpc, op = self._op_of_dict(arg)
                 if is_rpc:
-                    send = RpcSend(fn, node, op)
+                    send = RpcSend(fn, node, op,
+                                   has_trace=self._has_trace_key(arg),
+                                   dict_node=arg)
                     sends.append(send)
                     send_nodes[id(node)] = send
                     break
@@ -938,14 +959,56 @@ def check_edge_coverage(model: ProtocolModel,
     return em.findings
 
 
+# ======================================================== STA016
+# trace-propagation scope: the serving fleet only. Control-plane
+# envelopes (resilience/) are deliberately exempt — their cross-host
+# identity is DERIVED at both ends (``obs.derive_trace_id`` over the
+# lease / commit key), never carried in the envelope, so demanding a
+# "trace" key there would add dead payload the consumer ignores.
+TRACE_SCOPE_DIRS = ("serve",)
+
+
+def check_trace_propagation(model: ProtocolModel,
+                            em: Optional[_Emitter] = None,
+                            scope_dirs: Iterable[str] = TRACE_SCOPE_DIRS
+                            ) -> List:
+    """STA016: every serve/ RPC request dict literal must carry a
+    literal ``"trace"`` key (value may be ``None`` — key presence IS
+    the contract; ``obs/trace.py`` reassembles cross-host timelines
+    from what the envelopes carry, and one bare envelope severs the
+    request's trace at a process boundary)."""
+    em = em or _Emitter()
+    flat = sorted(
+        (s for sends in model.rpc_sends.values() for s in sends),
+        key=lambda s: (s.fn.module.rel, getattr(s.node, "lineno", 0)),
+    )
+    for s in flat:
+        if not _in_scope(s.fn.module.rel, scope_dirs):
+            continue
+        if s.has_trace:
+            continue
+        em.emit(
+            "STA016", s.fn.module, s.dict_node or s.node,
+            f"rpc send {s.op!r} in {s.fn.dotted} carries no "
+            "literal 'trace' key — serve/ envelopes must propagate "
+            "the ambient trace context (obs.current_trace(), even "
+            "when None) or a failover re-dispatch severs the "
+            "request's distributed trace (docs/OBSERVABILITY.md, "
+            "Tracing); add the key or suppress with a comment "
+            "saying why",
+        )
+    return em.findings
+
+
 # ------------------------------------------------------------- driver
 def check_protocol(graph: CallGraph) -> List:
-    """All three protocol rules over one shared graph + model."""
+    """All four protocol rules over one shared graph + model."""
     model = ProtocolModel(graph)
     findings: List = []
     findings.extend(check_barrier_divergence(model))
     findings.extend(check_rpc_contract(model))
     findings.extend(check_edge_coverage(model))
+    findings.extend(check_trace_propagation(model))
     return findings
 
 

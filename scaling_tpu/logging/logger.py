@@ -25,6 +25,24 @@ _LEVELS = {
     "critical": _pylogging.CRITICAL,
 }
 
+# ---------------------------------------------------------------- tracing
+# obs.spans registers a provider at import time so every log_event record
+# emitted under an active trace context carries the trace id. The hook
+# lives HERE (a module-level callback, not an import) because logging
+# sits below obs in the layering — obs depends on logging and never the
+# reverse — yet the ISSUE-20 stamping contract belongs to log_event
+# itself: serve-request events, capacity-lease events and supervisor
+# transitions all gain trace identity without each call site opting in.
+_trace_provider = None
+
+
+def set_trace_provider(provider) -> None:
+    """Register a zero-arg callable returning extra fields (or ``None``)
+    to merge into every ``log_event`` record. Explicit fields win; a
+    raising/absent provider costs nothing (telemetry is best-effort)."""
+    global _trace_provider
+    _trace_provider = provider
+
 
 class LoggerConfig(BaseConfig):
     log_level: str = Field("info", description="")
@@ -303,6 +321,14 @@ class _Logger:
         import time as _time
 
         rec = {"event": event, "ts": _time.time(), **fields}
+        if _trace_provider is not None:
+            try:
+                extra = _trace_provider()
+            except Exception:
+                extra = None
+            if extra:
+                for k, v in extra.items():
+                    rec.setdefault(k, v)
         line = _json.dumps(rec, sort_keys=True, default=str)
         getattr(self, _level, self.info)(f"EVENT {line}")
         # env first: the field doc promises the env var OVERRIDES the

@@ -30,7 +30,16 @@ from .registry import (
     get_registry,
     host_id,
 )
-from .spans import Span, current_span, span
+from .spans import (
+    Span,
+    current_span,
+    current_trace,
+    current_trace_id,
+    derive_trace_id,
+    new_trace_id,
+    span,
+    trace_context,
+)
 from .telemetry import StepTelemetry
 
 __all__ = [
@@ -43,10 +52,15 @@ __all__ = [
     "StepTimeEMA",
     "achieved_tflops",
     "current_span",
+    "current_trace",
+    "current_trace_id",
+    "derive_trace_id",
     "device_memory_snapshot",
     "get_registry",
     "host_id",
     "mfu",
+    "new_trace_id",
     "span",
+    "trace_context",
     "update_hardware_gauges",
 ]

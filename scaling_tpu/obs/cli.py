@@ -3,6 +3,11 @@
 Renders the health report on stdout; ``--json`` additionally writes the
 machine-readable payload. Exit codes: 0 clean, 1 a ``--assert-*`` gate
 fired, 2 the run dir held no parseable telemetry at all.
+
+``python -m scaling_tpu.obs trace <run_dir>`` delegates to the
+distributed-trace analyzer (:mod:`.trace`), which owns its own flag set
+— the two commands share only the run-dir loader and exit-code
+contract.
 """
 
 from __future__ import annotations
@@ -22,11 +27,20 @@ from .report import (
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "trace":
+        # the trace analyzer owns its own argparse (different flags,
+        # same exit-code contract) — dispatch before parsing so its
+        # --help renders its flags, not the report's
+        from .trace import main as trace_main
+
+        return trace_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="python -m scaling_tpu.obs",
         description="run-dir telemetry analyzer (docs/OBSERVABILITY.md)",
     )
-    parser.add_argument("command", choices=["report"])
+    parser.add_argument("command", choices=["report", "trace"])
     parser.add_argument("run_dir", help="directory holding the run's "
                         "events/metrics JSONL files (searched recursively)")
     parser.add_argument("--json", metavar="FILE",

@@ -31,6 +31,15 @@ from ..logging.logger import append_jsonl_line
 
 LabelKey = Tuple[Tuple[str, str], ...]
 
+# cardinality guard: one call site interpolating an unbounded value
+# into a label (a request id, a trace id, a raw path) would grow the
+# registry — and every snapshot / textfile render, forever — without
+# bound. Past this many distinct label sets per metric NAME, new series
+# fold into one ``__overflow__`` series so aggregate totals stay right
+# while the per-label split is capped.
+MAX_SERIES_PER_METRIC = 64
+OVERFLOW_LABELS: LabelKey = (("__overflow__", "true"),)
+
 # latency-shaped default buckets (seconds): spans range from sub-ms file
 # ops to multi-minute checkpoint writes / barrier waits
 DEFAULT_BUCKETS: Tuple[float, ...] = (
@@ -146,6 +155,10 @@ class MetricsRegistry:
         self._metrics: Dict[Tuple[str, LabelKey], object] = {}
         self._metrics_path: Optional[str] = None
         self._textfile_path: Optional[str] = None
+        # cardinality guard state: distinct series per metric name, and
+        # which names already warned (once per name, not per call)
+        self._series_per_name: Dict[str, int] = {}
+        self._overflow_warned: set = set()
 
     def configure(self, *, metrics_path: Optional[str] = None,
                   textfile_path: Optional[str] = None) -> None:
@@ -158,18 +171,45 @@ class MetricsRegistry:
 
     def _get(self, cls, name: str, labels, **kwargs):
         key = (name, _label_key(labels))
-        with self._lock:
-            existing = self._metrics.get(key)
-            if existing is not None:
-                if not isinstance(existing, cls):
-                    raise TypeError(
-                        f"metric {name!r} already registered as "
-                        f"{existing.kind}, requested {cls.kind}"
-                    )
-                return existing
-            metric = cls(name, key[1], self._lock, **kwargs)
-            self._metrics[key] = metric
-            return metric
+        warn_overflow = False
+        try:
+            with self._lock:
+                existing = self._metrics.get(key)
+                if existing is None and key[1] \
+                        and key[1] != OVERFLOW_LABELS \
+                        and self._series_per_name.get(name, 0) \
+                        >= MAX_SERIES_PER_METRIC:
+                    # cap hit: this NEW label set folds into the shared
+                    # overflow series instead of minting another one
+                    if name not in self._overflow_warned:
+                        self._overflow_warned.add(name)
+                        warn_overflow = True
+                    key = (name, OVERFLOW_LABELS)
+                    existing = self._metrics.get(key)
+                if existing is not None:
+                    if not isinstance(existing, cls):
+                        raise TypeError(
+                            f"metric {name!r} already registered as "
+                            f"{existing.kind}, requested {cls.kind}"
+                        )
+                    return existing
+                metric = cls(name, key[1], self._lock, **kwargs)
+                self._metrics[key] = metric
+                self._series_per_name[name] = \
+                    self._series_per_name.get(name, 0) + 1
+                return metric
+        finally:
+            if warn_overflow:
+                # outside the lock: the logger does I/O, and telemetry
+                # must never stall a concurrent observe()
+                from ..logging.logger import logger
+
+                logger.warning(
+                    f"metric {name!r} exceeded {MAX_SERIES_PER_METRIC} "
+                    "distinct label sets — folding further series into "
+                    "__overflow__ (an unbounded value is leaking into a "
+                    "label; fix the call site)"
+                )
 
     def counter(self, name: str, labels: Optional[Mapping] = None) -> Counter:
         return self._get(Counter, name, labels)
@@ -278,6 +318,8 @@ class MetricsRegistry:
         """Drop every metric (tests; a fresh process never needs this)."""
         with self._lock:
             self._metrics.clear()
+            self._series_per_name.clear()
+            self._overflow_warned.clear()
 
 
 def _json_safe(obj):

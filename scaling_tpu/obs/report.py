@@ -726,6 +726,28 @@ def serving_section(data: RunData) -> Tuple[List[str], Dict[str, float]]:
                 f"  preempted-and-resumed: {preempted} of {len(reqs)} "
                 "request(s)"
             )
+    # distributed-trace summary (docs/OBSERVABILITY.md "Tracing"): one
+    # line when the run stamped traces — coverage plus the phase that
+    # dominates the most traces' critical paths, pointing at
+    # ``obs trace`` for the full timelines. Absent when no request
+    # carries a trace, so pre-tracing run dirs (and the committed
+    # golden reports) stay byte-identical.
+    if any("trace" in e for e in reqs):
+        from .trace import PHASES, analyze  # local: trace imports report
+
+        t = analyze(data)
+        cov = t["coverage"]
+        if cov is not None:
+            stats["serve_trace_coverage"] = cov
+        counts = t["critical_path_counts"]
+        top = max(PHASES, key=lambda p: (counts.get(p, 0),
+                                         -PHASES.index(p)))
+        lines.append(
+            f"  traces: {t['traces']} reconstructed, coverage "
+            + (f"{cov:.1%}" if cov is not None else "n/a")
+            + f", top critical-path phase: {top} "
+            f"({counts.get(top, 0)} trace(s)) — see `obs trace`"
+        )
     # tick-time attribution: where the engine's device time actually went
     # (serve.prefill_chunk = chunked prefill, serve.prefill = whole-prompt
     # buckets, serve.decode = the per-tick decode step). This is the rail

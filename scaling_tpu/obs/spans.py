@@ -16,6 +16,17 @@ child) and are exception-safe: a body that raises still emits the span,
 marked ``ok=false`` with the exception type, and the exception
 propagates untouched.
 
+Distributed tracing rides the same stream (docs/OBSERVABILITY.md
+"Tracing"): a per-thread trace context — adopted via
+:func:`trace_context` or inherited from the enclosing span — stamps
+``trace`` / ``span_id`` / ``parent_span_id`` onto span events, and a
+provider hook registered with :func:`logger.set_trace_provider` stamps
+``trace`` onto every OTHER ``log_event`` record emitted under an active
+context. Trace-less code paths emit byte-identical records to before:
+no ids are allocated and no trace fields appear unless a context is
+active, which is also what keeps warmup traffic out of the trace
+coverage denominator.
+
 Device-drain semantics reuse :class:`SynchronizedTimer`'s contract
 without forcing a sync: a span measures host wall time unless the caller
 hands it device work via ``sp.wait_for(x)``, in which case the exit
@@ -28,13 +39,16 @@ No jax at module level; the drain imports it lazily.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 import time
+import uuid
 from contextlib import contextmanager
 from typing import Any, Iterator, Optional
 
 from ..logging import logger
+from ..logging.logger import set_trace_provider
 from .registry import get_registry
 
 _local = threading.local()
@@ -48,16 +62,94 @@ def _stack() -> list:
     return stack
 
 
+# ------------------------------------------------------------- trace ids
+def new_trace_id() -> str:
+    """A fresh 16-hex trace id (one per originating request)."""
+    return uuid.uuid4().hex[:16]
+
+
+def new_span_id() -> str:
+    """A fresh 8-hex span id (allocated only for traced spans)."""
+    return uuid.uuid4().hex[:8]
+
+
+def derive_trace_id(*parts: Any) -> str:
+    """Deterministic trace id from identity parts. Cross-host work that
+    shares an identity but never an RPC envelope — a capacity lease
+    ``(host, epoch)``, a checkpoint ``commit:step-N`` — derives the SAME
+    trace id independently on every host, so the analyzer reassembles
+    one fleet-wide trace without any context having crossed the wire."""
+    raw = "\x1f".join(str(p) for p in parts)
+    return hashlib.sha1(raw.encode("utf-8")).hexdigest()[:16]
+
+
+@contextmanager
+def trace_context(trace_id: Optional[str],
+                  parent_span_id: Optional[str] = None) -> Iterator[None]:
+    """Adopt an inbound trace context for this thread.
+
+    Spans opened in the body (and ``log_event`` records emitted in it)
+    carry ``trace_id``; the previous context is restored on exit, so
+    nested adoption — a worker dispatching one request per envelope —
+    composes. ``trace_id=None`` adopts the empty context (explicitly
+    masking any ambient trace, which the warmup path relies on)."""
+    prev = getattr(_local, "trace", None)
+    _local.trace = (trace_id, parent_span_id) if trace_id else None
+    try:
+        yield
+    finally:
+        _local.trace = prev
+
+
+def current_trace() -> Optional[dict]:
+    """The active trace context as a JSON-safe dict — the exact value an
+    RPC envelope's ``trace`` key carries (``{"trace_id": ...,
+    "parent_span_id": ...}``), or ``None`` outside any context. The
+    innermost traced span wins over an adopted context so the receiver
+    links to the sender's actual span."""
+    stack = _stack()
+    if stack and stack[-1].trace_id:
+        return {"trace_id": stack[-1].trace_id,
+                "parent_span_id": stack[-1].span_id}
+    ctx = getattr(_local, "trace", None)
+    if ctx is not None and ctx[0]:
+        return {"trace_id": ctx[0], "parent_span_id": ctx[1]}
+    return None
+
+
+def current_trace_id() -> Optional[str]:
+    """Just the active ``trace_id`` (what journal records store)."""
+    t = current_trace()
+    return t["trace_id"] if t else None
+
+
+def _trace_event_fields() -> Optional[dict]:
+    """Provider for :func:`logger.set_trace_provider`: the ``trace``
+    field to stamp onto non-span ``log_event`` records. Explicit fields
+    win over the provider in ``log_event``, and the provider returns
+    ``None`` outside any context so trace-less records stay
+    byte-identical to the pre-tracing stream."""
+    tid = current_trace_id()
+    return {"trace": tid} if tid else None
+
+
+set_trace_provider(_trace_event_fields)
+
+
 class Span:
     """Handle yielded by :func:`span`; mutate it to enrich the record."""
 
-    __slots__ = ("name", "fields", "_wait_for", "duration_s")
+    __slots__ = ("name", "fields", "_wait_for", "duration_s", "trace_id",
+                 "span_id", "parent_span_id")
 
     def __init__(self, name: str, fields: dict):
         self.name = name
         self.fields = fields
         self._wait_for: Any = None
         self.duration_s: Optional[float] = None
+        self.trace_id: Optional[str] = None
+        self.span_id: Optional[str] = None
+        self.parent_span_id: Optional[str] = None
 
     def wait_for(self, x: Any) -> Any:
         """Drain ``x`` (``jax.block_until_ready``) before the span closes,
@@ -85,6 +177,19 @@ def span(name: str, *, step: Optional[int] = None, level: str = "debug",
     sp = Span(name, dict(fields))
     stack = _stack()
     parent = stack[-1].name if stack else None
+    # resolve the trace lineage at entry, per thread: the enclosing span
+    # wins (its span_id becomes the parent link), else the adopted
+    # context; with neither the span stays trace-less and allocates no
+    # ids at all — the pre-tracing fast path, byte-identical records
+    if stack and stack[-1].trace_id:
+        sp.trace_id = stack[-1].trace_id
+        sp.parent_span_id = stack[-1].span_id
+    else:
+        ctx = getattr(_local, "trace", None)
+        if ctx is not None and ctx[0]:
+            sp.trace_id, sp.parent_span_id = ctx
+    if sp.trace_id:
+        sp.span_id = new_span_id()
     stack.append(sp)
     ok = True
     error: Optional[str] = None
@@ -122,6 +227,14 @@ def _emit(sp: Span, parent: Optional[str], duration: float, ok: bool,
         event_fields["step"] = step
     if error is not None:
         event_fields["error"] = error
+    # trace lineage (explicit annotate() fields win, like host below):
+    # only traced spans carry the columns, so trace-less runs emit the
+    # exact records they always did
+    if sp.trace_id is not None:
+        event_fields.setdefault("trace", sp.trace_id)
+        event_fields.setdefault("span_id", sp.span_id)
+        if sp.parent_span_id is not None:
+            event_fields.setdefault("parent_span_id", sp.parent_span_id)
     # host + relaunch epoch ride every span so the analyzer can attribute
     # per host AND per supervisor epoch — the same step gets re-saved and
     # the same barrier re-waited after a relaunch, and merging those

@@ -57,7 +57,7 @@ from pathlib import Path
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..logging import logger
-from ..obs.spans import span
+from ..obs.spans import derive_trace_id, span, trace_context
 from .faults import get_fault_plan
 from .guards import retry_io
 
@@ -109,6 +109,16 @@ class Lease:
     def outstanding(self) -> bool:
         """True while the host is NOT training's to use."""
         return self.state in ("granted", "active", "reclaiming")
+
+
+def lease_trace(host: str, epoch: int) -> str:
+    """The lease lifecycle's distributed-trace id: derived from the
+    lease identity ``(host, epoch)``, so the manager's grant/reclaim and
+    the fleet's activate/release — separate processes that never
+    exchange a trace context — independently stamp the SAME trace
+    (docs/OBSERVABILITY.md "Tracing"; same trick as checkpoint
+    commits)."""
+    return derive_trace_id("capacity-lease", host, epoch)
 
 
 class CapacityChannel:
@@ -618,14 +628,15 @@ class SupervisorCapacity:
         either side can resume from (granted re-expires, active
         re-reclaims)."""
         get_fault_plan().fire("capacity.reclaim", path=f"{reason}:{lease.host}")
-        with span("capacity.reclaim", host=lease.host, reason=reason):
-            self.channel.write_lease(dataclasses.replace(
-                lease, state=to_state, since=now, reason=reason,
-            ))
-        logger.log_event(
-            "capacity-reclaim", host=lease.host, state=to_state,
-            reason=reason,
-        )
+        with trace_context(lease_trace(lease.host, lease.epoch)):
+            with span("capacity.reclaim", host=lease.host, reason=reason):
+                self.channel.write_lease(dataclasses.replace(
+                    lease, state=to_state, since=now, reason=reason,
+                ))
+            logger.log_event(
+                "capacity-reclaim", host=lease.host, state=to_state,
+                reason=reason,
+            )
         if self.manager is not None:
             self.manager.note_action(now)
 
@@ -639,12 +650,17 @@ class SupervisorCapacity:
         get_fault_plan().fire("capacity.lease", path=f"grant:{host}")
         lease = Lease(host=host, slots=slots, state="granted", since=now,
                       epoch=epoch, reason="pressure")
-        with span("capacity.grant", host=host, slots=slots):
-            self.channel.write_lease(lease)
-        logger.log_event(
-            "capacity-lease", host=host, slots=slots, state="granted",
-            epoch=epoch,
-        )
+        # one trace per lease lifecycle: grant/activate/reclaim/release
+        # derive the SAME id from (host, epoch) on whichever side —
+        # manager or fleet — performs the transition, so the whole
+        # handoff reads as one cross-process trace in obs trace
+        with trace_context(lease_trace(host, epoch)):
+            with span("capacity.grant", host=host, slots=slots):
+                self.channel.write_lease(lease)
+            logger.log_event(
+                "capacity-lease", host=host, slots=slots, state="granted",
+                epoch=epoch,
+            )
         if self.manager is not None:
             self.manager.note_action(now)
         return lease
@@ -711,12 +727,13 @@ class FleetCapacityClient:
         get_fault_plan().fire("capacity.lease", path=f"activate:{lease.host}")
         out = dataclasses.replace(lease, state="active", since=now,
                                   reason="activated")
-        with span("capacity.activate", host=lease.host):
-            self.channel.write_lease(out)
-        logger.log_event(
-            "capacity-lease", host=lease.host, slots=lease.slots,
-            state="active",
-        )
+        with trace_context(lease_trace(lease.host, lease.epoch)):
+            with span("capacity.activate", host=lease.host):
+                self.channel.write_lease(out)
+            logger.log_event(
+                "capacity-lease", host=lease.host, slots=lease.slots,
+                state="active",
+            )
         return out
 
     def reclaiming(self) -> List[Lease]:
@@ -728,10 +745,11 @@ class FleetCapacityClient:
         now = now if now is not None else time.time()
         out = dataclasses.replace(lease, state="released", since=now,
                                   reason="drained")
-        with span("capacity.release", host=lease.host):
-            self.channel.write_lease(out)
-        logger.log_event(
-            "capacity-lease", host=lease.host, slots=lease.slots,
-            state="released",
-        )
+        with trace_context(lease_trace(lease.host, lease.epoch)):
+            with span("capacity.release", host=lease.host):
+                self.channel.write_lease(out)
+            logger.log_event(
+                "capacity-lease", host=lease.host, slots=lease.slots,
+                state="released",
+            )
         return out

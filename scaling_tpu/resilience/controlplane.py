@@ -130,6 +130,17 @@ class ControlPlane:
     def close(self) -> None:
         pass
 
+    def clock_offset(self) -> float:
+        """This host's wall clock minus the control plane's reference
+        clock, in seconds (best effort; 0.0 when the backend has no
+        shared clock). Subtracting it from local ``time.time()`` stamps
+        maps them onto the ONE reference clock every host shares — the
+        same skew-immune trick the heartbeat staleness math uses — which
+        is what lets ``obs trace`` order one request's records across
+        hosts. Each host logs it as a ``clock-offset`` event at startup
+        so the alignment survives into the run dir."""
+        return 0.0
+
     # -- shared logic ---------------------------------------------------
     def heartbeat(self, step: int, status: str = "running") -> None:
         self._last_step = step
@@ -336,6 +347,22 @@ class FileControlPlane(ControlPlane):
                 return None  # absent flag — the common case, not an error
 
         return retry_io(op, what=f"flag read {name!r}")
+
+    def clock_offset(self) -> float:
+        """Local wall clock vs the FS server's: write a probe and
+        compare its mtime (stamped by the ONE server clock all hosts'
+        heartbeat walls already come from) to local ``time.time()``.
+        Includes the write latency — NTP-sized accuracy, which is what
+        cross-host trace ordering needs, not perfection."""
+        def op():
+            probe = self.root / "heartbeat" / f".clock{self.host_id}"
+            self._atomic_write(probe, "1")
+            return time.time() - probe.stat().st_mtime
+
+        try:
+            return retry_io(op, what="clock probe")
+        except OSError:
+            return 0.0  # alignment is best-effort, never fatal
 
 
 # ----------------------------------------------------------------- tcp
@@ -549,6 +576,18 @@ class TcpControlPlane(ControlPlane):
     def get_flag(self, name: str) -> Optional[str]:
         return self._request({"op": "get_flag", "name": name})["value"]
 
+    def clock_offset(self) -> float:
+        """Local wall clock vs the coordinator's: the ``peers`` reply
+        already ships the server's ``now`` (the stamp heartbeat
+        staleness is computed against); the request round trip bounds
+        the error."""
+        try:
+            with span("cp.clock_probe", host=self.host_id, level="debug"):
+                reply = self._request({"op": "peers"})
+            return time.time() - float(reply.get("now") or time.time())
+        except (RuntimeError, OSError):
+            return 0.0  # alignment is best-effort, never fatal
+
     # -- elastic-capacity records (resilience.capacity rails) -----------
     # Sends live HERE, next to the server's dispatch table, so the
     # STA013 contract check sees client and handler together; the
@@ -586,8 +625,25 @@ def controlplane_from_env() -> Optional[ControlPlane]:
     host_id = int(os.environ.get(ENV_HOST_ID, "0"))
     num_hosts = int(os.environ.get(ENV_NUM_HOSTS, "1"))
     if control_dir:
-        return FileControlPlane(control_dir, host_id, num_hosts)
-    return TcpControlPlane(control_addr, host_id, num_hosts)
+        cp = FileControlPlane(control_dir, host_id, num_hosts)
+    else:
+        cp = TcpControlPlane(control_addr, host_id, num_hosts)
+    # every env-launched participant stamps its skew into the run dir
+    # once at startup, so obs trace can clock-align its records
+    log_clock_offset(cp)
+    return cp
+
+
+def log_clock_offset(cp: ControlPlane) -> None:
+    """Emit one ``clock-offset`` event: this host's wall clock minus the
+    control plane's reference clock. ``obs trace`` subtracts it from the
+    host's record timestamps, mapping every host's events onto the one
+    shared clock (the skew-immune stamp the heartbeat staleness math
+    already trusts) — finite, ordered cross-host timelines."""
+    logger.log_event(
+        "clock-offset", _level="debug", host=cp.host_id,
+        offset_s=round(cp.clock_offset(), 6),
+    )
 
 
 def straggler_table(

@@ -73,7 +73,7 @@ from pathlib import Path
 from typing import Any, Dict, List, Optional
 
 from ..logging import logger
-from ..obs import span
+from ..obs import derive_trace_id, span, trace_context
 from ..resilience.capacity import (
     ArbitrationPolicy,
     CapacityChannel,
@@ -850,12 +850,19 @@ def supervise_main(config: RunnerConfig, payload: Any) -> int:
     # capacity is not coming back and the survivors should carry on
     consecutive_losses = 0
     while True:
-        with span("supervisor.epoch", level="info", epoch=epoch) as ep:
-            rc = _run_epoch(
-                config, pool, workers, encoded, master_addr, control_root,
-                epoch, state, capacity,
-            )
-            ep.annotate(rc=rc)
+        # one trace per supervision epoch, derived from (control root,
+        # epoch) so a relaunched supervisor over the same run re-derives
+        # the same incident ids: every span/event in the epoch —
+        # teardown, backoff, relaunch — reads as one timeline in
+        # obs trace
+        with trace_context(derive_trace_id(
+                "supervisor-epoch", str(control_root), epoch)):
+            with span("supervisor.epoch", level="info", epoch=epoch) as ep:
+                rc = _run_epoch(
+                    config, pool, workers, encoded, master_addr,
+                    control_root, epoch, state, capacity,
+                )
+                ep.annotate(rc=rc)
         if rc == 0:
             act = state.get("capacity")
             if act is None or state["preempted"] or capacity is None:

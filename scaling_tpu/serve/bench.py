@@ -161,7 +161,7 @@ def run_bench(engine, workload, time_scale: float = 1.0,
     import signal as _signal
 
     from ..logging import logger
-    from ..obs import get_registry, span
+    from ..obs import get_registry, new_trace_id, span, trace_context
 
     watchdog = None
     if tick_timeout_s > 0:
@@ -196,9 +196,14 @@ def run_bench(engine, workload, time_scale: float = 1.0,
             while not engine.draining and idx < len(pending) and \
                     pending[idx][0] * time_scale <= now:
                 arrival, prompt, olen = pending[idx]
-                res = engine.submit(
-                    prompt, olen, arrival_s=t0 + arrival * time_scale
-                )
+                # one fresh trace id per measured request at submit —
+                # the origin of the distributed trace every downstream
+                # span/event/journal record inherits (warmup traffic
+                # runs outside any context and stays untraced)
+                with trace_context(new_trace_id()):
+                    res = engine.submit(
+                        prompt, olen, arrival_s=t0 + arrival * time_scale
+                    )
                 if isinstance(res, Backpressure) and res.draining:
                     # SIGTERM raced this submission: it was never
                     # offered to a live engine — unsubmitted, not shed
@@ -343,7 +348,7 @@ def run_fleet_bench(router, workload, time_scale: float = 1.0,
     import threading
 
     from ..logging import logger
-    from ..obs import get_registry, span
+    from ..obs import get_registry, new_trace_id, span, trace_context
     from ..obs.report import percentile
 
     handles = list(router.replicas)
@@ -400,9 +405,11 @@ def run_fleet_bench(router, workload, time_scale: float = 1.0,
             while not draining and idx < len(pending) and \
                     pending[idx][0] * time_scale <= now:
                 arrival, prompt, olen = pending[idx]
-                res = router.submit(
-                    prompt, olen, arrival_s=t0 + arrival * time_scale
-                )
+                # per-request trace origin (same contract as run_bench)
+                with trace_context(new_trace_id()):
+                    res = router.submit(
+                        prompt, olen, arrival_s=t0 + arrival * time_scale
+                    )
                 if isinstance(res, Backpressure):
                     if res.draining:
                         # SIGTERM raced this submission: unsubmitted
@@ -671,6 +678,8 @@ def _run_fleet(args, infs, workload, journal_base, make_engine,
             # req_ids keep the sampler-key fold, so the regenerated
             # tokens are the ones the crashed replica would have emitted
             for rec in rep.incomplete:
+                # a journaled request resumes its pre-crash trace
+                # (None for legacy journals — stays untraced)
                 eng.submit(
                     rec["prompt"], rec["max_new_tokens"],
                     eos_token_id=rec.get("eos_token_id"),
@@ -679,6 +688,7 @@ def _run_fleet(args, infs, workload, journal_base, make_engine,
                     deadline_ms=rec.get("deadline_ms"),
                     ttft_deadline_ms=rec.get("ttft_deadline_ms"),
                     req_id=int(rec["req"]), force=True,
+                    trace=rec.get("trace"),
                 )
             incomplete_total += len(rep.incomplete)
             completed_total += len(rep.completed)
@@ -813,7 +823,7 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
     from concurrent.futures import ThreadPoolExecutor
 
     from ..logging import logger
-    from ..obs import get_registry, span
+    from ..obs import get_registry, new_trace_id, span, trace_context
     from ..obs.report import percentile
     from ..resilience.faults import get_fault_plan
     from .journal import RequestJournal
@@ -844,7 +854,10 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
     control = None
     host_of: dict = {}  # replica_id -> host_id (sticky across relaunch)
     if args.hostsfile:
-        from ..resilience.controlplane import FileControlPlane
+        from ..resilience.controlplane import (
+            FileControlPlane,
+            log_clock_offset,
+        )
         from ..runner.config import RunnerConfig
         from ..runner.runner import get_resource_pool
         from ..tune.serving import PlacementPlan
@@ -856,6 +869,9 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
         control = FileControlPlane(
             run_dir / "control", host_id=0, num_hosts=len(plan.hosts),
         )
+        # the router host's skew stamp (workers stamp their own): the
+        # pair is what obs trace aligns cross-host timelines with
+        log_clock_offset(control)
         rdv = rendezvous_file(run_dir)
         if rdv.exists():
             # a previous drill's entries would satisfy ready-waits with
@@ -1027,7 +1043,11 @@ def _run_fleet_proc(args, workload, run_dir, journal_base) -> dict:
             while not draining and idx < len(pending) \
                     and pending[idx][0] <= now:
                 arrival, prompt, olen = pending[idx]
-                res = router.submit(prompt, olen)
+                # per-request trace origin: the RPC envelope carries it
+                # to the worker, whose dispatch adopts it (one trace per
+                # request across every process in the fleet)
+                with trace_context(new_trace_id()):
+                    res = router.submit(prompt, olen)
                 if isinstance(res, Backpressure):
                     if res.draining:
                         draining = True  # SIGTERM raced this submission
@@ -1752,6 +1772,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     deadline_ms=rec.get("deadline_ms"),
                     ttft_deadline_ms=rec.get("ttft_deadline_ms"),
                     req_id=int(rec["req"]), force=True,
+                    trace=rec.get("trace"),
                 )
             # skip every workload item the crashed run(s) CONSUMED — both
             # admitted submissions and overload sheds (a shed offer was

@@ -296,7 +296,8 @@ class ServeEngine:
                ttft_deadline_ms: Optional[float] = None,
                req_id: Optional[int] = None,
                force: bool = False,
-               count_shed: bool = True):
+               count_shed: bool = True,
+               trace: Optional[str] = None):
         """Admit one request, or reject it with a structured
         :class:`Backpressure` (draining, or over the shed watermarks) —
         the signal a fleet router retries elsewhere on. Returns the
@@ -313,7 +314,13 @@ class ServeEngine:
         or journaling it: the fleet router passes it because a rejection
         it retries on another replica is not a client-visible shed (the
         router counts the fleet-level rejection itself, and the journal
-        shed records must map 1:1 onto consumed workload items)."""
+        shed records must map 1:1 onto consumed workload items).
+
+        ``trace`` pins the request's distributed-trace id explicitly
+        (journal replay re-adopting a crashed request's identity); by
+        default the ambient ``obs.trace_context`` — set by the bench at
+        submit, or adopted from an RPC envelope by the replica worker —
+        is inherited. Warmup traffic never allocates or adopts one."""
         get_fault_plan().fire("serve.admit")
         if force:
             bp = None
@@ -345,6 +352,12 @@ class ServeEngine:
         if req_id is None:
             req_id = self._next_req_id
         self._next_req_id = max(self._next_req_id, req_id + 1)
+        if self.warmup_mode:
+            # warmup hygiene: traffic the --warmup flag keeps off the
+            # books must not enter the trace-coverage denominator either
+            trace = None
+        elif trace is None:
+            trace = obs.current_trace_id()
         req = Request(
             req_id=req_id, prompt=list(prompt),
             max_new_tokens=max_new_tokens,
@@ -359,8 +372,19 @@ class ServeEngine:
                 ttft_deadline_ms if ttft_deadline_ms is not None
                 else self.config.default_ttft_deadline_ms
             ),
+            trace_id=trace,
         )
-        seq = self.scheduler.add_request(req)
+        if trace is not None:
+            # the admit span is the trace's first engine-side record;
+            # re-assert the context so an explicitly-passed trace
+            # (journal replay, orphan re-dispatch) links up even with
+            # no ambient context on this thread
+            with obs.trace_context(trace):
+                with self._span("serve.admit", req=req_id,
+                                **self._replica_fields):
+                    seq = self.scheduler.add_request(req)
+        else:
+            seq = self.scheduler.add_request(req)
         if req.deadline_ms is not None or req.ttft_deadline_ms is not None:
             with self._deadline_lock:
                 self._deadline_live += 1
@@ -436,6 +460,19 @@ class ServeEngine:
 
             return contextlib.nullcontext()
         return obs.span(name, **fields)
+
+    @staticmethod
+    def _trace_fields(seqs, key: str = "traces") -> dict:
+        """Span annotation linking a batch span to every traced request
+        it advanced: ``{key: [trace ids]}``, empty dict when none are
+        traced so trace-less runs emit byte-identical span records. The
+        analyzer (obs/trace.py) indexes batch spans by these lists."""
+        out: List[str] = []
+        for s in seqs:
+            tid = s.request.trace_id
+            if tid and tid not in out:
+                out.append(tid)
+        return {key: out} if out else {}
 
     def _sample_last(self, logits, temps, topps, topks, reqids, gens,
                      base_key):
@@ -665,7 +702,7 @@ class ServeEngine:
         block_row[:len(seq.blocks)] = seq.blocks
         self._admit_slot(seq)
         with self._span("serve.prefill", step=self.tick_index,
-                      tokens=len(prompt)):
+                      tokens=len(prompt), **self._trace_fields([seq])):
             operands = self._dev((
                 tokens, block_row, np.int32(len(prompt)),
                 *self._scalar_sample_args(seq),
@@ -710,7 +747,8 @@ class ServeEngine:
             self._admit_slot(seq)
         finishing = start + n_real == len(prompt)
         with self._span("serve.prefill_chunk", step=self.tick_index,
-                      tokens=n_real, start=start):
+                      tokens=n_real, start=start,
+                      **self._trace_fields([seq])):
             operands = self._dev((
                 tokens, block_row, np.asarray([start], np.int32),
                 np.asarray([n_real], np.int32),
@@ -755,7 +793,7 @@ class ServeEngine:
         tables = np.where(active[:, None], self._tables, 0)
         ctx = np.where(active, self._ctx, 0)
         with self._span("serve.decode", step=self.tick_index,
-                      batch=len(decodes)):
+                      batch=len(decodes), **self._trace_fields(decodes)):
             operands = self._dev((
                 tables, ctx, self._tok, self._temp, self._topp,
                 self._topk, self._reqid, self._gen,
@@ -845,7 +883,9 @@ class ServeEngine:
         # inactive rows keep all-trash tables + new_len 0: their writes
         # land in the trash block and they expose zero visible slots
         with self._span("serve.mixed", step=self.tick_index,
-                      decodes=len(t.decodes), chunks=len(t.prefills)):
+                      decodes=len(t.decodes), chunks=len(t.prefills),
+                      **self._trace_fields(t.decodes),
+                      **self._trace_fields(t.prefills, "chunk_traces")):
             operands = self._dev((
                 tables, ctx, tokens, new_lens, self._temp, self._topp,
                 self._topk, self._reqid, gen0,
@@ -994,6 +1034,10 @@ class ServeEngine:
             preemptions=seq.preemptions,
             **self._replica_fields,
         )
+        if seq.request.trace_id is not None:
+            # the trace's terminal record: obs/trace.py reads e2e_s and
+            # status from here and anchors the timeline's end on ts
+            fields["trace"] = seq.request.trace_id
         if seq.first_token_s is not None:
             # a TTFT-deadline timeout never produced a first token — the
             # analyzer's percentiles must not see a fabricated sample
@@ -1041,6 +1085,13 @@ class ServeEngine:
         t = self.scheduler.schedule()
         if t.preempted:
             self._counter("serve_preemptions_total").inc(len(t.preempted))
+            # a zero-width marker span: records WHICH traced requests
+            # got pushed back to waiting this tick, so a trace's timeline
+            # shows the preemption that explains its decode gap
+            with self._span("serve.preempt", step=self.tick_index,
+                            count=len(t.preempted),
+                            **self._trace_fields(t.preempted)):
+                pass
         sched = self.scheduler
         if sched.prefix_hit_tokens > self._prefix_hits_flushed:
             self._counter("serve_prefix_hit_tokens_total").inc(
@@ -1048,7 +1099,17 @@ class ServeEngine:
             )
             self._prefix_hits_flushed = sched.prefix_hit_tokens
         self._reset_rows(self.scheduler.drain_freed_slots())
-        self._apply_cow(t.cow_pairs)
+        if t.cow_pairs:
+            # forks are ordered by this tick's (re-)admissions — the
+            # prefill rows — so their traces are the ones the copy work
+            # advanced (Tick flattens the per-seq pairs; the row list is
+            # the per-request attribution that survives)
+            with self._span("serve.cow", step=self.tick_index,
+                            pairs=len(t.cow_pairs),
+                            **self._trace_fields(t.prefills)):
+                self._apply_cow(t.cow_pairs)
+        else:
+            self._apply_cow(t.cow_pairs)
         if self.config.fused:
             if t.prefills or t.decodes:
                 self._run_mixed(t)

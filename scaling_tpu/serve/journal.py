@@ -72,6 +72,10 @@ class RequestJournal:
             "top_p": request.top_p,
             "deadline_ms": request.deadline_ms,
             "ttft_deadline_ms": request.ttft_deadline_ms,
+            # trace identity survives the crash with the replay recipe:
+            # the failover re-dispatch adopts it so the survivor's work
+            # lands on the ORIGINAL request's trace
+            "trace": getattr(request, "trace_id", None),
         })
 
     def record_tokens(self, req_id: int, tokens: List[int]) -> None:

@@ -90,6 +90,7 @@ import time
 from pathlib import Path
 from typing import Callable, Dict, List, Optional, Tuple
 
+from .. import obs
 from ..logging import logger
 from ..obs import span
 from ..resilience.faults import get_fault_plan
@@ -328,6 +329,18 @@ class _ReplicaWorker:
         return reply
 
     def _dispatch(self, req: dict) -> dict:
+        tr = req.get("trace") or {}
+        if tr.get("trace_id"):
+            # adopt the envelope's inbound trace for this dispatch (the
+            # handler runs on a per-connection thread, so adoption is
+            # naturally per-request): the engine's admit span, journal
+            # submit record and any spans opened here all inherit the
+            # ORIGINATING request's trace across the process boundary
+            with obs.trace_context(tr["trace_id"], tr.get("parent_span_id")):
+                return self._dispatch_op(req)
+        return self._dispatch_op(req)
+
+    def _dispatch_op(self, req: dict) -> dict:
         op = req.get("op")
         if op == "submit":
             kw = dict(req.get("kw") or {})
@@ -482,13 +495,19 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     host_id = cfg.get("host_id")
     control = None
     if cfg.get("control_dir"):
-        from ..resilience.controlplane import FileControlPlane
+        from ..resilience.controlplane import (
+            FileControlPlane,
+            log_clock_offset,
+        )
 
         control = FileControlPlane(
             cfg["control_dir"],
             host_id=int(host_id) if host_id is not None else replica_id,
             num_hosts=int(cfg.get("num_hosts", 1)),
         )
+        # stamp this worker host's clock skew into the shared event
+        # stream so obs trace can order its spans against the router's
+        log_clock_offset(control)
     worker = _ReplicaWorker(
         engine, linger_s=float(cfg.get("linger_s", DEFAULT_LINGER_S)),
         host_id=int(host_id) if host_id is not None else None,
@@ -638,7 +657,7 @@ class ProcReplicaHandle:
         """``stats`` RPC — the heartbeat: a successful reply refreshes
         ``last_ok_wall`` and the load cache; the reported loop age
         exposes a wedged tick loop whose RPC threads still answer."""
-        reply = self._rpc({"op": "stats"})
+        reply = self._rpc({"op": "stats", "trace": obs.current_trace()})
         self.last_stats = reply["stats"]
         self.last_loop_age_s = float(reply.get("loop_age_s", 0.0))
         self.last_dups = int(reply.get("dups", 0))
@@ -671,7 +690,8 @@ class ProcReplicaHandle:
     def poll_finished(self) -> List[dict]:
         """Ship finished-request records the host has not seen yet
         (cursor-based: a lost reply re-ships, never drops)."""
-        reply = self._rpc({"op": "poll", "from": self.poll_cursor})
+        reply = self._rpc({"op": "poll", "from": self.poll_cursor,
+                           "trace": obs.current_trace()})
         recs = reply["finished"]
         self.poll_cursor = int(
             reply.get("total", self.poll_cursor + len(recs))
@@ -680,7 +700,8 @@ class ProcReplicaHandle:
 
     def request_shutdown(self) -> None:
         try:
-            self._rpc({"op": "shutdown"}, attempts=1)
+            self._rpc({"op": "shutdown", "trace": obs.current_trace()},
+                      attempts=1)
         except (ReplicaUnreachable, RuntimeError):
             pass  # already gone — that's what shutdown wanted anyway
 
@@ -719,6 +740,11 @@ class ProcReplicaHandle:
             "prompt": [int(t) for t in prompt],
             "max_new_tokens": int(max_new_tokens),
             "kw": kwargs,
+            # the propagation contract (docs/OBSERVABILITY.md
+            # "Tracing", enforced by STA016): every envelope carries
+            # the ambient trace context — None outside one — so the
+            # worker's dispatch adopts the originating request's trace
+            "trace": obs.current_trace(),
         })
         if not reply.get("admitted"):
             bp = reply["bp"]
@@ -735,7 +761,7 @@ class ProcReplicaHandle:
 
     def begin_drain(self) -> None:
         try:
-            self._rpc({"op": "drain"})
+            self._rpc({"op": "drain", "trace": obs.current_trace()})
         except ReplicaUnreachable:
             pass  # dead replica: the supervisor's liveness pass owns it
 
@@ -1096,16 +1122,20 @@ class FleetSupervisor:
         for rec in self.orphans:
             # original req_id + force=True: any replica regenerates the
             # same tokens (the (request, position) sampler-key fold),
-            # and recovery work is never shed
-            res = self.router.submit(
-                rec["prompt"], rec["max_new_tokens"],
-                eos_token_id=rec.get("eos_token_id"),
-                temperature=rec.get("temperature", 0.0),
-                top_k=rec.get("top_k"), top_p=rec.get("top_p"),
-                deadline_ms=rec.get("deadline_ms"),
-                ttft_deadline_ms=rec.get("ttft_deadline_ms"),
-                req_id=int(rec["req"]), force=True,
-            )
+            # and recovery work is never shed. The journal/park record's
+            # trace is adopted so the survivor's work — and the retry
+            # RPC itself — lands on the ORIGINAL request's trace: one
+            # trace spanning the dead replica and the survivor
+            with obs.trace_context(rec.get("trace")):
+                res = self.router.submit(
+                    rec["prompt"], rec["max_new_tokens"],
+                    eos_token_id=rec.get("eos_token_id"),
+                    temperature=rec.get("temperature", 0.0),
+                    top_k=rec.get("top_k"), top_p=rec.get("top_p"),
+                    deadline_ms=rec.get("deadline_ms"),
+                    ttft_deadline_ms=rec.get("ttft_deadline_ms"),
+                    req_id=int(rec["req"]), force=True,
+                )
             if isinstance(res, Backpressure):
                 still.append(rec)  # every replica unreachable: retry
         if len(still) < len(self.orphans):

@@ -73,6 +73,7 @@ import dataclasses
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from .. import obs
 from ..logging import logger
 from .scheduler import Backpressure
 
@@ -380,6 +381,10 @@ class FleetRouter:
             "top_p": kwargs.get("top_p"),
             "deadline_ms": kwargs.get("deadline_ms"),
             "ttft_deadline_ms": kwargs.get("ttft_deadline_ms"),
+            # the originating request's trace rides the park so the
+            # re-offer / failover re-dispatch inherits it (doubt-parking
+            # must not break the one-request-one-trace invariant)
+            "trace": obs.current_trace_id(),
         }
 
     def resolve_in_doubt(self) -> None:
@@ -408,29 +413,33 @@ class FleetRouter:
                 for k in ("eos_token_id", "temperature", "top_k",
                           "top_p", "deadline_ms", "ttft_deadline_ms")
             }
-            try:
-                res = handle.submit(
-                    rec["prompt"], rec["max_new_tokens"],
-                    req_id=rec["req"], count_shed=False, **kw,
-                )
-            except ReplicaUnreachable:
-                continue  # still partitioned: parked until next tick
-            with self._lock:
-                self._in_doubt.pop(rec["req"], None)
-            if isinstance(res, Backpressure):
-                # definitive NOT-admitted: the original send never
-                # landed in the engine. The caller was already told
-                # "admitted", so ownership stands — force it through
-                # normal dispatch like an orphan (recovery work is
-                # never shed).
-                out = self.submit(
-                    rec["prompt"], rec["max_new_tokens"],
-                    req_id=rec["req"], force=True, **kw,
-                )
-                if isinstance(out, Backpressure):
-                    with self._lock:  # nothing reachable: re-park
-                        self._in_doubt[rec["req"]] = rec
-            else:
+            # re-offers run on the supervisor's thread: adopt the parked
+            # request's trace so the retry RPC (and an eventual fresh
+            # admission) lands on the ORIGINAL trace, not a new one
+            with obs.trace_context(rec.get("trace")):
+                try:
+                    res = handle.submit(
+                        rec["prompt"], rec["max_new_tokens"],
+                        req_id=rec["req"], count_shed=False, **kw,
+                    )
+                except ReplicaUnreachable:
+                    continue  # still partitioned: parked until next tick
+                with self._lock:
+                    self._in_doubt.pop(rec["req"], None)
+                if isinstance(res, Backpressure):
+                    # definitive NOT-admitted: the original send never
+                    # landed in the engine. The caller was already told
+                    # "admitted", so ownership stands — force it through
+                    # normal dispatch like an orphan (recovery work is
+                    # never shed).
+                    out = self.submit(
+                        rec["prompt"], rec["max_new_tokens"],
+                        req_id=rec["req"], force=True, **kw,
+                    )
+                    if isinstance(out, Backpressure):
+                        with self._lock:  # nothing reachable: re-park
+                            self._in_doubt[rec["req"]] = rec
+                    continue
                 logger.log_event(
                     "serve-in-doubt-resolved", req=rec["req"],
                     replica=rec["replica"],

@@ -77,6 +77,12 @@ class Request:
     # slot and blocks recycle immediately (docs/SERVING.md "Resilience")
     deadline_ms: Optional[float] = None
     ttft_deadline_ms: Optional[float] = None
+    # distributed-tracing identity (docs/OBSERVABILITY.md "Tracing"):
+    # assigned by the originating submitter (bench), carried through
+    # every RPC hop / journal record / failover re-dispatch so the
+    # request reconstructs as ONE trace fleet-wide. None = untraced
+    # (warmup, legacy journals) — nothing downstream stamps anything
+    trace_id: Optional[str] = None
 
 
 @dataclasses.dataclass

@@ -698,12 +698,24 @@ class BaseTrainer:
             # the commit-barrier wait IS the per-host straggler signal:
             # the host that waits longest committed first, the one that
             # waits ~0 made everyone else wait (analyzer attributes this
-            # offline from the span stream)
-            with span("ckpt.commit_barrier", step=commit.step,
-                      host=cp.host_id):
-                cp.barrier(
-                    f"commit:step-{commit.step}", self._cp_barrier_timeout
-                )
+            # offline from the span stream). Every host derives the SAME
+            # trace id from the commit identity — no context crosses the
+            # wire, yet obs trace reassembles one commit:step-N trace
+            # spanning all hosts (per coordination epoch: a post-relaunch
+            # re-save of the same step is a different incident)
+            from ..obs import derive_trace_id, trace_context
+
+            commit_trace = derive_trace_id(
+                "ckpt-commit", commit.step,
+                os.environ.get("SCALING_TPU_COORD_EPOCH", "0"),
+            )
+            with trace_context(commit_trace):
+                with span("ckpt.commit_barrier", step=commit.step,
+                          host=cp.host_id):
+                    cp.barrier(
+                        f"commit:step-{commit.step}",
+                        self._cp_barrier_timeout,
+                    )
             prev = self._cp_prev_commit_step
             if prev is not None and prev != commit.step and cp.host_id == 0:
                 # every host passed THIS commit barrier, so none can ever

@@ -17,7 +17,8 @@ FIXTURES = Path(__file__).resolve().parent / "fixtures"
 # fixtures/serve/raceclass.py (STA009 + stale lock annotations),
 # fixtures/serve/hotsync.py (STA010), fixtures/runner/rawio.py
 # (STA011), fixtures/tune/barrierdiv.py (STA012), fixtures/serve/
-# rpcproto.py (STA013/STA014) and fixtures/obs/stale.py (STA015) —
+# rpcproto.py (STA013/STA014, whose untraced envelopes also seed
+# STA016 since ISSUE 20) and fixtures/obs/stale.py (STA015) —
 # line numbers are part of the fixtures' contract (edits there stay
 # additive at the bottom; each fixture's lines deliberately avoid the
 # others' so every (rule, line) pair stays unique)
@@ -62,6 +63,9 @@ EXPECTED = [
     ("STA015", 24),   # raceclass: lock(tick_count) eats nothing (ctor-only peer)
     ("STA015", 40),   # stale: lock(ghost) with no hazard on ghost
     ("STA015", 61),   # raceclass: lock(loop_wall) eats nothing (ctor-only peer)
+    ("STA016", 28),   # rpcproto: ping envelope without a 'trace' key (ISSUE 20)
+    ("STA016", 32),   # rpcproto: status envelope without a 'trace' key
+    ("STA016", 37),   # rpcproto: guarded send still needs the trace key
 ]
 SUPPRESSED = [
     ("STA003", 60),  # sta: disable=STA003
@@ -165,7 +169,7 @@ def test_rule_table_is_stable():
     assert set(RULES) == {
         "STA001", "STA002", "STA003", "STA004", "STA005", "STA006", "STA007",
         "STA008", "STA009", "STA010", "STA011", "STA012", "STA013", "STA014",
-        "STA015",
+        "STA015", "STA016",
     }
     for rule, (severity, _) in RULES.items():
         assert severity in ("error", "warning"), rule

@@ -425,6 +425,51 @@ def test_sta014_lease_activation_edge_is_inside_the_gate(tmp_path):
                   "STA014") == []
 
 
+# ================================================================ STA016
+def test_sta016_serve_send_without_trace_fires(tmp_path):
+    f = active(run(tmp_path, {"serve/m.py": COVERAGE.format(
+        methods="    def bare(self):\n"
+                "        return self.t.request({'op': 'x'})\n"
+    )}), "STA016")
+    assert len(f) == 1
+    assert "'trace'" in f[0].message and "envelope" in f[0].message
+
+
+def test_sta016_trace_key_is_clean(tmp_path):
+    f = active(run(tmp_path, {"serve/m.py": COVERAGE.format(
+        methods="    def carried(self, tr):\n"
+                "        return self.t.request({'op': 'x', 'trace': tr})\n"
+    )}), "STA016")
+    assert f == []
+
+
+def test_sta016_dict_spread_gets_benefit_of_doubt(tmp_path):
+    # **base may well inject the trace — opaque spreads never fire
+    f = active(run(tmp_path, {"serve/m.py": COVERAGE.format(
+        methods="    def spread(self, base):\n"
+                "        return self.t.request({'op': 'x', **base})\n"
+    )}), "STA016")
+    assert f == []
+
+
+def test_sta016_control_plane_envelopes_are_exempt(tmp_path):
+    # resilience/ identity is DERIVED (derive_trace_id), never carried
+    f = active(run(tmp_path, {"resilience/m.py": COVERAGE.format(
+        methods="    def bare(self):\n"
+                "        return self.t.request({'op': 'arrive'})\n"
+    )}), "STA016")
+    assert f == []
+
+
+def test_sta016_suppression_honored(tmp_path):
+    findings = run(tmp_path, {"serve/m.py": COVERAGE.format(
+        methods="    def bare(self):\n"
+                "        return self.t.request(\n"
+                "            {'op': 'x'})  # sta: disable=STA016\n"
+    )})
+    assert active(findings, "STA016") == []
+
+
 # ================================================================ STA015
 def test_sta015_stale_disable_fires(tmp_path):
     f = active(run(tmp_path, {"m.py": "x = 1  # sta: disable=STA003\n"}),

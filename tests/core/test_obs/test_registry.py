@@ -162,3 +162,53 @@ def test_metric_classes_exported():
     assert Counter.kind == "counter"
     assert Gauge.kind == "gauge"
     assert Histogram.kind == "histogram"
+
+
+def test_cardinality_cap_folds_overflow_series():
+    """Past MAX_SERIES_PER_METRIC distinct label sets, NEW series fold
+    into one __overflow__ series — aggregate totals stay right, and the
+    registry (snapshot, textfile render) stops growing."""
+    from scaling_tpu.obs.registry import (
+        MAX_SERIES_PER_METRIC,
+        OVERFLOW_LABELS,
+    )
+
+    reg = MetricsRegistry()
+    n = MAX_SERIES_PER_METRIC + 25
+    for i in range(n):
+        reg.counter("leaky_total", labels={"req": i}).inc()
+    counters = reg.snapshot()["counters"]
+    series = [k for k in counters if k.startswith("leaky_total")]
+    assert len(series) == MAX_SERIES_PER_METRIC + 1
+    overflow_key = "leaky_total{__overflow__=true}"
+    assert overflow_key in counters
+    assert counters[overflow_key] == n - MAX_SERIES_PER_METRIC
+    # the overflow series is shared: a second novel label set lands in
+    # the SAME metric object
+    m1 = reg.counter("leaky_total", labels={"req": "novel-a"})
+    m2 = reg.counter("leaky_total", labels={"req": "novel-b"})
+    assert m1 is m2 and m1.labels == OVERFLOW_LABELS
+    # existing (pre-cap) series still resolve to their own objects
+    early = reg.counter("leaky_total", labels={"req": 0})
+    assert early.labels != OVERFLOW_LABELS
+    # other metric names are unaffected by leaky_total's overflow
+    other = reg.counter("fine_total", labels={"x": 1})
+    assert other.labels == (("x", "1"),)
+    # reset clears the guard state too
+    reg.reset()
+    fresh = reg.counter("leaky_total", labels={"req": "post-reset"})
+    assert fresh.labels != OVERFLOW_LABELS
+
+
+def test_cardinality_cap_ignores_unlabeled_metrics():
+    """Unlabeled metrics never fold: there is exactly one series per
+    name, which is the point of the cap."""
+    from scaling_tpu.obs.registry import MAX_SERIES_PER_METRIC
+
+    reg = MetricsRegistry()
+    for i in range(MAX_SERIES_PER_METRIC + 5):
+        reg.gauge("g", labels={"k": i}).set(float(i))
+    plain = reg.gauge("plain")
+    plain.set(1.0)
+    assert plain.labels == ()
+    assert reg.gauge("plain") is plain

@@ -94,3 +94,116 @@ def test_span_wait_for_drains_device_work(events):
         sp.wait_for(x)
     (rec,) = _read(events)
     assert rec["ok"] is True and rec["dur_s"] > 0
+
+
+# ------------------------------------------------- distributed tracing
+def test_trace_context_stamps_spans_and_events(events):
+    from scaling_tpu.logging import logger
+    from scaling_tpu.obs import new_trace_id, trace_context
+
+    reg = MetricsRegistry()
+    tid = new_trace_id()
+    with trace_context(tid):
+        with span("outer", registry=reg):
+            with span("inner", registry=reg):
+                pass
+        logger.log_event("loose-event", foo=1)
+    recs = _read(events)
+    by_span = {r.get("span"): r for r in recs if r.get("event") == "span"}
+    assert by_span["outer"]["trace"] == tid
+    assert by_span["inner"]["trace"] == tid
+    # parent linkage: the inner span's parent_span_id is the OUTER
+    # span's span_id, and the outer (root under this context) has none
+    assert by_span["inner"]["parent_span_id"] == by_span["outer"]["span_id"]
+    assert "parent_span_id" not in by_span["outer"]
+    # plain log_event records ride the same trace
+    loose = [r for r in recs if r.get("event") == "loose-event"]
+    assert loose and loose[0]["trace"] == tid
+
+
+def test_traceless_records_carry_no_trace_fields(events):
+    """Warmup hygiene's mechanism: without an active context, records
+    are byte-identical to pre-tracing ones — no ids minted at all."""
+    from scaling_tpu.logging import logger
+
+    reg = MetricsRegistry()
+    with span("plain", registry=reg):
+        pass
+    logger.log_event("plain-event", foo=1)
+    for rec in _read(events):
+        assert "trace" not in rec
+        assert "span_id" not in rec
+        assert "parent_span_id" not in rec
+
+
+def test_trace_adoption_links_remote_parent(events):
+    """An RPC worker adopting (trace_id, parent_span_id) from an
+    envelope: its first span becomes a child of the REMOTE caller's
+    span."""
+    from scaling_tpu.obs import trace_context
+
+    reg = MetricsRegistry()
+    with trace_context("cafe0123cafe0123", parent_span_id="deadbeef"):
+        with span("worker.op", registry=reg):
+            pass
+    (rec,) = _read(events)
+    assert rec["trace"] == "cafe0123cafe0123"
+    assert rec["parent_span_id"] == "deadbeef"
+
+
+def test_derive_trace_id_deterministic():
+    from scaling_tpu.obs import derive_trace_id
+
+    a = derive_trace_id("capacity-lease", "host0", 3)
+    b = derive_trace_id("capacity-lease", "host0", 3)
+    c = derive_trace_id("capacity-lease", "host0", 4)
+    assert a == b and a != c
+    assert len(a) == 16 and int(a, 16) >= 0
+
+
+def test_trace_context_is_thread_local(events):
+    """Concurrent traced threads never cross-link: each thread's spans
+    carry its OWN trace id and parent chain, and a thread spawned with
+    no context of its own stays untraced even while the spawner's
+    context is active."""
+    import threading
+
+    from scaling_tpu.obs import trace_context
+
+    reg = MetricsRegistry()
+    barrier = threading.Barrier(3)
+
+    def traced(tid):
+        with trace_context(tid):
+            with span(f"outer-{tid}", registry=reg):
+                barrier.wait(timeout=10)  # both threads inside spans
+                with span(f"inner-{tid}", registry=reg):
+                    pass
+
+    def untraced():
+        barrier.wait(timeout=10)
+        with span("orphan", registry=reg):
+            pass
+
+    threads = [
+        threading.Thread(target=traced, args=("a" * 16,)),
+        threading.Thread(target=traced, args=("b" * 16,)),
+        threading.Thread(target=untraced),
+    ]
+    with trace_context("c" * 16):  # spawner's own context must not leak
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+    recs = [r for r in _read(events) if r.get("event") == "span"]
+    by_span = {r["span"]: r for r in recs}
+    for tid in ("a" * 16, "b" * 16):
+        outer = by_span[f"outer-{tid}"]
+        inner = by_span[f"inner-{tid}"]
+        assert outer["trace"] == tid and inner["trace"] == tid
+        assert inner["parent_span_id"] == outer["span_id"]
+        assert "parent_span_id" not in outer
+    assert "trace" not in by_span["orphan"]
+    # span_ids unique across the traced threads
+    ids = [r["span_id"] for r in recs if "span_id" in r]
+    assert len(ids) == len(set(ids))

@@ -198,3 +198,115 @@ def test_autoscale_grows_and_shrinks_the_fleet(tmp_path):
     assert stats["replica_spawns"] == 1
     assert stats["replica_drains"] == 1
     assert stats["requests"] == 150
+
+
+# ---------------------------------------------- distributed tracing (ISSUE 20)
+def obs_trace(run_dir, *extra):
+    return subprocess.run(
+        [sys.executable, "-m", "scaling_tpu.obs", "trace", str(run_dir),
+         *extra],
+        cwd=REPO, env=_env(), capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_obs_trace_reconstructs_cross_host_failover_trace(chaos_pair):
+    """ISSUE 20 acceptance: the killed replica's in-flight request
+    reconstructs as ONE trace spanning both hosts — the dead replica's
+    spans and the survivor's re-dispatch spans share the trace id the
+    journal carried across the crash — with finite, ordered timestamps
+    after clock alignment."""
+    tmp, _, chaos, _ = chaos_pair
+    p = obs_trace(tmp / "chaos", "--slowest", "8", "--json",
+                  str(tmp / "chaos" / "trace.json"))
+    assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+    payload = json.loads((tmp / "chaos" / "trace.json").read_text())
+    assert payload["schema_version"] == 1
+    assert payload["traces"] == 8  # warmup stayed off the books
+    cross = {tid: t for tid, t in payload["per_trace"].items()
+             if set(t["hosts"]) >= {0, 1}}
+    assert cross, payload["per_trace"]  # at least one failover trace
+    for t in cross.values():
+        assert t["status"] == "completed"
+        for phase, v in t["phases"].items():
+            assert v >= 0.0 and v == v  # finite, non-negative
+        assert t["phases"]["e2e"] > 0.0
+    # the reassembled records really are ordered on the aligned clock
+    from scaling_tpu.obs.report import load_run_dir
+    from scaling_tpu.obs.trace import assemble_traces
+
+    traces = assemble_traces(load_run_dir(tmp / "chaos"))
+    for tid in cross:
+        starts = [r["_start"] for r in traces[tid]]
+        assert starts == sorted(starts)
+        assert all(s == s and abs(s) != float("inf") for s in starts)
+    # the renderer names the cross-host trace's hosts
+    assert "hosts=[0,1]" in p.stdout or "hosts=[1,0]" in p.stdout
+
+
+def test_obs_trace_coverage_gate_passes_healthy_fails_withheld(
+        chaos_pair, tmp_path):
+    """--assert-trace-coverage 0.95 passes over the real (healthy AND
+    chaos) run dirs; a run dir with its span records withheld — the
+    serve-request events survive, their work spans do not — FAILS with
+    exit 1. Missing data never passes by silence."""
+    tmp, _, _, _ = chaos_pair
+    for arm in ("clean", "chaos"):
+        p = obs_trace(tmp / arm, "--assert-trace-coverage", "0.95")
+        assert p.returncode == 0, arm + p.stdout[-2000:]
+        assert "PASS" in p.stdout
+    # withhold the span records (a producer that stopped stamping):
+    # serve-request completions survive, the work spans backing them
+    # do not — coverage collapses to 0
+    broken = tmp_path / "withheld"
+    broken.mkdir()
+    kept = []
+    for src in sorted((tmp / "clean").rglob("*.jsonl")):
+        for line in src.read_text().splitlines():
+            if line.strip() and '"span"' not in line:
+                kept.append(line)
+    assert any('"serve-request"' in line for line in kept)
+    (broken / "events.jsonl").write_text("\n".join(kept) + "\n")
+    p = obs_trace(broken, "--assert-trace-coverage", "0.95")
+    assert p.returncode == 1, p.stdout[-2000:]
+    assert "FAIL assert-trace-coverage" in p.stdout
+
+
+def test_obs_trace_coverage_gate_demands_completions(tmp_path):
+    """No completed serve-request events at all -> the coverage gate
+    fails outright (exit 1), mirroring every other gate's
+    missing-data-fails contract."""
+    (tmp_path / "events.jsonl").write_text(json.dumps(
+        {"event": "serve-shed", "ts": 1.0, "reason": "pressure"}) + "\n")
+    p = obs_trace(tmp_path, "--assert-trace-coverage", "0.5")
+    assert p.returncode == 1
+    assert "no completed serve-request" in p.stdout
+
+
+def test_obs_trace_critical_path_gate(chaos_pair):
+    """Sane per-phase ceilings pass; absurd ones fail with the
+    offending trace named."""
+    tmp, _, _, _ = chaos_pair
+    p = obs_trace(tmp / "chaos",
+                  "--assert-critical-path", "decode:300",
+                  "--assert-critical-path", "failover:300",
+                  "--assert-critical-path", "queue_wait:300")
+    assert p.returncode == 0, p.stdout[-2000:]
+    assert "PASS" in p.stdout
+    p = obs_trace(tmp / "chaos", "--assert-critical-path", "decode:1e-6")
+    assert p.returncode == 1
+    assert "FAIL assert-critical-path: decode" in p.stdout
+    # malformed / unknown phase specs fail loudly, not silently
+    p = obs_trace(tmp / "chaos", "--assert-critical-path", "warp:1.0")
+    assert p.returncode == 1
+    assert "unknown phase" in p.stdout
+
+
+def test_obs_report_one_line_trace_summary(chaos_pair):
+    """The report grows ONE trace line over traced run dirs (coverage +
+    top critical-path phase) and stays silent over untraced ones."""
+    tmp, _, _, _ = chaos_pair
+    p = obs_report(tmp / "chaos")
+    assert p.returncode == 0
+    (line,) = [l for l in p.stdout.splitlines()
+               if l.strip().startswith("traces:")]
+    assert "coverage" in line and "top critical-path phase" in line

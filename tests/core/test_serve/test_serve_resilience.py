@@ -261,3 +261,102 @@ def test_timeout_counter_rides_the_registry(toy_inference):
     e.run_until_done()
     after = obs.get_registry().counter("serve_requests_timeout_total").value
     assert after == before + 1
+
+
+# ------------------------------------------------------- distributed tracing
+def _events(path):
+    import json
+
+    return [json.loads(l) for l in path.read_text().splitlines()]
+
+
+def test_engine_adopts_ambient_trace_and_stamps_records(
+        toy_inference, tmp_path, monkeypatch):
+    """ISSUE 20 tentpole, engine side: a submit under an active
+    ``obs.trace_context`` stamps the admit span, the batch work spans
+    (via ``traces``/``chunk_traces`` lists) and the terminal
+    serve-request event with the originating trace id."""
+    from scaling_tpu.obs import trace_context
+
+    events = tmp_path / "events.jsonl"
+    monkeypatch.setenv("SCALING_TPU_EVENTS_PATH", str(events))
+    e = make_engine(toy_inference)
+    tid = "f00df00df00df00d"
+    with trace_context(tid):
+        e.submit(PROMPTS[0], 4)
+    e.submit(PROMPTS[1], 4)  # control: untraced sibling
+    e.run_until_done()
+    recs = _events(events)
+    sr = {r["req"]: r for r in recs if r.get("event") == "serve-request"}
+    assert sr[0]["trace"] == tid
+    assert "trace" not in sr[1]
+    admits = [r for r in recs if r.get("span") == "serve.admit"]
+    assert any(r.get("trace") == tid for r in admits)
+    work = [r for r in recs if r.get("span") in
+            ("serve.prefill", "serve.prefill_chunk", "serve.decode",
+             "serve.mixed")]
+    assert any(tid in (r.get("traces") or []) + (r.get("chunk_traces")
+                                                 or []) for r in work)
+    # the untraced sibling never appears in any membership list
+    all_ids = {t for r in recs
+               for t in (r.get("traces") or []) + (r.get("chunk_traces")
+                                                   or [])}
+    assert all_ids == {tid}
+
+
+def test_warmup_traffic_is_never_traced(toy_inference, tmp_path,
+                                        monkeypatch):
+    """ISSUE 20 satellite: warmup hygiene. Even under an active trace
+    context, warmup-mode traffic allocates no trace id and emits no
+    trace-stamped records — the coverage gate's denominator and the
+    committed goldens never see warmup."""
+    from scaling_tpu.obs import trace_context
+
+    events = tmp_path / "events.jsonl"
+    monkeypatch.setenv("SCALING_TPU_EVENTS_PATH", str(events))
+    e = make_engine(toy_inference)
+    e.warmup_mode = True
+    with trace_context("beefbeefbeefbeef"):
+        e.submit(PROMPTS[0], 3)
+    e.run_until_done()
+    assert all(s.request.trace_id is None for s in e.finished)
+    recs = _events(events) if events.exists() else []
+    for r in recs:
+        assert "trace" not in r and "traces" not in r \
+            and "chunk_traces" not in r, r
+    # and the analyzer sees nothing to reconstruct
+    from scaling_tpu.obs.report import load_run_dir
+    from scaling_tpu.obs.trace import analyze
+
+    payload = analyze(load_run_dir(tmp_path))
+    assert payload["traces"] == 0
+
+
+def test_journal_replay_preserves_trace_identity(toy_inference, tmp_path,
+                                                 monkeypatch):
+    """A crashed request's replayed submit re-adopts the journaled
+    trace id: the post-restart half of the timeline joins the same
+    trace instead of minting a fresh one."""
+    from scaling_tpu.obs import trace_context
+
+    events = tmp_path / "events.jsonl"
+    monkeypatch.setenv("SCALING_TPU_EVENTS_PATH", str(events))
+    jpath = tmp_path / "journal.jsonl"
+    tid = "abadcafeabadcafe"
+    e = make_engine(toy_inference)
+    e.attach_journal(RequestJournal(jpath))
+    with trace_context(tid):
+        e.submit(PROMPTS[0], 4)
+    # crash before any tick: replay from the journal into a fresh engine
+    replay = replay_journal(jpath)
+    (rec,) = replay.incomplete
+    assert rec["trace"] == tid
+    e2 = make_engine(toy_inference)
+    e2.submit(rec["prompt"], rec["max_new_tokens"], req_id=rec["req"],
+              trace=rec["trace"])
+    e2.run_until_done()
+    (s,) = e2.finished
+    assert s.request.trace_id == tid
+    sr = [r for r in _events(events)
+          if r.get("event") == "serve-request"]
+    assert sr and sr[-1]["trace"] == tid
